@@ -1,0 +1,82 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle (reference surveyed in SURVEY.md), built on jax/XLA/pallas/pjit.
+
+Top-level namespace mirrors ``import paddle``: tensor factories and ops live
+here, subpackages ``nn``, ``optimizer``, ``amp``, ``io``, ``jit``,
+``distributed``, ``static`` mirror paddle's.
+"""
+from __future__ import annotations
+
+from .core import state as _state
+from .core.tensor import Tensor, Parameter, to_tensor  # noqa: F401
+from .core.dtype import (  # noqa: F401
+    Place, TPUPlace, CPUPlace, set_default_dtype, get_default_dtype,
+    float64, float32, float16, bfloat16, int64, int32, int16, int8, uint8,
+    bool_, complex64, complex128,
+)
+from .core.autograd import no_grad, enable_grad, set_grad_enabled, grad  # noqa: F401
+from .core import autograd as _autograd_mod
+from .ops import *  # noqa: F401,F403
+from .ops import creation as _creation
+
+# framework-level helpers (paddle.* parity)
+from .core.state import seed, get_flags, set_flags  # noqa: F401
+
+from . import ops  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import autograd  # noqa: F401
+from . import framework  # noqa: F401
+from .framework import save, load  # noqa: F401
+from .jit import to_static  # noqa: F401
+
+import numpy as _np
+
+
+def is_grad_enabled():
+    return _state.is_grad_enabled()
+
+
+def in_dynamic_mode():
+    return True
+
+
+def device_count():
+    import jax
+    return len(jax.devices())
+
+
+def get_device():
+    import jax
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def set_device(device):
+    return Place(device)
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_tpu():
+    import jax
+    return jax.devices()[0].platform in ("tpu", "axon")
+
+
+def summary(layer, input_size=None, dtypes=None):
+    n_params = sum(p.size for p in layer.parameters())
+    trainable = sum(p.size for p in layer.parameters() if not p.stop_gradient)
+    print(f"Total params: {n_params}\nTrainable params: {trainable}")
+    return {"total_params": n_params, "trainable_params": trainable}
+
+
+__version__ = "0.1.0"

@@ -1,0 +1,107 @@
+"""paddle_tpu.autograd — user-facing autograd utilities.
+
+Analog of ``python/paddle/autograd/`` (reference): ``backward``, ``grad``,
+``no_grad``, and ``PyLayer`` custom-autograd (reference
+``python/paddle/autograd/py_layer.py``).
+"""
+from __future__ import annotations
+
+from ..core.autograd import grad, no_grad, enable_grad, set_grad_enabled  # noqa: F401
+from ..core.autograd import run_backward, Node
+from ..core.tensor import Tensor
+from ..core import state
+
+import jax
+import jax.numpy as jnp
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def is_grad_enabled():
+    return state.is_grad_enabled()
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op: subclass with static ``forward``/``backward``.
+
+    Analog of ``paddle.autograd.PyLayer`` (reference
+    ``python/paddle/autograd/py_layer.py``); wired into the tape as a Node
+    whose vjp calls the user's backward.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(out, (tuple, list))
+        outs = [out] if single else list(out)
+
+        grad_on = state.is_grad_enabled()
+        diff_inputs = [t for t in tensor_inputs if not t.stop_gradient]
+        if grad_on and diff_inputs:
+            def vjp_fn(cots):
+                if not isinstance(cots, tuple):
+                    cots = (cots,)
+                gts = [Tensor(c, stop_gradient=True) if c is not None else None
+                       for c in cots]
+                with no_grad():
+                    gin = cls.backward(ctx, *gts)
+                if not isinstance(gin, (tuple, list)):
+                    gin = (gin,)
+                res = []
+                gi = iter(gin)
+                for t in diff_inputs:
+                    g = next(gi, None)
+                    res.append(None if g is None else
+                               (g._read() if isinstance(g, Tensor) else jnp.asarray(g)))
+                return tuple(res)
+
+            node = Node(
+                cls.__name__, vjp_fn, inputs=diff_inputs,
+                out_ids=[id(o) for o in outs],
+                out_avals=[jax.ShapeDtypeStruct(tuple(o.shape), o.dtype)
+                           for o in outs],
+                seq_type=None if single else tuple)
+            for o in outs:
+                if jnp.issubdtype(o.dtype, jnp.inexact):
+                    o._node = node
+                    o._stop_gradient = False
+        return out
+
+
+class LegacyPyLayer(PyLayer):
+    pass

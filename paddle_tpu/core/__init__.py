@@ -1,0 +1,3 @@
+from . import state, dtype, autograd, dispatch, tensor  # noqa: F401
+from .tensor import Tensor, Parameter, to_tensor  # noqa: F401
+from .dtype import Place, TPUPlace, CPUPlace  # noqa: F401

@@ -1,0 +1,177 @@
+"""Op dispatch: every framework op funnels through `apply`.
+
+Capability analog of the PHI kernel dispatch + eager ad-function codegen
+(SURVEY C9/C15/C16; reference ``paddle/phi/core/kernel_factory.h:316``
+SelectKernelOrThrowError and the generated ``*_ad_func`` forward functions of
+``eager_gen.py``): unwrap tensors, run the XLA-lowered compute, and — when any
+differentiable input requires grad — record a jax.vjp node on the tape.
+
+There is no KernelKey{backend,layout,dtype} selection: XLA owns backend and
+layout; dtype promotion is jnp's. That whole reference subsystem collapses
+into this one file by design.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import state
+from .autograd import Node
+from .tensor import Tensor
+
+_TRACER_TYPES = (jax.core.Tracer,)
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
+
+
+def _flatten(args):
+    """Shallow-flatten args: Tensors may appear directly or inside one level
+    of list/tuple (concat/stack take tensor lists)."""
+    tensors = []
+    spec = []
+    for a in args:
+        if isinstance(a, Tensor):
+            spec.append(("t", len(tensors)))
+            tensors.append(a)
+        elif isinstance(a, (list, tuple)) and any(
+                isinstance(x, Tensor) for x in a):
+            inner = []
+            for x in a:
+                if isinstance(x, Tensor):
+                    inner.append(("t", len(tensors)))
+                    tensors.append(x)
+                else:
+                    inner.append(("c", x))
+            spec.append(("seq", type(a), inner))
+        else:
+            spec.append(("c", a))
+    return tensors, spec
+
+
+def _rebuild(spec, vals):
+    out = []
+    for s in spec:
+        if s[0] == "t":
+            out.append(vals[s[1]])
+        elif s[0] == "c":
+            out.append(s[1])
+        else:
+            _, typ, inner = s
+            seq = [vals[i[1]] if i[0] == "t" else i[1] for i in inner]
+            out.append(list(seq) if typ is list else tuple(seq))
+    return out
+
+
+def _check_nan_inf(name, vals):
+    for v in vals:
+        if isinstance(v, _TRACER_TYPES):
+            return
+        if jnp.issubdtype(v.dtype, jnp.inexact):
+            if not bool(jnp.all(jnp.isfinite(v))):
+                raise FloatingPointError(
+                    f"Operator '{name}' output contains NaN/Inf "
+                    f"(FLAGS check_nan_inf; reference analog "
+                    f"paddle/fluid/eager/nan_inf_utils.h)")
+
+
+def apply(name: str, fn: Callable, *args, **kwargs):
+    """Run op ``fn`` over (unwrapped) args; record grad node if needed.
+
+    Keyword args are static attributes; a Tensor passed as a kwarg is
+    unwrapped to its value (read through the jit tracker) but NOT
+    differentiated — ops must take differentiable operands positionally.
+    """
+    tensors, spec = _flatten(args)
+    vals = [t._read() for t in tensors]
+    if kwargs:
+        kwargs = {k: (v._read() if isinstance(v, Tensor) else v)
+                  for k, v in kwargs.items()}
+
+    grad_on = state.is_grad_enabled()
+    diff_idx = [i for i, t in enumerate(tensors)
+                if grad_on and not t.stop_gradient and _is_float(vals[i])]
+
+    if not diff_idx:
+        out_vals = fn(*_rebuild(spec, vals), **kwargs)
+        return _wrap_outputs(name, out_vals, node=None, any_grad=False)
+
+    def pure(*dvals):
+        merged = list(vals)
+        for i, dv in zip(diff_idx, dvals):
+            merged[i] = dv
+        return fn(*_rebuild(spec, merged), **kwargs)
+
+    out_vals, vjp_fn = jax.vjp(pure, *[vals[i] for i in diff_idx])
+    out, node_outs = _wrap_outputs(name, out_vals, node=..., any_grad=True)
+    node = Node(
+        name, vjp_fn,
+        inputs=[tensors[i] for i in diff_idx],
+        out_ids=[id(o) for o in node_outs],
+        out_avals=[jax.ShapeDtypeStruct(o._data.shape, o._data.dtype)
+                   for o in node_outs],
+        pure=pure,
+        seq_type=(tuple if isinstance(out_vals, tuple)
+                  else list if isinstance(out_vals, list) else None))
+    for o in node_outs:
+        o._node = node
+    return out
+
+
+def _wrap_outputs(name, out_vals, node, any_grad):
+    if state.get_flag("check_nan_inf"):
+        flat = out_vals if isinstance(out_vals, (tuple, list)) else [out_vals]
+        _check_nan_inf(name, [v for v in flat if hasattr(v, "dtype")])
+
+    def mk(v):
+        t = Tensor(v)
+        if any_grad and _is_float(v):
+            t._stop_gradient = False
+        return t
+
+    if isinstance(out_vals, (tuple, list)):
+        outs = [mk(v) for v in out_vals]
+        if node is None:
+            return (tuple(outs) if isinstance(out_vals, tuple) else outs)
+        return (tuple(outs) if isinstance(out_vals, tuple) else outs), outs
+    t = mk(out_vals)
+    if node is None:
+        return t
+    return t, [t]
+
+
+def primitive(name_or_fn=None, name: str | None = None):
+    """Decorator turning a pure jnp function into a framework op.
+
+    The decorated function's positional args may be Tensors (or lists of
+    Tensors); keyword args are static attributes (analog of op Attrs).
+    """
+    def deco(fn, opname=None):
+        opname = opname or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return apply(opname, fn, *args, **kwargs)
+
+        wrapper.raw = fn  # un-wrapped (jax-level) implementation
+        return wrapper
+
+    if callable(name_or_fn):
+        return deco(name_or_fn)
+    return lambda fn: deco(fn, name_or_fn or name)
+
+
+def unwrap(x):
+    """Tensor|array|scalar -> jax value."""
+    if isinstance(x, Tensor):
+        return x._read()
+    return x
+
+
+def wrap(v, stop_gradient=True) -> Tensor:
+    return Tensor(v, stop_gradient=stop_gradient)

@@ -1,0 +1,109 @@
+"""Global framework state: default dtype, grad mode, RNG, flags.
+
+Capability analog of the reference flags/env system (SURVEY C1,
+``paddle/common/flags.cc``) and the global tracer state
+(``paddle/fluid/imperative/tracer.h``).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+DEFAULT_DTYPE = np.dtype("float32")
+
+# --- flags registry (analog of PHI_DEFINE_EXPORTED_*; env override via
+# PDTPU_<name>, mirroring FLAGS_<name> env behavior in flags_native.cc) ---
+_FLAGS: dict[str, object] = {}
+_FLAG_DEFS: dict[str, tuple[type, object, str]] = {}
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    ftype = type(default)
+    env = os.environ.get("PDTPU_" + name.upper())
+    val = default
+    if env is not None:
+        if ftype is bool:
+            val = env.lower() in ("1", "true", "yes")
+        else:
+            val = ftype(env)
+    _FLAG_DEFS[name] = (ftype, default, help_str)
+    _FLAGS[name] = val
+    return val
+
+
+def get_flags(names=None):
+    if names is None:
+        return dict(_FLAGS)
+    if isinstance(names, str):
+        names = [names]
+    return {n: _FLAGS[n] for n in names}
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        if k not in _FLAG_DEFS:
+            raise KeyError(f"unknown flag {k!r}")
+        _FLAGS[k] = _FLAG_DEFS[k][0](v)
+
+
+def get_flag(name: str):
+    return _FLAGS[name]
+
+
+# Core flags (subset of the 138 reference flags that are meaningful on TPU).
+define_flag("check_nan_inf", False, "scan op outputs for nan/inf (numeric sanitizer)")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; 3: only log stats")
+define_flag("benchmark", False, "sync + time every op")
+define_flag("eager_delete_tensor_gb", 0.0, "GC threshold (no-op under XLA; kept for parity)")
+define_flag("use_stride_kernel", True, "allow view/stride ops to alias (jax always copies-on-write)")
+define_flag("log_level", 0, "framework VLOG level")
+
+
+class _GradMode(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_grad_mode = _GradMode()
+
+
+def is_grad_enabled() -> bool:
+    return _grad_mode.enabled
+
+
+def set_grad_enabled(enabled: bool) -> bool:
+    old = _grad_mode.enabled
+    _grad_mode.enabled = enabled
+    return old
+
+
+# --- global RNG (paddle.seed analog). Functional JAX PRNG under the hood:
+# a mutable key that is split on every draw. ---
+class _RNG:
+    def __init__(self):
+        self._key = None
+        self._seed = 0
+
+    def seed(self, s: int):
+        import jax
+
+        self._seed = int(s)
+        self._key = jax.random.PRNGKey(self._seed)
+
+    def next_key(self):
+        import jax
+
+        if self._key is None:
+            self.seed(0)
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+default_rng = _RNG()
+
+
+def seed(s: int):
+    default_rng.seed(s)
+    return default_rng

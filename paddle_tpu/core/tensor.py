@@ -1,0 +1,293 @@
+"""Eager Tensor façade over jax.Array.
+
+Capability analog of ``paddle::Tensor`` + ``phi::DenseTensor`` +
+``egr::AutogradMeta`` (SURVEY C8/C16; reference
+``paddle/phi/api/include/tensor.h:82``, ``paddle/phi/core/dense_tensor.h:37``,
+``paddle/fluid/eager/autograd_meta.h:61``). The device buffer is a jax.Array
+(HBM-resident, managed by PJRT — the allocator story of SURVEY C7 is XLA's);
+autograd metadata (stop_gradient, grad, producing Node) lives here.
+
+Tensor math methods are installed by ``paddle_tpu.ops`` (the analog of the
+generated pybind method table, ``paddle/fluid/pybind/eager_method.cc``).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import state
+from .dtype import Place, convert_dtype
+
+
+# Active capture tracker (set by paddle_tpu.jit); sees every read/write of
+# concrete tensors so whole train steps can be lifted into one XLA program.
+_tracker = None
+
+
+def set_tracker(tr):
+    global _tracker
+    old = _tracker
+    _tracker = tr
+    return old
+
+
+class Tensor:
+    __slots__ = ("_data", "_stop_gradient", "_grad", "_node", "_hooks",
+                 "_retain_grad", "name", "__weakref__")
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True,
+                 name=None):
+        if isinstance(data, Tensor):
+            data = data._read()
+        dtype = convert_dtype(dtype)
+        if not isinstance(data, jax.Array) and not isinstance(
+                data, jax.core.Tracer):
+            if dtype is None and isinstance(data, (float, list)) :
+                arr = np.asarray(data)
+                if arr.dtype == np.float64:
+                    dtype = state.DEFAULT_DTYPE
+            data = jnp.asarray(data, dtype=dtype)
+            if place is not None:
+                data = jax.device_put(data, Place(place).device)
+        elif dtype is not None and data.dtype != dtype:
+            data = data.astype(dtype)
+        self._data = data
+        self._stop_gradient = bool(stop_gradient)
+        self._grad: Optional[Tensor] = None
+        self._node = None
+        self._hooks: list = []
+        self._retain_grad = False
+        self.name = name
+
+    # --- raw data access (all ops funnel through here; the jit capture
+    # tracker hooks these, cf. SOT's eval-frame interception, SURVEY L9) ---
+    def _read(self):
+        if _tracker is not None:
+            return _tracker.on_read(self)
+        return self._data
+
+    def _write(self, val):
+        if _tracker is not None:
+            _tracker.on_write(self, val)
+            return
+        self._data = val
+
+    def _adopt(self, other: "Tensor"):
+        """In-place semantics: this tensor takes over ``other``'s value and
+        grad history (used by ``__setitem__`` / ``add_`` style ops).
+
+        If ``other``'s producing node consumed ``self`` (x.add_(y) pattern),
+        the pre-mutation identity is moved onto a ghost tensor so the tape
+        doesn't see a self-loop (the reference handles this with inplace
+        version counters, ``paddle/fluid/eager/utils.h`` CheckInplace)."""
+        new_node = other._node
+        if new_node is not None and any(t is self for t in new_node.inputs):
+            ghost = Tensor.__new__(Tensor)
+            ghost._data = self._data
+            ghost._stop_gradient = self._stop_gradient
+            ghost._grad = None
+            ghost._node = self._node
+            ghost._hooks = []
+            ghost._retain_grad = False
+            ghost.name = None
+            if self._node is not None:
+                try:
+                    i = self._node.out_ids.index(id(self))
+                    self._node.out_ids[i] = id(ghost)
+                except ValueError:
+                    pass
+            new_node.inputs = [ghost if t is self else t
+                               for t in new_node.inputs]
+        self._write(other._data if _tracker is None else other._read())
+        self._node = new_node
+        if new_node is not None:
+            try:
+                idx = new_node.out_ids.index(id(other))
+                new_node.out_ids[idx] = id(self)
+            except ValueError:
+                pass
+        self._stop_gradient = other._stop_gradient
+
+    # --- properties -----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        try:
+            devs = getattr(self._data, "devices", None)
+            if devs is not None:
+                return Place(next(iter(devs())))
+        except Exception:
+            pass
+        return Place()
+
+    @property
+    def stop_gradient(self):
+        return self._stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._stop_gradient = bool(v)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        if g is not None and not isinstance(g, Tensor):
+            g = Tensor(g)
+        self._grad = g
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    @property
+    def T(self):
+        from .. import ops
+        return ops.transpose_last2(self)
+
+    @property
+    def mT(self):
+        from .. import ops
+        return ops.transpose_last2(self)
+
+    # --- autograd -------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .autograd import run_backward
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._grad._data))
+        else:
+            self._grad = None
+
+    def _accumulate_grad(self, g):
+        if self._grad is None:
+            self._grad = Tensor(g, stop_gradient=True)
+        else:
+            self._grad = Tensor(self._grad._data + g, stop_gradient=True)
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Removable:
+            def remove(self_inner):
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Removable()
+
+    def retain_grads(self):
+        self._retain_grad = True
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._read(), stop_gradient=True)
+
+    def detach_(self) -> "Tensor":
+        self._node = None
+        self._stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from .. import ops
+        return ops.assign(self)
+
+    # --- host interop ---------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._read())
+
+    def item(self):
+        return self._read().item()
+
+    def tolist(self):
+        return np.asarray(self._read()).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._read())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, *a, **k):
+        return self._read().__dlpack__(*a, **k)
+
+    # --- python protocol ------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __bool__(self):
+        return bool(self._read())
+
+    def __float__(self):
+        return float(self._read())
+
+    def __int__(self):
+        return int(self._read())
+
+    def __index__(self):
+        return int(self._read())
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        sg = self._stop_gradient
+        try:
+            body = repr(np.asarray(self._data))
+            body = body[body.index("(") + 1: body.rindex(")")] if "(" in body else body
+        except Exception:
+            body = f"<traced {self._data}>"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                f"stop_gradient={sg},\n       {body})")
+
+    # numpy precedence
+    __array_priority__ = 100
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """``paddle.to_tensor`` analog (reference
+    ``python/paddle/tensor/creation.py``)."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+class Parameter(Tensor):
+    """Trainable tensor. Analog of ``paddle.base.framework.Parameter`` /
+    ``EagerParamBase`` (reference ``python/paddle/base/framework.py``)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False

@@ -1,0 +1,67 @@
+"""paddle_tpu.framework — save/load and framework-level helpers.
+
+Analog of ``python/paddle/framework/io.py`` (reference ``io.py:721`` save,
+``:960`` load): pickle-based nested state dicts with tensors converted to
+numpy on save and restored as device tensors on load.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter
+
+
+_SENTINEL = "__pdtpu_tensor__"
+
+
+def _to_host(obj):
+    if isinstance(obj, Tensor):
+        return {_SENTINEL: True, "data": np.asarray(obj._read()),
+                "stop_gradient": obj.stop_gradient,
+                "is_param": isinstance(obj, Parameter)}
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_host(v) for v in obj)
+    return obj
+
+
+def _to_device(obj):
+    if isinstance(obj, dict):
+        if obj.get(_SENTINEL):
+            if obj.get("is_param"):
+                return Parameter(jnp.asarray(obj["data"]),
+                                 trainable=not obj["stop_gradient"])
+            t = Tensor(jnp.asarray(obj["data"]))
+            t.stop_gradient = obj["stop_gradient"]
+            return t
+        return {k: _to_device(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_device(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_host(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if return_numpy:
+        return obj
+    return _to_device(obj)
+
+
+def set_grad_enabled(mode):
+    from ..core.autograd import set_grad_enabled as _sge
+    return _sge(mode)

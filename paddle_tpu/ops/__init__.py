@@ -1,0 +1,214 @@
+"""paddle_tpu.ops — the op library.
+
+Analog of the reference's PHI op surface (SURVEY C11/C15,
+``paddle/phi/api/yaml/ops.yaml`` 297 ops) exposed with paddle's python names
+(``python/paddle/tensor/``). Also installs Tensor methods/operators — the
+analog of the generated pybind method table
+(``paddle/fluid/pybind/eager_method.cc``).
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor, Parameter
+from ..core.dispatch import apply, primitive, unwrap
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+
+from . import creation, math, manipulation, logic, linalg, random  # noqa: F401
+
+
+# ---- indexing ------------------------------------------------------------
+
+def _getitem(x: Tensor, idx):
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    tensor_slots = []
+    spec = []
+    for it in idx:
+        if isinstance(it, Tensor):
+            if it.dtype == np.dtype(bool):
+                # boolean mask: dynamic shape — concretize (eager only)
+                spec.append(("c", np.asarray(it._read())))
+            else:
+                spec.append(("t", len(tensor_slots)))
+                tensor_slots.append(it)
+        elif isinstance(it, (list, np.ndarray)) and not isinstance(it, str):
+            spec.append(("c", np.asarray(it)))
+        else:
+            spec.append(("c", it))
+
+    def fn(v, *ts):
+        items = tuple(ts[s[1]] if s[0] == "t" else s[1] for s in spec)
+        return v[items]
+
+    return apply("getitem", fn, x, *tensor_slots)
+
+
+def _setitem(x: Tensor, idx, value):
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    tensor_slots = []
+    spec = []
+    for it in idx:
+        if isinstance(it, Tensor):
+            if it.dtype == np.dtype(bool):
+                spec.append(("c", np.asarray(it._read())))
+            else:
+                spec.append(("t", len(tensor_slots)))
+                tensor_slots.append(it)
+        elif isinstance(it, (list, np.ndarray)) and not isinstance(it, str):
+            spec.append(("c", np.asarray(it)))
+        else:
+            spec.append(("c", it))
+    val_is_tensor = isinstance(value, Tensor)
+
+    def fn(v, *ts):
+        items = tuple(ts[s[1]] if s[0] == "t" else s[1] for s in spec)
+        val = ts[-1] if val_is_tensor else jnp.asarray(value, v.dtype)
+        return v.at[items].set(val.astype(v.dtype) if hasattr(val, "astype") else val)
+
+    args = tensor_slots + ([value] if val_is_tensor else [])
+    out = apply("setitem", fn, x, *args)
+    x._adopt(out)
+    return x
+
+
+# ---- in-place variants (adopt result; analog of paddle *_ ops) ----------
+
+def _make_inplace(op):
+    def method(self, *a, **k):
+        out = op(self, *a, **k)
+        self._adopt(out)
+        return self
+    return method
+
+
+# ---- install Tensor methods ---------------------------------------------
+
+def _swap(fn):
+    return lambda self, other: fn(to_tensor(other) if not isinstance(other, Tensor) else other, self)
+
+
+_METHODS = {
+    # math
+    "add": add, "subtract": subtract, "multiply": multiply, "divide": divide,
+    "floor_divide": floor_divide, "mod": mod, "remainder": mod, "pow": pow,
+    "matmul": matmul, "sqrt": sqrt, "rsqrt": rsqrt, "exp": exp, "expm1": expm1,
+    "log": log, "log2": log2, "log10": log10, "log1p": log1p, "abs": abs,
+    "neg": neg, "sign": sign, "floor": floor, "ceil": ceil, "round": round,
+    "trunc": trunc, "frac": frac, "sin": sin, "cos": cos, "tan": tan,
+    "asin": asin, "acos": acos, "atan": atan, "sinh": sinh, "cosh": cosh,
+    "tanh": tanh, "asinh": asinh, "acosh": acosh, "atanh": atanh, "erf": erf,
+    "erfinv": erfinv, "reciprocal": reciprocal, "square": square,
+    "maximum": maximum, "minimum": minimum, "fmax": fmax, "fmin": fmin,
+    "clip": clip, "lerp": lerp, "scale": scale, "atan2": atan2,
+    "logsumexp": logsumexp, "logaddexp": logaddexp, "nan_to_num": nan_to_num,
+    "cumsum": cumsum, "cumprod": cumprod, "digamma": digamma, "lgamma": lgamma,
+    "hypot": hypot, "heaviside": heaviside, "angle": angle, "conj": conj,
+    "trace": trace, "diagonal": diagonal, "kron": kron, "inner": inner,
+    "outer": outer, "addmm": addmm,
+    # reductions
+    "sum": sum, "mean": mean, "max": max, "min": min, "prod": prod,
+    "amax": amax, "amin": amin, "std": std, "var": var, "median": median,
+    "nanmean": nanmean, "nansum": nansum, "quantile": quantile,
+    "argmax": argmax, "argmin": argmin, "count_nonzero": count_nonzero,
+    "all": all, "any": any, "norm": norm,
+    # logic
+    "equal": equal, "not_equal": not_equal, "greater_than": greater_than,
+    "greater_equal": greater_equal, "less_than": less_than,
+    "less_equal": less_equal, "equal_all": equal_all,
+    "logical_and": logical_and, "logical_or": logical_or,
+    "logical_xor": logical_xor, "logical_not": logical_not,
+    "bitwise_and": bitwise_and, "bitwise_or": bitwise_or,
+    "bitwise_xor": bitwise_xor, "bitwise_not": bitwise_not,
+    "isnan": isnan, "isinf": isinf, "isfinite": isfinite, "isclose": isclose,
+    "allclose": allclose,
+    # manipulation
+    "reshape": reshape, "reshape_": reshape_, "transpose": transpose,
+    "flatten": flatten, "squeeze": squeeze, "unsqueeze": unsqueeze,
+    "unsqueeze_": unsqueeze_, "split": split, "chunk": chunk, "tile": tile,
+    "expand": expand, "expand_as": expand_as, "broadcast_to": broadcast_to,
+    "flip": flip, "roll": roll, "gather": gather, "gather_nd": gather_nd,
+    "scatter": scatter, "scatter_nd_add": scatter_nd_add,
+    "index_select": index_select, "index_sample": index_sample,
+    "index_add": index_add, "masked_select": masked_select,
+    "masked_fill": masked_fill, "where": where,
+    "take_along_axis": take_along_axis, "put_along_axis": put_along_axis,
+    "repeat_interleave": repeat_interleave, "unbind": unbind,
+    "cast": cast, "astype": astype, "topk": topk, "sort": sort,
+    "argsort": argsort, "nonzero": nonzero, "unique": unique,
+    "tril": tril, "triu": triu, "diag": diag, "moveaxis": moveaxis,
+    "swapaxes": swapaxes, "unstack": unstack, "bincount": bincount,
+    "histogram": histogram, "searchsorted": searchsorted,
+    "kthvalue": kthvalue, "mode": mode, "view": view,
+    "as_strided": as_strided, "masked_scatter": masked_scatter,
+    "index_put": index_put, "strided_slice": strided_slice,
+    "slice": slice, "pad": pad, "flatten_": _make_inplace(flatten),
+    # linalg
+    "dot": dot, "mm": mm, "bmm": bmm, "mv": mv, "t": t, "cross": cross,
+    "cholesky": cholesky, "inverse": inverse, "pinv": pinv, "solve": solve,
+    "det": det, "slogdet": slogdet, "matrix_power": matrix_power,
+    "qr": qr, "svd": svd, "eigh": eigh, "eig": eig, "lu": lu,
+    "cholesky_solve": cholesky_solve, "triangular_solve": triangular_solve,
+    "tensordot": tensordot,
+    # creation-ish
+    "zeros_like": zeros_like, "ones_like": ones_like, "full_like": full_like,
+    "clone": creation.clone, "numel": numel, "real": real, "imag": imag,
+    # random in-place
+    "exponential_": random.exponential_, "normal_": random.normal_,
+    "uniform_": random.uniform_,
+}
+
+_INPLACE_BASE = ["add", "subtract", "multiply", "divide", "clip", "scale",
+                 "floor", "ceil", "exp", "sqrt", "rsqrt", "reciprocal",
+                 "round", "abs", "cast", "tanh", "squeeze"]
+
+
+def _install():
+    for name, fn in _METHODS.items():
+        setattr(Tensor, name, fn)
+    for name in _INPLACE_BASE:
+        setattr(Tensor, name + "_", _make_inplace(_METHODS[name]))
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+    Tensor.__add__ = add
+    Tensor.__radd__ = _swap(add)
+    Tensor.__sub__ = subtract
+    Tensor.__rsub__ = _swap(subtract)
+    Tensor.__mul__ = multiply
+    Tensor.__rmul__ = _swap(multiply)
+    Tensor.__truediv__ = divide
+    Tensor.__rtruediv__ = _swap(divide)
+    Tensor.__floordiv__ = floor_divide
+    Tensor.__rfloordiv__ = _swap(floor_divide)
+    Tensor.__mod__ = mod
+    Tensor.__rmod__ = _swap(mod)
+    Tensor.__pow__ = pow
+    Tensor.__rpow__ = _swap(pow)
+    Tensor.__matmul__ = matmul
+    Tensor.__rmatmul__ = _swap(matmul)
+    Tensor.__neg__ = neg
+    Tensor.__abs__ = abs
+    Tensor.__invert__ = bitwise_not
+    Tensor.__eq__ = equal
+    Tensor.__ne__ = not_equal
+    Tensor.__lt__ = less_than
+    Tensor.__le__ = less_equal
+    Tensor.__gt__ = greater_than
+    Tensor.__ge__ = greater_equal
+    Tensor.__and__ = bitwise_and
+    Tensor.__or__ = bitwise_or
+    Tensor.__xor__ = bitwise_xor
+
+
+_install()

@@ -1,0 +1,209 @@
+"""Tensor creation ops. Analog of ``python/paddle/tensor/creation.py``
+(reference) over jnp; kernels are XLA's (SURVEY C11 creation kernels)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import state
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, to_tensor  # re-export
+from ..core.dispatch import primitive, unwrap
+
+
+def _dt(dtype):
+    d = convert_dtype(dtype)
+    return state.DEFAULT_DTYPE if d is None else d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._read()))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) if not isinstance(s, int) else s for s in shape)
+
+
+def zeros(shape, dtype=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None):
+    fill_value = unwrap(fill_value)
+    if dtype is None and isinstance(fill_value, (bool, int)):
+        dtype = "bool" if isinstance(fill_value, bool) else "int64"
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+@primitive
+def _zeros_like(x, dtype):
+    return jnp.zeros(x.shape, dtype or x.dtype)
+
+
+def zeros_like(x, dtype=None):
+    return _zeros_like(x, dtype=convert_dtype(dtype))
+
+
+def ones_like(x, dtype=None):
+    x = x._read() if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.ones(x.shape, convert_dtype(dtype) or x.dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    x = x._read() if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.full(x.shape, unwrap(fill_value),
+                           convert_dtype(dtype) or x.dtype))
+
+
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            dtype = state.DEFAULT_DTYPE
+        else:
+            dtype = np.dtype("int64")
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None):
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+@primitive
+def diag(x, offset=0, padding_value=0):
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x, k=offset)
+        mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+        return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+    return jnp.diag(x, k=offset)
+
+
+@primitive
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@primitive
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    return jnp.vectorize(jnp.diag, signature="(n)->(n,n)")(x) if (
+        offset == 0 and dim1 == -2 and dim2 == -1) else _diag_embed_general(
+            x, offset, dim1, dim2)
+
+
+def _diag_embed_general(x, offset, dim1, dim2):
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = out.at[..., r, c].set(x)
+    src = list(range(out.ndim))
+    d1 = dim1 % out.ndim
+    d2 = dim2 % out.ndim
+    if (d1, d2) != (out.ndim - 2, out.ndim - 1):
+        perm = [d for d in src if d not in (out.ndim - 2, out.ndim - 1)]
+        perm.insert(d1, out.ndim - 2)
+        perm.insert(d2, out.ndim - 1)
+        out = jnp.transpose(out, perm)
+    return out
+
+
+@primitive
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@primitive
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril_indices(row, col, offset=0):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]))
+
+
+def triu_indices(row, col=None, offset=0):
+    r, c = jnp.triu_indices(row, k=offset, m=col or row)
+    return Tensor(jnp.stack([r, c]))
+
+
+def meshgrid(*args):
+    args = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = jnp.meshgrid(*[unwrap(a) for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+@primitive
+def assign(x):
+    return jnp.asarray(x)
+
+
+def clone(x):
+    return assign(x)
+
+
+def one_hot(x, num_classes):
+    x = unwrap(x)
+    return Tensor(jax.nn.one_hot(x, num_classes, dtype=state.DEFAULT_DTYPE))
+
+
+@primitive
+def complex(real, imag):
+    return jax.lax.complex(real, imag)
+
+
+@primitive
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@primitive
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@primitive
+def real(x):
+    return jnp.real(x)
+
+
+@primitive
+def imag(x):
+    return jnp.imag(x)
+
+
+@primitive
+def polar(abs, angle):
+    return jax.lax.complex(abs * jnp.cos(angle), abs * jnp.sin(angle))
+
+
+def numel(x):
+    x = unwrap(x)
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1,
+                              dtype=jnp.int64))

@@ -1,0 +1,197 @@
+"""Linear algebra ops. Analog of ``python/paddle/tensor/linalg.py``
+(reference ``linalg.py:176`` matmul) — matmuls stay large/batched so XLA can
+tile them onto the MXU; bf16-friendly by default."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive, unwrap, apply
+from ..core.tensor import Tensor
+
+
+@primitive
+def _matmul(x, y, transpose_x, transpose_y):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+
+
+def mm(x, y):
+    return matmul(x, y)
+
+
+def bmm(x, y):
+    return matmul(x, y)
+
+
+@primitive
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@primitive
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+def einsum(equation, *operands):
+    return apply("einsum", lambda *ops: jnp.einsum(equation, *ops), *operands)
+
+
+@primitive
+def _p_norm(x, p, axis, keepdim):
+    if p == np.inf:
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == -np.inf:
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum(jnp.asarray(x != 0, x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+@primitive
+def _fro_norm(x, axis, keepdim):
+    return jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=keepdim))
+
+
+def norm(x, p=None, axis=None, keepdim=False):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    if p is None or p == "fro":
+        return _fro_norm(x, axis=axis, keepdim=keepdim)
+    return _p_norm(x, p=float(p), axis=axis, keepdim=keepdim)
+
+
+@primitive
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+@primitive
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+@primitive
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@primitive
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+inv = inverse
+
+
+@primitive
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@primitive
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@primitive
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@primitive
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@primitive
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+@primitive
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+@primitive
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eig(x):
+    # general eig has no XLA lowering on TPU: host fallback (eager only)
+    arr = np.asarray(unwrap(x))
+    w, v = np.linalg.eig(arr)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+@primitive
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@primitive
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@primitive
+def slogdet(x):
+    s, ld = jnp.linalg.slogdet(x)
+    return jnp.stack([s, ld])
+
+
+@primitive
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@primitive
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@primitive
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+@primitive
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@primitive
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@primitive
+def bincount_weighted(x, w):
+    return jnp.bincount(x, weights=w)
+
+
+@primitive
+def lu(x, pivot=True):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+
+
+@primitive
+def householder_product(x, tau):
+    return jax.lax.linalg.householder_product(x, tau)

@@ -1,0 +1,122 @@
+"""Comparison & logical ops. Analog of ``python/paddle/tensor/logic.py``
+(reference)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive, unwrap
+from ..core.tensor import Tensor
+
+
+@primitive
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+@primitive
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+@primitive
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+@primitive
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+@primitive
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+@primitive
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+def equal_all(x, y):
+    return Tensor(jnp.array_equal(unwrap(x), unwrap(y)))
+
+
+@primitive
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+@primitive
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+@primitive
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+@primitive
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@primitive
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+@primitive
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+@primitive
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+@primitive
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@primitive
+def bitwise_left_shift(x, y):
+    return jnp.left_shift(x, y)
+
+
+@primitive
+def bitwise_right_shift(x, y):
+    return jnp.right_shift(x, y)
+
+
+@primitive
+def _all(x, axis, keepdim):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    return _all(x, axis=axis, keepdim=keepdim)
+
+
+@primitive
+def _any(x, axis, keepdim):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    return _any(x, axis=axis, keepdim=keepdim)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x):
+    return Tensor(jnp.asarray(x.size == 0))

@@ -1,0 +1,607 @@
+"""Shape/layout/indexing ops. Analog of
+``python/paddle/tensor/manipulation.py`` (reference). XLA makes most of these
+free (layout/copy elision), unlike the reference's stride-kernel machinery
+(SURVEY C8 strides)."""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive, unwrap, apply
+from ..core.tensor import Tensor
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._read()))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) for s in shape)
+
+
+@primitive
+def _reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape):
+    return _reshape(x, shape=_norm_shape(shape))
+
+
+def reshape_(x, shape):
+    out = reshape(x, shape)
+    x._adopt(out)
+    return x
+
+
+def view(x, shape_or_dtype):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return apply("view_dtype", lambda v: v.view(shape_or_dtype), x)
+
+
+@primitive
+def _transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm=None):
+    if perm is not None:
+        perm = tuple(int(p) for p in perm)
+    return _transpose(x, perm=perm)
+
+
+def transpose_last2(x):
+    nd = x.ndim
+    if nd < 2:
+        return transpose(x)
+    perm = tuple(range(nd - 2)) + (nd - 1, nd - 2)
+    return transpose(x, perm)
+
+
+def t(x):
+    if x.ndim <= 1:
+        return x
+    return transpose(x, (1, 0))
+
+
+@primitive
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@primitive
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+@primitive
+def _flatten(x, start_axis, stop_axis):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape(1)
+    start = start_axis % nd
+    stop = stop_axis % nd
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return x.reshape(shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    return _flatten(x, start_axis=start_axis, stop_axis=stop_axis)
+
+
+@primitive
+def _squeeze(x, axis):
+    if axis is None:
+        return jnp.squeeze(x)
+    axis = [axis] if isinstance(axis, int) else list(axis)
+    axis = tuple(a % x.ndim for a in axis if x.shape[a % x.ndim] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+def squeeze(x, axis=None):
+    return _squeeze(x, axis=axis)
+
+
+@primitive
+def _unsqueeze(x, axis):
+    axis = [axis] if isinstance(axis, int) else list(axis)
+    out = x
+    nd = x.ndim + len(axis)
+    for a in sorted(a % nd for a in axis):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+def unsqueeze(x, axis):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    return _unsqueeze(x, axis=axis)
+
+
+def unsqueeze_(x, axis):
+    out = unsqueeze(x, axis)
+    x._adopt(out)
+    return x
+
+
+@primitive
+def _concat(xs, axis):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _concat(list(x), axis=axis)
+
+
+@primitive
+def _stack(xs, axis):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0):
+    return _stack(list(x), axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    axis = int(unwrap(axis))
+    dim = x.shape[axis % x.ndim]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: axis dim {dim} is not divisible by "
+                f"num_or_sections={num_or_sections}")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(unwrap(s)) for s in num_or_sections]
+        if any(s == -1 for s in sizes):
+            rest = dim - builtins.sum(s for s in sizes if s != -1)
+            sizes = [rest if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def fn(v):
+        return tuple(
+            jax.lax.slice_in_dim(v, o, o + s, axis=axis % v.ndim)
+            for o, s in zip(offsets, sizes))
+
+    return list(apply("split", fn, x))
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0):
+    axis = axis % x.ndim
+    n = x.shape[axis]
+
+    def fn(v):
+        return tuple(jnp.squeeze(jax.lax.slice_in_dim(v, i, i + 1, axis=axis),
+                                 axis=axis) for i in range(n))
+
+    return list(apply("unbind", fn, x))
+
+
+def unstack(x, axis=0, num=None):
+    return unbind(x, axis)
+
+
+@primitive
+def _tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def tile(x, repeat_times):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    return _tile(x, repeat_times=tuple(int(unwrap(r)) for r in repeat_times))
+
+
+@primitive
+def _broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def broadcast_to(x, shape):
+    return _broadcast_to(x, shape=_norm_shape(shape))
+
+
+def expand(x, shape):
+    shape = _norm_shape(shape)
+    # paddle expand: -1 keeps original dim
+    xs = list(x.shape)
+    full = []
+    pad = len(shape) - len(xs)
+    for i, s in enumerate(shape):
+        if s == -1:
+            full.append(xs[i - pad] if i >= pad else 1)
+        else:
+            full.append(s)
+    return broadcast_to(x, full)
+
+
+def expand_as(x, y):
+    return broadcast_to(x, y.shape)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def broadcast_tensors(inputs):
+    shapes = [tuple(t.shape) for t in inputs]
+    out_shape = np.broadcast_shapes(*shapes)
+    return [broadcast_to(t, out_shape) for t in inputs]
+
+
+@primitive
+def _flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def flip(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    return _flip(x, axis=tuple(axis))
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return apply("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x)
+
+
+@primitive
+def _roll(x, shifts, axis):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def roll(x, shifts, axis=None):
+    if isinstance(shifts, Tensor):
+        shifts = shifts.tolist()
+    return _roll(x, shifts=shifts, axis=axis)
+
+
+@primitive
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def astype(x, dtype):
+    from ..core.dtype import convert_dtype
+    return cast(x, dtype=convert_dtype(dtype))
+
+
+@primitive
+def _pad_nd(x, pad, mode, value):
+    if mode == "constant":
+        return jnp.pad(x, pad, mode="constant", constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, pad, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    """paddle.nn.functional.pad-compatible: `pad` is [l,r] pairs from the
+    LAST axis backward when len(pad) < 2*ndim (torch-style), or per-axis."""
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(unwrap(p)) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # torch/paddle semantics: FIRST pair pads the LAST (innermost) axis,
+        # working backward; for channel-last layouts the innermost padded
+        # axis sits just before the trailing channel dim.
+        npairs = len(pad) // 2
+        width = [(0, 0)] * nd
+        last = nd - 1
+        if data_format in ("NHWC", "NLC", "NDHWC"):
+            last = nd - 2
+        for i in range(npairs):
+            width[last - i] = (pad[2 * i], pad[2 * i + 1])
+    return _pad_nd(x, pad=tuple(width), mode=mode, value=value)
+
+
+@primitive
+def _slice(x, axes, starts, ends):
+    for ax, st, en in zip(axes, starts, ends):
+        dim = x.shape[ax]
+        st = builtins.max(st + dim, 0) if st < 0 else builtins.min(st, dim)
+        en = builtins.max(en + dim, 0) if en < 0 else builtins.min(en, dim)
+        x = jax.lax.slice_in_dim(x, st, builtins.max(en, st), axis=ax)
+    return x
+
+
+def slice(x, axes, starts, ends):
+    starts = [int(unwrap(s)) for s in starts]
+    ends = [int(unwrap(e)) for e in ends]
+    return _slice(x, axes=tuple(axes), starts=tuple(starts), ends=tuple(ends))
+
+
+@primitive
+def _strided_slice(x, axes, starts, ends, strides):
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(st, en, sd)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    return _strided_slice(
+        x, axes=tuple(axes), starts=tuple(int(unwrap(s)) for s in starts),
+        ends=tuple(int(unwrap(e)) for e in ends),
+        strides=tuple(int(unwrap(s)) for s in strides))
+
+
+# ---- gather/scatter family ----------------------------------------------
+
+
+@primitive
+def gather(x, index, axis=0):
+    index = index.reshape(-1) if index.ndim > 1 else index
+    return jnp.take(x, index, axis=axis)
+
+
+@primitive
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@primitive
+def take_along_axis(x, indices, axis, broadcast=True):
+    if broadcast:
+        shape = list(jnp.broadcast_shapes(x.shape, indices.shape))
+        shape[axis] = indices.shape[axis]
+        indices = jnp.broadcast_to(indices, shape)
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@primitive
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    values = jnp.broadcast_to(jnp.asarray(values, x.dtype), indices.shape)
+    return _pala(x, indices, values, axis,
+                 "set" if reduce == "assign" else reduce)
+
+
+def _pala(x, indices, values, axis, mode):
+    dims = list(range(x.ndim))
+    ind = [jnp.broadcast_to(
+        jnp.arange(x.shape[d]).reshape([-1 if i == d else 1 for i in dims]),
+        indices.shape) for d in dims]
+    ind[axis] = indices
+    at = x.at[tuple(ind)]
+    if mode == "set":
+        return at.set(values)
+    if mode in ("add", "sum"):
+        return at.add(values)
+    if mode in ("mul", "multiply"):
+        return at.multiply(values)
+    raise ValueError(f"unsupported reduce mode {mode}")
+
+
+@primitive
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@primitive
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@primitive
+def index_add(x, index, axis, value):
+    idx = [builtins.slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].add(value)
+
+
+@primitive
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(indices)
+    return x.at[idx].add(value) if accumulate else x.at[idx].set(value)
+
+
+@primitive
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@primitive
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    index, updates = unwrap(index), unwrap(updates)
+    zeros = jnp.zeros(_norm_shape(shape), updates.dtype)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return Tensor(zeros.at[idx].add(updates))
+
+
+@primitive
+def masked_select(x, mask):
+    # dynamic-shape op: eager only (XLA needs static shapes under jit)
+    return x[mask]
+
+
+@primitive
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+@primitive
+def masked_scatter(x, mask, value):
+    n = int(mask.sum())
+    return x.at[mask].set(value.reshape(-1)[:n])
+
+
+@primitive
+def where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+@primitive
+def select_scatter(x, values, axis, index):
+    idx = [builtins.slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(values)
+
+
+@primitive
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+# ---- search / sort -------------------------------------------------------
+
+
+@primitive
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    if axis != -1 and axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+        v, i = jax.lax.top_k(xm if largest else -xm, k)
+        v = v if largest else -v
+        return (jnp.moveaxis(v, -1, axis),
+                jnp.moveaxis(i.astype(jnp.int64), -1, axis))
+    v, i = jax.lax.top_k(x if largest else -x, k)
+    return (v if largest else -v), i.astype(jnp.int64)
+
+
+@primitive
+def sort(x, axis=-1, descending=False, stable=False):
+    out = jnp.sort(x, axis=axis, stable=stable)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+@primitive
+def argsort(x, axis=-1, descending=False, stable=False):
+    out = jnp.argsort(x, axis=axis, stable=stable).astype(jnp.int64)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+@primitive
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    r = jnp.searchsorted(sorted_sequence, values, side=side)
+    return r.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@primitive
+def kthvalue(x, k, axis=-1, keepdim=False):
+    v = jnp.sort(x, axis=axis)
+    i = jnp.argsort(x, axis=axis).astype(jnp.int64)
+    sl = [builtins.slice(None)] * x.ndim
+    sl[axis] = builtins.slice(k - 1, k)
+    v, i = v[tuple(sl)], i[tuple(sl)]
+    if not keepdim:
+        v, i = jnp.squeeze(v, axis), jnp.squeeze(i, axis)
+    return v, i
+
+
+@primitive
+def mode(x, axis=-1, keepdim=False):
+    axis = axis % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    # most-frequent value: O(n^2) pairwise count (fine for op-sized n),
+    # ties resolved to the smallest value (argmax over sorted order)
+    s = jnp.sort(xm, axis=-1)
+    counts = jnp.sum(s[..., :, None] == s[..., None, :], axis=-1)
+    pick = jnp.argmax(counts, axis=-1, keepdims=True)
+    out = jnp.take_along_axis(s, pick, axis=-1)
+    idx = jnp.argmax(jnp.asarray(xm == out, jnp.int32), axis=-1, keepdims=True)
+    out = jnp.moveaxis(out, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if not keepdim:
+        out, idx = jnp.squeeze(out, axis), jnp.squeeze(idx, axis)
+    return out, idx.astype(jnp.int64)
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic output shape: eager-only
+    arr = np.asarray(unwrap(x))
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(z, jnp.int64)) for z in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=-1), jnp.int64))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    arr = np.asarray(unwrap(x))
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    arr = np.asarray(unwrap(x))
+    flat = arr.flatten() if axis is None else arr
+    keep = np.ones(flat.shape[0 if axis is None else axis], bool)
+    if axis is None:
+        keep[1:] = flat[1:] != flat[:-1]
+        out = flat[keep]
+    else:
+        sl = np.any(np.diff(flat, axis=axis) != 0,
+                    axis=tuple(i for i in range(flat.ndim) if i != axis))
+        keep[1:] = sl
+        out = np.compress(keep, flat, axis=axis)
+    outs = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(np.cumsum(keep) - 1, np.int64)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, keep.shape[0]))
+        outs.append(Tensor(jnp.asarray(counts, np.int64)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+@primitive
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength,
+                        length=None)
+
+
+@primitive
+def histogram(x, bins=100, min=0, max=0):
+    rng = None if (min == 0 and max == 0) else (min, max)
+    h, _ = jnp.histogram(x, bins=bins, range=rng)
+    return h
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x.shape, jnp.int32))
+
+
+@primitive
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+    lo, hi = shard_id * size, (shard_id + 1) * size
+    inside = (x >= lo) & (x < hi)
+    return jnp.where(inside, x - lo, ignore_value)
+
+
+def tensordot(x, y, axes=2):
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def as_strided(x, shape, stride, offset=0):
+    def fn(v):
+        flat = v.reshape(-1)[offset:]
+        idx = np.zeros(tuple(shape), np.int64)
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            r = np.arange(s) * st
+            idx = idx + r.reshape([-1 if i == d else 1 for i in range(len(shape))])
+        return flat[idx.reshape(-1)].reshape(tuple(shape))
+    return apply("as_strided", fn, x)

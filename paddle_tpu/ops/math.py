@@ -1,0 +1,631 @@
+"""Elementwise & reduction math ops.
+
+Analog of ``python/paddle/tensor/math.py`` (reference; e.g. ``add``, ``scale``)
+with kernels delegated to XLA (SURVEY C11 ``paddle/phi/kernels/``; the
+broadcast/elementwise machinery of ``kernels/funcs/broadcast_function.h``
+is jnp broadcasting)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive, unwrap
+from ..core.tensor import Tensor
+
+# ---- binary elementwise --------------------------------------------------
+
+
+@primitive
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@primitive
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@primitive
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@primitive
+def divide(x, y):
+    return jnp.true_divide(x, y)
+
+
+@primitive
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@primitive
+def mod(x, y):
+    return jnp.mod(x, y)
+
+
+remainder = mod
+
+
+@primitive
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+@primitive
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@primitive
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@primitive
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@primitive
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@primitive
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@primitive
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+@primitive
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+@primitive
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+@primitive
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+@primitive
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@primitive
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+@primitive
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+@primitive
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+# ---- unary elementwise ---------------------------------------------------
+
+
+@primitive
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@primitive
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+@primitive
+def exp(x):
+    return jnp.exp(x)
+
+
+@primitive
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@primitive
+def log(x):
+    return jnp.log(x)
+
+
+@primitive
+def log2(x):
+    return jnp.log2(x)
+
+
+@primitive
+def log10(x):
+    return jnp.log10(x)
+
+
+@primitive
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@primitive
+def abs(x):
+    return jnp.abs(x)
+
+
+@primitive
+def neg(x):
+    return jnp.negative(x)
+
+
+@primitive
+def sign(x):
+    return jnp.sign(x)
+
+
+@primitive
+def floor(x):
+    return jnp.floor(x)
+
+
+@primitive
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@primitive
+def round(x):
+    return jnp.round(x)
+
+
+@primitive
+def trunc(x):
+    return jnp.trunc(x)
+
+
+@primitive
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@primitive
+def sin(x):
+    return jnp.sin(x)
+
+
+@primitive
+def cos(x):
+    return jnp.cos(x)
+
+
+@primitive
+def tan(x):
+    return jnp.tan(x)
+
+
+@primitive
+def asin(x):
+    return jnp.arcsin(x)
+
+
+@primitive
+def acos(x):
+    return jnp.arccos(x)
+
+
+@primitive
+def atan(x):
+    return jnp.arctan(x)
+
+
+@primitive
+def sinh(x):
+    return jnp.sinh(x)
+
+
+@primitive
+def cosh(x):
+    return jnp.cosh(x)
+
+
+@primitive
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@primitive
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+@primitive
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+@primitive
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+@primitive
+def erf(x):
+    return jax.lax.erf(x)
+
+
+@primitive
+def erfinv(x):
+    return jax.lax.erf_inv(x)
+
+
+@primitive
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+@primitive
+def square(x):
+    return jnp.square(x)
+
+
+@primitive
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+@primitive
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+@primitive
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+@primitive
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@primitive
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+@primitive
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+@primitive
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+@primitive
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+@primitive
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    s = jnp.asarray(scale, x.dtype) if not hasattr(scale, "dtype") else scale.astype(x.dtype)
+    b = jnp.asarray(bias, x.dtype)
+    if bias_after_scale:
+        return x * s + b
+    return (x + b) * s
+
+
+@primitive
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@primitive
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@primitive
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+# ---- scan / cumulative ---------------------------------------------------
+
+
+@primitive
+def cumsum(x, axis=None, dtype=None):
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+@primitive
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+def _running_extreme(x, axis, op):
+    """Running max/min values + index where the current extreme was attained
+    (last attaining position, via an associative scan over masked indices)."""
+    vals = jax.lax.associative_scan(op, x, axis=axis)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    idx = jnp.broadcast_to(
+        jnp.arange(x.shape[axis], dtype=jnp.int64).reshape(shape), x.shape)
+    attained = jnp.where(x == vals, idx, jnp.int64(-1))
+    inds = jax.lax.associative_scan(jnp.maximum, attained, axis=axis)
+    return vals, inds
+
+
+@primitive
+def cummax(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return _running_extreme(x, axis % x.ndim, jnp.maximum)
+
+
+@primitive
+def cummin(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return _running_extreme(x, axis % x.ndim, jnp.minimum)
+
+
+@primitive
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+# ---- reductions ----------------------------------------------------------
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@primitive
+def _sum(x, axis, keepdim, dtype):
+    return jnp.sum(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    return _sum(x, axis=_axis(axis), keepdim=keepdim, dtype=dtype)
+
+
+@primitive
+def _mean(x, axis, keepdim):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return _mean(x, axis=_axis(axis), keepdim=keepdim)
+
+
+@primitive
+def _max(x, axis, keepdim):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):
+    return _max(x, axis=_axis(axis), keepdim=keepdim)
+
+
+@primitive
+def _min(x, axis, keepdim):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):
+    return _min(x, axis=_axis(axis), keepdim=keepdim)
+
+
+@primitive
+def _prod(x, axis, keepdim, dtype):
+    return jnp.prod(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return _prod(x, axis=_axis(axis), keepdim=keepdim, dtype=dtype)
+
+
+@primitive
+def amax(x, axis=None, keepdim=False):
+    return jnp.amax(x, axis=axis, keepdims=keepdim)
+
+
+@primitive
+def amin(x, axis=None, keepdim=False):
+    return jnp.amin(x, axis=axis, keepdims=keepdim)
+
+
+@primitive
+def _std(x, axis, unbiased, keepdim):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return _std(x, axis=_axis(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+@primitive
+def _var(x, axis, unbiased, keepdim):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return _var(x, axis=_axis(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+@primitive
+def _logsumexp(x, axis, keepdim):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return _logsumexp(x, axis=_axis(axis), keepdim=keepdim)
+
+
+@primitive
+def _median(x, axis, keepdim):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return _median(x, axis=_axis(axis), keepdim=keepdim)
+
+
+@primitive
+def _quantile(x, q, axis, keepdim):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return _quantile(x, unwrap(q), axis=_axis(axis), keepdim=keepdim)
+
+
+@primitive
+def _nanmean(x, axis, keepdim):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return _nanmean(x, axis=_axis(axis), keepdim=keepdim)
+
+
+@primitive
+def _nansum(x, axis, keepdim, dtype):
+    return jnp.nansum(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return _nansum(x, axis=_axis(axis), keepdim=keepdim, dtype=dtype)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    x = unwrap(x)
+    return Tensor(jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim))
+
+
+@primitive
+def _argmax(x, axis, keepdim, dtype):
+    r = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return r.astype(dtype or jnp.int64)
+
+
+def argmax(x, axis=None, keepdim=False, dtype=None):
+    return _argmax(x, axis=None if axis is None else int(axis),
+                   keepdim=keepdim, dtype=dtype)
+
+
+@primitive
+def _argmin(x, axis, keepdim, dtype):
+    r = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return r.astype(dtype or jnp.int64)
+
+
+def argmin(x, axis=None, keepdim=False, dtype=None):
+    return _argmin(x, axis=None if axis is None else int(axis),
+                   keepdim=keepdim, dtype=dtype)
+
+
+# ---- predicates ----------------------------------------------------------
+
+
+@primitive
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@primitive
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@primitive
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return Tensor(jnp.isclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol,
+                              equal_nan=equal_nan))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return Tensor(jnp.allclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+# ---- misc ----------------------------------------------------------------
+
+
+@primitive
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+@primitive
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@primitive
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@primitive
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@primitive
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@primitive
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def increment(x, value=1.0):
+    out = add(x, Tensor(jnp.asarray(value, x.dtype)))
+    x._adopt(out)
+    return x
+
+
+@primitive
+def angle(x):
+    return jnp.angle(x)
+
+
+@primitive
+def conj(x):
+    return jnp.conj(x)

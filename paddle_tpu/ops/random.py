@@ -1,0 +1,133 @@
+"""Random ops over the functional JAX PRNG with a mutable global seed
+(paddle.seed analog; reference generator lives in
+``paddle/phi/core/generator.h``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import state
+from ..core.dtype import convert_dtype
+from ..core.dispatch import unwrap
+from ..core.tensor import Tensor
+
+
+def _dt(dtype):
+    d = convert_dtype(dtype)
+    return state.DEFAULT_DTYPE if d is None else d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._read()))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) for s in shape)
+
+
+def rand(shape, dtype=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None):
+    key = state.default_rng.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), dtype=_dt(dtype)))
+
+
+def standard_normal(shape, dtype=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = jnp.asarray(unwrap(mean)), jnp.asarray(unwrap(std))
+        shp = jnp.broadcast_shapes(m.shape, s.shape)
+        key = state.default_rng.next_key()
+        return Tensor(m + s * jax.random.normal(key, shp, dtype=state.DEFAULT_DTYPE))
+    key = state.default_rng.next_key()
+    return Tensor(mean + std * jax.random.normal(
+        key, _shape(shape if shape is not None else [1]),
+        dtype=state.DEFAULT_DTYPE))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    key = (jax.random.PRNGKey(seed) if seed else state.default_rng.next_key())
+    return Tensor(jax.random.uniform(
+        key, _shape(shape), dtype=_dt(dtype),
+        minval=float(unwrap(min)), maxval=float(unwrap(max))))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    key = state.default_rng.next_key()
+    return Tensor(jax.random.randint(
+        key, _shape(shape), int(low), int(high)).astype(convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    return randint(low, high, shape=x.shape, dtype=dtype or x.dtype)
+
+
+def randperm(n, dtype="int64"):
+    key = state.default_rng.next_key()
+    return Tensor(jax.random.permutation(key, n).astype(convert_dtype(dtype)))
+
+
+def bernoulli(x):
+    key = state.default_rng.next_key()
+    p = unwrap(x)
+    return Tensor(jax.random.bernoulli(key, p, p.shape).astype(p.dtype))
+
+
+def poisson(x):
+    key = state.default_rng.next_key()
+    lam = unwrap(x)
+    return Tensor(jax.random.poisson(key, lam, lam.shape).astype(lam.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    key = state.default_rng.next_key()
+    p = unwrap(x)
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if replacement:
+        batch = p.shape[:-1]
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(num_samples, *batch))
+        out = jnp.moveaxis(out, 0, -1) if batch else out
+        return Tensor(out.astype(jnp.int64))
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(key, p.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def rand_like(x, dtype=None):
+    return rand(x.shape, dtype=dtype or x.dtype)
+
+
+def randn_like(x, dtype=None):
+    return randn(x.shape, dtype=dtype or x.dtype)
+
+
+def exponential_(x, lam=1.0):
+    key = state.default_rng.next_key()
+    out = jax.random.exponential(key, tuple(x.shape)).astype(x.dtype) / lam
+    x._write(out)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0):
+    key = state.default_rng.next_key()
+    out = mean + std * jax.random.normal(key, tuple(x.shape)).astype(x.dtype)
+    x._write(out)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0):
+    key = state.default_rng.next_key()
+    out = jax.random.uniform(key, tuple(x.shape), minval=min,
+                             maxval=max).astype(x.dtype)
+    x._write(out)
+    return x

@@ -1,0 +1,23 @@
+"""Test fixture: force an 8-device virtual CPU mesh (the "fake backend"
+pattern of the reference's fake_cpu_device.h plugin tests, SURVEY §4) so
+single-host CI can exercise all sharding paths without TPU hardware.
+
+Note: this image's sitecustomize registers the `axon` TPU platform and sets
+jax_platforms="axon,cpu" via jax.config (which overrides env vars), so we
+must update the config — not just JAX_PLATFORMS — before backends init.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+if jax._src.xla_bridge.backends_are_initialized():
+    from jax.extend.backend import clear_backends
+
+    clear_backends()

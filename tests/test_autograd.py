@@ -1,0 +1,207 @@
+"""Autograd engine tests with numeric-gradient checks — the OpTest
+check_grad discipline (reference test/legacy_test/op_test.py:2975, SURVEY §4)
+applied to the tape engine."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pp
+
+
+def numeric_grad(fn, x, eps=1e-2):
+    """Central-difference gradient of scalar fn at numpy array x."""
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp, xm = x.copy(), x.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        g[i] = (fn(xp) - fn(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_grad(op, x_np, rtol=1e-2, atol=1e-3):
+    x = pp.to_tensor(x_np.astype("float32"), stop_gradient=False)
+    y = op(x).sum()
+    y.backward()
+    num = numeric_grad(lambda v: float(np.sum(np.asarray(
+        op(pp.to_tensor(v.astype("float32"))).numpy(), np.float64))), x_np)
+    np.testing.assert_allclose(x.grad.numpy(), num, rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("op,data", [
+    (lambda x: x.exp(), np.array([[0.1, -0.5], [1.0, 0.3]])),
+    (lambda x: x.tanh(), np.array([[0.1, -0.5], [1.0, 0.3]])),
+    (lambda x: x.sigmoid() if hasattr(x, "sigmoid") else 1 / (1 + (-x).exp()),
+     np.array([[0.2, -0.7]])),
+    (lambda x: x.sqrt(), np.array([[0.5, 1.5], [2.0, 3.0]])),
+    (lambda x: x.log(), np.array([[0.5, 1.5]])),
+    (lambda x: x * x * x, np.array([[0.5, -1.5]])),
+    (lambda x: x.abs(), np.array([[0.5, -1.5]])),
+    (lambda x: pp.maximum(x, pp.zeros_like(x)), np.array([[0.5, -1.5]])),
+    (lambda x: x.reshape([4]).cumsum(), np.array([[0.5, -1.5], [1.0, 2.0]])),
+    (lambda x: pp.matmul(x, x, transpose_y=True), np.array([[0.5, -1.5], [1.0, 2.0]])),
+    (lambda x: x.transpose([1, 0]) @ x, np.array([[0.5, -1.5], [1.0, 2.0]])),
+    (lambda x: x[0:1, :] * 3, np.array([[0.5, -1.5], [1.0, 2.0]])),
+    (lambda x: pp.concat([x, x * 2], axis=0), np.array([[0.5, -1.5]])),
+    (lambda x: x.mean(axis=0), np.array([[0.5, -1.5], [1.0, 2.0]])),
+    (lambda x: pp.where(x > pp.to_tensor(0.0), x * 2, x * 3),
+     np.array([[0.5, -1.5]])),
+    (lambda x: x.max(axis=1), np.array([[0.5, -1.5], [1.0, 2.0]])),
+    (lambda x: x.norm(), np.array([[0.5, -1.5], [1.0, 2.0]])),
+    (lambda x: pp.softmax(x, axis=-1) if hasattr(pp, "softmax") else x,
+     np.array([[0.5, -1.5, 0.2]])),
+])
+def test_numeric_grads(op, data):
+    check_grad(op, data)
+
+
+def test_grad_accumulation():
+    x = pp.to_tensor([1.0, 2.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5, 5])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_diamond_graph():
+    x = pp.to_tensor([2.0], stop_gradient=False)
+    a = x * 3
+    b = a * a + a  # a used twice
+    b.sum().backward()
+    # d/dx (9x^2 + 3x) = 18x + 3 = 39
+    np.testing.assert_allclose(x.grad.numpy(), [39.0])
+
+
+def test_stop_gradient_blocks():
+    x = pp.to_tensor([1.0], stop_gradient=False)
+    y = pp.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = pp.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    z = (x * 2 + y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_retain_graph():
+    x = pp.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_non_scalar_backward_needs_grad():
+    x = pp.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(pp.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+
+
+def test_no_grad_context():
+    x = pp.to_tensor([1.0], stop_gradient=False)
+    with pp.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_grad_api_and_double_backward():
+    x = pp.to_tensor([2.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    (gx,) = pp.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+    assert not gx.stop_gradient
+    (ggx,) = pp.grad(gx.sum(), x)
+    np.testing.assert_allclose(ggx.numpy(), [12.0])  # d(3x^2)/dx = 6x
+
+
+def test_backward_hook():
+    x = pp.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 10
+
+    x.register_hook(hook)
+    (x * 2).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+
+def test_retain_grads_intermediate():
+    x = pp.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.retain_grads()
+    z = (y * 3).sum()
+    z.backward()
+    np.testing.assert_allclose(y.grad.numpy(), [3.0])
+
+
+def test_multi_output_op_grad():
+    x = pp.to_tensor(np.arange(6, dtype="float32").reshape(2, 3),
+                     stop_gradient=False)
+    parts = pp.split(x, 3, axis=1)
+    loss = (parts[0] * 1 + parts[1] * 2 + parts[2] * 3).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 2, 3], [1, 2, 3]])
+
+
+def test_partial_use_of_outputs():
+    x = pp.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+    a, b = pp.split(x, 2, axis=0)
+    a.sum().backward()  # b unused -> zero cotangent branch
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 1], [0, 0]])
+
+
+def test_int_output_no_grad():
+    x = pp.to_tensor([3.0, 1.0], stop_gradient=False)
+    v, i = pp.topk(x, 1)
+    assert i.stop_gradient
+    v.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 0])
+
+
+def test_setitem_grad():
+    x = pp.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 2
+    y[0] = 0.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0, 2, 2])
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, a):
+            ctx.save_for_backward(a)
+            return a * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            (a,) = ctx.saved_tensor
+            return g * 2
+
+    x = pp.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2])

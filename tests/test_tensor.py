@@ -1,0 +1,188 @@
+"""Tensor façade basics. Mirrors the reference's eager tensor tests
+(test/legacy_test/test_eager_tensor.py style, SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pp
+
+
+def test_to_tensor_roundtrip():
+    x = pp.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    assert x.dtype == np.dtype("float32")
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_default_dtype_float64_input():
+    x = pp.to_tensor(np.array(1.5))  # np float64 stays float64 (explicit array)
+    y = pp.to_tensor(1.5)            # python float -> default dtype
+    assert y.dtype == np.dtype("float32")
+
+
+def test_dtype_cast():
+    x = pp.to_tensor([1, 2, 3])
+    assert x.dtype == np.dtype("int32") or x.dtype == np.dtype("int64")
+    y = x.astype("float32")
+    assert y.dtype == np.dtype("float32")
+    z = x.cast("bfloat16")
+    assert z.dtype.itemsize == 2
+
+
+def test_item_and_len():
+    x = pp.to_tensor([[1.0, 2.0]])
+    assert len(x) == 1
+    assert pp.to_tensor(3.5).item() == pytest.approx(3.5)
+
+
+def test_operators():
+    a = pp.to_tensor([1.0, 2.0])
+    b = pp.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((2.0 * a).numpy(), [2, 4])
+    np.testing.assert_allclose((1.0 - a).numpy(), [0, -1])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+    assert bool((a == a).all())
+    assert bool((a < b).all())
+
+
+def test_matmul_shapes():
+    a = pp.ones([2, 3])
+    b = pp.ones([3, 4])
+    assert (a @ b).shape == [2, 4]
+    c = pp.ones([5, 2, 3])
+    assert pp.matmul(c, b).shape == [5, 2, 4]
+    assert pp.matmul(a, a, transpose_y=True).shape == [2, 2]
+
+
+def test_getitem_setitem():
+    x = pp.to_tensor(np.arange(12).reshape(3, 4).astype("float32"))
+    np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(x[1:, ::2].numpy(), [[4, 6], [8, 10]])
+    idx = pp.to_tensor([0, 2])
+    np.testing.assert_allclose(x[idx].numpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+    x[0, 0] = 42.0
+    assert x.numpy()[0, 0] == 42.0
+    x[:, 1] = pp.to_tensor([7.0, 7.0, 7.0])
+    np.testing.assert_allclose(x.numpy()[:, 1], [7, 7, 7])
+
+
+def test_bool_mask_getitem():
+    x = pp.to_tensor([1.0, -2.0, 3.0])
+    m = x > pp.to_tensor(0.0)
+    np.testing.assert_allclose(x[m].numpy(), [1, 3])
+
+
+def test_reshape_family():
+    x = pp.arange(24, dtype="float32")
+    assert x.reshape([2, 3, 4]).shape == [2, 3, 4]
+    assert x.reshape([2, -1]).shape == [2, 12]
+    assert x.reshape([2, 3, 4]).flatten(1, 2).shape == [2, 12]
+    assert x.reshape([1, 24, 1]).squeeze().shape == [24]
+    assert x.unsqueeze(0).shape == [1, 24]
+    assert x.reshape([2, 3, 4]).transpose([2, 0, 1]).shape == [4, 2, 3]
+
+
+def test_concat_split_stack():
+    a = pp.ones([2, 3])
+    b = pp.zeros([2, 3])
+    c = pp.concat([a, b], axis=0)
+    assert c.shape == [4, 3]
+    s = pp.split(c, 2, axis=0)
+    assert len(s) == 2 and s[0].shape == [2, 3]
+    s2 = pp.split(c, [1, 3], axis=0)
+    assert s2[1].shape == [3, 3]
+    st = pp.stack([a, b], axis=1)
+    assert st.shape == [2, 2, 3]
+
+
+def test_reductions():
+    x = pp.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.sum().item() == 10
+    assert x.mean().item() == 2.5
+    assert x.max().item() == 4
+    np.testing.assert_allclose(x.sum(axis=0).numpy(), [4, 6])
+    np.testing.assert_allclose(x.sum(axis=1, keepdim=True).numpy(), [[3], [7]])
+    assert x.argmax().item() == 3
+    np.testing.assert_allclose(x.argmax(axis=1).numpy(), [1, 1])
+    assert x.prod().item() == 24
+
+
+def test_where_clip_topk():
+    x = pp.to_tensor([3.0, 1.0, 2.0])
+    v, i = pp.topk(x, 2)
+    np.testing.assert_allclose(v.numpy(), [3, 2])
+    np.testing.assert_allclose(i.numpy(), [0, 2])
+    np.testing.assert_allclose(pp.clip(x, 1.5, 2.5).numpy(), [2.5, 1.5, 2.0])
+    c = pp.where(x > pp.to_tensor(1.5), x, pp.zeros_like(x))
+    np.testing.assert_allclose(c.numpy(), [3, 0, 2])
+
+
+def test_gather_scatter():
+    x = pp.to_tensor(np.arange(12).reshape(3, 4).astype("float32"))
+    g = pp.gather(x, pp.to_tensor([2, 0]), axis=0)
+    np.testing.assert_allclose(g.numpy(), [[8, 9, 10, 11], [0, 1, 2, 3]])
+    idx = pp.to_tensor([[0, 1], [2, 3]])
+    np.testing.assert_allclose(
+        pp.gather_nd(x, idx).numpy(), [1, 11])
+    t = pp.take_along_axis(x, pp.to_tensor([[0], [1], [2]]), axis=1)
+    np.testing.assert_allclose(t.numpy(), [[0], [5], [10]])
+
+
+def test_creation_ops():
+    assert pp.zeros([2, 2]).sum().item() == 0
+    assert pp.ones([2, 2], dtype="int32").dtype == np.dtype("int32")
+    assert pp.full([2], 7).numpy().tolist() == [7, 7]
+    np.testing.assert_allclose(pp.arange(5).numpy(), [0, 1, 2, 3, 4])
+    np.testing.assert_allclose(pp.eye(2).numpy(), [[1, 0], [0, 1]])
+    np.testing.assert_allclose(pp.tril(pp.ones([2, 2])).numpy(), [[1, 0], [1, 1]])
+    assert pp.linspace(0, 1, 5).shape == [5]
+    x = pp.one_hot(pp.to_tensor([0, 2]), 3)
+    np.testing.assert_allclose(x.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_random_reproducible():
+    pp.seed(42)
+    a = pp.randn([4])
+    pp.seed(42)
+    b = pp.randn([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    u = pp.uniform([1000], min=0.0, max=1.0)
+    assert 0.0 <= float(u.min()) and float(u.max()) <= 1.0
+    r = pp.randperm(10)
+    assert sorted(r.tolist()) == list(range(10))
+
+
+def test_save_load(tmp_path):
+    x = pp.to_tensor([[1.0, 2.0]])
+    state = {"w": x, "step": 3, "nested": {"b": pp.ones([2])}}
+    p = str(tmp_path / "ckpt.pd")
+    pp.save(state, p)
+    loaded = pp.load(p)
+    np.testing.assert_allclose(loaded["w"].numpy(), x.numpy())
+    assert loaded["step"] == 3
+    np.testing.assert_allclose(loaded["nested"]["b"].numpy(), [1, 1])
+
+
+def test_einsum_and_linalg():
+    a = pp.ones([2, 3])
+    b = pp.ones([3, 4])
+    e = pp.einsum("ij,jk->ik", a, b)
+    np.testing.assert_allclose(e.numpy(), 3 * np.ones((2, 4)))
+    m = pp.to_tensor([[2.0, 0.0], [0.0, 2.0]])
+    np.testing.assert_allclose(pp.inverse(m).numpy(), [[0.5, 0], [0, 0.5]])
+    assert pp.det(m).item() == pytest.approx(4.0)
+    assert pp.norm(pp.to_tensor([3.0, 4.0])).item() == pytest.approx(5.0)
+
+
+def test_flags():
+    pp.set_flags({"check_nan_inf": True})
+    try:
+        with pytest.raises(FloatingPointError):
+            _ = pp.log(pp.to_tensor([-1.0]))
+    finally:
+        pp.set_flags({"check_nan_inf": False})
