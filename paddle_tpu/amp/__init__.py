@@ -1,1 +1,267 @@
+"""paddle_tpu.amp — automatic mixed precision.
 
+Analog of ``python/paddle/amp/`` (reference ``auto_cast.py:279`` auto_cast,
+``:858`` decorate, ``grad_scaler.py:573`` GradScaler). TPU-native choices:
+
+- default low dtype is **bfloat16** (TPU MXU native; fp16 also supported);
+- O1 casting happens in the op-dispatch funnel (``core/dispatch.py``): ops on
+  the white list run with inputs cast to the low dtype, black-list ops are
+  pinned to float32 — the analog of the reference's per-op AMP lists
+  (``python/paddle/amp/amp_lists.py``);
+- bf16 needs no loss scaling, so ``GradScaler(enable=False)`` is the natural
+  TPU mode, but full dynamic scaling is implemented for fp16 parity.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import state
+from ..core.tensor import Tensor, Parameter
+
+# Ops that are numerically safe + MXU-bound: run in low precision.
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
+    "conv2d_transpose", "conv3d_transpose", "bmm", "mm", "einsum", "addmm",
+    "scaled_dot_product_attention", "flash_attn_unpadded", "mv",
+}
+# Numerically risky reductions/normalizations: pin to float32.
+BLACK_LIST = {
+    "softmax", "log_softmax", "cross_entropy", "nll_loss", "mse_loss",
+    "l1_loss", "bce_with_logits", "binary_cross_entropy", "kl_div",
+    "layer_norm", "rms_norm", "batch_norm", "instance_norm", "group_norm",
+    "mean", "sum", "exp", "log", "pow", "cumsum", "logsumexp", "norm",
+    "softmax_with_cross_entropy", "ctc_loss", "sigmoid_focal_loss",
+}
+
+
+class _AmpState:
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_amp = _AmpState()
+
+
+def amp_state():
+    return _amp
+
+
+def _to_jnp_dtype(d):
+    if d in ("bfloat16", "bf16"):
+        return jnp.bfloat16
+    if d in ("float16", "fp16"):
+        return jnp.float16
+    return jnp.dtype(d)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """Reference ``auto_cast.py:279``. Under O2 every op (except black list)
+    runs in the low dtype; under O1 only white-list ops do."""
+    old = (_amp.enabled, _amp.dtype, _amp.level, _amp.custom_white,
+           _amp.custom_black)
+    _amp.enabled = bool(enable)
+    _amp.dtype = _to_jnp_dtype(dtype)
+    _amp.level = level
+    _amp.custom_white = set(custom_white_list or ())
+    _amp.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_amp.enabled, _amp.dtype, _amp.level, _amp.custom_white,
+         _amp.custom_black) = old
+
+
+autocast = auto_cast
+
+
+def amp_cast_inputs(name, vals):
+    """Called from core.dispatch.apply when amp is enabled: returns vals cast
+    per the active AMP lists."""
+    white = (name in WHITE_LIST or name in _amp.custom_white)
+    black = (name in BLACK_LIST or name in _amp.custom_black) and \
+        name not in _amp.custom_white
+    if _amp.level == "O2":
+        target = jnp.float32 if black else _amp.dtype
+    else:
+        if black:
+            target = jnp.float32
+        elif white:
+            target = _amp.dtype
+        else:
+            return vals
+    out = []
+    for v in vals:
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating) \
+                and v.dtype != target:
+            out.append(v.astype(target))
+        else:
+            out.append(v)
+    return out
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """Reference ``auto_cast.py:858``: O2 casts model params to the low
+    dtype; optimizers get master (float32) weights."""
+    from ..nn import Layer
+
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        low = _to_jnp_dtype(dtype)
+        excluded = []
+        if excluded_layers:
+            excl_list = (excluded_layers if isinstance(excluded_layers, list)
+                         else [excluded_layers])
+            for m in model_list:
+                for l in m.sublayers(include_self=True):
+                    if isinstance(l, tuple(
+                            e for e in excl_list if isinstance(e, type))) or \
+                            l in [e for e in excl_list
+                                  if isinstance(e, Layer)]:
+                        excluded.append(id(l))
+        from ..nn.layers import _BatchNormBase, LayerNorm, GroupNorm
+        for m in model_list:
+            for l in m.sublayers(include_self=True):
+                # keep norm layers in fp32 (reference keeps BN/LN master)
+                if isinstance(l, (_BatchNormBase, LayerNorm, GroupNorm)) or \
+                        id(l) in excluded:
+                    continue
+                for pname, p in list(l._parameters.items()):
+                    if p is None:
+                        continue
+                    v = p._read()
+                    if jnp.issubdtype(v.dtype, jnp.floating):
+                        p._write(v.astype(low))
+    if optimizers is None:
+        return models if single_model else model_list
+    single_opt = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if single_opt else list(optimizers)
+    if master_weight is not False:
+        for o in opt_list:
+            o._multi_precision = True
+    if single_model and single_opt:
+        return models, optimizers
+    return model_list, opt_list
+
+
+class GradScaler:
+    """Reference ``grad_scaler.py:573``: dynamic loss scaling for fp16.
+    With bf16 (TPU default) pass ``enable=False`` — scale() and step() become
+    pass-throughs, matching reference behavior when amp is off."""
+
+    def __init__(self, enable=True, init_loss_scaling=65536.0,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        from .. import ops
+        return ops.scale(var, scale=self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameters:
+            if p.grad is None:
+                continue
+            g = p.grad._read().astype(jnp.float32) * inv
+            if not bool(jnp.all(jnp.isfinite(g))):
+                found = True
+            p.grad._write(g)
+        self._found_inf = found
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._unscaled:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._update_scale()
+        self._unscaled = False
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if self._enable and self._unscaled:
+            self._update_scale()
+            self._unscaled = False
+
+    def _update_scale(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every,
+                "decr_every_n_nan_or_inf": self._decr_every,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps,
+                "use_dynamic_loss_scaling": self._dynamic}
+
+    def set_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True

@@ -24,6 +24,7 @@ from .autograd import Node
 from .tensor import Tensor
 
 _TRACER_TYPES = (jax.core.Tracer,)
+_amp_mod = None  # lazily bound paddle_tpu.amp (breaks the import cycle)
 
 
 def _is_float(x) -> bool:
@@ -93,6 +94,14 @@ def apply(name: str, fn: Callable, *args, **kwargs):
         kwargs = {k: (v._read() if isinstance(v, Tensor) else v)
                   for k, v in kwargs.items()}
 
+    # AMP O1/O2 cast (analog of the generated ad_func AMP block, SURVEY C16)
+    global _amp_mod
+    if _amp_mod is None:
+        from .. import amp as _amp_mod_imported
+        _amp_mod = _amp_mod_imported
+    if _amp_mod.amp_state().enabled:
+        vals = _amp_mod.amp_cast_inputs(name, vals)
+
     grad_on = state.is_grad_enabled()
     diff_idx = [i for i, t in enumerate(tensors)
                 if grad_on and not t.stop_gradient and _is_float(vals[i])]
@@ -152,7 +161,10 @@ def primitive(name_or_fn=None, name: str | None = None):
     Tensors); keyword args are static attributes (analog of op Attrs).
     """
     def deco(fn, opname=None):
-        opname = opname or fn.__name__
+        opname = (opname or fn.__name__).lstrip("_")
+        for suffix in ("_impl",):
+            if opname.endswith(suffix):
+                opname = opname[: -len(suffix)]
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):

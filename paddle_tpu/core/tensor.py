@@ -282,7 +282,8 @@ class Parameter(Tensor):
     """Trainable tensor. Analog of ``paddle.base.framework.Parameter`` /
     ``EagerParamBase`` (reference ``python/paddle/base/framework.py``)."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+    __slots__ = ("trainable", "optimize_attr", "regularizer",
+                 "is_distributed", "need_clip", "no_sync")
 
     def __init__(self, data, dtype=None, name=None, trainable=True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable,
@@ -291,3 +292,5 @@ class Parameter(Tensor):
         self.optimize_attr = {"learning_rate": 1.0}
         self.regularizer = None
         self.is_distributed = False
+        self.need_clip = True
+        self.no_sync = False

@@ -1,1 +1,36 @@
+"""paddle_tpu.nn — the neural network layer library.
 
+Analog of ``python/paddle/nn/`` (reference). ``Layer`` is the module base;
+``functional`` the op surface; concrete layers mirror paddle.nn's names.
+"""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import Layer, ParamAttr  # noqa: F401
+from .layers import (  # noqa: F401
+    Identity, Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    Flatten, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
+    PixelShuffle, PixelUnshuffle, Pad1D, Pad2D, Pad3D, ZeroPad2D,
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose,
+    Conv3DTranspose,
+    LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+    SyncBatchNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    LocalResponseNorm, SpectralNorm,
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+    ReLU, ReLU6, GELU, Sigmoid, LogSigmoid, Silu, Swish, Tanh, Tanhshrink,
+    Softmax, LogSoftmax, LeakyReLU, ELU, CELU, SELU, Hardswish, Hardsigmoid,
+    Hardtanh, Hardshrink, Softshrink, Softplus, Softsign, Mish, PReLU, GLU,
+    Maxout, ThresholdedReLU, RReLU,
+    Sequential, LayerList, ParameterList, LayerDict,
+)
+from .loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    SmoothL1Loss, KLDivLoss, MarginRankingLoss, HingeEmbeddingLoss,
+    CosineEmbeddingLoss, TripletMarginLoss, CTCLoss,
+)
+from .transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

@@ -1,0 +1,81 @@
+"""Gradient clipping.
+
+Analog of ``python/paddle/nn/clip.py`` (reference: ClipGradByGlobalNorm used
+by every fleet optimizer). Operates on (param, grad) lists, returning new
+grads — the optimizer applies them.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+    def _dygraph_clip(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or getattr(p, "need_clip", True) is False:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._read(), self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or getattr(p, "need_clip", True) is False:
+                out.append((p, g))
+                continue
+            v = g._read()
+            norm = jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((p, Tensor((v.astype(jnp.float32) * scale)
+                                  .astype(v.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _dygraph_clip(self, params_grads):
+        sq = []
+        for p, g in params_grads:
+            if g is None or getattr(p, "need_clip", True) is False:
+                continue
+            v = g._read()
+            sq.append(jnp.sum(jnp.square(v.astype(jnp.float32))))
+        if not sq:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or getattr(p, "need_clip", True) is False:
+                out.append((p, g))
+                continue
+            v = g._read()
+            out.append((p, Tensor((v.astype(jnp.float32) * scale)
+                                  .astype(v.dtype))))
+        return out

@@ -1,0 +1,278 @@
+"""Activation functionals.
+
+Analog of ``python/paddle/nn/functional/activation.py`` (reference). Each op
+is a framework primitive: XLA fuses these into surrounding matmuls, which is
+the TPU replacement for the reference's fused CUDA activation kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive, apply, unwrap
+from ...core.tensor import Tensor
+
+
+@primitive
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+@primitive
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+@primitive(name="gelu")
+def _gelu_impl(x, approximate=False):
+    return jax.nn.gelu(x, approximate=bool(approximate))
+
+
+def gelu(x, approximate=False, name=None):
+    return _gelu_impl(x, approximate=approximate)
+
+
+@primitive
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@primitive
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@primitive
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+@primitive
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@primitive
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@primitive(name="softmax")
+def _softmax_impl(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import convert_dtype
+    if dtype is not None:
+        from ... import ops
+        x = ops.cast(x, convert_dtype(dtype))
+    return _softmax_impl(x, axis=axis)
+
+
+@primitive(name="log_softmax")
+def _log_softmax_impl(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import convert_dtype
+    if dtype is not None:
+        from ... import ops
+        x = ops.cast(x, convert_dtype(dtype))
+    return _log_softmax_impl(x, axis=axis)
+
+
+@primitive(name="leaky_relu")
+def _leaky_relu_impl(x, negative_slope=0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _leaky_relu_impl(x, negative_slope=negative_slope)
+
+
+@primitive(name="elu")
+def _elu_impl(x, alpha=1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _elu_impl(x, alpha=alpha)
+
+
+@primitive(name="celu")
+def _celu_impl(x, alpha=1.0):
+    return jax.nn.celu(x, alpha=alpha)
+
+
+def celu(x, alpha=1.0, name=None):
+    return _celu_impl(x, alpha=alpha)
+
+
+@primitive
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@primitive
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@primitive
+def hardsigmoid(x, slope=0.1666667, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@primitive(name="hardtanh")
+def _hardtanh_impl(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _hardtanh_impl(x, min=min, max=max)
+
+
+@primitive(name="hardshrink")
+def _hardshrink_impl(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _hardshrink_impl(x, threshold=threshold)
+
+
+@primitive(name="softshrink")
+def _softshrink_impl(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _softshrink_impl(x, threshold=threshold)
+
+
+@primitive(name="softplus")
+def _softplus_impl(x, beta=1.0, threshold=20.0):
+    bx = beta * x
+    return jnp.where(bx > threshold, x, jnp.logaddexp(bx, 0.0) / beta)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _softplus_impl(x, beta=beta, threshold=threshold)
+
+
+@primitive
+def softsign(x):
+    return x / (1.0 + jnp.abs(x))
+
+
+@primitive
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@primitive
+def prelu(x, weight):
+    w = weight
+    if w.ndim == 1 and w.shape[0] > 1 and x.ndim > 1:
+        # per-channel (NCHW convention: channel axis 1)
+        shape = [1] * x.ndim
+        shape[1] = w.shape[0]
+        w = w.reshape(shape)
+    return jnp.where(x >= 0, x, w * x)
+
+
+@primitive(name="glu")
+def _glu_impl(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def glu(x, axis=-1, name=None):
+    return _glu_impl(x, axis=axis)
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU fusion (reference incubate fused swiglu): silu(x) * y."""
+    if y is None:
+        return _glu_swish_split(x)
+    return _swiglu_impl(x, y)
+
+
+@primitive(name="swiglu")
+def _swiglu_impl(x, y):
+    return jax.nn.silu(x) * y
+
+
+@primitive(name="swiglu_split")
+def _glu_swish_split(x):
+    a, b = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(a) * b
+
+
+@primitive(name="maxout")
+def _maxout_impl(x, groups, axis=1):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return _maxout_impl(x, groups=groups, axis=axis)
+
+
+@primitive(name="thresholded_relu")
+def _thresholded_relu_impl(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return _thresholded_relu_impl(x, threshold=threshold, value=value)
+
+
+@primitive(name="rrelu")
+def _rrelu_eval(x, lower, upper):
+    return jnp.where(x >= 0, x, (lower + upper) / 2.0 * x)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    if not training:
+        return _rrelu_eval(x, lower=lower, upper=upper)
+    from ...core import state
+    key = Tensor(jax.random.key_data(state.default_rng.next_key()))
+    return apply("rrelu", _rrelu_train_impl, x, key, lower=lower, upper=upper)
+
+
+def _rrelu_train_impl(x, key, lower, upper):
+    k = jax.random.wrap_key_data(key.astype(jnp.uint32))
+    a = jax.random.uniform(k, x.shape, jnp.float32, lower, upper).astype(x.dtype)
+    return jnp.where(x >= 0, x, a * x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import state
+    key = Tensor(jax.random.key_data(state.default_rng.next_key()))
+    return apply("gumbel_softmax", _gumbel_softmax_impl, x, key,
+                 temperature=temperature, hard=hard, axis=axis)
+
+
+def _gumbel_softmax_impl(x, key, temperature, hard, axis):
+    k = jax.random.wrap_key_data(key.astype(jnp.uint32))
+    g = jax.random.gumbel(k, x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                    inplace=False)
+        # straight-through: hard value forward, soft gradient backward
+        y = y_hard + y - jax.lax.stop_gradient(y)
+    return y

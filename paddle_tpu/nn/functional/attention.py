@@ -1,0 +1,159 @@
+"""Attention functionals.
+
+Analog of ``python/paddle/nn/functional/flash_attention.py`` (reference
+``flash_attention.py:147,303,442``; CUDA kernels
+``paddle/phi/kernels/gpu/flash_attn_kernel.cu:91``). TPU-native: the public
+API keeps paddle's [batch, seq, heads, head_dim] signature; the implementation
+dispatches to a Pallas flash-attention kernel on TPU (``paddle_tpu.ops.pallas``)
+and falls back to an XLA soft(max(QK))V composition elsewhere (CPU tests).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+
+
+def _use_pallas(q):
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        from ...ops.pallas import flash_attention  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def _dropout_probs(probs, dropout, key):
+    keep = jax.random.bernoulli(key, 1.0 - dropout, probs.shape)
+    return jnp.where(keep, probs / (1.0 - dropout),
+                     jnp.zeros((), probs.dtype))
+
+
+def _sdpa_xla(q, k, v, mask=None, dropout=0.0, causal=False, scale=None,
+              dropout_key=None):
+    # q,k,v: [B, S, H, D] (paddle layout) -> compute in [B, H, S, D]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    d = qt.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    # grouped-query attention: repeat kv heads if fewer than q heads
+    hq, hk = qt.shape[1], kt.shape[1]
+    if hk != hq:
+        rep = hq // hk
+        kt = jnp.repeat(kt, rep, axis=1)
+        vt = jnp.repeat(vt, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt,
+                        preferred_element_type=jnp.float32) * s
+    if causal:
+        q_len, k_len = logits.shape[-2], logits.shape[-1]
+        idx_q = jnp.arange(q_len)[:, None] + (k_len - q_len)
+        idx_k = jnp.arange(k_len)[None, :]
+        cmask = idx_q >= idx_k
+        logits = jnp.where(cmask, logits, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        else:
+            logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(qt.dtype)
+    if dropout > 0.0 and dropout_key is not None:
+        probs = _dropout_probs(probs, dropout,
+                               jax.random.wrap_key_data(
+                                   dropout_key.astype(jnp.uint32)))
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """paddle.nn.functional.scaled_dot_product_attention parity
+    (layout [batch, seq, num_heads, head_dim])."""
+    args = [query, key, value]
+    has_mask = attn_mask is not None
+    if has_mask:
+        args.append(attn_mask)
+    drop = float(dropout_p) if training else 0.0
+    if drop > 0.0:
+        from ...core import state
+        from ...core.tensor import Tensor
+        args.append(Tensor(jax.random.key_data(
+            state.default_rng.next_key())))
+
+    def impl(q, k, v, *rest):
+        i = 0
+        m = rest[i] if has_mask else None
+        if has_mask:
+            i += 1
+        dk = rest[i] if drop > 0.0 else None
+        if _use_pallas(q) and m is None and drop == 0.0:
+            from ...ops.pallas import flash_attention as fa
+            return fa.flash_attention(q, k, v, causal=is_causal)
+        return _sdpa_xla(q, k, v, mask=m, dropout=drop, causal=is_causal,
+                         dropout_key=dk)
+
+    return apply("scaled_dot_product_attention", impl, *args)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """paddle flash_attention parity (reference
+    ``nn/functional/flash_attention.py:147``): returns (out, softmax_lse)
+    shaped like the reference's (out, None) when return_softmax=False."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, training=True, name=None):
+    from ... import ops
+    q, k, v = ops.unbind(qkv, axis=2)
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False, training=True,
+                        name=None):
+    """Varlen flash attention (reference ``flash_attention.py:303``):
+    packed [total_tokens, heads, dim] with cu_seqlens prefix sums. The TPU
+    path segments via a block-diagonal mask — static shapes keep XLA happy."""
+    args = [query, key, value, cu_seqlens_q, cu_seqlens_k]
+
+    def impl(q, k, v, cu_q, cu_k):
+        total_q = q.shape[0]
+        total_k = k.shape[0]
+        # segment ids from cu_seqlens: token i belongs to segment
+        # sum(cu <= i) - 1
+        pos_q = jnp.arange(total_q)
+        pos_k = jnp.arange(total_k)
+        seg_q = jnp.searchsorted(cu_q, pos_q, side="right") - 1
+        seg_k = jnp.searchsorted(cu_k, pos_k, side="right") - 1
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            off_q = pos_q - jnp.take(cu_q, seg_q)
+            off_k = pos_k - jnp.take(cu_k, seg_k)
+            mask = mask & (off_q[:, None] >= off_k[None, :])
+        qb = q[None]  # [1, Sq, H, D]
+        kb = k[None]
+        vb = v[None]
+        out = _sdpa_xla(qb, kb, vb, mask=mask[None, None], scale=scale)
+        return out[0]
+
+    out = apply("flash_attn_unpadded", impl, *args)
+    return out, None
+
+
+def sdp_kernel(*a, **k):  # compatibility no-op context
+    import contextlib
+    return contextlib.nullcontext()

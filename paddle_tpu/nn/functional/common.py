@@ -1,0 +1,312 @@
+"""Common functionals: linear, embedding, dropout, padding, interpolate.
+
+Analog of ``python/paddle/nn/functional/common.py`` and ``input.py``
+(reference). Linear keeps paddle's [in, out] weight layout so state dicts
+round-trip; XLA maps it onto the MXU either way.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import state
+from ...core.dispatch import apply, primitive
+from ...core.tensor import Tensor
+
+
+@primitive
+def linear(x, weight, bias=None):
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@primitive(name="embedding")
+def _embedding_impl(weight, x, padding_idx=None, sparse=False):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return _embedding_impl(weight, x, padding_idx=padding_idx)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply("one_hot",
+                 lambda v: jax.nn.one_hot(v, num_classes, dtype=jnp.float32),
+                 x)
+
+
+def _new_key_tensor():
+    return Tensor(jax.random.key_data(state.default_rng.next_key()))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    """Reference ``common.py`` dropout: two modes — upscale_in_train
+    (inverted dropout, default) and downscale_in_infer."""
+    if isinstance(p, Tensor):
+        p = float(p.item())
+    if not training:
+        if mode == "downscale_in_infer":
+            return apply("dropout_infer", lambda v: v * (1.0 - p), x)
+        return x
+    if p == 0.0:
+        return x
+    if p == 1.0:
+        return apply("dropout", lambda v: jnp.zeros_like(v), x)
+    key = _new_key_tensor()
+    return apply("dropout", _dropout_impl, x, key, p=p, axis=axis, mode=mode)
+
+
+def _dropout_impl(x, key, p, axis, mode):
+    k = jax.random.wrap_key_data(key.astype(jnp.uint32))
+    if axis is None:
+        mask_shape = x.shape
+    else:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        mask_shape = tuple(
+            s if i in axes else 1 for i, s in enumerate(x.shape))
+    keep = jax.random.bernoulli(k, 1.0 - p, mask_shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+    return jnp.where(keep, x, jnp.zeros((), x.dtype))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = _new_key_tensor()
+    return apply("alpha_dropout", _alpha_dropout_impl, x, key, p=p)
+
+
+def _alpha_dropout_impl(x, key, p):
+    k = jax.random.wrap_key_data(key.astype(jnp.uint32))
+    alpha = 1.6732632423543772 * 1.0507009873554805
+    alpha_p = -alpha
+    keep = jax.random.bernoulli(k, 1.0 - p, x.shape)
+    a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+    b = -a * alpha_p * p
+    y = jnp.where(keep, x, jnp.full((), alpha_p, x.dtype))
+    return a * y + b
+
+
+@primitive(name="pad")
+def _pad_impl(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    nd = x.ndim
+    pad = list(pad)
+    if len(pad) == 2 * nd:
+        # paddle "full" form: [[before,after] per dim] flattened, low-dim first
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial form applies to the spatial dims (reversed, like torch)
+        n_spatial = len(pad) // 2
+        cfg = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            spatial = list(range(2, nd))
+        else:
+            spatial = list(range(1, nd - 1))
+        spatial = spatial[-n_spatial:]
+        for i, d in enumerate(reversed(spatial)):
+            cfg[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None,
+        pad_from_left_axis=True):
+    return _pad_impl(x, pad=tuple(int(p) for p in np.asarray(pad).ravel()),
+                     mode=mode, value=value, data_format=data_format)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+@primitive(name="interpolate")
+def _interpolate_impl(x, size, mode, align_corners, data_format):
+    chan_first = data_format.startswith("NC")
+    if chan_first:
+        spatial_axes = list(range(2, x.ndim))
+    else:
+        spatial_axes = list(range(1, x.ndim - 1))
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic",
+              "area": "linear"}[mode]
+    new_shape = list(x.shape)
+    for ax, s in zip(spatial_axes, size):
+        new_shape[ax] = int(s)
+    if align_corners and method != "nearest":
+        # jax.image.resize has no align_corners; emulate with explicit
+        # coordinate map via scale_and_translate.
+        scales, translations = [], []
+        for ax, s in zip(spatial_axes, size):
+            in_s = x.shape[ax]
+            if s == 1 or in_s == 1:
+                scales.append(1.0)
+                translations.append(0.0)
+            else:
+                sc = (s - 1) / (in_s - 1)
+                scales.append(sc)
+                translations.append(0.5 * (1 - sc))
+        return jax.image.scale_and_translate(
+            x, new_shape, spatial_axes,
+            jnp.asarray(scales, jnp.float32),
+            jnp.asarray(translations, jnp.float32),
+            {"linear": "linear", "cubic": "cubic"}[method],
+            antialias=False).astype(x.dtype)
+    return jax.image.resize(x, new_shape, method=method).astype(x.dtype)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format=None,
+                name=None):
+    nd = x.ndim - 2
+    if data_format is None:
+        data_format = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[nd]
+    chan_first = data_format.startswith("NC")
+    spatial = x.shape[2:] if chan_first else x.shape[1:-1]
+    if size is None:
+        if scale_factor is None:
+            raise ValueError("one of size / scale_factor must be set")
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * nd
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    else:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        if isinstance(size, (int,)):
+            size = [size] * nd
+        size = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                for s in size]
+    return _interpolate_impl(x, size=tuple(size), mode=mode,
+                             align_corners=bool(align_corners),
+                             data_format=data_format)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format=None, name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+@primitive(name="pixel_shuffle")
+def _pixel_shuffle_impl(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _pixel_shuffle_impl(x, upscale_factor=int(upscale_factor),
+                               data_format=data_format)
+
+
+@primitive(name="pixel_unshuffle")
+def _pixel_unshuffle_impl(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = x.transpose(0, 1, 3, 5, 2, 4)
+        return x.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = x.transpose(0, 2, 4, 1, 3, 5).reshape(n, h // r, w // r, c * r * r)
+    return x
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return _pixel_unshuffle_impl(x, downscale_factor=int(downscale_factor),
+                                 data_format=data_format)
+
+
+@primitive(name="unfold")
+def _unfold_impl(x, kernel_sizes, strides, paddings, dilations):
+    n, c, h, w = x.shape
+    kh, kw = kernel_sizes
+    sh, sw = strides
+    ph0, pw0, ph1, pw1 = paddings
+    dh, dw = dilations
+    x = jnp.pad(x, [(0, 0), (0, 0), (ph0, ph1), (pw0, pw1)])
+    oh = (x.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (x.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches.reshape(n, c * kh * kw, oh * ow)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    p = paddings
+    if isinstance(p, int):
+        p = [p, p, p, p]
+    elif len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    return _unfold_impl(x, kernel_sizes=pair(kernel_sizes),
+                        strides=pair(strides), paddings=tuple(p),
+                        dilations=pair(dilations))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return apply("cosine_similarity", _cos_sim_impl, x1, x2, axis=axis,
+                 eps=eps)
+
+
+def _cos_sim_impl(a, b, axis, eps):
+    num = jnp.sum(a * b, axis=axis)
+    den = jnp.sqrt(jnp.sum(a * a, axis=axis) * jnp.sum(b * b, axis=axis))
+    return num / jnp.maximum(den, eps)
+
+
+@primitive(name="label_smooth")
+def _label_smooth_impl(label, epsilon=0.1):
+    k = label.shape[-1]
+    return (1.0 - epsilon) * label + epsilon / k
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    if prior_dist is not None:
+        return apply(
+            "label_smooth",
+            lambda l, p: (1.0 - epsilon) * l + epsilon * p,
+            label, prior_dist)
+    return _label_smooth_impl(label, epsilon=epsilon)
+
+
+@primitive(name="normalize")
+def _normalize_impl(x, p=2, axis=1, epsilon=1e-12):
+    norm = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
+    return x / jnp.maximum(norm, epsilon)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _normalize_impl(x, p=p, axis=axis, epsilon=epsilon)

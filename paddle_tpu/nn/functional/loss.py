@@ -1,0 +1,343 @@
+"""Loss functionals.
+
+Analog of ``python/paddle/nn/functional/loss.py`` (reference; kernels
+``paddle/phi/kernels/funcs/cross_entropy.h`` etc.). Cross-entropy follows the
+reference semantics: hard or soft labels, ignore_index, class weights,
+label_smoothing, use_softmax toggle.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+
+def _reduce(loss, reduction, weight_sum=None):
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if weight_sum is not None:
+        return jnp.sum(loss) / jnp.maximum(weight_sum, 1e-12)
+    return jnp.mean(loss)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    args = [input, label] + ([weight] if weight is not None else [])
+
+    def impl(logits, lab, *w):
+        w = w[0] if w else None
+        ax = axis if axis >= 0 else logits.ndim + axis
+        n_class = logits.shape[ax]
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=ax)
+        else:
+            logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-37))
+        if soft_label or (lab.ndim == logits.ndim and
+                          lab.shape[ax] == n_class and
+                          jnp.issubdtype(lab.dtype, jnp.floating)):
+            soft = lab.astype(jnp.float32)
+            if label_smoothing > 0.0:
+                soft = (1 - label_smoothing) * soft + label_smoothing / n_class
+            loss = -jnp.sum(soft * logp, axis=ax)
+            if w is not None:
+                wc = jnp.sum(soft * w.astype(jnp.float32), axis=ax)
+                loss = loss * wc
+                return _reduce(loss, reduction,
+                               jnp.sum(wc) if reduction == "mean" else None)
+            return _reduce(loss, reduction)
+        idx = lab
+        if idx.ndim == logits.ndim:
+            idx = jnp.squeeze(idx, axis=ax)
+        idx = idx.astype(jnp.int32)
+        valid = idx != ignore_index
+        safe = jnp.where(valid, idx, 0)
+        if label_smoothing > 0.0:
+            nll = -jnp.take_along_axis(
+                logp, safe[..., None] if ax == logits.ndim - 1
+                else jnp.expand_dims(safe, ax), axis=ax).squeeze(ax)
+            smooth = -jnp.mean(logp, axis=ax)
+            loss = (1 - label_smoothing) * nll + label_smoothing * smooth
+        else:
+            loss = -jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, ax), axis=ax).squeeze(ax)
+        loss = jnp.where(valid, loss, 0.0)
+        if w is not None:
+            wc = jnp.where(valid, jnp.take(w.astype(jnp.float32), safe), 0.0)
+            loss = loss * wc
+            return _reduce(loss, reduction,
+                           jnp.sum(wc) if reduction == "mean" else None)
+        if reduction == "mean":
+            n_valid = jnp.sum(valid.astype(jnp.float32))
+            return jnp.sum(loss) / jnp.maximum(n_valid, 1.0)
+        return _reduce(loss, reduction)
+
+    return apply("cross_entropy", impl, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as _softmax
+    from ... import ops
+    loss = ops.unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    args = [input, label] + ([weight] if weight is not None else [])
+
+    def impl(logp, lab, *w):
+        w = w[0] if w else None
+        idx = lab.astype(jnp.int32)
+        valid = idx != ignore_index
+        safe = jnp.where(valid, idx, 0)
+        loss = -jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        loss = jnp.where(valid, loss, 0.0)
+        if w is not None:
+            wc = jnp.where(valid, jnp.take(w, safe), 0.0)
+            loss = loss * wc
+            return _reduce(loss, reduction,
+                           jnp.sum(wc) if reduction == "mean" else None)
+        if reduction == "mean":
+            n_valid = jnp.sum(valid.astype(jnp.float32))
+            return jnp.sum(loss) / jnp.maximum(n_valid, 1.0)
+        return _reduce(loss, reduction)
+
+    return apply("nll_loss", impl, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply("mse_loss",
+                 lambda a, b: _reduce(jnp.square(a - b), reduction),
+                 input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply("l1_loss",
+                 lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                 input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def impl(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle multiplies by delta (huber parametrization)
+        return _reduce(loss * delta, reduction)
+
+    return apply("smooth_l1_loss", impl, input, label)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    args = [input, label] + ([weight] if weight is not None else [])
+
+    def impl(p, y, *w):
+        p32 = jnp.clip(p.astype(jnp.float32), 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p32) + (1 - y) * jnp.log1p(-p32))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    return apply("binary_cross_entropy", impl, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    args = [logit, label]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        args.append(weight)
+    if has_pw:
+        args.append(pos_weight)
+
+    def impl(z, y, *rest):
+        z32 = z.astype(jnp.float32)
+        y32 = y.astype(jnp.float32)
+        i = 0
+        w = rest[i] if has_w else None
+        if has_w:
+            i += 1
+        pw = rest[i] if has_pw else None
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), pos_weight scales +term
+        log1pexp = jnp.logaddexp(0.0, -jnp.abs(z32))
+        if pw is not None:
+            coeff = (pw - 1.0) * y32 + 1.0
+            loss = (1 - y32) * z32 + coeff * (
+                jnp.logaddexp(0.0, -z32))
+        else:
+            loss = jnp.maximum(z32, 0) - z32 * y32 + log1pexp
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return apply("bce_with_logits", impl, *args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def impl(logp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - logp)
+        else:
+            y32 = y.astype(jnp.float32)
+            loss = jnp.where(y32 > 0, y32 * (jnp.log(jnp.maximum(y32, 1e-37))
+                                             - logp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / loss.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply("kl_div", impl, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def impl(a, b, y):
+        loss = jnp.maximum(-y * (a - b) + margin, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply("margin_ranking_loss", impl, input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def impl(x, y):
+        loss = jnp.where(y == 1.0, x, jnp.maximum(0.0, margin - x))
+        return _reduce(loss, reduction)
+
+    return apply("hinge_embedding_loss", impl, input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    def impl(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply("cosine_embedding_loss", impl, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def impl(a, pos, neg):
+        d_ap = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + epsilon, p),
+                                 axis=-1), 1.0 / p)
+        d_an = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + epsilon, p),
+                                 axis=-1), 1.0 / p)
+        if swap:
+            d_pn = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg) + epsilon,
+                                               p), axis=-1), 1.0 / p)
+            d_an = jnp.minimum(d_an, d_pn)
+        loss = jnp.maximum(d_ap - d_an + margin, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply("triplet_margin_loss", impl, input, positive, negative)
+
+
+def square_error_cost(input, label):
+    return apply("square_error_cost", lambda a, b: jnp.square(a - b),
+                 input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+
+    def impl(z, y, *n):
+        z32, y32 = z.astype(jnp.float32), y.astype(jnp.float32)
+        p = jax.nn.sigmoid(z32)
+        ce = jnp.maximum(z32, 0) - z32 * y32 + jnp.logaddexp(0.0, -jnp.abs(z32))
+        p_t = p * y32 + (1 - p) * (1 - y32)
+        a_t = alpha * y32 + (1 - alpha) * (1 - y32)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+
+    return apply("sigmoid_focal_loss", impl, *args)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def impl(p, y):
+        p32 = p.astype(jnp.float32)
+        return -(y * jnp.log(p32 + epsilon) +
+                 (1 - y) * jnp.log(1 - p32 + epsilon))
+
+    return apply("log_loss", impl, input, label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard dynamic program in log space (lax.scan over
+    time). Reference: warpctc binding (``paddle/phi/kernels/gpu/
+    warpctc_kernel.cu``); here it's pure XLA so it runs on TPU."""
+    args = [log_probs, labels, input_lengths, label_lengths]
+
+    def impl(lp, lab, in_len, lab_len):
+        # lp: [T, B, C] logits (paddle convention); normalize to log-probs
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        ext = 2 * S + 1
+        NEG = -1e30
+        # extended label seq: blank l1 blank l2 ... blank
+        ext_lab = jnp.full((B, ext), blank, dtype=jnp.int32)
+        ext_lab = ext_lab.at[:, 1::2].set(lab.astype(jnp.int32))
+        same_as_prev2 = jnp.concatenate(
+            [jnp.zeros((B, 2), bool),
+             ext_lab[:, 2:] == ext_lab[:, :-2]], axis=1)
+        is_blank = ext_lab == blank
+
+        alpha0 = jnp.full((B, ext), NEG)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.take_along_axis(lp[0], ext_lab[:, 1:2], axis=1)[:, 0])
+
+        def step(alpha, lp_t):
+            shift1 = jnp.concatenate(
+                [jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+            shift2 = jnp.concatenate(
+                [jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+            allow2 = (~is_blank) & (~same_as_prev2)
+            merged = jnp.logaddexp(alpha, shift1)
+            merged = jnp.where(allow2, jnp.logaddexp(merged, shift2), merged)
+            emit = jnp.take_along_axis(lp_t, ext_lab, axis=1)
+            return merged + emit, merged + emit
+
+        _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T,B,ext]
+        t_idx = (in_len.astype(jnp.int32) - 1)
+        last = jnp.take_along_axis(
+            alphas, t_idx[None, :, None].repeat(ext, 2), axis=0)[0]
+        end1 = 2 * lab_len.astype(jnp.int32)      # final blank
+        end2 = 2 * lab_len.astype(jnp.int32) - 1  # final label
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(last, end1[:, None], axis=1)[:, 0],
+            jnp.take_along_axis(last, jnp.maximum(end2, 0)[:, None],
+                                axis=1)[:, 0])
+        loss = -ll
+        if norm_by_times:
+            loss = loss / in_len.astype(jnp.float32)
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(
+                lab_len.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+
+    return apply("ctc_loss", impl, *args)

@@ -1,0 +1,225 @@
+"""Pooling functionals over ``jax.lax.reduce_window``.
+
+Analog of ``python/paddle/nn/functional/pooling.py`` (reference; kernels
+``paddle/phi/kernels/funcs/pooling.h``). One XLA reduce_window primitive
+covers max/avg 1d/2d/3d; adaptive pools compute per-output windows.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply
+from .conv import _tuplize, _norm_padding
+
+
+def _spatial_axes(nd, channel_last, ndim):
+    if channel_last:
+        return list(range(1, 1 + nd))
+    return list(range(ndim - nd, ndim))
+
+
+def _window(nd, ndim, channel_last, sizes):
+    w = [1] * ndim
+    for ax, s in zip(_spatial_axes(nd, channel_last, ndim), sizes):
+        w[ax] = s
+    return tuple(w)
+
+
+def _pool(name, x, nd, kernel_size, stride, padding, ceil_mode, data_format,
+          kind, exclusive=True, divisor_override=None):
+    ks = _tuplize(kernel_size, nd)
+    st = _tuplize(stride if stride is not None else kernel_size, nd)
+    channel_last = data_format.endswith("C")
+    pad = _norm_padding(padding, nd, st, (1,) * nd, ks)
+    if pad == "SAME":
+        pads = "SAME"
+    else:
+        if ceil_mode:
+            # extend the high side so that ceil-division windows fit
+            pads = []
+            spatial = (x.shape[1:1 + nd] if channel_last
+                       else x.shape[x.ndim - nd:])
+            for i in range(nd):
+                size = spatial[i] + pad[i][0] + pad[i][1]
+                out_ceil = -(-(size - ks[i]) // st[i]) + 1
+                needed = (out_ceil - 1) * st[i] + ks[i] - size
+                pads.append((pad[i][0], pad[i][1] + max(0, needed)))
+        else:
+            pads = list(pad)
+
+    def impl(v):
+        ndim = v.ndim
+        win = _window(nd, ndim, channel_last, ks)
+        strd = _window(nd, ndim, channel_last, st)
+        if pads == "SAME":
+            padcfg = "SAME"
+        else:
+            padcfg = [(0, 0)] * ndim
+            for ax, p in zip(_spatial_axes(nd, channel_last, ndim), pads):
+                padcfg[ax] = p
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) \
+                else jnp.iinfo(v.dtype).min
+            return jax.lax.reduce_window(
+                v, jnp.asarray(init, v.dtype), jax.lax.max, win, strd, padcfg)
+        s = jax.lax.reduce_window(
+            v, jnp.asarray(0, v.dtype), jax.lax.add, win, strd, padcfg)
+        if divisor_override:
+            return s / divisor_override
+        if exclusive and padcfg != "SAME":
+            ones = jnp.ones(v.shape, v.dtype)
+            cnt = jax.lax.reduce_window(
+                ones, jnp.asarray(0, v.dtype), jax.lax.add, win, strd, padcfg)
+            return s / cnt
+        return s / float(np.prod(ks))
+
+    return apply(name, impl, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    out = _pool("max_pool1d", x, 1, kernel_size, stride, padding, ceil_mode,
+                fmt, "max")
+    if return_mask:
+        return out, _pool_mask(x, out, 1, kernel_size, stride, padding, fmt)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool("max_pool2d", x, 2, kernel_size, stride, padding, ceil_mode,
+                data_format, "max")
+    if return_mask:
+        return out, _pool_mask(x, out, 2, kernel_size, stride, padding,
+                               data_format)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool("max_pool3d", x, 3, kernel_size, stride, padding, ceil_mode,
+                data_format, "max")
+    if return_mask:
+        return out, _pool_mask(x, out, 3, kernel_size, stride, padding,
+                               data_format)
+    return out
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _pool("avg_pool1d", x, 1, kernel_size, stride, padding, ceil_mode,
+                 fmt, "avg", exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool("avg_pool2d", x, 2, kernel_size, stride, padding, ceil_mode,
+                 data_format, "avg", exclusive=exclusive,
+                 divisor_override=divisor_override)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool("avg_pool3d", x, 3, kernel_size, stride, padding, ceil_mode,
+                 data_format, "avg", exclusive=exclusive,
+                 divisor_override=divisor_override)
+
+
+def _pool_mask(x, out, nd, kernel_size, stride, padding, data_format):
+    """Index mask for return_mask=True (flat spatial index of each max)."""
+    from ... import ops
+    # recompute via patches: rarely used; correctness over speed.
+    raise NotImplementedError(
+        "return_mask=True is not supported on the TPU backend yet")
+
+
+def _adaptive_windows(in_size, out_size):
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = -(-((np.arange(out_size) + 1) * in_size) // out_size)
+    return starts, ends
+
+
+def _adaptive_pool(name, x, nd, output_size, data_format, kind):
+    channel_last = data_format.endswith("C")
+    out_sizes = _tuplize(output_size, nd)
+    in_ndim = x.ndim
+
+    def impl(v):
+        axes = _spatial_axes(nd, channel_last, in_ndim)
+        out_sz = [v.shape[a] if o is None else int(o)
+                  for a, o in zip(axes, out_sizes)]
+        # uniform-window fast path: reduces to plain pooling
+        if all(v.shape[a] % o == 0 for a, o in zip(axes, out_sz)):
+            ks = [v.shape[a] // o for a, o in zip(axes, out_sz)]
+            win = _window(nd, in_ndim, channel_last, ks)
+            if kind == "max":
+                init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) \
+                    else jnp.iinfo(v.dtype).min
+                return jax.lax.reduce_window(
+                    v, jnp.asarray(init, v.dtype), jax.lax.max, win, win,
+                    [(0, 0)] * in_ndim)
+            s = jax.lax.reduce_window(
+                v, jnp.asarray(0, v.dtype), jax.lax.add, win, win,
+                [(0, 0)] * in_ndim)
+            return s / float(np.prod(ks))
+        # general path: gather per-output windows axis by axis
+        out = v
+        for a, o in zip(axes, out_sz):
+            starts, ends = _adaptive_windows(out.shape[a], o)
+            pieces = []
+            for s0, e0 in zip(starts, ends):
+                sl = [slice(None)] * out.ndim
+                sl[a] = slice(int(s0), int(e0))
+                seg = out[tuple(sl)]
+                red = (jnp.max if kind == "max" else jnp.mean)(
+                    seg, axis=a, keepdims=True)
+                pieces.append(red)
+            out = jnp.concatenate(pieces, axis=a)
+        return out
+
+    return apply(name, impl, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool("adaptive_avg_pool1d", x, 1, output_size, "NCW",
+                          "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool("adaptive_avg_pool2d", x, 2, output_size,
+                          data_format, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool("adaptive_avg_pool3d", x, 3, output_size,
+                          data_format, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool("adaptive_max_pool1d", x, 1, output_size, "NCW",
+                         "max")
+    if return_mask:
+        raise NotImplementedError("return_mask on TPU backend")
+    return out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool("adaptive_max_pool2d", x, 2, output_size, "NCHW",
+                         "max")
+    if return_mask:
+        raise NotImplementedError("return_mask on TPU backend")
+    return out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool("adaptive_max_pool3d", x, 3, output_size, "NCDHW",
+                         "max")
+    if return_mask:
+        raise NotImplementedError("return_mask on TPU backend")
+    return out

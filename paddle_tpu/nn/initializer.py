@@ -1,0 +1,199 @@
+"""paddle_tpu.nn.initializer — parameter initializers.
+
+Capability analog of ``python/paddle/nn/initializer/`` (reference: constant,
+normal, uniform, xavier, kaiming, truncated normal...). TPU-native: each
+initializer is a callable ``(shape, dtype) -> jnp.ndarray`` drawing from the
+framework's global functional PRNG (``core.state.default_rng``), so seeding
+via ``paddle_tpu.seed`` makes init deterministic.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import state
+
+
+def _fan_in_out(shape):
+    shape = tuple(int(s) for s in shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # Linear weights are [in, out] in paddle convention.
+        return shape[0], shape[1]
+    # Conv weights [out_c, in_c, *k] (paddle convention).
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=jnp.float32):
+        k = state.default_rng.next_key()
+        return (self.mean + self.std *
+                jax.random.normal(k, shape, jnp.float32)).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype=jnp.float32):
+        k = state.default_rng.next_key()
+        x = jax.random.truncated_normal(k, self.a, self.b, shape, jnp.float32)
+        return (self.mean + self.std * x).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=jnp.float32):
+        k = state.default_rng.next_key()
+        return jax.random.uniform(
+            k, shape, jnp.float32, self.low, self.high).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return Normal(0.0, std)(shape, dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return Uniform(-limit, limit)(shape, dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = (math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+                if self.nonlinearity == "leaky_relu" else math.sqrt(2.0))
+        return Normal(0.0, gain / math.sqrt(fi))(shape, dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = (math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+                if self.nonlinearity == "leaky_relu" else math.sqrt(2.0))
+        limit = gain * math.sqrt(3.0 / fi)
+        return Uniform(-limit, limit)(shape, dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32):
+        arr = jnp.asarray(np.asarray(self.value), dtype=dtype)
+        assert tuple(arr.shape) == tuple(shape), (
+            f"Assign initializer shape {arr.shape} != parameter shape {shape}")
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        k = state.default_rng.next_key()
+        return (self.gain * jax.random.orthogonal(
+            k, int(shape[-1]), tuple(shape[:-1]))).astype(dtype)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (reference nn/initializer/dirac.py)."""
+
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=jnp.float32):
+        out_c, in_c = int(shape[0]), int(shape[1])
+        kernel = [int(s) for s in shape[2:]]
+        w = np.zeros(tuple(shape), dtype=np.float32)
+        per = out_c // self.groups
+        center = tuple(k // 2 for k in kernel)
+        for o in range(out_c):
+            i = o % per
+            if i < in_c:
+                w[(o, i) + center] = 1.0
+        return jnp.asarray(w, dtype=dtype)
+
+
+# paddle-compatible default: XavierUniform-like "default" is actually
+# Uniform(-sqrt(1/fan_in)) for Linear/Conv in paddle (GlorotUniform for some).
+def _default_weight_init(shape, dtype=jnp.float32):
+    return XavierUniform()(shape, dtype)
+
+
+def calculate_gain(nonlinearity, param=None):
+    recommended = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "tanh": 5.0 / 3, "relu": math.sqrt(2.0),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity == "leaky_relu":
+        slope = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + slope ** 2))
+    if nonlinearity in recommended:
+        return recommended[nonlinearity]
+    raise ValueError(f"unsupported nonlinearity {nonlinearity!r}")
+
+
+def to_initializer(x):
+    """Coerce user input (None | Initializer | number | array | bool) into an
+    Initializer. ``False`` means "no parameter" and is handled by callers."""
+    if x is None:
+        return None
+    if isinstance(x, Initializer):
+        return x
+    if isinstance(x, (int, float)):
+        return Constant(float(x))
+    return Assign(x)

@@ -1,1 +1,9 @@
+"""paddle_tpu.optimizer — optimizers + LR schedulers.
 
+Analog of ``python/paddle/optimizer/`` (reference ``optimizer.py:103``).
+"""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta,
+    RMSProp, Lamb, LBFGS, L1Decay, L2Decay,
+)

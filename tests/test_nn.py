@@ -1,0 +1,243 @@
+"""nn.Layer / layers / functional tests.
+
+Mirrors the reference test strategy (SURVEY §4): numpy-reference forward
+checks + numeric gradient spot checks, run on the virtual CPU backend.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_matches_numpy():
+    np.random.seed(0)
+    x = np.random.randn(4, 8).astype("float32")
+    l = nn.Linear(8, 3)
+    out = l(pt.to_tensor(x))
+    w = np.asarray(l.weight.numpy())
+    b = np.asarray(l.bias.numpy())
+    np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+
+
+def test_conv2d_matches_scipy_style():
+    x = np.random.randn(1, 2, 5, 5).astype("float32")
+    conv = nn.Conv2D(2, 3, 3, padding=1)
+    y = conv(pt.to_tensor(x))
+    assert y.shape == [1, 3, 5, 5]
+    # identity kernel check
+    w = np.zeros((2, 2, 3, 3), dtype="float32")
+    w[0, 0, 1, 1] = 1.0
+    w[1, 1, 1, 1] = 1.0
+    out = F.conv2d(pt.to_tensor(x), pt.to_tensor(w), None, 1, 1)
+    np.testing.assert_allclose(out.numpy(), x, rtol=1e-5)
+
+
+def test_conv2d_grad():
+    x = pt.randn([2, 3, 8, 8])
+    x.stop_gradient = False
+    conv = nn.Conv2D(3, 4, 3)
+    loss = conv(x).sum()
+    loss.backward()
+    assert x.grad.shape == [2, 3, 8, 8]
+    assert conv.weight.grad.shape == [4, 3, 3, 3]
+
+
+def test_conv_transpose_shape_inverts_conv():
+    x = pt.randn([1, 4, 10, 10])
+    down = nn.Conv2D(4, 8, 3, stride=2, padding=1)
+    up = nn.Conv2DTranspose(8, 4, 3, stride=2, padding=1, output_padding=1)
+    y = down(x)
+    z = up(y)
+    assert z.shape == [1, 4, 10, 10]
+
+
+def test_pools():
+    x = pt.to_tensor(np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    mp = F.max_pool2d(x, 2, 2)
+    np.testing.assert_allclose(
+        mp.numpy().ravel(), [5, 7, 13, 15])
+    ap = F.avg_pool2d(x, 2, 2)
+    np.testing.assert_allclose(
+        ap.numpy().ravel(), [2.5, 4.5, 10.5, 12.5])
+    aap = F.adaptive_avg_pool2d(x, 1)
+    np.testing.assert_allclose(aap.numpy().ravel(), [7.5])
+
+
+def test_batch_norm_train_and_eval():
+    bn = nn.BatchNorm2D(3)
+    x = pt.randn([8, 3, 4, 4])
+    y = bn(x)
+    m = y.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == y.shape
+
+
+def test_layer_norm_matches_numpy():
+    x = np.random.randn(2, 3, 8).astype("float32")
+    ln = nn.LayerNorm(8)
+    y = ln(pt.to_tensor(x)).numpy()
+    ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+        x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_rms_norm():
+    x = np.random.randn(2, 8).astype("float32")
+    rn = nn.RMSNorm(8)
+    y = rn(pt.to_tensor(x)).numpy()
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_cross_entropy_matches_numpy():
+    logits = np.random.randn(5, 7).astype("float32")
+    labels = np.random.randint(0, 7, 5)
+    loss = F.cross_entropy(pt.to_tensor(logits),
+                           pt.to_tensor(labels.astype("int64")))
+    # numpy reference
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(5), labels]).mean()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index_and_soft():
+    logits = np.random.randn(6, 4).astype("float32")
+    labels = np.array([0, 1, -100, 3, -100, 2])
+    loss = F.cross_entropy(pt.to_tensor(logits),
+                           pt.to_tensor(labels.astype("int64")),
+                           ignore_index=-100)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    valid = labels != -100
+    ref = -np.log(p[valid, labels[valid]]).mean()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+    # soft labels
+    soft = np.random.dirichlet(np.ones(4), 6).astype("float32")
+    loss2 = F.cross_entropy(pt.to_tensor(logits), pt.to_tensor(soft),
+                            soft_label=True)
+    ref2 = -(soft * np.log(p)).sum(-1).mean()
+    np.testing.assert_allclose(float(loss2), ref2, rtol=1e-5)
+
+
+def test_dropout_train_eval():
+    x = pt.ones([1000])
+    y = F.dropout(x, 0.5, training=True)
+    # inverted dropout preserves expectation
+    assert abs(float(y.numpy().mean()) - 1.0) < 0.2
+    y2 = F.dropout(x, 0.5, training=False)
+    np.testing.assert_allclose(y2.numpy(), np.ones(1000))
+
+
+def test_activations():
+    x = np.linspace(-3, 3, 13).astype("float32")
+    t = pt.to_tensor(x)
+    np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+    np.testing.assert_allclose(F.sigmoid(t).numpy(), 1 / (1 + np.exp(-x)),
+                               rtol=1e-5)
+    np.testing.assert_allclose(F.silu(t).numpy(), x / (1 + np.exp(-x)),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        F.softmax(t).numpy(),
+        np.exp(x - x.max()) / np.exp(x - x.max()).sum(), rtol=1e-5)
+
+
+def test_embedding_and_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = pt.to_tensor(np.array([[1, 0, 3]], dtype="int64"))
+    out = emb(idx)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+    # gradient flows to weight
+    loss = out.sum()
+    loss.backward()
+    assert emb.weight.grad is not None
+
+
+def test_state_dict_roundtrip():
+    m1 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict(m1.state_dict())
+    x = pt.randn([3, 4])
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_sequential_container_ops():
+    m = nn.Sequential(nn.Linear(4, 4), nn.ReLU())
+    assert len(m) == 2
+    assert isinstance(m[0], nn.Linear)
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(list(ll.parameters())) == 8
+
+
+def test_hooks():
+    l = nn.Linear(2, 2)
+    calls = []
+    h = l.register_forward_post_hook(
+        lambda layer, inp, out: calls.append(1))
+    l(pt.randn([1, 2]))
+    assert calls == [1]
+    h.remove()
+    l(pt.randn([1, 2]))
+    assert calls == [1]
+
+
+def test_multihead_attention_and_transformer():
+    mha = nn.MultiHeadAttention(16, 4)
+    q = pt.randn([2, 5, 16])
+    out = mha(q, q, q)
+    assert out.shape == [2, 5, 16]
+    enc = nn.TransformerEncoder(
+        nn.TransformerEncoderLayer(16, 4, 32), 2)
+    y = enc(q)
+    assert y.shape == [2, 5, 16]
+
+
+def test_sdpa_causal_matches_manual():
+    np.random.seed(1)
+    q = np.random.randn(1, 4, 2, 8).astype("float32")
+    out = F.scaled_dot_product_attention(
+        pt.to_tensor(q), pt.to_tensor(q), pt.to_tensor(q), is_causal=True)
+    # manual reference
+    qt = q.transpose(0, 2, 1, 3)  # b h s d
+    logits = qt @ qt.transpose(0, 1, 3, 2) / np.sqrt(8)
+    mask = np.tril(np.ones((4, 4))) > 0
+    logits = np.where(mask, logits, -np.inf)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = (p @ qt).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    from paddle_tpu.nn import ClipGradByGlobalNorm
+    p = pt.Parameter(np.ones(4, dtype="float32"))
+    g = pt.to_tensor(np.full(4, 10.0, dtype="float32"))
+    clip = ClipGradByGlobalNorm(1.0)
+    (_, g2), = clip([(p, g)])
+    np.testing.assert_allclose(
+        np.linalg.norm(g2.numpy()), 1.0, rtol=1e-5)
+
+
+def test_interpolate():
+    x = pt.to_tensor(np.arange(4, dtype="float32").reshape(1, 1, 2, 2))
+    y = F.interpolate(x, size=[4, 4], mode="nearest")
+    assert y.shape == [1, 1, 4, 4]
+    y2 = F.interpolate(x, scale_factor=2, mode="bilinear")
+    assert y2.shape == [1, 1, 4, 4]
+
+
+def test_pad_modes():
+    x = pt.to_tensor(np.arange(9, dtype="float32").reshape(1, 1, 3, 3))
+    y = F.pad(x, [1, 1, 1, 1])
+    assert y.shape == [1, 1, 5, 5]
+    y2 = F.pad(x, [1, 1, 1, 1], mode="reflect")
+    assert y2.shape == [1, 1, 5, 5]

@@ -1,0 +1,185 @@
+"""Optimizer + LR scheduler + AMP tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import optimizer as opt
+
+
+def _quadratic_steps(optimizer_factory, n=50):
+    """Minimize ||w - 3||^2 and return final w."""
+    w = pt.Parameter(np.zeros(4, dtype="float32"))
+    o = optimizer_factory([w])
+    for _ in range(n):
+        loss = ((w - 3.0) ** 2).sum()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    return w.numpy()
+
+
+def test_sgd_converges():
+    w = _quadratic_steps(lambda ps: opt.SGD(0.1, parameters=ps), 100)
+    np.testing.assert_allclose(w, np.full(4, 3.0), atol=1e-3)
+
+
+def test_momentum_converges():
+    w = _quadratic_steps(lambda ps: opt.Momentum(0.05, 0.9, parameters=ps),
+                         100)
+    np.testing.assert_allclose(w, np.full(4, 3.0), atol=5e-2)
+
+
+def test_adam_converges():
+    w = _quadratic_steps(lambda ps: opt.Adam(0.3, parameters=ps), 100)
+    np.testing.assert_allclose(w, np.full(4, 3.0), atol=1e-2)
+
+
+def test_adamw_decay_shrinks_weights():
+    w = pt.Parameter(np.full(4, 5.0, dtype="float32"))
+    o = opt.AdamW(learning_rate=0.0, weight_decay=0.1, parameters=[w])
+    w.grad = pt.zeros([4])
+    o.step()
+    # lr=0 -> only decay path, which multiplies by (1 - lr*coeff) = 1
+    np.testing.assert_allclose(w.numpy(), np.full(4, 5.0))
+    o2 = opt.AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[w])
+    w.grad = pt.zeros([4])
+    o2.step()
+    assert (w.numpy() < 5.0).all()
+
+
+def test_adam_matches_reference_formula():
+    w0 = np.array([1.0, -2.0], dtype="float32")
+    g = np.array([0.5, 0.3], dtype="float32")
+    w = pt.Parameter(w0.copy())
+    o = opt.Adam(learning_rate=0.01, parameters=[w])
+    w.grad = pt.to_tensor(g.copy())
+    o.step()
+    m = 0.1 * g
+    v = 0.001 * g * g
+    m_hat = m / (1 - 0.9)
+    v_hat = v / (1 - 0.999)
+    ref = w0 - 0.01 * m_hat / (np.sqrt(v_hat) + 1e-8)
+    np.testing.assert_allclose(w.numpy(), ref, rtol=1e-5)
+
+
+def test_multi_precision_master_weights():
+    w = pt.Parameter(np.full(4, 1.0, dtype="float32"))
+    w._write(w._read().astype("bfloat16"))
+    o = opt.SGD(0.001, parameters=[w], multi_precision=True)
+    for _ in range(10):
+        w.grad = pt.to_tensor(np.full(4, 0.01, dtype="float32"))
+        o.step()
+    # 10 tiny steps accumulate exactly in the fp32 master copy
+    master = o._master_weights[id(w)]
+    np.testing.assert_allclose(np.asarray(master), np.full(4, 0.9999),
+                               rtol=1e-5)
+
+
+def test_optimizer_state_dict_roundtrip():
+    w = pt.Parameter(np.ones(3, dtype="float32"), name="w")
+    o = opt.Adam(0.1, parameters=[w])
+    w.grad = pt.ones([3])
+    o.step()
+    sd = o.state_dict()
+    o2 = opt.Adam(0.1, parameters=[w])
+    o2.set_state_dict(sd)
+    assert o2._step_count == 1
+    np.testing.assert_allclose(
+        np.asarray(o2._accumulators["moment1"][id(w)]),
+        np.asarray(o._accumulators["moment1"][id(w)]))
+
+
+def test_lr_schedulers():
+    from paddle_tpu.optimizer.lr import (
+        CosineAnnealingDecay, LinearWarmup, MultiStepDecay, NoamDecay,
+        PiecewiseDecay, PolynomialDecay, StepDecay)
+    s = StepDecay(0.1, step_size=2, gamma=0.5)
+    lrs = []
+    for _ in range(5):
+        lrs.append(s())
+        s.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+    w = LinearWarmup(0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+    vals = []
+    for _ in range(5):
+        vals.append(w())
+        w.step()
+    np.testing.assert_allclose(vals[:4], [0.0, 0.025, 0.05, 0.075])
+    c = CosineAnnealingDecay(1.0, T_max=10)
+    assert abs(c() - 1.0) < 1e-6
+    p = PiecewiseDecay([3, 6], [0.1, 0.01, 0.001])
+    assert p() == 0.1
+
+
+def test_scheduler_drives_optimizer():
+    from paddle_tpu.optimizer.lr import StepDecay
+    sched = StepDecay(0.1, step_size=1, gamma=0.1)
+    w = pt.Parameter(np.zeros(1, dtype="float32"))
+    o = opt.SGD(sched, parameters=[w])
+    w.grad = pt.ones([1])
+    o.step()
+    np.testing.assert_allclose(w.numpy(), [-0.1], rtol=1e-6)
+    sched.step()
+    w.grad = pt.ones([1])
+    o.step()
+    np.testing.assert_allclose(w.numpy(), [-0.11], rtol=1e-5)
+
+
+def test_grad_clip_in_optimizer():
+    from paddle_tpu.nn import ClipGradByGlobalNorm
+    w = pt.Parameter(np.zeros(4, dtype="float32"))
+    o = opt.SGD(1.0, parameters=[w], grad_clip=ClipGradByGlobalNorm(1.0))
+    w.grad = pt.to_tensor(np.full(4, 100.0, dtype="float32"))
+    o.step()
+    np.testing.assert_allclose(np.linalg.norm(w.numpy()), 1.0, rtol=1e-4)
+
+
+def test_amp_auto_cast_o1():
+    import paddle_tpu.amp as amp
+    x = pt.randn([4, 4])
+    y = pt.randn([4, 4])
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        z = pt.matmul(x, y)
+        assert str(z.dtype) == "bfloat16"
+        s = F.softmax(z)  # black list -> fp32
+        assert str(s.dtype) == "float32"
+    z2 = pt.matmul(x, y)
+    assert str(z2.dtype) == "float32"
+
+
+def test_amp_grad_scaler_fp16_flow():
+    import paddle_tpu.amp as amp
+    w = pt.Parameter(np.ones(2, dtype="float32"))
+    o = opt.SGD(0.1, parameters=[w])
+    scaler = amp.GradScaler(init_loss_scaling=1024.0)
+    loss = (w * 2.0).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    # grad should be 2*1024 before unscale
+    np.testing.assert_allclose(w.grad.numpy(), [2048.0, 2048.0])
+    scaler.step(o)
+    np.testing.assert_allclose(w.numpy(), [0.8, 0.8], rtol=1e-6)
+
+
+def test_grad_scaler_skips_on_inf():
+    import paddle_tpu.amp as amp
+    w = pt.Parameter(np.ones(2, dtype="float32"))
+    o = opt.SGD(0.1, parameters=[w])
+    scaler = amp.GradScaler(init_loss_scaling=1024.0)
+    w.grad = pt.to_tensor(np.array([np.inf, 1.0], dtype="float32"))
+    scaler.step(o)
+    np.testing.assert_allclose(w.numpy(), [1.0, 1.0])  # step skipped
+    assert scaler._scale == 512.0  # scale halved
+
+
+def test_amp_decorate_o2():
+    import paddle_tpu.amp as amp
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.BatchNorm1D(8))
+    o = opt.Adam(0.1, parameters=model.parameters())
+    model, o = amp.decorate(model, o, level="O2", dtype="bfloat16")
+    assert str(model[0].weight.dtype) == "bfloat16"
+    # norm layers stay fp32
+    assert str(model[2].weight.dtype) == "float32"
+    assert o._multi_precision
