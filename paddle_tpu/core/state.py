@@ -80,24 +80,35 @@ def set_grad_enabled(enabled: bool) -> bool:
 
 
 # --- global RNG (paddle.seed analog). Functional JAX PRNG under the hood:
-# a mutable key that is split on every draw. ---
+# a mutable key that is split on every draw. The key lives in a Tensor and is
+# read/written through the capture funnel, so a jit-captured train step
+# threads the RNG state as a real input/output instead of baking a constant
+# (the reference reaches the same end with stateful curand generators +
+# seed/offset capture in CUDA graphs, SURVEY C30). ---
 class _RNG:
     def __init__(self):
-        self._key = None
+        self._key_var = None
         self._seed = 0
 
     def seed(self, s: int):
         import jax
+        from .tensor import Tensor
 
         self._seed = int(s)
-        self._key = jax.random.PRNGKey(self._seed)
+        key = jax.random.key_data(jax.random.PRNGKey(self._seed))
+        if self._key_var is None:
+            self._key_var = Tensor(key)
+        else:
+            self._key_var._write(key)
 
     def next_key(self):
         import jax
 
-        if self._key is None:
+        if self._key_var is None:
             self.seed(0)
-        self._key, sub = jax.random.split(self._key)
+        key = jax.random.wrap_key_data(self._key_var._read())
+        new_key, sub = jax.random.split(key)
+        self._key_var._write(jax.random.key_data(new_key))
         return sub
 
 

@@ -61,6 +61,8 @@ class Tensor:
         self._hooks: list = []
         self._retain_grad = False
         self.name = name
+        if _tracker is not None:
+            _tracker.on_create(self)
 
     # --- raw data access (all ops funnel through here; the jit capture
     # tracker hooks these, cf. SOT's eval-frame interception, SURVEY L9) ---
@@ -188,7 +190,9 @@ class Tensor:
         if self._grad is None:
             self._grad = Tensor(g, stop_gradient=True)
         else:
-            self._grad = Tensor(self._grad._data + g, stop_gradient=True)
+            self._grad = Tensor(self._grad._read() + g, stop_gradient=True)
+        if _tracker is not None:
+            _tracker.on_grad_write(self)
 
     def register_hook(self, hook):
         self._hooks.append(hook)

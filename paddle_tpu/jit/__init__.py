@@ -1,5 +1,428 @@
-def to_static(fn=None, **kw):
-    # placeholder; real trace-and-compile lands with the jit module
-    if fn is None:
-        return lambda f: f
+"""paddle_tpu.jit — whole-step compilation of eager code.
+
+Capability analog of the reference dy2static stack (SURVEY L9:
+``paddle.jit.to_static`` ``python/paddle/jit/api.py:135``; the SOT bytecode
+tracer ``jit/sot/``; compile cache ``symbolic/compile_cache.py``) — but
+TPU-native in mechanism: instead of bytecode simulation producing a
+StatementIR that feeds a ProgramDesc executor, we *capture* the eager
+tape-level reads/writes of framework state while re-running the function
+under ``jax.jit`` tracing, producing one fused XLA program per input
+signature. Graph breaks (data-dependent Python control flow) fall back to
+eager, mirroring SOT's fallback semantics.
+
+How it works (see also ``core/tensor.py`` ``_tracker``):
+1. Discovery pass — the function runs eagerly once (this *is* step 0) while
+   a tracker records: which pre-existing Tensors are read (program inputs:
+   params, optimizer state, RNG key, batch args), which are written
+   (state outputs: updated params/moments/BN stats/RNG), and which tensors
+   the function returns.
+2. A pure function over (input values) -> (explicit outputs + state outputs)
+   is wrapped in ``jax.jit`` with state inputs donated (in-place update on
+   TPU HBM, the analog of the reference's inplace address reuse in
+   ``inplace_pass.cc``).
+3. Cached invocations read the current values of the captured input tensors,
+   run the compiled program, and write state outputs back — no Python op
+   dispatch at all in steady state.
+"""
+from __future__ import annotations
+
+import logging
+import warnings
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import state
+from ..core import tensor as tensor_mod
+from ..core.tensor import Tensor
+
+logger = logging.getLogger("paddle_tpu.jit")
+
+
+def _tree_signature(obj):
+    """Cache key component for one argument."""
+    if isinstance(obj, Tensor):
+        d = obj._data
+        return ("T", tuple(d.shape), str(d.dtype))
+    from ..nn import Layer
+    if isinstance(obj, Layer):
+        # train/eval flips change the traced program (dropout, BN): guard on
+        # the mode vector (the analog of SOT's guard system)
+        return ("L", id(obj), obj.training,
+                tuple(l.training for l in obj.sublayers()))
+    if isinstance(obj, (list, tuple)):
+        return (type(obj).__name__,
+                tuple(_tree_signature(o) for o in obj))
+    if isinstance(obj, dict):
+        return ("d", tuple(sorted(
+            (k, _tree_signature(v)) for k, v in obj.items())))
+    if isinstance(obj, (np.ndarray, jax.Array)):
+        return ("A", tuple(obj.shape), str(obj.dtype))
+    return ("c", obj if isinstance(obj, (int, float, str, bool,
+                                         type(None))) else str(obj))
+
+
+def _flatten_tensors(obj, out):
+    if isinstance(obj, Tensor):
+        out.append(obj)
+    elif isinstance(obj, (list, tuple)):
+        for o in obj:
+            _flatten_tensors(o, out)
+    elif isinstance(obj, dict):
+        for k in sorted(obj):
+            _flatten_tensors(obj[k], out)
+    return out
+
+
+class GraphBreak(Exception):
+    pass
+
+
+def _scrub_leaked_tracers(discovery):
+    """Replay re-executes the function, so the tape may assign tracer-backed
+    grad Tensors onto real (pre-existing) tensors. Drop any such leftovers —
+    the compiled program returns grads explicitly via grad_out_owners."""
+    seen = list(discovery.inputs) + list(discovery.written.values()) + \
+        list(discovery.grad_owners.values())
+    for t in seen:
+        g = t._grad
+        if g is not None and isinstance(g._data, jax.core.Tracer):
+            t._grad = None
+        if t._node is not None:
+            t._node = None
+
+
+class _DiscoveryTracker:
+    """Concrete-value pass: classifies tensors into inputs/state/fresh while
+    the function executes for real (step 0)."""
+
+    def __init__(self):
+        self.inputs: list[Tensor] = []      # pre-existing, read
+        self.input_ids: set[int] = set()
+        self.written: dict[int, Tensor] = {}  # pre-existing, written
+        self.fresh: set[int] = set()        # created during capture
+        self.grad_owners: dict[int, Tensor] = {}
+        self.host_syncs: list[Callable] = []
+
+    def on_create(self, t):
+        self.fresh.add(id(t))
+
+    def on_read(self, t):
+        tid = id(t)
+        if tid not in self.fresh and tid not in self.input_ids:
+            self.input_ids.add(tid)
+            self.inputs.append(t)
+        return t._data
+
+    def on_write(self, t, val):
+        tid = id(t)
+        if tid in self.fresh:
+            # A tensor created during capture but mutated through the state
+            # funnel is persistent state born lazily on step 0 (e.g.
+            # optimizer accumulators): promote it to a real program
+            # input/output so later steps thread it instead of re-creating.
+            self.fresh.discard(tid)
+            self.input_ids.add(tid)
+            self.inputs.append(t)
+        self.written[tid] = t
+        t._data = val
+
+    def on_grad_write(self, t):
+        if id(t) not in self.fresh:
+            self.grad_owners[id(t)] = t
+
+    def add_host_sync(self, fn):
+        self.host_syncs.append(fn)
+
+
+class _ReplayTracker:
+    """Tracing pass: substitutes jax tracers for the discovered inputs."""
+
+    def __init__(self, input_ids_to_pos, vals):
+        self.pos = input_ids_to_pos
+        self.vals = vals
+        self.env: dict[int, Any] = {}
+        self.fresh: set[int] = set()
+        self.grad_owners: dict[int, Tensor] = {}
+
+    def on_create(self, t):
+        self.fresh.add(id(t))
+
+    def on_read(self, t):
+        tid = id(t)
+        if tid in self.env:
+            return self.env[tid]
+        if tid in self.pos:
+            return self.vals[self.pos[tid]]
+        if tid in self.fresh:
+            return t._data
+        # Tensor not seen during discovery (nondeterministic structure)
+        raise GraphBreak(
+            "tensor read not seen during discovery (op structure is "
+            "nondeterministic across calls)")
+
+    def on_write(self, t, val):
+        self.env[id(t)] = val
+
+    def on_grad_write(self, t):
+        if id(t) not in self.fresh:
+            self.grad_owners[id(t)] = t
+
+    def add_host_sync(self, fn):
+        pass  # collected once, during discovery
+
+
+class _Executable:
+    """One compiled specialization (per input signature). Holds strong refs
+    to the captured state tensors (params/opt state/RNG) — the analog of the
+    reference partial program's persistable-var scope."""
+
+    def __init__(self, fn, discovery, ret_rebuild, n_ret):
+        self.fn = fn
+        self.discovery = discovery
+        self.compiled = None
+        self.capt_state: list[Tensor] = []
+        self.state_out_tensors: list[Tensor] = []
+        self.grad_out_owners: list[Tensor] = []
+        self.ret_rebuild = ret_rebuild
+        self.n_ret = n_ret
+
+    def build(self, arg_tensors, call_args, call_kwargs):
+        d = self.discovery
+        arg_pos = {id(t): i for i, t in enumerate(arg_tensors)}
+        self.capt_state = [t for t in d.inputs if id(t) not in arg_pos]
+        ordered = list(arg_tensors) + self.capt_state
+        pos = {id(t): i for i, t in enumerate(ordered)}
+
+        # mutated explicit-arg tensors are written back BY POSITION to the
+        # tensors of the *current* call, not the step-0 objects
+        written = [t for t in d.written.values() if id(t) not in arg_pos]
+        self.arg_out_pos = [arg_pos[id(t)] for t in d.written.values()
+                            if id(t) in arg_pos]
+        written_args = [t for t in d.written.values() if id(t) in arg_pos]
+        grad_owners = list(d.grad_owners.values())
+        self.state_out_tensors = written
+        self.grad_out_owners = grad_owners
+        fn = self.fn
+
+        def pure(*vals):
+            tr = _ReplayTracker(pos, vals)
+            old = tensor_mod.set_tracker(tr)
+            try:
+                out = fn(*call_args, **call_kwargs)
+            finally:
+                tensor_mod.set_tracker(old)
+            ret_vals = []
+            for t in _flatten_tensors(out, []):
+                ret_vals.append(tr.env.get(id(t), t._data))
+            state_vals = [tr.env.get(id(t), t._data) for t in written]
+            arg_vals = [tr.env.get(id(t), t._data) for t in written_args]
+            grad_vals = []
+            for t in grad_owners:
+                g = t._grad
+                grad_vals.append(g._data if g is not None
+                                 else jnp.zeros_like(t._data))
+            return (tuple(ret_vals) + tuple(state_vals) + tuple(arg_vals) +
+                    tuple(grad_vals))
+
+        # donate captured-state inputs that are also outputs (HBM buffer
+        # reuse — the analog of the reference inplace_pass). Explicit args
+        # are never donated: the caller still owns those buffers.
+        written_ids = {id(t) for t in written}
+        n_args = len(arg_tensors)
+        donate = tuple(i for i, t in enumerate(ordered)
+                       if i >= n_args and id(t) in written_ids)
+        self.compiled = jax.jit(pure, donate_argnums=donate)
+        # force tracing now so failures surface at capture time
+        try:
+            self.compiled.lower(*[t._data for t in ordered])
+        finally:
+            _scrub_leaked_tracers(d)
+
+    def __call__(self, arg_tensors):
+        for sync in self.discovery.host_syncs:
+            sync()
+        vals = [t._read() for t in arg_tensors] + \
+            [t._read() for t in self.capt_state]
+        outs = self.compiled(*vals)
+        n_ret = self.n_ret
+        n_state = len(self.state_out_tensors)
+        ret_vals = outs[:n_ret]
+        state_vals = outs[n_ret:n_ret + n_state]
+        grad_vals = outs[n_ret + n_state:]
+        for t, v in zip(self.state_out_tensors, state_vals):
+            t._data = v
+            t._node = None
+        for t, v in zip(self.grad_out_owners, grad_vals):
+            t._grad = Tensor(v, stop_gradient=True)
+        return self.ret_rebuild([Tensor(v) for v in ret_vals])
+
+
+def _make_rebuilder(out):
+    """fn(list_of_ret_tensors) -> structure shaped like ``out``."""
+    if isinstance(out, Tensor):
+        return lambda ts: ts[0]
+    if isinstance(out, (list, tuple)):
+        typ = type(out)
+
+        def rebuild(ts, _out=out, _typ=typ):
+            res, i = [], 0
+            for o in _out:
+                if isinstance(o, Tensor):
+                    res.append(ts[i])
+                    i += 1
+                else:
+                    res.append(o)
+            return _typ(res)
+        return rebuild
+    if isinstance(out, dict):
+        def rebuild_d(ts, _out=out):
+            res, i = {}, 0
+            for k in _out:
+                if isinstance(_out[k], Tensor):
+                    res[k] = ts[i]
+                    i += 1
+                else:
+                    res[k] = _out[k]
+            return res
+        return rebuild_d
+    return lambda ts, _out=out: _out
+
+
+class StaticFunction:
+    """Analog of ``SymbolicStaticFunction``
+    (reference ``jit/dy2static/program_translator.py:708``)."""
+
+    def __init__(self, fn, build_strategy=None, backend=None,
+                 full_graph=False):
+        self.fn = fn
+        self._cache: dict[Any, _Executable] = {}
+        self._fallback_keys: set = set()
+        self._full_graph = full_graph
+        self.__name__ = getattr(fn, "__name__", "static_fn")
+
+    def __get__(self, instance, owner):
+        # bound-method support for @to_static on Layer methods
+        import functools
+        if instance is None:
+            return self
+        bound = functools.partial(self.__call__, instance)
+        bound.__wrapped__ = self
+        return bound
+
+    def _cache_key(self, args, kwargs):
+        from .. import amp
+        a = amp.amp_state()
+        return (tuple(_tree_signature(x) for x in args),
+                tuple(sorted((k, _tree_signature(v))
+                             for k, v in kwargs.items())),
+                a.enabled, str(a.dtype), a.level,
+                state.is_grad_enabled())
+
+    def __call__(self, *args, **kwargs):
+        if tensor_mod._tracker is not None:
+            # nested to_static: inline into the outer capture
+            return self.fn(*args, **kwargs)
+        try:
+            key = self._cache_key(args, kwargs)
+        except Exception:
+            return self.fn(*args, **kwargs)
+        if key in self._fallback_keys:
+            return self.fn(*args, **kwargs)
+        exe = self._cache.get(key)
+        arg_tensors = _flatten_tensors((list(args), kwargs), [])
+        if exe is not None:
+            return exe(arg_tensors)
+        return self._capture(key, args, kwargs, arg_tensors)
+
+    def _capture(self, key, args, kwargs, arg_tensors):
+        d = _DiscoveryTracker()
+        old = tensor_mod.set_tracker(d)
+        try:
+            out = self.fn(*args, **kwargs)
+        finally:
+            tensor_mod.set_tracker(old)
+        ret_tensors = _flatten_tensors(out, [])
+        exe = _Executable(self.fn, d, _make_rebuilder(out),
+                          len(ret_tensors))
+        try:
+            exe.build(arg_tensors, args, kwargs)
+        except Exception as e:  # trace failed -> permanent eager fallback
+            if self._full_graph:
+                raise
+            warnings.warn(
+                f"to_static: eager fallback for {self.__name__} "
+                f"({type(e).__name__}: {e})")
+            self._fallback_keys.add(key)
+            return out
+        self._cache[key] = exe
+        return out  # discovery pass already produced step-0 results
+
+    def concrete_program(self, *args, **kwargs):
+        return self._cache.get(self._cache_key(args, kwargs))
+
+    @property
+    def code(self):
+        import inspect
+        try:
+            return inspect.getsource(self.fn)
+        except OSError:
+            return "<source unavailable>"
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=False, **kwargs):
+    """``paddle.jit.to_static`` analog (reference ``jit/api.py:135``)."""
+    def deco(fn):
+        if isinstance(fn, StaticFunction):
+            return fn
+        import functools
+        sf = StaticFunction(fn, build_strategy, backend, full_graph)
+        functools.update_wrapper(sf, fn, updated=[])
+        return sf
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn):
+    fn._pdtpu_not_to_static = True
     return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+def enable_to_static(flag):
+    pass
+
+
+class BuildStrategy:
+    """Compatibility shim (reference CompiledProgram BuildStrategy); XLA owns
+    all the fusion/inlining decisions these flags used to toggle."""
+
+    def __init__(self):
+        self.build_cinn_pass = False
+        self.enable_inplace = True
+
+
+# --- save / load (inference export) ---------------------------------------
+def save(layer, path, input_spec=None, **config):
+    """``paddle.jit.save`` analog (reference ``jit/api.py:744``): exports
+    state dict now; StableHLO program export lands with the inference
+    engine."""
+    from .. import framework as fw
+    from ..nn import Layer
+    if isinstance(layer, Layer):
+        fw.save(layer.state_dict(), path + ".pdparams")
+    else:
+        raise TypeError("jit.save expects a Layer")
+
+
+def load(path, **config):
+    from .. import framework as fw
+    return fw.load(path + ".pdparams")

@@ -59,10 +59,16 @@ class Optimizer:
         assert grad_clip is None or isinstance(grad_clip, ClipGradBase)
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
-        self._accumulators: dict[str, dict[int, jnp.ndarray]] = {}
-        self._master_weights: dict[int, jnp.ndarray] = {}
+        self._accumulators: dict[str, dict[int, Tensor]] = {}
+        self._master_weights: dict[int, Tensor] = {}
         self._step_count = 0
         self._aux_state: dict = {}
+        # 0-d device scalar holding the current LR: under jit capture it is
+        # threaded as an input (synced from the scheduler host-side before
+        # each compiled invocation), so LR changes don't retrigger tracing.
+        # Created here, not lazily — it must pre-exist any capture so the
+        # tracker classifies it as an input rather than a temporary.
+        self._lr_var = Tensor(jnp.float32(self.get_lr()))
 
     # --- lr -------------------------------------------------------------
     def get_lr(self):
@@ -79,19 +85,20 @@ class Optimizer:
     def set_lr_scheduler(self, scheduler):
         self._learning_rate = scheduler
 
-    # --- accumulators ---------------------------------------------------
+    # --- accumulators (state lives in Tensors so jit capture threads it
+    # through the compiled step as inputs/outputs) ------------------------
     def _acc(self, name, p, init=None, dtype=None):
         store = self._accumulators.setdefault(name, {})
         pid = id(p)
         if pid not in store:
             v = p._read()
             dt = dtype or (jnp.float32 if self._use_master(p) else v.dtype)
-            store[pid] = (jnp.zeros(v.shape, dt) if init is None
-                          else jnp.full(v.shape, init, dt))
-        return store[pid]
+            store[pid] = Tensor(jnp.zeros(v.shape, dt) if init is None
+                                else jnp.full(v.shape, init, dt))
+        return store[pid]._read()
 
     def _set_acc(self, name, p, val):
-        self._accumulators[name][id(p)] = val
+        self._accumulators[name][id(p)]._write(val)
 
     def _use_master(self, p):
         return self._multi_precision and p._read().dtype in (
@@ -100,8 +107,9 @@ class Optimizer:
     def _get_master(self, p):
         pid = id(p)
         if pid not in self._master_weights:
-            self._master_weights[pid] = p._read().astype(jnp.float32)
-        return self._master_weights[pid]
+            self._master_weights[pid] = Tensor(
+                p._read().astype(jnp.float32))
+        return self._master_weights[pid]._read()
 
     # --- step -----------------------------------------------------------
     def _collect(self):
@@ -128,12 +136,24 @@ class Optimizer:
             return g32 + reg.coeff * jnp.sign(master)
         return g32
 
+    def _live_lr(self):
+        """Current LR as a traceable value. Under capture, reads the
+        persistent lr scalar (a real program input) and registers a host-side
+        sync so the scheduler's value is fed in before every invocation."""
+        from ..core import tensor as _tm
+        tr = _tm._tracker
+        if tr is None:
+            return self.get_lr()
+        tr.add_host_sync(
+            lambda: self._lr_var._write(jnp.float32(self.get_lr())))
+        return self._lr_var._read()
+
     def step(self):
         self._step_count += 1
         pairs = self._collect()
         if self._grad_clip is not None:
             pairs = self._grad_clip(pairs)
-        lr = self.get_lr()
+        lr = self._live_lr()
         for p, g in pairs:
             lr_p = lr * p.optimize_attr.get("learning_rate", 1.0) \
                 if hasattr(p, "optimize_attr") else lr
@@ -142,7 +162,7 @@ class Optimizer:
             if self._use_master(p):
                 master = self._get_master(p)
                 new_master = self._update(p, master, g32, lr_p)
-                self._master_weights[id(p)] = new_master
+                self._master_weights[id(p)]._write(new_master)
                 p._write(new_master.astype(p._read().dtype))
             else:
                 v = p._read()
@@ -168,10 +188,10 @@ class Optimizer:
         for acc_name, store in self._accumulators.items():
             for pid, val in store.items():
                 if pid in names:
-                    sd[f"{names[pid]}.{acc_name}"] = Tensor(val)
+                    sd[f"{names[pid]}.{acc_name}"] = Tensor(val._read())
         for pid, val in self._master_weights.items():
             if pid in names:
-                sd[f"{names[pid]}.master_weight"] = Tensor(val)
+                sd[f"{names[pid]}.master_weight"] = Tensor(val._read())
         if isinstance(self._learning_rate, LRScheduler):
             sd["LR_Scheduler"] = self._learning_rate.state_dict()
         sd["@step"] = self._step_count
@@ -194,9 +214,9 @@ class Optimizer:
             arr = val._read() if isinstance(val, Tensor) else \
                 jnp.asarray(np.asarray(val))
             if acc == "master_weight":
-                self._master_weights[id(p)] = arr
+                self._master_weights[id(p)] = Tensor(arr)
             else:
-                self._accumulators.setdefault(acc, {})[id(p)] = arr
+                self._accumulators.setdefault(acc, {})[id(p)] = Tensor(arr)
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):

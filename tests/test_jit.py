@@ -1,0 +1,181 @@
+"""to_static capture/compile engine tests (jit module)."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import optimizer as opt
+
+
+def _data(n=16, din=8, nclass=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return (pt.to_tensor(rng.randn(n, din).astype("float32")),
+            pt.to_tensor(rng.randint(0, nclass, n).astype("int64")))
+
+
+def test_jit_matches_eager_training():
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    optim = opt.Adam(1e-2, parameters=model.parameters())
+
+    @pt.jit.to_static(full_graph=True)
+    def step(x, y):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+        return loss
+
+    x, y = _data()
+    jit_losses = [float(step(x, y)) for _ in range(10)]
+    assert len(step._cache) == 1
+
+    pt.seed(0)
+    model2 = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    optim2 = opt.Adam(1e-2, parameters=model2.parameters())
+    eager_losses = []
+    for _ in range(10):
+        loss = F.cross_entropy(model2(x), y)
+        loss.backward()
+        optim2.step()
+        optim2.clear_grad()
+        eager_losses.append(float(loss))
+    np.testing.assert_allclose(jit_losses, eager_losses, rtol=2e-3,
+                               atol=1e-6)
+
+
+def test_jit_cache_per_shape():
+    model = nn.Linear(4, 2)
+
+    @pt.jit.to_static(full_graph=True)
+    def fwd(x):
+        return model(x)
+
+    fwd(pt.randn([2, 4]))
+    fwd(pt.randn([2, 4]))
+    assert len(fwd._cache) == 1
+    fwd(pt.randn([8, 4]))
+    assert len(fwd._cache) == 2
+
+
+def test_jit_graph_break_falls_back():
+    @pt.jit.to_static
+    def fn(x):
+        if float(x.sum()) > 0:  # data-dependent python branch
+            return x * 2
+        return x * 3
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        a = fn(pt.ones([2]))
+        b = fn(pt.ones([2]))
+    np.testing.assert_allclose(a.numpy(), [2, 2])
+    np.testing.assert_allclose(b.numpy(), [2, 2])
+    assert fn._fallback_keys
+
+
+def test_jit_rng_threads_through():
+    """Dropout must produce different masks on each compiled call."""
+    pt.seed(0)
+
+    @pt.jit.to_static(full_graph=True)
+    def f(x):
+        return F.dropout(x, 0.5, training=True)
+
+    x = pt.ones([64])
+    a = f(x).numpy()
+    b = f(x).numpy()
+    c = f(x).numpy()
+    assert not np.allclose(a, b) or not np.allclose(b, c)
+
+
+def test_jit_lr_schedule_feeds_compiled_step():
+    from paddle_tpu.optimizer.lr import StepDecay
+    sched = StepDecay(0.1, step_size=1, gamma=0.1)
+    w = pt.Parameter(np.zeros(1, dtype="float32"))
+    o = opt.SGD(sched, parameters=[w])
+
+    @pt.jit.to_static(full_graph=True)
+    def step():
+        loss = (w * 1.0).sum()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+
+    step()
+    np.testing.assert_allclose(w.numpy(), [-0.1], rtol=1e-6)
+    sched.step()
+    step()  # compiled path must see the NEW lr 0.01
+    np.testing.assert_allclose(w.numpy(), [-0.11], rtol=1e-5)
+    assert len(step._cache) == 1  # no recompilation for the lr change
+
+
+def test_jit_train_eval_guard():
+    model = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+
+    @pt.jit.to_static(full_graph=True)
+    def fwd(m, x):
+        return m(x)
+
+    x = pt.ones([4, 4])
+    fwd(model, x)
+    model.eval()
+    out1 = fwd(model, x).numpy()
+    out2 = fwd(model, x).numpy()
+    np.testing.assert_allclose(out1, out2)  # eval: no dropout
+    assert len(fwd._cache) == 2  # train + eval specializations
+
+
+def test_jit_bn_stats_update():
+    bn = nn.BatchNorm1D(4)
+
+    @pt.jit.to_static(full_graph=True)
+    def fwd(x):
+        return bn(x)
+
+    x = pt.randn([32, 4])
+    m0 = bn._mean.numpy().copy()
+    fwd(x)
+    m1 = bn._mean.numpy().copy()
+    fwd(x)
+    m2 = bn._mean.numpy().copy()
+    assert not np.allclose(m0, m1)
+    assert not np.allclose(m1, m2)  # stats keep moving on compiled calls
+
+
+def test_jit_multiple_outputs_and_nontensor():
+    @pt.jit.to_static(full_graph=True)
+    def f(x):
+        return x + 1, x * 2, "tag"
+
+    a, b, tag = f(pt.ones([3]))
+    np.testing.assert_allclose(a.numpy(), [2, 2, 2])
+    np.testing.assert_allclose(b.numpy(), [2, 2, 2])
+    assert tag == "tag"
+    a, b, tag = f(pt.zeros([3]))
+    np.testing.assert_allclose(a.numpy(), [1, 1, 1])
+    assert tag == "tag"
+
+
+def test_jit_amp_step():
+    import paddle_tpu.amp as amp
+    pt.seed(3)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    optim = opt.AdamW(1e-2, parameters=model.parameters())
+
+    @pt.jit.to_static(full_graph=True)
+    def step(x, y):
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+        return loss
+
+    x, y = _data(seed=3)
+    losses = [float(step(x, y)) for _ in range(15)]
+    assert losses[-1] < losses[0]
