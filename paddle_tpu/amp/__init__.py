@@ -220,7 +220,10 @@ class GradScaler:
         self._unscaled = False
 
     def minimize(self, optimizer, scaled_loss):
-        scaled_loss.backward()
+        # The documented idiom is ``scaler.scale(loss).backward();
+        # scaler.minimize(opt, scaled)`` — backward has already run, so only
+        # unscale + conditional step here (reference grad_scaler.py:202 does
+        # the same: minimize never re-runs autodiff).
         self.step(optimizer)
 
     def update(self):

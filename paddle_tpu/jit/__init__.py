@@ -188,6 +188,7 @@ class _Executable:
         self.grad_out_owners: list[Tensor] = []
         self.ret_rebuild = ret_rebuild
         self.n_ret = n_ret
+        self.arg_out_pos: list[int] = []
 
     def build(self, arg_tensors, call_args, call_kwargs):
         d = self.discovery
@@ -249,12 +250,19 @@ class _Executable:
         outs = self.compiled(*vals)
         n_ret = self.n_ret
         n_state = len(self.state_out_tensors)
+        n_arg_out = len(self.arg_out_pos)
         ret_vals = outs[:n_ret]
         state_vals = outs[n_ret:n_ret + n_state]
-        grad_vals = outs[n_ret + n_state:]
+        arg_vals = outs[n_ret + n_state:n_ret + n_state + n_arg_out]
+        grad_vals = outs[n_ret + n_state + n_arg_out:]
         for t, v in zip(self.state_out_tensors, state_vals):
             t._data = v
             t._node = None
+        # mutated explicit-arg tensors: write back positionally onto the
+        # tensors of THIS call (not the step-0 objects)
+        for pos, v in zip(self.arg_out_pos, arg_vals):
+            arg_tensors[pos]._data = v
+            arg_tensors[pos]._node = None
         for t, v in zip(self.grad_out_owners, grad_vals):
             t._grad = Tensor(v, stop_gradient=True)
         return self.ret_rebuild([Tensor(v) for v in ret_vals])

@@ -84,7 +84,8 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
     out = _pool("max_pool1d", x, 1, kernel_size, stride, padding, ceil_mode,
                 fmt, "max")
     if return_mask:
-        return out, _pool_mask(x, out, 1, kernel_size, stride, padding, fmt)
+        return out, _pool_mask(x, out, 1, kernel_size, stride, padding, fmt,
+                               ceil_mode)
     return out
 
 
@@ -94,7 +95,7 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                 data_format, "max")
     if return_mask:
         return out, _pool_mask(x, out, 2, kernel_size, stride, padding,
-                               data_format)
+                               data_format, ceil_mode)
     return out
 
 
@@ -104,7 +105,7 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                 data_format, "max")
     if return_mask:
         return out, _pool_mask(x, out, 3, kernel_size, stride, padding,
-                               data_format)
+                               data_format, ceil_mode)
     return out
 
 
@@ -131,12 +132,68 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                  divisor_override=divisor_override)
 
 
-def _pool_mask(x, out, nd, kernel_size, stride, padding, data_format):
-    """Index mask for return_mask=True (flat spatial index of each max)."""
-    from ... import ops
-    # recompute via patches: rarely used; correctness over speed.
-    raise NotImplementedError(
-        "return_mask=True is not supported on the TPU backend yet")
+def _pool_mask(x, out, nd, kernel_size, stride, padding, data_format,
+               ceil_mode=False):
+    """Flat-spatial argmax index per window for ``return_mask=True``
+    (reference ``max_pool2d_with_index``, kernels
+    ``paddle/phi/kernels/funcs/pooling.h``). TPU-native: one static slice per
+    kernel offset (K slices, K = prod(kernel)) + argmax over the stacked
+    candidates — static shapes, no gather loops."""
+    ks = _tuplize(kernel_size, nd)
+    st = _tuplize(stride if stride is not None else kernel_size, nd)
+    channel_last = data_format.endswith("C")
+    pad = _norm_padding(padding, nd, st, (1,) * nd, ks)
+    if pad == "SAME":
+        raise NotImplementedError("return_mask with SAME padding")
+
+    def impl(v):
+        ndim = v.ndim
+        axes = _spatial_axes(nd, channel_last, ndim)
+        spatial = [v.shape[a] for a in axes]
+        outsp = []
+        for i in range(nd):
+            size = spatial[i] + pad[i][0] + pad[i][1]
+            n = (size - ks[i]) // st[i] + 1
+            if ceil_mode:
+                n = -(-(size - ks[i]) // st[i]) + 1
+            outsp.append(n)
+        neg = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) \
+            else jnp.iinfo(v.dtype).min
+        padcfg = [(0, 0)] * ndim
+        hi_ext = [(outsp[i] - 1) * st[i] + ks[i] - spatial[i] - pad[i][0]
+                  for i in range(nd)]
+        for i, a in enumerate(axes):
+            padcfg[a] = (pad[i][0], max(0, hi_ext[i]))
+        vp = jnp.pad(v, padcfg, mode="constant", constant_values=neg)
+
+        import itertools
+        cands = []
+        for offs in itertools.product(*[range(k) for k in ks]):
+            sl = [slice(None)] * ndim
+            for i, a in enumerate(axes):
+                sl[a] = slice(offs[i], offs[i] + (outsp[i] - 1) * st[i] + 1,
+                              st[i])
+            cands.append(vp[tuple(sl)])
+        stacked = jnp.stack(cands, axis=0)      # [K, ...out...]
+        k_idx = jnp.argmax(stacked, axis=0)     # first max, paddle semantics
+
+        # decompose candidate id into per-axis kernel offsets, then map to
+        # flat index over the ORIGINAL (unpadded) spatial dims
+        flat = jnp.zeros_like(k_idx)
+        rem = k_idx
+        for i in range(nd):
+            kprod = int(np.prod(ks[i + 1:])) if i + 1 < nd else 1
+            off_i = rem // kprod
+            rem = rem % kprod
+            shape = [1] * k_idx.ndim
+            shape[axes[i]] = outsp[i]
+            base = (jnp.arange(outsp[i]) * st[i] - pad[i][0]).reshape(shape)
+            coord = base + off_i
+            sprod = int(np.prod(spatial[i + 1:])) if i + 1 < nd else 1
+            flat = flat + coord * sprod
+        return flat.astype(jnp.int32)
+
+    return apply("max_pool_mask", impl, x)
 
 
 def _adaptive_windows(in_size, out_size):

@@ -249,6 +249,28 @@ class _ConvNd(Layer):
             default_initializer=I.Uniform(-bound, bound)
             if bias_attr is None else None)
 
+    def _pm_input(self, x):
+        """Non-zero ``padding_mode`` (reflect/replicate/circular) is realised
+        by pre-padding the input with F.pad and running the conv unpadded
+        (XLA's conv only zero-pads)."""
+        if self._padding_mode == "zeros":
+            return x, self._padding
+        from .functional.conv import _norm_padding, _tuplize
+        nd = self._nd
+        pairs = _norm_padding(self._padding, nd, _tuplize(self._stride, nd),
+                              _tuplize(self._dilation, nd),
+                              self._kernel_size)
+        if pairs == "SAME":
+            raise ValueError(
+                "padding_mode != 'zeros' requires explicit integer padding, "
+                f"got {self._padding!r}")
+        flat = []
+        for lo, hi in reversed(pairs):  # innermost spatial axis first
+            flat += [lo, hi]
+        x = F.pad(x, flat, mode=self._padding_mode,
+                  data_format=self._data_format)
+        return x, 0
+
     def extra_repr(self):
         return (f"{self._in_channels}, {self._out_channels}, "
                 f"kernel_size={list(self._kernel_size)}, "
@@ -264,8 +286,9 @@ class Conv1D(_ConvNd):
                          weight_attr, bias_attr, data_format)
 
     def forward(self, x):
+        x, pad = self._pm_input(x)
         return F.conv1d(x, self.weight, self.bias, self._stride,
-                        self._padding, self._dilation, self._groups,
+                        pad, self._dilation, self._groups,
                         self._data_format)
 
 
@@ -278,8 +301,9 @@ class Conv2D(_ConvNd):
                          weight_attr, bias_attr, data_format)
 
     def forward(self, x):
+        x, pad = self._pm_input(x)
         return F.conv2d(x, self.weight, self.bias, self._stride,
-                        self._padding, self._dilation, self._groups,
+                        pad, self._dilation, self._groups,
                         self._data_format)
 
 
@@ -292,8 +316,9 @@ class Conv3D(_ConvNd):
                          weight_attr, bias_attr, data_format)
 
     def forward(self, x):
+        x, pad = self._pm_input(x)
         return F.conv3d(x, self.weight, self.bias, self._stride,
-                        self._padding, self._dilation, self._groups,
+                        pad, self._dilation, self._groups,
                         self._data_format)
 
 
@@ -521,12 +546,13 @@ class SpectralNorm(Layer):
 # pooling
 # --------------------------------------------------------------------------
 class _Pool(Layer):
-    _fn = None
-    _nd = 0
+    """Shared storage for pool layers; each subclass owns its __init__ so the
+    positional parameter order matches the reference exactly
+    (``python/paddle/nn/layer/pooling.py:79,185,284,388,498,598``)."""
 
-    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
-                 return_mask=False, data_format=None, name=None,
-                 exclusive=True, divisor_override=None):
+    def _store(self, kernel_size, stride, padding, ceil_mode=False,
+               return_mask=False, data_format=None, exclusive=True,
+               divisor_override=None):
         super().__init__()
         self.kernel_size = kernel_size
         self.stride = stride
@@ -539,47 +565,81 @@ class _Pool(Layer):
 
 
 class MaxPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, name=None):
+        self._store(kernel_size, stride, padding, ceil_mode=ceil_mode,
+                    return_mask=return_mask)
+
     def forward(self, x):
         return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
-                            self.return_mask, self.ceil_mode,
-                            self.data_format or "NCL")
+                            self.return_mask, self.ceil_mode, "NCL")
 
 
 class MaxPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, data_format="NCHW",
+                 name=None):
+        self._store(kernel_size, stride, padding, ceil_mode=ceil_mode,
+                    return_mask=return_mask, data_format=data_format)
+
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
                             self.return_mask, self.ceil_mode,
-                            self.data_format or "NCHW")
+                            self.data_format)
 
 
 class MaxPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, data_format="NCDHW",
+                 name=None):
+        self._store(kernel_size, stride, padding, ceil_mode=ceil_mode,
+                    return_mask=return_mask, data_format=data_format)
+
     def forward(self, x):
         return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
                             self.return_mask, self.ceil_mode,
-                            self.data_format or "NCDHW")
+                            self.data_format)
 
 
 class AvgPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, name=None):
+        self._store(kernel_size, stride, padding, ceil_mode=ceil_mode,
+                    exclusive=exclusive)
+
     def forward(self, x):
         return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding,
-                            self.exclusive, self.ceil_mode,
-                            self.data_format or "NCL")
+                            self.exclusive, self.ceil_mode, "NCL")
 
 
 class AvgPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCHW",
+                 name=None):
+        self._store(kernel_size, stride, padding, ceil_mode=ceil_mode,
+                    exclusive=exclusive, divisor_override=divisor_override,
+                    data_format=data_format)
+
     def forward(self, x):
         return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
                             self.ceil_mode, self.exclusive,
                             self.divisor_override,
-                            self.data_format or "NCHW")
+                            self.data_format)
 
 
 class AvgPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        self._store(kernel_size, stride, padding, ceil_mode=ceil_mode,
+                    exclusive=exclusive, divisor_override=divisor_override,
+                    data_format=data_format)
+
     def forward(self, x):
         return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
                             self.ceil_mode, self.exclusive,
                             self.divisor_override,
-                            self.data_format or "NCDHW")
+                            self.data_format)
 
 
 class AdaptiveAvgPool1D(Layer):

@@ -220,7 +220,13 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
-        loss.backward()
+        """Apply the update from gradients already on the parameters.
+
+        Matches the reference dygraph semantics (``optimizer.py`` minimize
+        collects existing ``p.grad`` pairs; it does NOT re-run autodiff), so
+        the canonical ``loss.backward(); opt.minimize(loss)`` idiom applies
+        each gradient exactly once.
+        """
         self.step()
         return None, None
 

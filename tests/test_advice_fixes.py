@@ -1,0 +1,117 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+
+
+def test_minimize_applies_gradient_once():
+    # canonical idiom: loss.backward(); opt.minimize(loss) must not
+    # double-accumulate (ADVICE high: minimize used to re-run backward)
+    lin = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    w0 = lin.weight.numpy().copy()
+    loss = lin(x).sum()
+    loss.backward()
+    g = lin.weight.grad.numpy().copy()
+    opt.minimize(loss)
+    np.testing.assert_allclose(lin.weight.numpy(), w0 - 0.1 * g, rtol=1e-6)
+
+
+def test_scaler_minimize_applies_gradient_once():
+    lin = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0,
+                                   use_dynamic_loss_scaling=False)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    w0 = lin.weight.numpy().copy()
+    loss = lin(x).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.minimize(opt, scaled)
+    # grad of sum(x @ w + b) wrt w is column-sums of x = 2.0 each
+    np.testing.assert_allclose(lin.weight.numpy(), w0 - 0.1 * 2.0,
+                               rtol=1e-5)
+
+
+def test_pool_positional_signature_matches_reference():
+    x = paddle.to_tensor(np.random.rand(1, 1, 6, 6).astype(np.float32))
+    # reference MaxPool2D order: kernel, stride, padding, RETURN_MASK, ...
+    out = nn.MaxPool2D(2, 2, 0, True)(x)
+    assert isinstance(out, (tuple, list)) and len(out) == 2  # (out, mask)
+    # reference AvgPool1D order: kernel, stride, padding, EXCLUSIVE
+    x1 = paddle.to_tensor(np.random.rand(1, 1, 6).astype(np.float32))
+    out1 = nn.AvgPool1D(2, 2, 0, True)(x1)
+    assert out1.shape == [1, 1, 3]
+    # ceil_mode still reachable by keyword
+    out2 = nn.MaxPool2D(2, 2, 0, ceil_mode=True)(
+        paddle.to_tensor(np.random.rand(1, 1, 5, 5).astype(np.float32)))
+    assert out2.shape == [1, 1, 3, 3]
+
+
+@pytest.mark.parametrize("mode,npmode", [("reflect", "reflect"),
+                                         ("replicate", "edge"),
+                                         ("circular", "wrap")])
+def test_conv2d_padding_mode(mode, npmode):
+    rng = np.random.RandomState(0)
+    x = rng.rand(1, 2, 5, 5).astype(np.float32)
+    conv = nn.Conv2D(2, 3, 3, padding=1, padding_mode=mode)
+    out = conv(paddle.to_tensor(x))
+    # reference semantics: pad input with the mode, then valid conv
+    xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)], mode=npmode)
+    conv_ref = nn.Conv2D(2, 3, 3, padding=0)
+    conv_ref.weight._write(conv.weight._read())
+    conv_ref.bias._write(conv.bias._read())
+    ref = conv_ref(paddle.to_tensor(xp))
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_jit_mutated_explicit_arg_written_back():
+    # ADVICE medium: a to_static fn that mutates an explicit-arg tensor must
+    # write the mutation back to the caller's tensor (per call), and grads
+    # must not be mis-offset.
+    @paddle.jit.to_static
+    def step(buf, x):
+        y = (x * 2.0).sum()
+        buf._adopt(buf + 1.0)
+        return y
+
+    buf = paddle.to_tensor(np.zeros((3,), np.float32))
+    x = paddle.to_tensor(np.ones((3,), np.float32))
+    r0 = step(buf, x)          # step 0: discovery (eager)
+    np.testing.assert_allclose(buf.numpy(), np.ones(3), rtol=1e-6)
+    r1 = step(buf, x)          # compiled path
+    np.testing.assert_allclose(buf.numpy(), 2 * np.ones(3), rtol=1e-6)
+    buf2 = paddle.to_tensor(np.full((3,), 10.0, np.float32))
+    step(buf2, x)              # mutation lands on THIS call's tensor
+    np.testing.assert_allclose(buf2.numpy(), np.full(3, 11.0), rtol=1e-6)
+    np.testing.assert_allclose(buf.numpy(), 2 * np.ones(3), rtol=1e-6)
+    assert float(r0) == float(r1) == 6.0
+
+
+def test_jit_arg_mutation_with_grads():
+    lin = nn.Linear(3, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.0,
+                               parameters=lin.parameters())
+
+    @paddle.jit.to_static
+    def step(counter, x):
+        loss = lin(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        counter._adopt(counter + 1.0)
+        return loss
+
+    counter = paddle.to_tensor(np.zeros((), np.float32))
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    step(counter, x)
+    step(counter, x)
+    step(counter, x)
+    assert float(counter) == 3.0
