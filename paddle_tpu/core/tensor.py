@@ -36,7 +36,7 @@ def set_tracker(tr):
 
 class Tensor:
     __slots__ = ("_data", "_stop_gradient", "_grad", "_node", "_hooks",
-                 "_retain_grad", "name", "__weakref__")
+                 "_retain_grad", "name", "_dist", "__weakref__")
 
     def __init__(self, data, dtype=None, place=None, stop_gradient=True,
                  name=None):
@@ -61,6 +61,7 @@ class Tensor:
         self._hooks: list = []
         self._retain_grad = False
         self.name = name
+        self._dist = None  # (ProcessMesh, placements) when distributed
         if _tracker is not None:
             _tracker.on_create(self)
 
@@ -95,6 +96,7 @@ class Tensor:
             ghost._hooks = []
             ghost._retain_grad = False
             ghost.name = None
+            ghost._dist = None
             if self._node is not None:
                 try:
                     i = self._node.out_ids.index(id(self))
@@ -161,6 +163,18 @@ class Tensor:
     @property
     def is_leaf(self):
         return self._node is None
+
+    # --- distributed metadata (DistTensor analog, SURVEY D6) -----------
+    @property
+    def process_mesh(self):
+        return self._dist[0] if self._dist is not None else None
+
+    @property
+    def placements(self):
+        return self._dist[1] if self._dist is not None else None
+
+    def is_dist(self):
+        return self._dist is not None
 
     @property
     def T(self):

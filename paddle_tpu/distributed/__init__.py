@@ -1,0 +1,42 @@
+"""paddle_tpu.distributed — TPU-native distributed stack.
+
+Mirrors ``paddle.distributed`` (SURVEY §2.2): rank-style collectives
+(D22/D1), process groups (D1/D3), DataParallel (D5), the semi-auto GSPMD
+API (D6/D7/D20), fleet hybrid-parallel orchestration (D13-D17), and
+distributed checkpoint (D23) — all lowered to XLA collectives over the
+device mesh instead of NCCL/TCPStore.
+"""
+from .collective import (  # noqa: F401
+    Group, new_group, get_group, destroy_process_group, is_initialized,
+)
+from .communication import (  # noqa: F401
+    ReduceOp, all_reduce, all_gather, broadcast, reduce, scatter, gather,
+    reduce_scatter, alltoall, alltoall_single, send, recv, isend, irecv,
+    P2POp, batch_isend_irecv, barrier, wait, get_backend,
+)
+from .parallel import (  # noqa: F401
+    ParallelEnv, init_parallel_env, get_rank, get_world_size, is_available,
+    DataParallel,
+)
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh, Shard, Replicate, Partial, Placement,
+    shard_tensor, dtensor_from_fn, reshard, shard_layer, shard_optimizer,
+    Strategy, get_mesh, set_mesh,
+)
+from .auto_parallel.api import shard_parameter, to_static  # noqa: F401
+
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+
+
+def get_world_process_group():
+    from .collective import _ensure_world
+    return _ensure_world()
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """Reference ``paddle.distributed.spawn``: under single-controller SPMD
+    there is nothing to spawn — the one process drives all chips. Runs
+    ``func`` directly (multi-host pods launch one process per host via the
+    launcher, not spawn)."""
+    return func(*args)

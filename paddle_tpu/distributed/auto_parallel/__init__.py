@@ -1,0 +1,5 @@
+from .api import (  # noqa: F401
+    ProcessMesh, Shard, Replicate, Partial, Placement,
+    shard_tensor, dtensor_from_fn, reshard, shard_layer, shard_optimizer,
+    to_static, Strategy, get_mesh, set_mesh,
+)

@@ -1,0 +1,97 @@
+"""Distributed checkpoint with cross-topology reshard on load.
+
+Capability analog of ``python/paddle/distributed/checkpoint/
+save_state_dict.py:104`` / ``load_state_dict.py:377`` (SURVEY D23). The
+reference writes one shard-file per rank plus a metadata manifest and
+reassembles/reshards on load. Single-controller TPU: the controller sees
+the global value of every dist tensor, so the checkpoint holds global
+arrays plus each tensor's sharding metadata; loading into a *different*
+mesh topology is a ``device_put`` onto the new sharding — XLA moves the
+bytes (the reference's cross-topology reshard engine collapses into that).
+
+For multi-host pods the same layout works per-process via
+``jax.experimental.multihost_utils`` gather; orbax-style per-shard zarr is
+a future optimization, not a semantic change.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+
+_META = "meta.pkl"
+_DATA = "data.npz"
+
+
+def _spec_to_meta(dist):
+    if dist is None:
+        return None
+    mesh, spec = dist
+    if hasattr(mesh, "jmesh"):  # ProcessMesh
+        names = list(mesh.dim_names)
+        shape = list(mesh.shape)
+        from ..auto_parallel.api import _to_partition_spec
+        spec = _to_partition_spec(mesh, spec) if isinstance(spec, list) \
+            else spec
+    else:  # raw jax Mesh
+        names = list(mesh.axis_names)
+        shape = [mesh.shape[n] for n in names]
+    entries = []
+    if isinstance(spec, P):
+        for e in spec:
+            if e is None:
+                entries.append(None)
+            elif isinstance(e, tuple):
+                entries.append(list(e))
+            else:
+                entries.append([e])
+    return {"axis_names": names, "mesh_shape": shape, "spec": entries}
+
+
+def save_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, **kwargs):
+    """Reference ``save_state_dict.py:104``."""
+    os.makedirs(path, exist_ok=True)
+    arrays, meta = {}, {}
+    for k, v in state_dict.items():
+        if isinstance(v, Tensor):
+            val = v._read()
+            arrays[k] = np.asarray(val)
+            meta[k] = _spec_to_meta(v._dist)
+        else:
+            arrays[k] = np.asarray(v)
+            meta[k] = None
+    np.savez(os.path.join(path, _DATA), **arrays)
+    with open(os.path.join(path, _META), "wb") as f:
+        pickle.dump(meta, f)
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, **kwargs):
+    """Reference ``load_state_dict.py:377``: fills ``state_dict``'s tensors
+    in place, resharding each value onto the tensor's *current* placement
+    (cross-topology restore). Tensors in the checkpoint but not in
+    ``state_dict`` are ignored, matching the reference's partial-load."""
+    data = np.load(os.path.join(path, _DATA))
+    for k, t in state_dict.items():
+        if k not in data.files:
+            raise KeyError(f"checkpoint {path} has no tensor '{k}'")
+        arr = data[k]
+        if isinstance(t, Tensor):
+            cur = t._read()
+            if not isinstance(cur, jax.core.Tracer):
+                # keep the destination topology's sharding
+                sharding = getattr(cur, "sharding", None)
+                val = jax.device_put(arr.astype(cur.dtype), sharding) \
+                    if sharding is not None else arr.astype(cur.dtype)
+                t._write(val)
+            else:
+                t._write(arr)
+        else:
+            state_dict[k] = arr
+    return state_dict

@@ -1,0 +1,131 @@
+"""Process groups over the TPU device mesh.
+
+Capability analog of the reference ProcessGroup stack (SURVEY D1/D3;
+``paddle/fluid/distributed/collective/process_group.h:47``,
+``python/paddle/distributed/collective.py:186`` ``new_group``) — TPU-native
+mechanism: there is no NCCL communicator and no TCPStore rendezvous. A
+*group* is a 1-axis ``jax.sharding.Mesh`` over a subset of devices; every
+collective lowers to an XLA collective (``psum``/``all_gather``/
+``ppermute``…) riding ICI, issued either eagerly through ``jax.shard_map``
+or fused into the surrounding jit program. Bootstrap is JAX's distributed
+runtime (coordination service) instead of TCPStore.
+
+Single-controller SPMD convention: one Python process drives all devices.
+A "rank" is a device index within the group. Tensors passed to the
+rank-style communication API (communication.py) carry an explicit leading
+rank axis of size ``group.nranks`` — the stack of the per-rank local
+tensors that a multi-process framework would hold separately.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_groups: dict[int, "Group"] = {}
+_next_gid = 0
+
+
+class Group:
+    """A communication group = an ordered list of devices + a 1-axis mesh.
+
+    Analog of reference ``python/paddle/distributed/communication/group.py``
+    Group (pg + ranks); here the "process group backend" is the XLA
+    collective compiler, keyed by the mesh axis name.
+    """
+
+    AXIS = "pg"  # every group's mesh uses this axis name
+
+    def __init__(self, gid: int, ranks: Sequence[int], devices):
+        self.id = gid
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        self.devices = list(devices)
+        self.mesh = Mesh(np.array(self.devices), (self.AXIS,))
+        self.name = f"pg_{gid}"
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, global_rank: int) -> int:
+        return self.ranks.index(global_rank) if global_rank in self.ranks else -1
+
+    @property
+    def process_group(self):  # reference API parity (returns backend handle)
+        return self
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks})"
+
+
+def _world_devices():
+    return list(jax.devices())
+
+
+def _ensure_world() -> Group:
+    if 0 not in _groups:
+        devs = _world_devices()
+        _groups[0] = Group(0, list(range(len(devs))), devs)
+        global _next_gid
+        _next_gid = max(_next_gid, 1)
+    return _groups[0]
+
+
+def get_group(gid: int = 0) -> Group:
+    """Reference ``collective.py`` ``_get_group_map``/``get_group`` analog."""
+    if gid == 0:
+        return _ensure_world()
+    if gid not in _groups:
+        raise ValueError(f"Group {gid} is not initialized by new_group")
+    return _groups[gid]
+
+
+def _get_default_group() -> Group:
+    return _ensure_world()
+
+
+def _resolve(group: Optional[Group]) -> Group:
+    if group is None:
+        return _ensure_world()
+    if isinstance(group, int):
+        return get_group(group)
+    return group
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend=None,
+              timeout=None) -> Group:
+    """Create a communication group over device indices ``ranks``.
+
+    Analog of ``python/paddle/distributed/collective.py:186``. The NCCL
+    communicator-init broadcast is replaced by mesh construction — XLA
+    materializes the communicator lazily at first collective compile.
+    """
+    global _next_gid
+    world = _ensure_world()
+    if ranks is None:
+        ranks = list(world.ranks)
+    ranks = sorted(ranks)
+    for r in ranks:
+        if r not in world.ranks:
+            raise ValueError(f"rank {r} not in world {world.ranks}")
+    devs = [world.devices[r] for r in ranks]
+    g = Group(_next_gid, ranks, devs)
+    _groups[g.id] = g
+    _next_gid += 1
+    return g
+
+
+def destroy_process_group(group: Optional[Group] = None):
+    """Reference ``collective.py`` analog; drops group bookkeeping."""
+    global _groups
+    if group is None:
+        _groups = {}
+    else:
+        _groups.pop(_resolve(group).id, None)
+
+
+def is_initialized() -> bool:
+    return 0 in _groups

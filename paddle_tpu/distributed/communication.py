@@ -1,0 +1,447 @@
+"""Rank-style communication API lowered to XLA collectives.
+
+Capability analog of ``paddle.distributed.{all_reduce, all_gather, …}``
+(SURVEY D22; reference ``python/paddle/distributed/communication/*.py``,
+C++ ``ProcessGroupNCCL`` D1). TPU-native mechanism: every call builds a
+tiny ``jax.shard_map`` program over the group's mesh axis and lets XLA
+emit the ICI collective (``psum``/``all_gather``/``all_to_all``/
+``ppermute``). Under ``jit.to_static`` capture these fuse into the
+surrounding XLA program — there is no separate comm stream to manage
+(PJRT schedules compute/collective overlap).
+
+Groups may be ``collective.Group`` (1-axis mesh over a device subset) or a
+``fleet.topology.AxisGroup`` (one axis of the hybrid mesh) — both expose
+``mesh``/``axis``/``nranks``.
+
+Single-controller convention (see collective.py): a per-rank local tensor
+of shape ``S`` is represented as one global Tensor of shape ``[nranks, *S]``
+whose slice ``r`` is rank ``r``'s copy, sharded over the group axis. All
+operations are in-place on that Tensor (reference semantics) and
+non-differentiable (collectives used inside model code — TP layers,
+sequence parallel — use the differentiable GSPMD layers instead).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .collective import Group, _resolve
+
+AXIS = Group.AXIS
+
+
+class ReduceOp:
+    """Reference ``paddle.distributed.ReduceOp`` parity."""
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+def _reduce_fn(op, ax):
+    if op == ReduceOp.SUM:
+        return lambda a: lax.psum(a, ax)
+    if op == ReduceOp.MAX:
+        return lambda a: lax.pmax(a, ax)
+    if op == ReduceOp.MIN:
+        return lambda a: lax.pmin(a, ax)
+    if op == ReduceOp.AVG:
+        return lambda a: lax.pmean(a, ax)
+    if op == ReduceOp.PROD:
+        # no pprod primitive: gather the factors and multiply (sign-safe,
+        # unlike the exp-of-psum-of-logs trick)
+        return lambda a: jnp.prod(
+            lax.all_gather(a, ax, axis=0, tiled=False), axis=0)
+    raise ValueError(f"unknown ReduceOp {op}")
+
+
+def _axis_of(g) -> str:
+    return getattr(g, "axis", AXIS)
+
+
+def _value(x):
+    if isinstance(x, Tensor):
+        return x._read()
+    return jnp.asarray(x)
+
+
+def _assign(t: Tensor, val):
+    """In-place, autograd-opaque write (collectives don't join the tape)."""
+    t._write(val)
+    t._node = None
+
+
+def _put(mesh, x, spec):
+    """Pin x to the group mesh sharding (no-op on tracers: inside a jit
+    trace the sharding is a constraint XLA already knows from shard_map)."""
+    if isinstance(x, jax.core.Tracer):
+        return x
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def _check_rank_axis(name, x, g):
+    if x.ndim == 0 or x.shape[0] != g.nranks:
+        raise ValueError(
+            f"{name}: expected leading rank axis of size {g.nranks} "
+            f"(single-controller convention: tensor = stack of per-rank "
+            f"local tensors), got shape {tuple(x.shape)}")
+
+
+def _group_rank(g, r: int, what: str) -> int:
+    """Map a global rank to its index within the group; reject ranks outside
+    the group (the reference raises likewise)."""
+    gr = g.get_group_rank(r) if hasattr(g, "get_group_rank") else (
+        r if 0 <= r < g.nranks else -1)
+    if gr < 0:
+        raise ValueError(f"{what}={r} is not a member of {g!r}")
+    return gr
+
+
+def _smap(g, body, x, in_spec=None, out_spec=None):
+    ax = _axis_of(g)
+    in_spec = P(ax) if in_spec is None else in_spec
+    out_spec = P(ax) if out_spec is None else out_spec
+    f = jax.shard_map(body, mesh=g.mesh, in_specs=in_spec,
+                      out_specs=out_spec)
+    return f(_put(g.mesh, x, in_spec if isinstance(in_spec, P) else P(ax)))
+
+
+# --- collectives -----------------------------------------------------------
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None,
+               sync_op: bool = True):
+    """Reference ``communication/all_reduce.py``; lowers to ``lax.psum``."""
+    g = _resolve(group)
+    ax = _axis_of(g)
+    x = _value(tensor)
+    _check_rank_axis("all_reduce", x, g)
+    if g.nranks == 1:
+        return tensor
+    y = _smap(g, lambda a: _reduce_fn(op, ax)(a), x)
+    _assign(tensor, y)
+    return tensor
+
+
+def all_gather(tensor_list: List, tensor: Tensor, group=None,
+               sync_op: bool = True):
+    """Reference ``communication/all_gather.py``: after the call,
+    ``tensor_list[i]`` holds rank i's tensor (replicated content, kept
+    sharded over the rank axis for HBM parity with the per-rank layout)."""
+    g = _resolve(group)
+    ax = _axis_of(g)
+    x = _value(tensor)
+    _check_rank_axis("all_gather", x, g)
+    n = g.nranks
+    if n == 1:
+        tensor_list.append(Tensor(x, stop_gradient=True))
+        return tensor_list
+
+    def body(a):  # a: [1, *S]
+        full = lax.all_gather(a, ax, axis=0, tiled=True)  # [n, *S]
+        return tuple(full[i:i + 1] for i in range(n))
+
+    outs = _smap(g, body, x, out_spec=tuple(P(ax) for _ in range(n)))
+    for o in outs:
+        tensor_list.append(Tensor(o, stop_gradient=True))
+    return tensor_list
+
+
+def broadcast(tensor: Tensor, src: int = 0, group=None,
+              sync_op: bool = True):
+    """Reference ``communication/broadcast.py``."""
+    g = _resolve(group)
+    ax = _axis_of(g)
+    x = _value(tensor)
+    _check_rank_axis("broadcast", x, g)
+    if g.nranks == 1:
+        return tensor
+    s = _group_rank(g, src, "src")
+
+    def body(a):
+        full = lax.all_gather(a, ax, axis=0, tiled=True)
+        return full[s:s + 1]
+
+    _assign(tensor, _smap(g, body, x))
+    return tensor
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group=None,
+           sync_op: bool = True):
+    """Reference ``communication/reduce.py``: result lands on rank ``dst``;
+    other ranks keep their input (reference leaves them unspecified)."""
+    g = _resolve(group)
+    ax = _axis_of(g)
+    x = _value(tensor)
+    _check_rank_axis("reduce", x, g)
+    if g.nranks == 1:
+        return tensor
+    d = _group_rank(g, dst, "dst")
+
+    def body(a):
+        s = _reduce_fn(op, ax)(a)
+        r = lax.axis_index(ax)
+        return jnp.where(r == d, s, a)
+
+    _assign(tensor, _smap(g, body, x))
+    return tensor
+
+
+def scatter(tensor: Tensor, tensor_list: Optional[List] = None, src: int = 0,
+            group=None, sync_op: bool = True):
+    """Reference ``communication/scatter.py``: rank i receives
+    ``tensor_list[i]`` as held by rank ``src``."""
+    g = _resolve(group)
+    ax = _axis_of(g)
+    n = g.nranks
+    if tensor_list is None:
+        raise ValueError("scatter requires tensor_list on the src rank")
+    vals = [_value(t) for t in tensor_list]
+    if len(vals) != n:
+        raise ValueError(f"scatter: need {n} tensors, got {len(vals)}")
+    s = _group_rank(g, src, "src")
+    if n == 1:
+        _assign(tensor, vals[0])
+        return tensor
+    stacked = jnp.stack(vals, axis=0)  # [L=n, n_rank, *S]
+
+    def body(a):  # a: [n, 1, *S] (sharded on rank axis, dim 1)
+        full = lax.all_gather(a, ax, axis=1, tiled=True)  # [n, n, *S]
+        r = lax.axis_index(ax)
+        mine = lax.dynamic_index_in_dim(full, r, 0, keepdims=False)  # [n,*S]
+        return mine[s:s + 1]
+
+    y = _smap(g, body, stacked, in_spec=P(None, ax), out_spec=P(ax))
+    _assign(tensor, y)
+    return tensor
+
+
+def gather(tensor: Tensor, gather_list: Optional[List] = None, dst: int = 0,
+           group=None, sync_op: bool = True):
+    """Reference ``communication/gather.py``. Single-controller: the gathered
+    list is materialized for all ranks (dst only reads it)."""
+    g = _resolve(group)
+    if gather_list is None:
+        gather_list = []
+    return all_gather(gather_list, tensor, group=g)
+
+
+def reduce_scatter(tensor: Tensor, tensor_list: List, op=ReduceOp.SUM,
+                   group=None, sync_op: bool = True):
+    """Reference ``communication/reduce_scatter.py``: rank r receives
+    ``sum over ranks q of tensor_list_q[r]``; lowers to ``lax.psum_scatter``."""
+    g = _resolve(group)
+    ax = _axis_of(g)
+    n = g.nranks
+    vals = [_value(t) for t in tensor_list]
+    if len(vals) != n:
+        raise ValueError(f"reduce_scatter: need {n} tensors, got {len(vals)}")
+    if n == 1:
+        _assign(tensor, vals[0])
+        return tensor
+    stacked = jnp.stack(vals, axis=1)  # [n_rank, L=n, *S]
+
+    def body(a):  # [1, n, *S]
+        loc = a[0]  # [n, *S]
+        if op == ReduceOp.SUM:
+            return lax.psum_scatter(loc, ax, scatter_dimension=0,
+                                    tiled=True)  # [1, *S]
+        if op == ReduceOp.AVG:
+            return lax.psum_scatter(loc, ax, scatter_dimension=0,
+                                    tiled=True) / n
+        full = _reduce_fn(op, ax)(loc)  # [n, *S] reduced elementwise
+        r = lax.axis_index(ax)
+        return lax.dynamic_index_in_dim(full, r, 0, keepdims=True)
+
+    y = _smap(g, body, stacked, in_spec=P(ax), out_spec=P(ax))
+    _assign(tensor, y)
+    return tensor
+
+
+def alltoall(out_tensor_list: List, in_tensor_list: List, group=None,
+             sync_op: bool = True):
+    """Reference ``communication/all_to_all.py``: rank r's out[i] = rank i's
+    in[r]; lowers to ``lax.all_to_all``."""
+    g = _resolve(group)
+    ax = _axis_of(g)
+    n = g.nranks
+    vals = [_value(t) for t in in_tensor_list]
+    if len(vals) != n:
+        raise ValueError(f"alltoall: need {n} tensors, got {len(vals)}")
+    if n == 1:
+        out_tensor_list.append(Tensor(vals[0], stop_gradient=True))
+        return out_tensor_list
+    stacked = jnp.stack(vals, axis=1)  # [n_rank, L=n, *S]
+
+    def body(a):  # [1, n, *S]
+        b = lax.all_to_all(a, ax, split_axis=1, concat_axis=0)  # [n, 1, *S]
+        return tuple(b[i] for i in range(n))  # each [1, *S]
+
+    outs = _smap(g, body, stacked, in_spec=P(ax),
+                 out_spec=tuple(P(ax) for _ in range(n)))
+    for o in outs:
+        out_tensor_list.append(Tensor(o, stop_gradient=True))
+    return out_tensor_list
+
+
+def alltoall_single(out_tensor: Tensor, in_tensor: Tensor,
+                    in_split_sizes=None, out_split_sizes=None, group=None,
+                    sync_op: bool = True):
+    """Reference ``communication/all_to_all.py`` alltoall_single (equal
+    splits; the uneven-split variant is served by ``alltoall``)."""
+    g = _resolve(group)
+    ax = _axis_of(g)
+    n = g.nranks
+    x = _value(in_tensor)
+    _check_rank_axis("alltoall_single", x, g)
+    if in_split_sizes or out_split_sizes:
+        raise NotImplementedError(
+            "alltoall_single with uneven splits: use alltoall")
+    if n == 1:
+        _assign(out_tensor, x)
+        return out_tensor
+
+    # per-rank local [m, *S]: split dim0 into n chunks, chunk j -> rank j,
+    # concat received chunks on dim0 (the reference's equal-split fast path)
+    def body(a):  # local [1, m, *S]
+        loc = a[0]
+        b = lax.all_to_all(loc, ax, split_axis=0, concat_axis=0, tiled=True)
+        return b[None]
+
+    y = _smap(g, body, x)
+    _assign(out_tensor, y)
+    return out_tensor
+
+
+# --- point to point --------------------------------------------------------
+
+def _ppermute_merge(tensor: Tensor, perm, g):
+    """One collective-permute; slices not receiving data keep their value."""
+    ax = _axis_of(g)
+    x = _value(tensor)
+    _check_rank_axis("p2p", x, g)
+
+    def body(a):
+        return lax.ppermute(a, ax, perm)
+
+    y = _smap(g, body, x)
+    dsts = [d for _, d in perm]
+    idx = jnp.arange(g.nranks).reshape((-1,) + (1,) * (x.ndim - 1))
+    mask = jnp.isin(idx, jnp.asarray(dsts))
+    return jnp.where(mask, y, x)
+
+
+def send(tensor: Tensor, dst: int = 0, group=None, sync_op: bool = True,
+         src: int = 0):
+    """Single-controller p2p: copies rank ``src``'s slice to rank ``dst``
+    via ``lax.ppermute`` (ICI collective-permute). In the reference this is
+    a per-process NCCL send (``communication/send.py``); here the one
+    program expresses both sides, so ``send`` performs the full transfer and
+    ``recv`` validates/reads it."""
+    g = _resolve(group)
+    perm = [(_group_rank(g, src, "src"), _group_rank(g, dst, "dst"))]
+    _assign(tensor, _ppermute_merge(tensor, perm, g))
+    return tensor
+
+
+def recv(tensor: Tensor, src: int = 0, group=None, sync_op: bool = True,
+         dst: int = 0):
+    """Pairs with ``send`` (see above): pulls rank ``src``'s slice into rank
+    ``dst``'s slot of ``tensor``."""
+    return send(tensor, dst=dst, group=group, src=src)
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst=dst, group=group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src=src, group=group)
+
+
+class P2POp:
+    """Reference ``communication/batch_isend_irecv.py`` P2POp: op is
+    ``isend``/``irecv``; peer is the remote rank; ``rank`` (extension) is
+    the local rank the op runs on (explicit because one controller drives
+    every rank)."""
+
+    def __init__(self, op, tensor, peer, group=None, rank=0):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+        self.rank = rank
+
+
+def batch_isend_irecv(p2p_op_list: List[P2POp]):
+    """Executes the batch's matched send/recv pairs as ppermutes (one per
+    distinct payload tensor — sends sharing a tensor fuse into a single
+    collective-permute, exactly how pipeline-parallel P2P should ride ICI;
+    reference ``p2p_communication.py:52`` batches NCCL send/recv).
+
+    Each ``isend`` moves its own tensor's slice [rank] to slice [peer]; a
+    matching ``irecv`` (peer/rank mirrored) designates the destination
+    tensor — when it is a different buffer than the send's, the received
+    slice is written there."""
+    if not p2p_op_list:
+        return []
+    g = _resolve(p2p_op_list[0].group)
+    sends = [op for op in p2p_op_list if op.op in (isend, send)]
+    recvs = [op for op in p2p_op_list if op.op in (irecv, recv)]
+
+    # group sends by payload tensor id so shared buffers fuse into one
+    # ppermute while distinct buffers each get their own transfer
+    by_tensor: dict[int, list[P2POp]] = {}
+    for op in sends:
+        by_tensor.setdefault(id(op.tensor), []).append(op)
+
+    for ops in by_tensor.values():
+        tensor = ops[0].tensor
+        perm = [(_group_rank(g, op.rank, "rank"),
+                 _group_rank(g, op.peer, "peer")) for op in ops]
+        merged = _ppermute_merge(tensor, perm, g)
+        _assign(tensor, merged)
+        # route received slices into matched recv buffers
+        for op in ops:
+            for r in recvs:
+                if r.peer == op.rank and r.rank == op.peer:
+                    if r.tensor is not tensor:
+                        x = _value(r.tensor)
+                        d = _group_rank(g, op.peer, "peer")
+                        idx = jnp.arange(g.nranks).reshape(
+                            (-1,) + (1,) * (x.ndim - 1))
+                        _assign(r.tensor,
+                                jnp.where(idx == d, _value(tensor), x))
+    return []
+
+
+# --- sync ------------------------------------------------------------------
+
+def barrier(group=None):
+    """Reference ``communication/group.py`` barrier: an all_reduce on a
+    scalar, then a host sync."""
+    g = _resolve(group)
+    t = Tensor(jnp.ones((g.nranks, 1), dtype=jnp.int32))
+    all_reduce(t, group=g)
+    v = t._read()
+    if not isinstance(v, jax.core.Tracer):
+        jax.block_until_ready(v)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Reference ``communication/wait``; PJRT futures make every result
+    awaitable — block on the buffer."""
+    v = _value(tensor)
+    if not isinstance(v, jax.core.Tracer):
+        jax.block_until_ready(v)
+    return tensor
+
+
+def get_backend(group=None):
+    return "xla:ici"

@@ -1,0 +1,18 @@
+"""paddle_tpu.distributed.fleet — hybrid-parallel orchestration.
+
+Analog of ``python/paddle/distributed/fleet`` (SURVEY D13-D17): topology /
+HybridCommunicateGroup, tensor-parallel layers (mpu), sharding optimizer,
+and the fleet facade.
+"""
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from .fleet import (  # noqa: F401
+    init, DistributedStrategy, distributed_model, distributed_optimizer,
+    get_hybrid_communicate_group, set_hybrid_communicate_group,
+    worker_index, worker_num,
+)
+from . import layers  # noqa: F401
+from .layers.mpu import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)
+from .sharding_optimizer import DygraphShardingOptimizer  # noqa: F401

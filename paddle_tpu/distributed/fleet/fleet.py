@@ -1,0 +1,147 @@
+"""Fleet facade: init / distributed_model / distributed_optimizer.
+
+Capability analog of ``python/paddle/distributed/fleet/fleet.py`` (SURVEY
+D13; ``Fleet`` ``:100``, hybrid_configs ``:605-610``, ``distributed_model``
+``model.py:32``). The reference wraps the model per-strategy with NCCL
+group plumbing; here ``init`` builds the hybrid mesh and
+``distributed_model`` pins GSPMD shardings (batch over dp×sharding,
+parameters replicated unless a TP layer already sharded them).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from .topology import HybridCommunicateGroup
+
+_hcg: Optional[HybridCommunicateGroup] = None
+_strategy = None
+
+
+class DistributedStrategy:
+    """Reference ``distributed_strategy.py`` DistributedStrategy proto —
+    the hybrid_configs subset that matters on TPU plus pass-through dicts
+    for the rest."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.find_unused_parameters = False
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    """Reference ``fleet.py:167`` fleet.init."""
+    global _hcg, _strategy
+    strategy = strategy or DistributedStrategy()
+    cfg = strategy.hybrid_configs
+    _strategy = strategy
+    _hcg = HybridCommunicateGroup(
+        dp_degree=cfg.get("dp_degree", 1),
+        mp_degree=cfg.get("mp_degree", 1),
+        pp_degree=cfg.get("pp_degree", 1),
+        sharding_degree=cfg.get("sharding_degree", 1),
+        sep_degree=cfg.get("sep_degree", 1))
+    from .. import collective as _coll
+    _coll._ensure_world()
+    return _hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg
+
+
+def set_hybrid_communicate_group(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def worker_index():
+    return 0
+
+
+def worker_num():
+    return len(jax.devices())
+
+
+class HybridParallelModel(Layer):
+    """Wraps a model for hybrid execution: shards batch inputs over the
+    dp×sharding axes; TP layers inside carry their own weight shardings.
+    Analog of the meta_parallel wrappers (reference ``model.py:141-160``)."""
+
+    def __init__(self, layers: Layer, hcg: HybridCommunicateGroup):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        mesh = hcg.mesh
+        repl = NamedSharding(mesh, P())
+        for p in layers.parameters():
+            v = p._read()
+            if not isinstance(v, jax.core.Tracer) and not p.is_dist():
+                p._write(jax.device_put(v, repl))
+
+    def forward(self, *inputs, **kwargs):
+        mesh = self._hcg.mesh
+        dpdeg = (self._hcg.get_data_parallel_world_size() *
+                 self._hcg.get_sharding_parallel_world_size())
+        sh = NamedSharding(mesh, P(("dp", "sharding")))
+
+        def shard_batch(x):
+            if isinstance(x, Tensor):
+                v = x._read()
+                if (not isinstance(v, jax.core.Tracer) and v.ndim > 0
+                        and v.shape[0] % dpdeg == 0):
+                    return Tensor(jax.device_put(v, sh),
+                                  stop_gradient=x.stop_gradient)
+            return x
+
+        inputs = tuple(shard_batch(x) for x in inputs)
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+
+def distributed_model(model: Layer) -> Layer:
+    """Reference ``fleet/base/distributed_strategy`` + ``model.py:32``."""
+    if _hcg is None:
+        init()
+    return HybridParallelModel(model, _hcg)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Reference ``fleet.py`` distributed_optimizer: wraps with the
+    HybridParallelOptimizer behavior. Under GSPMD gradients are globally
+    correct by construction, so the wrapper only adds sharding-stage
+    handling when sharding_degree > 1."""
+    if _hcg is not None and _hcg.get_sharding_parallel_world_size() > 1:
+        from .sharding_optimizer import DygraphShardingOptimizer
+        return DygraphShardingOptimizer(optimizer, _hcg)
+    return optimizer

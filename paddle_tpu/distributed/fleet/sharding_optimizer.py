@@ -1,0 +1,128 @@
+"""Sharding (ZeRO) optimizer stages — GSPMD mechanism.
+
+Capability analog of ``python/paddle/distributed/fleet/meta_optimizers/
+dygraph_optimizer/dygraph_sharding_optimizer.py:49`` (stage 1) and
+``group_sharded_stage2/3`` (SURVEY D16). The reference partitions the
+parameter list rank-by-rank and hand-codes reduce-scatter + broadcast; on
+TPU the same memory win comes from *sharding annotations*: optimizer
+moments (stage 1), gradients (stage 2), and parameters (stage 3/FSDP) are
+pinned sharded along the ``sharding`` mesh axis, and XLA emits the
+reduce-scatter/all-gather pairs inside the compiled step — the
+"weight-update sharding" transform that is the published GSPMD recipe for
+ZeRO on TPU.
+
+Stage semantics:
+- stage 1: accumulators sharded (dim-0) over the sharding axis.
+- stage 2: + gradients resharded before the update.
+- stage 3: + parameters stored sharded; all-gather happens inside forward
+  (XLA inserts it where the full weight is consumed).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .topology import HybridCommunicateGroup
+
+
+def _shard0_spec(shape, axis_name, axis_size):
+    """Shard along dim 0 when divisible; replicate otherwise (the reference
+    likewise keeps non-divisible small params unsharded)."""
+    if len(shape) > 0 and shape[0] % axis_size == 0 and shape[0] >= axis_size:
+        return P(axis_name)
+    return P()
+
+
+class DygraphShardingOptimizer:
+    """Wraps an inner optimizer; shards its state over the sharding axis."""
+
+    def __init__(self, optimizer, hcg: HybridCommunicateGroup = None,
+                 stage: int = 1):
+        self._inner = optimizer
+        if hcg is None:
+            from .fleet import get_hybrid_communicate_group, init
+            hcg = get_hybrid_communicate_group() or init()
+        self._hcg = hcg
+        self._mesh = hcg.mesh
+        self._axis = "sharding"
+        self._n = hcg.get_sharding_parallel_world_size()
+        self.stage = stage
+
+    # reference API: the inner optimizer's interface is preserved
+    @property
+    def _parameter_list(self):
+        return getattr(self._inner, "_parameters", [])
+
+    def _reshard_grads(self):
+        for p in self._parameter_list:
+            g = p.grad
+            if g is None:
+                continue
+            v = g._read()
+            if isinstance(v, jax.core.Tracer):
+                continue
+            spec = _shard0_spec(v.shape, self._axis, self._n)
+            g._write(jax.device_put(v, NamedSharding(self._mesh, spec)))
+
+    def _shard_accumulators(self):
+        for store in self._inner._accumulators.values():
+            for acc in store.values():
+                v = acc._read()
+                if isinstance(v, jax.core.Tracer) or acc.is_dist():
+                    continue
+                spec = _shard0_spec(v.shape, self._axis, self._n)
+                if spec != P():
+                    acc._write(jax.device_put(
+                        v, NamedSharding(self._mesh, spec)))
+                    acc._dist = (self._mesh, spec)
+
+    def step(self):
+        if self._n > 1 and self.stage >= 2:
+            self._reshard_grads()
+        self._inner.step()
+        if self._n > 1:
+            self._shard_accumulators()
+
+    def minimize(self, loss, *a, **k):
+        if self._n > 1 and self.stage >= 2:
+            self._reshard_grads()
+        out = self._inner.minimize(loss, *a, **k)
+        if self._n > 1:
+            self._shard_accumulators()
+        return out
+
+    def clear_grad(self, *a, **k):
+        return self._inner.clear_grad(*a, **k)
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
+                           group=None, **kwargs):
+    """Reference ``python/paddle/distributed/sharding/group_sharded.py``:
+    level 'os' = stage 1, 'os_g' = stage 2, 'p_g_os' = stage 3. Stage 3
+    additionally pins the parameters themselves sharded (FSDP layout)."""
+    stage = {"os": 1, "os_g": 2, "p_g_os": 3}[level]
+    from .fleet import get_hybrid_communicate_group, init
+    hcg = get_hybrid_communicate_group() or init()
+    opt = DygraphShardingOptimizer(optimizer, hcg, stage=stage)
+    if stage >= 3:
+        mesh, n = hcg.mesh, hcg.get_sharding_parallel_world_size()
+        for p in model.parameters():
+            v = p._read()
+            if isinstance(v, jax.core.Tracer) or p.is_dist():
+                continue
+            spec = _shard0_spec(v.shape, "sharding", n)
+            if spec != P():
+                p._write(jax.device_put(v, NamedSharding(mesh, spec)))
+                p._dist = (mesh, spec)
+    return model, opt, scaler

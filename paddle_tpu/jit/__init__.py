@@ -189,6 +189,7 @@ class _Executable:
         self.ret_rebuild = ret_rebuild
         self.n_ret = n_ret
         self.arg_out_pos: list[int] = []
+        self.trace_count = 0  # XLA (re)traces; guards retrace regressions
 
     def build(self, arg_tensors, call_args, call_kwargs):
         d = self.discovery
@@ -209,6 +210,7 @@ class _Executable:
         fn = self.fn
 
         def pure(*vals):
+            self.trace_count += 1
             tr = _ReplayTracker(pos, vals)
             old = tensor_mod.set_tracker(tr)
             try:
@@ -264,7 +266,13 @@ class _Executable:
             arg_tensors[pos]._data = v
             arg_tensors[pos]._node = None
         for t, v in zip(self.grad_out_owners, grad_vals):
-            t._grad = Tensor(v, stop_gradient=True)
+            if t._grad is not None:
+                # mutate in place so the object identity the trace captured
+                # stays valid across XLA retraces (sharding changes)
+                t._grad._data = v
+                t._grad._node = None
+            else:
+                t._grad = Tensor(v, stop_gradient=True)
         return self.ret_rebuild([Tensor(v) for v in ret_vals])
 
 
@@ -352,6 +360,12 @@ class StaticFunction:
             out = self.fn(*args, **kwargs)
         finally:
             tensor_mod.set_tracker(old)
+        # a grad owner whose grad is None at function exit was cleared
+        # in-function (opt.clear_grad): it is not a program output — and
+        # writing a value back would desync eager state from the captured
+        # program (stale grads then break later retraces)
+        d.grad_owners = {k: t for k, t in d.grad_owners.items()
+                         if t._grad is not None}
         ret_tensors = _flatten_tensors(out, [])
         exe = _Executable(self.fn, d, _make_rebuilder(out),
                           len(ret_tensors))

@@ -1,0 +1,264 @@
+"""Collective communication + DataParallel tests on the 8-device virtual
+mesh (the reference's TestDistBase pattern, test/legacy_test/
+test_dist_base.py:959, collapsed to single-controller SPMD)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+N = 8  # conftest forces 8 virtual CPU devices
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _env():
+    dist.init_parallel_env()
+
+
+def _rank_tensor(shape=(), base=0.0):
+    """Stack of per-rank values: slice r holds value base + r."""
+    vals = np.stack([np.full(shape, base + r, dtype=np.float32)
+                     for r in range(N)])
+    return paddle.to_tensor(vals)
+
+
+def test_world():
+    assert dist.get_world_size() == N
+    assert dist.get_rank() == 0
+    assert dist.is_initialized()
+
+
+def test_all_reduce_sum():
+    t = _rank_tensor((3,))
+    dist.all_reduce(t)
+    expect = sum(range(N))  # 0+1+...+7 = 28
+    np.testing.assert_allclose(t.numpy(), np.full((N, 3), expect), rtol=1e-6)
+
+
+def test_all_reduce_ops():
+    t = _rank_tensor((2,), base=1.0)  # ranks hold 1..8
+    dist.all_reduce(t, op=dist.ReduceOp.MAX)
+    np.testing.assert_allclose(t.numpy(), np.full((N, 2), 8.0))
+    t = _rank_tensor((2,), base=1.0)
+    dist.all_reduce(t, op=dist.ReduceOp.MIN)
+    np.testing.assert_allclose(t.numpy(), np.full((N, 2), 1.0))
+    t = _rank_tensor((2,), base=1.0)
+    dist.all_reduce(t, op=dist.ReduceOp.AVG)
+    np.testing.assert_allclose(t.numpy(), np.full((N, 2), 4.5))
+
+
+def test_all_gather():
+    t = _rank_tensor((2,))
+    out = []
+    dist.all_gather(out, t)
+    assert len(out) == N
+    for i, o in enumerate(out):
+        np.testing.assert_allclose(o.numpy(), np.full((N, 2), float(i)))
+
+
+def test_broadcast():
+    t = _rank_tensor((2,))
+    dist.broadcast(t, src=3)
+    np.testing.assert_allclose(t.numpy(), np.full((N, 2), 3.0))
+
+
+def test_reduce():
+    t = _rank_tensor((2,))
+    dist.reduce(t, dst=2)
+    got = t.numpy()
+    np.testing.assert_allclose(got[2], np.full((2,), 28.0))
+    np.testing.assert_allclose(got[0], np.full((2,), 0.0))
+    np.testing.assert_allclose(got[5], np.full((2,), 5.0))
+
+
+def test_scatter():
+    # src rank 1 scatters: rank i receives tensor_list[i] (as held by src)
+    tl = [_rank_tensor((2,), base=10.0 * i) for i in range(N)]
+    out = paddle.zeros([N, 2])
+    dist.scatter(out, tl, src=1)
+    got = out.numpy()
+    for r in range(N):
+        # tensor_list[r] slice at src=1 is 10*r + 1
+        np.testing.assert_allclose(got[r], np.full((2,), 10.0 * r + 1.0))
+
+
+def test_reduce_scatter():
+    tl = [_rank_tensor((2,), base=float(i)) for i in range(N)]
+    out = paddle.zeros([N, 2])
+    dist.reduce_scatter(out, tl)
+    got = out.numpy()
+    for r in range(N):
+        # sum over ranks q of tensor_list[r][q] = sum(r + q) = N*r + 28
+        np.testing.assert_allclose(got[r], np.full((2,), N * r + 28.0))
+
+
+def test_alltoall():
+    tl = [_rank_tensor((2,), base=100.0 * i) for i in range(N)]
+    out = []
+    dist.alltoall(out, tl)
+    for i, o in enumerate(out):
+        got = o.numpy()
+        for r in range(N):
+            # out[i][r] = in[r][i] = 100*r + i
+            np.testing.assert_allclose(got[r], np.full((2,), 100.0 * r + i))
+
+
+def test_alltoall_single():
+    # per-rank local [N] vector = rank id repeated; after exchange, local
+    # chunk j = rank j's chunk for me
+    x = np.zeros((N, N), dtype=np.float32)
+    for r in range(N):
+        x[r] = r * 10 + np.arange(N)
+    t = paddle.to_tensor(x)
+    out = paddle.zeros([N, N])
+    dist.alltoall_single(out, t)
+    got = out.numpy()
+    for r in range(N):
+        np.testing.assert_allclose(got[r], np.arange(N) * 10 + r)
+
+
+def test_send_recv():
+    t = _rank_tensor((2,))
+    dist.send(t, dst=5, src=2)
+    got = t.numpy()
+    np.testing.assert_allclose(got[5], np.full((2,), 2.0))
+    np.testing.assert_allclose(got[0], np.full((2,), 0.0))
+
+
+def test_new_group_subset():
+    g = dist.new_group(ranks=[0, 1, 2, 3])
+    vals = np.stack([np.full((2,), float(r), np.float32) for r in range(4)])
+    t = paddle.to_tensor(vals)
+    dist.all_reduce(t, group=g)
+    np.testing.assert_allclose(t.numpy(), np.full((4, 2), 6.0))
+
+
+def test_barrier_and_wait():
+    dist.barrier()
+    t = _rank_tensor((2,))
+    dist.wait(t)
+
+
+class _MLP(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = paddle.nn.Linear(8, 16)
+        self.fc2 = paddle.nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def test_data_parallel_loss_parity():
+    """The reference's dist-base test pattern: DataParallel training must
+    match single-device training on the same global batch."""
+    paddle.seed(7)
+    single = _MLP()
+    paddle.seed(7)
+    wrapped = dist.DataParallel(_MLP())
+
+    opt_s = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=single.parameters())
+    opt_d = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=wrapped.parameters())
+
+    rng = np.random.RandomState(0)
+    losses_s, losses_d = [], []
+    for _ in range(3):
+        xb = rng.randn(16, 8).astype(np.float32)
+        yb = rng.randn(16, 4).astype(np.float32)
+
+        x = paddle.to_tensor(xb)
+        y = paddle.to_tensor(yb)
+        loss = ((single(x) - y) ** 2).mean()
+        loss.backward()
+        opt_s.step()
+        opt_s.clear_grad()
+        losses_s.append(float(loss))
+
+        x = paddle.to_tensor(xb)
+        y = paddle.to_tensor(yb)
+        loss = ((wrapped(x) - y) ** 2).mean()
+        loss.backward()
+        opt_d.step()
+        opt_d.clear_grad()
+        losses_d.append(float(loss))
+
+    np.testing.assert_allclose(losses_s, losses_d, rtol=1e-5)
+
+
+def test_data_parallel_actually_shards():
+    wrapped = dist.DataParallel(_MLP())
+    x = paddle.to_tensor(np.random.randn(16, 8).astype(np.float32))
+    out = wrapped(x)
+    # forward ran on a batch sharded over dp: verify by re-sharding input
+    shx = wrapped._shard_input(x)
+    shards = shx._read().sharding
+    assert len(shards.device_set) == N
+
+
+def test_all_reduce_prod_with_negatives():
+    vals = np.stack([np.full((2,), float(r - 3), np.float32)
+                     for r in range(N)])  # includes negatives and zero
+    t = paddle.to_tensor(vals)
+    dist.all_reduce(t, op=dist.ReduceOp.PROD)
+    expect = np.prod([r - 3 for r in range(N)])  # contains 0 -> 0
+    np.testing.assert_allclose(t.numpy(), np.full((N, 2), expect))
+    vals = np.stack([np.full((2,), float(r + 1) * (-1) ** r, np.float32)
+                     for r in range(4)])
+    g = dist.new_group(ranks=[0, 1, 2, 3])
+    t = paddle.to_tensor(vals)
+    dist.all_reduce(t, op=dist.ReduceOp.PROD, group=g)
+    expect = 1 * -2 * 3 * -4  # = 24, sign preserved
+    np.testing.assert_allclose(t.numpy(), np.full((4, 2), expect))
+
+
+def test_out_of_group_rank_rejected():
+    g = dist.new_group(ranks=[2, 3])
+    vals = np.zeros((2, 2), np.float32)
+    t = paddle.to_tensor(vals)
+    with pytest.raises(ValueError):
+        dist.broadcast(t, src=5, group=g)
+
+
+def test_axis_group_collectives():
+    """HybridCommunicateGroup's AxisGroup works with the comm API."""
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 1}
+    prev = fleet.get_hybrid_communicate_group()
+    hcg = fleet.init(strategy=strategy)
+    try:
+        mp = hcg.get_model_parallel_group()
+        assert mp.nranks == 2
+        t = paddle.to_tensor(np.stack([np.full((3,), 1.0, np.float32),
+                                       np.full((3,), 5.0, np.float32)]))
+        dist.all_reduce(t, group=mp)
+        np.testing.assert_allclose(t.numpy(), np.full((2, 3), 6.0))
+        dp = hcg.get_data_parallel_group()
+        t = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(4, 1))
+        dist.broadcast(t, src=2, group=dp)
+        np.testing.assert_allclose(t.numpy(), np.full((4, 1), 2.0))
+    finally:
+        fleet.set_hybrid_communicate_group(prev)
+
+
+def test_batch_isend_irecv_distinct_tensors():
+    """Two sends with different payload buffers both transfer (review fix)."""
+    a = _rank_tensor((2,))           # slice r = r
+    b = _rank_tensor((2,), base=50.) # slice r = 50 + r
+    ops = [
+        dist.P2POp(dist.isend, a, peer=1, rank=0),
+        dist.P2POp(dist.irecv, a, peer=0, rank=1),
+        dist.P2POp(dist.isend, b, peer=3, rank=2),
+        dist.P2POp(dist.irecv, b, peer=2, rank=3),
+    ]
+    dist.batch_isend_irecv(ops)
+    got_a, got_b = a.numpy(), b.numpy()
+    np.testing.assert_allclose(got_a[1], np.full((2,), 0.0))   # from rank 0
+    np.testing.assert_allclose(got_b[3], np.full((2,), 52.0))  # from rank 2
+    np.testing.assert_allclose(got_a[0], np.full((2,), 0.0))   # untouched
+    np.testing.assert_allclose(got_b[2], np.full((2,), 52.0))
