@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Driver benchmark: prints ONE JSON line
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Headline: GPT-124M (BASELINE.md config-4 class) training throughput on one
+chip — jit-compiled full train step (fwd + loss + bwd + AdamW), bf16 AMP O1,
+activation recompute. vs_baseline = achieved MFU / 0.40, the A100-parity
+north star of BASELINE.md (the reference publishes no absolute numbers, so
+parity-with-Paddle-CUDA is expressed as matching 40% model-FLOPs
+utilization on the local chip's peak).
+
+TPU rules (.claude/skills/verify/SKILL.md): everything through the jit
+path; no SIGKILL; single process owns the chip.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# bf16 peak FLOPs by device kind (per chip)
+_PEAK = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _peak_flops(dev) -> float:
+    kind = getattr(dev, "device_kind", "")
+    for k, v in _PEAK.items():
+        if k.lower() in str(kind).lower():
+            return v
+    return 197e12  # assume v5e-class when unknown
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.amp as amp
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=1024, dropout=0.0,
+                        recompute=True)
+        batch, seq, warmup, iters = 8, 1024, 2, 10
+    else:  # CPU smoke (local testing only; driver runs on the real chip)
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128, dropout=0.0,
+                        recompute=True)
+        batch, seq, warmup, iters = 2, 64, 2, 4
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.train()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    @paddle.jit.to_static
+    def train_step(ids, labels):
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss = model(ids, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.default_rng(0)
+
+    def batch_fn():
+        ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        lab = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        return paddle.to_tensor(ids), paddle.to_tensor(lab)
+
+    for _ in range(warmup):
+        loss = train_step(*batch_fn())
+    float(loss)  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = train_step(*batch_fn())
+    final_loss = float(loss)  # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    flops_per_token = model.flops_per_token(seq)
+    achieved = tokens_per_sec * flops_per_token
+    peak = _peak_flops(dev)
+    mfu = achieved / peak if on_tpu else 0.0
+
+    print(json.dumps({
+        "metric": "gpt124m_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+            "batch": batch, "seq_len": seq, "iters": iters,
+            "step_time_ms": round(dt / iters * 1e3, 2),
+            "params": model.num_params(),
+            "model_tflops_per_sec": round(achieved / 1e12, 2),
+            "mfu": round(mfu, 4),
+            "final_loss": round(final_loss, 4),
+            "amp": "O1-bf16", "recompute": True,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # still emit a parseable line on failure
+        print(json.dumps({
+            "metric": "gpt124m_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/sec", "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"}))
+        sys.exit(1)

@@ -332,6 +332,14 @@ class _ShardOptimizer:
         if self._shard_fn is not None:
             self._apply_shard_fn()
 
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        out = self._inner.minimize(loss, startup_program, parameters,
+                                   no_grad_set)
+        if self._shard_fn is not None:
+            self._apply_shard_fn()
+        return out
+
     def _apply_shard_fn(self):
         opt = self._inner
         params = {id(p): p for p in getattr(opt, "_parameters", [])}
@@ -375,7 +383,11 @@ class Strategy:
         self.amp = Strategy._Flags(enable=False, dtype="bfloat16", level="O2")
         if config:
             for k, v in config.items():
-                setattr(self, k, v)
+                cur = getattr(self, k, None)
+                if isinstance(v, dict) and isinstance(cur, Strategy._Flags):
+                    cur.__dict__.update(v)
+                else:
+                    setattr(self, k, v)
 
 
 def to_static(layer_or_fn, loader=None, loss=None, optimizer=None,

@@ -32,15 +32,15 @@ def _spec_to_meta(dist):
     if dist is None:
         return None
     mesh, spec = dist
+    from ..auto_parallel.api import _to_partition_spec
     if hasattr(mesh, "jmesh"):  # ProcessMesh
         names = list(mesh.dim_names)
         shape = list(mesh.shape)
-        from ..auto_parallel.api import _to_partition_spec
-        spec = _to_partition_spec(mesh, spec) if isinstance(spec, list) \
-            else spec
     else:  # raw jax Mesh
         names = list(mesh.axis_names)
         shape = [mesh.shape[n] for n in names]
+    if not isinstance(spec, P) and isinstance(spec, (list, tuple)):
+        spec = _to_partition_spec(mesh, spec)
     entries = []
     if isinstance(spec, P):
         for e in spec:

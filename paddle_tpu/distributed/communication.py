@@ -406,18 +406,26 @@ def batch_isend_irecv(p2p_op_list: List[P2POp]):
         perm = [(_group_rank(g, op.rank, "rank"),
                  _group_rank(g, op.peer, "peer")) for op in ops]
         merged = _ppermute_merge(tensor, perm, g)
-        _assign(tensor, merged)
-        # route received slices into matched recv buffers
+        # route received slices into matched recv buffers; destinations whose
+        # recv designates a DIFFERENT buffer must not clobber the sender
+        # tensor's copy of that slice
+        ext_dsts = []
         for op in ops:
             for r in recvs:
-                if r.peer == op.rank and r.rank == op.peer:
-                    if r.tensor is not tensor:
-                        x = _value(r.tensor)
-                        d = _group_rank(g, op.peer, "peer")
-                        idx = jnp.arange(g.nranks).reshape(
-                            (-1,) + (1,) * (x.ndim - 1))
-                        _assign(r.tensor,
-                                jnp.where(idx == d, _value(tensor), x))
+                if r.peer == op.rank and r.rank == op.peer \
+                        and r.tensor is not tensor:
+                    x = _value(r.tensor)
+                    d = _group_rank(g, op.peer, "peer")
+                    ext_dsts.append(d)
+                    idx = jnp.arange(g.nranks).reshape(
+                        (-1,) + (1,) * (x.ndim - 1))
+                    _assign(r.tensor, jnp.where(idx == d, merged, x))
+        if ext_dsts:
+            x0 = _value(tensor)
+            idx = jnp.arange(g.nranks).reshape((-1,) + (1,) * (x0.ndim - 1))
+            keep = jnp.isin(idx, jnp.asarray(ext_dsts))
+            merged = jnp.where(keep, x0, merged)
+        _assign(tensor, merged)
     return []
 
 

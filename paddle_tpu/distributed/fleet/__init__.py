@@ -16,3 +16,6 @@ from .layers.mpu import (  # noqa: F401
     ParallelCrossEntropy,
 )
 from .sharding_optimizer import DygraphShardingOptimizer  # noqa: F401
+from .recompute import (  # noqa: F401
+    recompute, recompute_sequential, recompute_hybrid,
+)

@@ -1,0 +1,233 @@
+"""GPT-family decoder-only language model — the flagship trainable.
+
+Capability analog of the GPT/LLaMA configs the reference trains through
+fleet hybrid parallelism (SURVEY §6 configs 4-5; the reference keeps model
+defs downstream in PaddleNLP, e.g. its ``GPTForPretraining``, but the
+training mechanics — VocabParallelEmbedding / Column-RowParallelLinear
+sharding, flash attention, recompute — are reference in-tree features:
+``python/paddle/distributed/fleet/layers/mpu/mp_layers.py:47,333,540``,
+``python/paddle/nn/functional/flash_attention.py:147``,
+``python/paddle/distributed/fleet/recompute/recompute.py:404``).
+
+TPU-native: one model class, parallelism applied *afterwards* as GSPMD
+sharding (``shard_gpt``) instead of swapping layer classes — the mesh axes
+decide dp/tp/sp; XLA's partitioner emits the Megatron collectives.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from ..nn.layers import Dropout, Embedding, LayerNorm, Linear
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    intermediate_size: int = 0  # 0 -> 4*hidden
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    tie_embeddings: bool = True
+    use_flash_attention: bool = True
+    recompute: bool = False  # activation recompute per block (jax.checkpoint)
+
+    def __post_init__(self):
+        if self.intermediate_size == 0:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+def _init_normal(std):
+    return I.Normal(mean=0.0, std=std)
+
+
+class GPTAttention(Layer):
+    """Causal self-attention with a fused qkv projection (the shape the
+    reference fuses in ``fused_attention``-family kernels, SURVEY C12)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.head_dim
+        self.qkv = Linear(h, 3 * h, weight_attr=_init_normal(0.02))
+        self.proj = Linear(
+            h, h, weight_attr=_init_normal(0.02 / math.sqrt(2 * cfg.num_layers)))
+        self.dropout = cfg.dropout
+        self.use_flash = cfg.use_flash_attention
+
+    def forward(self, x):
+        from .. import ops
+        b, s, h = x.shape
+        qkv = self.qkv(x)
+        qkv = ops.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = ops.unbind(qkv, axis=2)  # each [b, s, heads, head_dim]
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.dropout if self.training else 0.0)
+        out = ops.reshape(out, [b, s, h])
+        return self.proj(out)
+
+
+class GPTMLP(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.fc1 = Linear(cfg.hidden_size, cfg.intermediate_size,
+                          weight_attr=_init_normal(0.02))
+        self.fc2 = Linear(
+            cfg.intermediate_size, cfg.hidden_size,
+            weight_attr=_init_normal(0.02 / math.sqrt(2 * cfg.num_layers)))
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.mlp = GPTMLP(cfg)
+        self.drop = Dropout(cfg.dropout)
+        self._recompute = cfg.recompute
+
+    def _inner(self, x):
+        x = x + self.drop(self.attn(self.ln1(x)))
+        x = x + self.drop(self.mlp(self.ln2(x)))
+        return x
+
+    def forward(self, x):
+        if self._recompute and self.training:
+            from ..distributed.fleet.recompute import recompute
+            return recompute(self._inner, x)
+        return self._inner(x)
+
+
+class GPTModel(Layer):
+    """Embeddings + transformer stack + final norm -> hidden states."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = Embedding(cfg.vocab_size, cfg.hidden_size,
+                             weight_attr=_init_normal(0.02))
+        self.wpe = Embedding(cfg.max_seq_len, cfg.hidden_size,
+                             weight_attr=_init_normal(0.02))
+        self.drop = Dropout(cfg.dropout)
+        self.blocks = [GPTBlock(cfg) for _ in range(cfg.num_layers)]
+        for i, blk in enumerate(self.blocks):
+            self.add_sublayer(f"block_{i}", blk)
+        self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids):
+        from .. import ops
+        s = input_ids.shape[1]
+        pos = ops.arange(0, s, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    """LM head on top; ``forward(ids, labels)`` returns mean next-token
+    cross-entropy (labels already shifted by the data pipeline, as in the
+    reference pretrain loaders)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        if cfg.tie_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
+                                  weight_attr=_init_normal(0.02),
+                                  bias_attr=False)
+
+    def logits(self, input_ids) -> Tensor:
+        from .. import ops
+        h = self.gpt(input_ids)
+        if self.lm_head is not None:
+            return self.lm_head(h)
+        return ops.matmul(h, self.gpt.wte.weight, transpose_y=True)
+
+    def forward(self, input_ids, labels=None):
+        logits = self.logits(input_ids)
+        if labels is None:
+            return logits
+        return F.cross_entropy(
+            ops_reshape(logits, [-1, self.cfg.vocab_size]),
+            ops_reshape(labels, [-1]))
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Standard 6N + attention estimate (per trained token)."""
+        n = self.num_params()
+        c = self.cfg
+        attn = 12 * c.num_layers * c.hidden_size * seq_len
+        return 6.0 * n + attn
+
+
+def ops_reshape(x, shape):
+    from .. import ops
+    return ops.reshape(x, shape)
+
+
+# --- GSPMD sharding recipe (the fleet-TP analog for this model) ------------
+
+def shard_gpt(model: GPTForCausalLM, mesh, dp_axis="dp", mp_axis="mp",
+              sp_axis=None):
+    """Pin Megatron-style shardings over ``mesh`` (a ProcessMesh).
+
+    Column-parallel: qkv / fc1 weights shard output dim over mp.
+    Row-parallel: proj / fc2 weights shard input dim over mp.
+    Vocab-parallel: wte shards vocab dim over mp.
+    XLA's SPMD partitioner then inserts the identity/allreduce pairs the
+    reference hand-codes in ``mp_ops.py`` (SURVEY D14). dp/sp axes shard the
+    *data* (batch/sequence), applied by the caller on inputs; parameters
+    stay replicated over dp/sp (pure DP; use fleet sharding stages for ZeRO).
+    """
+    from ..distributed.auto_parallel.api import (Replicate, Shard,
+                                                 shard_parameter)
+
+    names = mesh.dim_names
+    if mp_axis not in names:
+        return model
+    mp_dim = names.index(mp_axis)
+
+    def pl(tensor_dim):
+        p = [Replicate()] * mesh.ndim
+        p[mp_dim] = Shard(tensor_dim)
+        return p
+
+    rep = [Replicate()] * mesh.ndim
+    shard_parameter(model.gpt.wte.weight, mesh, pl(0))
+    shard_parameter(model.gpt.wpe.weight, mesh, rep)
+    for blk in model.gpt.blocks:
+        shard_parameter(blk.attn.qkv.weight, mesh, pl(1))
+        shard_parameter(blk.attn.qkv.bias, mesh, pl(0))
+        shard_parameter(blk.attn.proj.weight, mesh, pl(0))
+        shard_parameter(blk.attn.proj.bias, mesh, rep)
+        shard_parameter(blk.mlp.fc1.weight, mesh, pl(1))
+        shard_parameter(blk.mlp.fc1.bias, mesh, pl(0))
+        shard_parameter(blk.mlp.fc2.weight, mesh, pl(0))
+        shard_parameter(blk.mlp.fc2.bias, mesh, rep)
+    if model.lm_head is not None:
+        shard_parameter(model.lm_head.weight, mesh, pl(1))
+    return model
