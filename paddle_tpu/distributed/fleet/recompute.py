@@ -60,16 +60,87 @@ class _SubstituteTracker:
             self.outer.add_host_sync(fn)
 
 
+class _ReadRecorder:
+    """Records which pre-existing Tensors a callable reads (to discover the
+    parameters of a plain function/lambda passed to ``recompute``); writes
+    are swallowed exactly like the substitute tracker so the probe run has
+    no side effects on framework state."""
+
+    def __init__(self, outer):
+        self.outer = outer
+        self.reads: list[Tensor] = []
+        self._seen: set[int] = set()
+        self._fresh: set[int] = set()
+        self.writes: dict[int, object] = {}
+
+    def on_create(self, t):
+        self._fresh.add(id(t))
+        if self.outer is not None:
+            self.outer.on_create(t)
+
+    def on_read(self, t):
+        tid = id(t)
+        if tid in self.writes:
+            return self.writes[tid]
+        if tid not in self._fresh and tid not in self._seen:
+            self._seen.add(tid)
+            self.reads.append(t)
+        if self.outer is not None:
+            return self.outer.on_read(t)
+        return t._data
+
+    def on_write(self, t, val):
+        self.writes[id(t)] = val
+
+    def on_grad_write(self, t):
+        pass
+
+    def add_host_sync(self, fn):
+        pass
+
+
+def _discover_params(function, args, kwargs):
+    """Differentiable parameters read by ``function``: from the owning
+    Layer when bound, else from a side-effect-free probe run (its outputs
+    are unused, so under jit the probe is dead code XLA removes)."""
+    owner = getattr(function, "__self__", None)
+    if hasattr(owner, "parameters"):
+        return [p for p in owner.parameters() if not p.stop_gradient]
+    if hasattr(function, "parameters"):  # a Layer passed directly
+        return [p for p in function.parameters() if not p.stop_gradient]
+    cached = getattr(function, "_pdtpu_recompute_params", None)
+    if cached is not None:
+        return cached
+    rec = _ReadRecorder(tensor_mod._tracker)
+    old = tensor_mod.set_tracker(rec)
+    try:
+        with no_grad():
+            function(*args, **kwargs)
+    finally:
+        tensor_mod.set_tracker(old)
+    params = [t for t in rec.reads if not t.stop_gradient]
+    # Cache on the function object: a reused callable probes only once.
+    # (A lambda recreated every step re-probes — under jit.to_static the
+    # probe is dead code XLA removes, but in pure-eager loops prefer a bound
+    # Layer method, which skips probing entirely.)
+    try:
+        function._pdtpu_recompute_params = params
+    except AttributeError:
+        pass
+    return params
+
+
 def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
               **kwargs):
     """Run ``function(*args)`` with its activations rematerialized in
-    backward. ``function`` is typically a bound ``Layer`` method; its
-    parameters are discovered from the owning layer and threaded as explicit
-    differentiable inputs."""
-    owner = getattr(function, "__self__", None)
-    params = [p for p in owner.parameters()
-              if not p.stop_gradient] if hasattr(owner, "parameters") else []
+    backward. ``function`` may be a bound ``Layer`` method (parameters come
+    from the owning layer), a ``Layer``, or any callable (parameters are
+    discovered by a probe run); they are threaded as explicit
+    differentiable inputs of the checkpointed region."""
+    params = _discover_params(function, args, kwargs)
     tensor_args = [a for a in args if isinstance(a, Tensor)]
+    arg_ids = {id(a) for a in tensor_args}
+    params = [p for p in params if id(p) not in arg_ids]
     all_inputs = tensor_args + params
 
     def run_block(*vals):

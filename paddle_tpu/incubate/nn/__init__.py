@@ -1,0 +1,4 @@
+"""``paddle.incubate.nn`` parity (reference ``python/paddle/incubate/nn``)."""
+from . import functional  # noqa: F401
+
+__all__ = ["functional"]

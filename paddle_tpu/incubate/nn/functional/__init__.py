@@ -1,0 +1,242 @@
+"""``paddle.incubate.nn.functional`` parity — fused-op surface.
+
+Reference: ``python/paddle/incubate/nn/functional/`` (fused_rms_norm.py:21,
+fused_layer_norm.py:21, fused_rotary_position_embedding.py:21, swiglu.py:20,
+fused_dropout_add.py:22, fused_matmul_bias.py:24). On TPU these lower to the
+Pallas fused kernels in ``paddle_tpu.ops.pallas``; elsewhere to XLA
+compositions (which XLA fuses anyway — the capability, not the CUDA
+mechanism, is what's matched).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import apply
+from ....nn import functional as F
+
+
+def _on_tpu():
+    return jax.default_backend() == "tpu"
+
+
+def fused_rms_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis,
+                   bias=None, residual=None, quant_scale=-1,
+                   quant_round_type=0, quant_max_bound=0, quant_min_bound=0):
+    """RMSNorm(bias + residual + x) fused pattern (reference
+    ``fused_rms_norm.py:21``). Returns (out, residual_out) like the
+    reference's two-output kernel."""
+
+    def impl(v, w, *rest):
+        i = 0
+        b = rest[i] if bias is not None else None
+        if bias is not None:
+            i += 1
+        r = rest[i] if residual is not None else None
+        if residual is not None:
+            i += 1
+        nb = rest[i] if norm_bias is not None else None
+        if b is not None:
+            v = v + b
+        if r is not None:
+            v = v + r
+        res_out = v
+        if _on_tpu() and begin_norm_axis in (-1, v.ndim - 1) and nb is None:
+            from ....ops.pallas import norms
+            out = norms.rms_norm(v, w, eps=epsilon)
+        else:
+            axes = tuple(range(begin_norm_axis if begin_norm_axis >= 0
+                               else v.ndim + begin_norm_axis, v.ndim))
+            v32 = v.astype(jnp.float32)
+            ms = jnp.mean(jnp.square(v32), axis=axes, keepdims=True)
+            out = (v32 * jax.lax.rsqrt(ms + epsilon)).astype(v.dtype) * w
+            if nb is not None:
+                out = out + nb
+        return out, res_out
+
+    args = [x, norm_weight] + [t for t in (bias, residual, norm_bias)
+                               if t is not None]
+    return apply("fused_rms_norm", impl, *args)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon, residual_alpha=1.0,
+                     begin_norm_axis=1, bias=None, residual=None,
+                     quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                     quant_min_bound=0):
+    """LayerNorm(bias + residual_alpha*residual + x) fused pattern
+    (reference ``fused_layer_norm.py:21``). Returns (out, residual_out)."""
+
+    def impl(v, *rest):
+        i = 0
+        w = rest[i] if norm_weight is not None else None
+        if norm_weight is not None:
+            i += 1
+        nb = rest[i] if norm_bias is not None else None
+        if norm_bias is not None:
+            i += 1
+        b = rest[i] if bias is not None else None
+        if bias is not None:
+            i += 1
+        r = rest[i] if residual is not None else None
+        if b is not None:
+            v = v + b
+        if r is not None:
+            v = v + residual_alpha * r
+        res_out = v
+        if w is None and nb is None:
+            return v, res_out
+        last = begin_norm_axis in (-1, v.ndim - 1)
+        if _on_tpu() and last and w is not None and nb is not None:
+            from ....ops.pallas import norms
+            return norms.layer_norm(v, w, nb, eps=epsilon), res_out
+        axes = tuple(range(begin_norm_axis if begin_norm_axis >= 0
+                           else v.ndim + begin_norm_axis, v.ndim))
+        v32 = v.astype(jnp.float32)
+        mean = jnp.mean(v32, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(v32 - mean), axis=axes, keepdims=True)
+        out = ((v32 - mean) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+        if w is not None:
+            out = out * w
+        if nb is not None:
+            out = out + nb
+        return out, res_out
+
+    args = [x] + [t for t in (norm_weight, norm_bias, bias, residual)
+                  if t is not None]
+    return apply("fused_layer_norm", impl, *args)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    time_major=False):
+    """Reference ``fused_rotary_position_embedding.py:21``. Layout
+    [batch, seq, num_heads, head_dim]; sin/cos [seq, head_dim] or
+    [1, seq, 1, head_dim]. Paddle's ``use_neox_rotary_style=True`` pairs
+    adjacent elements (2i, 2i+1); False pairs front/back halves — note this
+    maps to the *opposite* convention of our kernel's ``use_neox`` flag."""
+    import math
+
+    def default_angles(positions, d):
+        """positions: [S] or [B, S] -> tiled angle table [.., S, D]."""
+        inv = 1.0 / 10000.0 ** (jnp.arange(0, d // 2) * 2.0 / d)
+        ang = positions[..., None].astype(jnp.float32) * inv
+        if use_neox_rotary_style:
+            return jnp.repeat(ang, 2, axis=-1)        # interleaved tiling
+        return jnp.concatenate([ang, ang], -1)        # half tiling
+
+    def prep_tables(s_val, c_val, d):
+        s_val = s_val.reshape(s_val.shape[-3] if s_val.ndim == 4
+                              else s_val.shape[0], d)
+        c_val = c_val.reshape(c_val.shape[-3] if c_val.ndim == 4
+                              else c_val.shape[0], d)
+        return c_val, s_val
+
+    def impl(*tensors):
+        ts = list(tensors)
+        xq = ts.pop(0)
+        if time_major:
+            xq = jnp.swapaxes(xq, 0, 1)
+        sq, d = xq.shape[1], xq.shape[-1]
+        tab = [None, None]
+        if sin is not None:
+            tab = [ts[-2], ts[-1]]  # [sin, cos] — appended in that order
+            ts = ts[:-2]
+        pid = ts.pop(-1) if position_ids is not None else None
+        if tab[0] is None:
+            # computed tables: evaluate angles at the requested positions
+            # directly (arbitrary position values, e.g. KV-cache decode)
+            pos = jnp.arange(sq) if pid is None else pid  # [S] or [B, S]
+            ang = default_angles(pos, d)
+            c_tab, s_tab = jnp.cos(ang), jnp.sin(ang)
+        else:
+            c_tab, s_tab = prep_tables(tab[0], tab[1], d)
+            if pid is not None:
+                # per-example gather [B, S, D]; positions must lie within
+                # the provided tables (clip matches the reference's
+                # in-bounds contract without UB)
+                c_tab = jnp.take(c_tab, pid, axis=0, mode="clip")
+                s_tab = jnp.take(s_tab, pid, axis=0, mode="clip")
+        from ....ops.pallas import rope
+        outs = []
+        kernel_neox = not use_neox_rotary_style  # see docstring
+        for xx in [xq] + ts:
+            if time_major and xx is not xq:
+                xx = jnp.swapaxes(xx, 0, 1)
+            o = rope.apply_rope(xx, c_tab, s_tab, use_neox=kernel_neox)
+            if time_major:
+                o = jnp.swapaxes(o, 0, 1)
+            outs.append(o)
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    args = [q] + [t for t in (k, v) if t is not None]
+    if position_ids is not None:
+        args.append(position_ids)
+    if sin is not None:
+        args += [sin, cos]
+    out = apply("fused_rotary_position_embedding", impl, *args)
+    n = 1 + (k is not None) + (v is not None)
+    if n == 1:
+        return out, None, None
+    outs = list(out) + [None] * (3 - n)
+    return tuple(outs)
+
+
+def swiglu(x, y=None, name=None):
+    """Reference ``swiglu.py:20``: silu(x) * y (y defaults to chunk)."""
+
+    def impl(v, *rest):
+        if rest:
+            return jax.nn.silu(v) * rest[0]
+        a, b = jnp.split(v, 2, axis=-1)
+        return jax.nn.silu(a) * b
+
+    args = [x] + ([y] if y is not None else [])
+    return apply("swiglu", impl, *args)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """Reference ``fused_dropout_add.py:22``: dropout(x) + y."""
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """Reference ``fused_matmul_bias.py:24`` — XLA fuses the epilogue."""
+
+    def impl(a, b, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = jnp.matmul(a, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = [x, y] + ([bias] if bias is not None else [])
+    return apply("fused_matmul_bias", impl, *args)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return fused_matmul_bias(x, weight, bias, False, transpose_weight)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation=None, name=None):
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    if activation in (None, "none", ""):
+        return out
+    if activation == "relu":
+        return F.relu(out)
+    if activation in ("gelu", "gelu_approx"):
+        return F.gelu(out, approximate=True)
+    raise ValueError(f"unsupported activation {activation!r}")
+
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
+    "swiglu", "fused_dropout_add", "fused_matmul_bias", "fused_linear",
+    "fused_linear_activation",
+]

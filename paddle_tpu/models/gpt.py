@@ -75,7 +75,8 @@ class GPTAttention(Layer):
         q, k, v = ops.unbind(qkv, axis=2)  # each [b, s, heads, head_dim]
         out = F.scaled_dot_product_attention(
             q, k, v, is_causal=True,
-            dropout_p=self.dropout if self.training else 0.0)
+            dropout_p=self.dropout if self.training else 0.0,
+            backend=None if self.use_flash else "xla")
         out = ops.reshape(out, [b, s, h])
         return self.proj(out)
 

@@ -71,9 +71,16 @@ def _sdpa_xla(q, k, v, mask=None, dropout=0.0, causal=False, scale=None,
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True, name=None):
+                                 training=True, name=None, backend=None):
     """paddle.nn.functional.scaled_dot_product_attention parity
-    (layout [batch, seq, num_heads, head_dim])."""
+    (layout [batch, seq, num_heads, head_dim]).
+
+    ``backend`` (extension over the reference signature): None = auto
+    (Pallas flash attention on TPU when eligible), "xla" forces the
+    unfused fallback, "pallas" requires the flash kernel."""
+    if backend not in (None, "xla", "pallas"):
+        raise ValueError(
+            f"backend must be None, 'xla' or 'pallas'; got {backend!r}")
     args = [query, key, value]
     has_mask = attn_mask is not None
     if has_mask:
@@ -91,7 +98,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         if has_mask:
             i += 1
         dk = rest[i] if drop > 0.0 else None
-        if _use_pallas(q) and m is None and drop == 0.0:
+        eligible = m is None and drop == 0.0
+        if backend == "pallas" and not eligible:
+            raise ValueError("backend='pallas' requires no attn_mask and "
+                             "dropout_p == 0")
+        use_pl = (backend == "pallas" or
+                  (backend is None and _use_pallas(q) and eligible))
+        if use_pl:
             from ...ops.pallas import flash_attention as fa
             return fa.flash_attention(q, k, v, causal=is_causal)
         return _sdpa_xla(q, k, v, mask=m, dropout=drop, causal=is_causal,

@@ -16,6 +16,18 @@ from ...core.dispatch import apply
 from ...core.tensor import Tensor
 
 
+def _pallas_norms():
+    """Fused Pallas norm kernels, used on TPU (None elsewhere: the XLA
+    fallback below is faster than interpret mode on CPU)."""
+    if jax.default_backend() != "tpu":
+        return None
+    try:
+        from ...ops.pallas import norms
+        return norms
+    except ImportError:
+        return None
+
+
 def _moments(v, axes):
     v32 = v.astype(jnp.float32)
     mean = jnp.mean(v32, axis=axes, keepdims=True)
@@ -32,6 +44,10 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
 
     def impl(v, *wb):
         axes = tuple(range(v.ndim - n_axes, v.ndim))
+        pn = _pallas_norms()
+        if (pn is not None and n_axes == 1 and weight is not None
+                and bias is not None):
+            return pn.layer_norm(v, wb[0], wb[1], eps=epsilon)
         mean, var = _moments(v, axes)
         out = (v.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
         out = out.astype(v.dtype)
@@ -55,6 +71,10 @@ def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
     def impl(v, *wb):
         axis = begin_norm_axis if begin_norm_axis >= 0 else v.ndim + begin_norm_axis
         axes = tuple(range(axis, v.ndim))
+        pn = _pallas_norms()
+        if (pn is not None and axes == (v.ndim - 1,) and weight is not None
+                and bias is None):
+            return pn.rms_norm(v, wb[0], eps=epsilon)
         v32 = v.astype(jnp.float32)
         ms = jnp.mean(jnp.square(v32), axis=axes, keepdims=True)
         out = (v32 * jax.lax.rsqrt(ms + epsilon)).astype(v.dtype)

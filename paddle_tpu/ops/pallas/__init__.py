@@ -1,0 +1,29 @@
+"""TPU Pallas fused-kernel library.
+
+Capability analog of the reference's hand-written CUDA fusion tier
+(SURVEY C12/C13: ``paddle/phi/kernels/fusion/gpu/`` and the FlashAttention-2
+integration ``paddle/phi/kernels/gpu/flash_attn_kernel.cu:91``) — but
+implemented as Mosaic/Pallas TPU kernels: online-softmax flash attention
+tiled for the MXU, fused norm kernels that keep stats in VMEM, and a fused
+rotary-embedding kernel.
+
+Off-TPU (CPU CI, the 8-device virtual mesh) every kernel transparently runs
+in Pallas interpreter mode, so the exact same code path is testable without
+hardware — the analog of the reference's fake_cpu_device plugin fixture
+(SURVEY §4).
+"""
+from __future__ import annotations
+
+import jax
+
+
+def use_interpret() -> bool:
+    """Pallas kernels compile only for real TPUs; elsewhere interpret."""
+    return jax.default_backend() != "tpu"
+
+
+from . import flash_attention  # noqa: E402
+from . import norms  # noqa: E402
+from . import rope  # noqa: E402
+
+__all__ = ["flash_attention", "norms", "rope", "use_interpret"]

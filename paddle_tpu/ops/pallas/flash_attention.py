@@ -1,0 +1,340 @@
+"""Pallas TPU flash attention (forward + backward, causal + GQA).
+
+Capability analog of the reference FlashAttention-2 integration
+(``paddle/phi/kernels/gpu/flash_attn_kernel.cu:91`` fwd,
+``flash_attn_grad_kernel.cu`` bwd, python surface
+``python/paddle/nn/functional/flash_attention.py:147``) — TPU-native design:
+
+* online-softmax tiling sized for the MXU (q blocks x k blocks, fp32
+  accumulators in registers/VMEM, bf16 matmul inputs);
+* per-(batch, head) grid programs keep K/V resident in VMEM while a q block
+  streams through — no [S, S] score matrix ever exists in HBM;
+* causal programs stop the k loop at the diagonal block (the FA2 trick that
+  halves causal FLOPs);
+* grouped-query attention maps q-head -> kv-head in the BlockSpec index map
+  (no materialized ``repeat`` of K/V, unlike the XLA fallback);
+* backward recomputes the softmax from the saved logsumexp (flash-attn
+  recompute strategy): a dk/dv pass tiled over k blocks and a dq pass tiled
+  over q blocks.
+
+Public entry: ``flash_attention(q, k, v, causal=..., scale=...)`` in
+paddle's [batch, seq, num_heads, head_dim] layout, differentiable via
+``jax.custom_vjp``.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() NaN-free
+
+
+def _block_sizes(sq, sk):
+    bq = min(128, sq)
+    bk = min(128, sk)
+    return bq, bk
+
+
+def _pad_to(x, axis, mult):
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                sq, sk, bq, bk):
+    """One (batch, q-head, q-block) program: stream k/v blocks with online
+    softmax. Block shapes: q/o [1,1,bq,D]; k/v [1,1,Skp,D]; lse [1,1,bq]."""
+    iq = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale       # [bq, D]
+    offset = sk - sq                                   # causal diagonal shift
+
+    nk = pl.cdiv(sk, bk)
+    if causal:
+        # last k block that the last row of this q block can see
+        hi = jnp.minimum(nk, ((iq + 1) * bq + offset + bk - 1) // bk)
+    else:
+        hi = nk
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
+    cols0 = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+
+    def body(j, carry):
+        m_i, l_i, acc = carry
+        kb = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        cols = cols0 + j * bk
+        mask = cols < sk                               # k padding
+        if causal:
+            mask = mask & (rows + offset >= cols)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                         # [bq, bk]
+        alpha = jnp.exp(m_i - m_new)                   # [bq, 1]
+        l_new = l_i * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    a0 = jnp.zeros((bq, q.shape[-1]), jnp.float32)
+    m_f, l_f, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, a0))
+
+    l_safe = jnp.where(l_f == 0.0, 1.0, l_f)           # padded q rows
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m_f + jnp.log(l_safe))[:, 0]
+
+
+def _fwd(q, k, v, scale, causal, interpret):
+    """q [B,Hq,Sq,D]; k,v [B,Hk,Sk,D] -> (o [B,Hq,Sq,D], lse [B,Hq,Sq])."""
+    b, hq, sq, d = q.shape
+    hk, sk = k.shape[1], k.shape[2]
+    rep = hq // hk
+    bq, bk = _block_sizes(sq, sk)
+    qp = _pad_to(q, 2, bq)
+    kp = _pad_to(k, 2, bk)
+    vp = _pad_to(v, 2, bk)
+    sqp, skp = qp.shape[2], kp.shape[2]
+    grid = (b, hq, sqp // bq)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               sq=sq, sk=sk, bq=bq, bk=bk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, skp, d),
+                         lambda ib, ih, iq, _rep=rep: (ib, ih // _rep, 0, 0)),
+            pl.BlockSpec((1, 1, skp, d),
+                         lambda ib, ih, iq, _rep=rep: (ib, ih // _rep, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, bq), lambda ib, ih, iq: (ib, ih, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sqp, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, sqp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return o[:, :, :sq], lse[:, :, :sq]
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, sq, sk, bq, bk):
+    """One (batch, q-head, k-block) program: accumulate this k block's
+    dk/dv over all attending q blocks. GQA heads are summed by the caller."""
+    ik = pl.program_id(2)
+    kb = k_ref[0, 0].astype(jnp.float32)               # [bk, D]
+    vb = v_ref[0, 0].astype(jnp.float32)
+    offset = sk - sq
+
+    nq = pl.cdiv(sq, bq)
+    if causal:
+        lo = jnp.maximum(0, (ik * bk - offset) // bq)  # first attending q
+    else:
+        lo = 0
+
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ik * bk
+    rows0 = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+
+    def body(iq, carry):
+        dk, dv = carry
+        qb = q_ref[0, 0, pl.ds(iq * bq, bq), :].astype(jnp.float32) * scale
+        dob = do_ref[0, 0, pl.ds(iq * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(iq * bq, bq)]        # [bq]
+        dlt = delta_ref[0, 0, pl.ds(iq * bq, bq)]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        rows = rows0 + iq * bq
+        mask = (cols < sk) & (rows < sq)
+        if causal:
+            mask = mask & (rows + offset >= cols)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bk, D]
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        ds = p * (dp - dlt[:, None])                   # [bq, bk]
+        dk = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bk, D]
+        return dk, dv
+
+    z = jnp.zeros((bk, kb.shape[-1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lo, nq, body, (z, z))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, scale, causal, sq, sk, bq, bk):
+    """One (batch, q-head, q-block) program: this q block's dq."""
+    iq = pl.program_id(2)
+    qb = q_ref[0, 0].astype(jnp.float32) * scale       # [bq, D]
+    dob = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]                                # [bq]
+    dlt = delta_ref[0, 0]
+    offset = sk - sq
+
+    nk = pl.cdiv(sk, bk)
+    if causal:
+        hi = jnp.minimum(nk, ((iq + 1) * bq + offset + bk - 1) // bk)
+    else:
+        hi = nk
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
+    cols0 = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+
+    def body(j, dq):
+        kb = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        cols = cols0 + j * bk
+        mask = cols < sk
+        if causal:
+            mask = mask & (rows + offset >= cols)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt[:, None])
+        return dq + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(
+        0, hi, body, jnp.zeros((bq, qb.shape[-1]), jnp.float32))
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd(scale, causal, interpret, res, g):
+    q, k, v, o, lse = res
+    do = g
+    b, hq, sq, d = q.shape
+    hk, sk = k.shape[1], k.shape[2]
+    rep = hq // hk
+    bq, bk = _block_sizes(sq, sk)
+
+    # delta_i = rowsum(dO * O): the FA2 precompute — one fused XLA reduce
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    qp = _pad_to(q, 2, bq)
+    dop = _pad_to(do, 2, bq)
+    lsep = _pad_to(lse, 2, bq)
+    dltp = _pad_to(delta, 2, bq)
+    kp = _pad_to(k, 2, bk)
+    vp = _pad_to(v, 2, bk)
+    sqp, skp = qp.shape[2], kp.shape[2]
+
+    # --- dk/dv: grid over k blocks; one output copy per q head, summed
+    # over the GQA group afterwards (B*Hq programs write disjoint slices).
+    kernel = functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                               sq=sq, sk=sk, bq=bq, bk=bk)
+    kv_spec = pl.BlockSpec((1, 1, skp, d),
+                           lambda ib, ih, ikb, _rep=rep: (ib, ih // _rep, 0, 0))
+    q_full = pl.BlockSpec((1, 1, sqp, d), lambda ib, ih, ikb: (ib, ih, 0, 0))
+    v1_full = pl.BlockSpec((1, 1, sqp), lambda ib, ih, ikb: (ib, ih, 0))
+    dkh, dvh = pl.pallas_call(
+        kernel,
+        grid=(b, hq, skp // bk),
+        in_specs=[q_full, kv_spec, kv_spec, q_full, v1_full, v1_full],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda ib, ih, ikb: (ib, ih, ikb, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda ib, ih, ikb: (ib, ih, ikb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, skp, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, skp, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, dltp)
+    if rep > 1:
+        dkh = dkh.reshape(b, hk, rep, skp, d).sum(axis=2)
+        dvh = dvh.reshape(b, hk, rep, skp, d).sum(axis=2)
+    dk = dkh[:, :, :sk].astype(k.dtype)
+    dv = dvh[:, :, :sk].astype(v.dtype)
+
+    # --- dq: grid over q blocks
+    kernel = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                               sq=sq, sk=sk, bq=bq, bk=bk)
+    qb_spec = pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq: (ib, ih, iq, 0))
+    kv_spec = pl.BlockSpec((1, 1, skp, d),
+                           lambda ib, ih, iq, _rep=rep: (ib, ih // _rep, 0, 0))
+    v1_spec = pl.BlockSpec((1, 1, bq), lambda ib, ih, iq: (ib, ih, iq))
+    dq = pl.pallas_call(
+        kernel,
+        grid=(b, hq, sqp // bq),
+        in_specs=[qb_spec, kv_spec, kv_spec, qb_spec, v1_spec, v1_spec],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda ib, ih, iq: (ib, ih, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hq, sqp, d), q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, dltp)
+    return dq[:, :, :sq], dk, dv
+
+
+# --------------------------------------------------------------------------
+# public API
+# --------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhsd(q, k, v, scale, causal, interpret):
+    o, _ = _fwd(q, k, v, scale, causal, interpret)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, interpret):
+    o, lse = _fwd(q, k, v, scale, causal, interpret)
+    return o, (q, k, v, o, lse)
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
+    """Flash attention in paddle layout [batch, seq, num_heads, head_dim].
+
+    ``num_heads(q)`` may be a multiple of ``num_heads(k) == num_heads(v)``
+    (grouped-query attention). Returns [batch, seq_q, num_heads, head_dim].
+    """
+    if interpret is None:
+        from . import use_interpret
+        interpret = use_interpret()
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    hq, hk = q.shape[2], k.shape[2]
+    if hk == 0 or hq % hk != 0:
+        raise ValueError(
+            f"flash_attention: query heads ({hq}) must be a multiple of "
+            f"key/value heads ({hk}) for grouped-query attention")
+    qt = jnp.swapaxes(q, 1, 2)  # -> [B, H, S, D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o = _flash_bhsd(qt, kt, vt, float(scale), bool(causal), bool(interpret))
+    return jnp.swapaxes(o, 1, 2)

@@ -1,0 +1,229 @@
+"""Fused normalization Pallas kernels (rms_norm, layer_norm).
+
+Capability analog of the reference fused-norm CUDA kernels
+(``paddle/phi/kernels/fusion/gpu/rms_norm_kernel.cu``,
+``fused_layernorm_kernel.cu``): one pass over the rows computes stats in
+fp32 and applies scale/shift without materializing intermediates in HBM.
+Backward recomputes the normalized value from saved fp32 stats (rstd/mean),
+the standard fused-norm strategy.
+
+Inputs are treated as [rows, hidden]: callers flatten leading dims. Weight
+and bias (optional at the functional layer) are taken as required here —
+the functional passes ones/zeros when absent, keeping the kernel mono-shape.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _row_block(rows):
+    return min(256, rows)
+
+
+def _pad_rows(x, br):
+    pad = (-x.shape[0]) % br
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    return x
+
+
+# --------------------------------------------------------------------------
+# rms_norm
+# --------------------------------------------------------------------------
+def _rms_fwd_kernel(x_ref, w_ref, o_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x), axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    o_ref[:] = (x * rstd * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    rstd_ref[:] = rstd
+
+
+def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dwp_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    rstd = rstd_ref[:]
+    xhat = x * rstd
+    wg = g * w
+    # dx = rstd * (wg - xhat * mean(wg * xhat))
+    c = jnp.mean(wg * xhat, axis=1, keepdims=True)
+    dx_ref[:] = (rstd * (wg - xhat * c)).astype(dx_ref.dtype)
+    dwp_ref[:] = jnp.sum(g * xhat, axis=0, keepdims=True)  # partial dw
+
+
+def _rms_call(x, w, eps, interpret):
+    rows, h = x.shape
+    br = _row_block(rows)
+    xp = _pad_rows(x, br)
+    grid = (xp.shape[0] // br,)
+    o, rstd = pl.pallas_call(
+        functools.partial(_rms_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                  pl.BlockSpec((1, h), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                   pl.BlockSpec((br, 1), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct(xp.shape, x.dtype),
+                   jax.ShapeDtypeStruct((xp.shape[0], 1), jnp.float32)],
+        interpret=interpret,
+    )(xp, w[None, :])
+    return o[:rows], rstd[:rows]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms2d(x, w, eps, interpret):
+    return _rms_call(x, w, eps, interpret)[0]
+
+
+def _rms2d_fwd(x, w, eps, interpret):
+    o, rstd = _rms_call(x, w, eps, interpret)
+    return o, (x, w, rstd)
+
+
+def _rms2d_bwd(eps, interpret, res, g):
+    x, w, rstd = res
+    rows, h = x.shape
+    br = _row_block(rows)
+    xp = _pad_rows(x, br)
+    gp = _pad_rows(g, br)
+    rp = jnp.pad(rstd, ((0, xp.shape[0] - rows), (0, 0)))
+    grid = (xp.shape[0] // br,)
+    dx, dwp = pl.pallas_call(
+        functools.partial(_rms_bwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                  pl.BlockSpec((1, h), lambda i: (0, 0)),
+                  pl.BlockSpec((br, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((br, h), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                   pl.BlockSpec((1, h), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct(xp.shape, x.dtype),
+                   jax.ShapeDtypeStruct((grid[0], h), jnp.float32)],
+        interpret=interpret,
+    )(xp, w[None, :], rp, gp)
+    return dx[:rows], jnp.sum(dwp, axis=0).astype(w.dtype)
+
+
+_rms2d.defvjp(_rms2d_fwd, _rms2d_bwd)
+
+
+def rms_norm(x, weight, eps=1e-6, interpret=None):
+    """Fused RMSNorm over the last axis. x: [..., hidden]."""
+    if interpret is None:
+        from . import use_interpret
+        interpret = use_interpret()
+    shape = x.shape
+    out = _rms2d(x.reshape(-1, shape[-1]), weight, float(eps),
+                 bool(interpret))
+    return out.reshape(shape)
+
+
+# --------------------------------------------------------------------------
+# layer_norm
+# --------------------------------------------------------------------------
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, o_ref, mean_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    mean = jnp.mean(x, axis=1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (x - mean) * rstd
+    o_ref[:] = (xhat * w_ref[:].astype(jnp.float32) +
+                b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    mean_ref[:] = mean
+    rstd_ref[:] = rstd
+
+
+def _ln_bwd_kernel(x_ref, w_ref, mean_ref, rstd_ref, g_ref,
+                   dx_ref, dwp_ref, dbp_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    mean = mean_ref[:]
+    rstd = rstd_ref[:]
+    xhat = (x - mean) * rstd
+    wg = g * w
+    c1 = jnp.mean(wg, axis=1, keepdims=True)
+    c2 = jnp.mean(wg * xhat, axis=1, keepdims=True)
+    dx_ref[:] = (rstd * (wg - c1 - xhat * c2)).astype(dx_ref.dtype)
+    dwp_ref[:] = jnp.sum(g * xhat, axis=0, keepdims=True)
+    dbp_ref[:] = jnp.sum(g, axis=0, keepdims=True)
+
+
+def _ln_call(x, w, b, eps, interpret):
+    rows, h = x.shape
+    br = _row_block(rows)
+    xp = _pad_rows(x, br)
+    grid = (xp.shape[0] // br,)
+    o, mean, rstd = pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                  pl.BlockSpec((1, h), lambda i: (0, 0)),
+                  pl.BlockSpec((1, h), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                   pl.BlockSpec((br, 1), lambda i: (i, 0)),
+                   pl.BlockSpec((br, 1), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct(xp.shape, x.dtype),
+                   jax.ShapeDtypeStruct((xp.shape[0], 1), jnp.float32),
+                   jax.ShapeDtypeStruct((xp.shape[0], 1), jnp.float32)],
+        interpret=interpret,
+    )(xp, w[None, :], b[None, :])
+    return o[:rows], mean[:rows], rstd[:rows]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ln2d(x, w, b, eps, interpret):
+    return _ln_call(x, w, b, eps, interpret)[0]
+
+
+def _ln2d_fwd(x, w, b, eps, interpret):
+    o, mean, rstd = _ln_call(x, w, b, eps, interpret)
+    return o, (x, w, mean, rstd)
+
+
+def _ln2d_bwd(eps, interpret, res, g):
+    x, w, mean, rstd = res
+    rows, h = x.shape
+    br = _row_block(rows)
+    xp = _pad_rows(x, br)
+    gp = _pad_rows(g, br)
+    pad = xp.shape[0] - rows
+    mp = jnp.pad(mean, ((0, pad), (0, 0)))
+    rp = jnp.pad(rstd, ((0, pad), (0, 0)))
+    grid = (xp.shape[0] // br,)
+    dx, dwp, dbp = pl.pallas_call(
+        functools.partial(_ln_bwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                  pl.BlockSpec((1, h), lambda i: (0, 0)),
+                  pl.BlockSpec((br, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((br, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((br, h), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                   pl.BlockSpec((1, h), lambda i: (i, 0)),
+                   pl.BlockSpec((1, h), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct(xp.shape, x.dtype),
+                   jax.ShapeDtypeStruct((grid[0], h), jnp.float32),
+                   jax.ShapeDtypeStruct((grid[0], h), jnp.float32)],
+        interpret=interpret,
+    )(xp, w[None, :], mp, rp, gp)
+    return (dx[:rows], jnp.sum(dwp, axis=0).astype(w.dtype),
+            jnp.sum(dbp, axis=0).astype(w.dtype))
+
+
+_ln2d.defvjp(_ln2d_fwd, _ln2d_bwd)
+
+
+def layer_norm(x, weight, bias, eps=1e-5, interpret=None):
+    """Fused LayerNorm over the last axis. x: [..., hidden]."""
+    if interpret is None:
+        from . import use_interpret
+        interpret = use_interpret()
+    shape = x.shape
+    out = _ln2d(x.reshape(-1, shape[-1]), weight, bias, float(eps),
+                bool(interpret))
+    return out.reshape(shape)

@@ -1,0 +1,99 @@
+"""Fused rotary position embedding (RoPE) Pallas kernel.
+
+Capability analog of the reference fused-rope CUDA kernel
+(``paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu``, python surface
+``paddle.incubate.nn.functional.fused_rotary_position_embedding``): applies
+cos/sin rotation to q (and optionally k, v) in one pass, half-rotate
+("neox") or interleaved pairing, without materializing the rotated halves
+in HBM. RoPE is a linear map whose transpose is the rotation by -theta, so
+the backward reuses the same kernel with negated sin.
+
+The interleaved pairing is computed with lane rolls + a parity mask (a
+minor-dim reshape/stack does not lower through Mosaic).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref, *, use_neox):
+    x = x_ref[0, 0].astype(jnp.float32)        # [S, D]
+    cos = cos_ref[0].astype(jnp.float32)       # [S, D]
+    sin = sin_ref[0].astype(jnp.float32)
+    d = x.shape[-1]
+    if use_neox:
+        # pair (i, i + d/2): rotate_half
+        x1 = x[:, : d // 2]
+        x2 = x[:, d // 2:]
+        rot = jnp.concatenate([-x2, x1], axis=-1)
+    else:
+        # pair (2i, 2i+1): rot[2i] = -x[2i+1], rot[2i+1] = x[2i]
+        nxt = pltpu.roll(x, d - 1, 1)          # nxt[i] = x[i+1]
+        prv = pltpu.roll(x, 1, 1)              # prv[i] = x[i-1]
+        even = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1) % 2 == 0
+        rot = jnp.where(even, -nxt, prv)
+    o_ref[0, 0] = (x * cos + rot * sin).astype(o_ref.dtype)
+
+
+def _rope_call(x, cos, sin, use_neox, interpret):
+    """x: [B, H, S, D]; cos/sin: [S, D] or [B, S, D] (per-batch tables,
+    e.g. gathered by position_ids) -> same-shape rotated x."""
+    b, h, s, d = x.shape
+    if cos.ndim == 2:
+        cos, sin = cos[None], sin[None]
+    batched = cos.shape[0] != 1
+    tab_ix = (lambda ib, ih: (ib, 0, 0)) if batched \
+        else (lambda ib, ih: (0, 0, 0))
+    kernel = functools.partial(_rope_kernel, use_neox=use_neox)
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h),
+        in_specs=[
+            pl.BlockSpec((1, 1, s, d), lambda ib, ih: (ib, ih, 0, 0)),
+            pl.BlockSpec((1, s, d), tab_ix),
+            pl.BlockSpec((1, s, d), tab_ix),
+        ],
+        out_specs=pl.BlockSpec((1, 1, s, d), lambda ib, ih: (ib, ih, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x, cos, sin)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _rope_bhsd(x, cos, sin, use_neox, interpret):
+    return _rope_call(x, cos, sin, use_neox, interpret)
+
+
+def _rope_fwd(x, cos, sin, use_neox, interpret):
+    return _rope_call(x, cos, sin, use_neox, interpret), (cos, sin)
+
+
+def _rope_bwd(use_neox, interpret, res, g):
+    cos, sin = res
+    # transpose of rotation(theta) = rotation(-theta)
+    return _rope_call(g, cos, -sin, use_neox, interpret), None, None
+
+
+_rope_bhsd.defvjp(_rope_fwd, _rope_bwd)
+
+
+def apply_rope(x, cos, sin, use_neox=True, interpret=None):
+    """Rotary embedding in paddle layout [batch, seq, num_heads, head_dim].
+
+    cos/sin: [seq, head_dim] — or [batch, seq, head_dim] for per-example
+    position tables — tiled to full head_dim (for ``use_neox=True``:
+    ``cos[s, i] = cos(s * inv_freq[i % (d/2)])``; for interleaved:
+    ``inv_freq[i // 2]``).
+    """
+    if interpret is None:
+        from . import use_interpret
+        interpret = use_interpret()
+    xt = jnp.swapaxes(x, 1, 2)
+    o = _rope_bhsd(xt, cos.astype(jnp.float32), sin.astype(jnp.float32),
+                   bool(use_neox), bool(interpret))
+    return jnp.swapaxes(o, 1, 2)

@@ -1,0 +1,185 @@
+"""paddle.incubate.nn.functional fused-op parity tests (reference surface
+``python/paddle/incubate/nn/functional/``; numerics checked against unfused
+compositions, the reference's own fused-kernel test strategy)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as FI
+
+
+def _t(shape, seed=0, dtype="float32"):
+    return paddle.to_tensor(
+        np.random.default_rng(seed).normal(size=shape).astype(dtype))
+
+
+def test_fused_rms_norm():
+    x = _t((4, 16, 64), 1)
+    w = _t((64,), 2)
+    out, res = FI.fused_rms_norm(x, w, None, 1e-6, -1)
+    xv = x.numpy().astype(np.float64)
+    ms = np.mean(xv * xv, axis=-1, keepdims=True)
+    ref = xv / np.sqrt(ms + 1e-6) * w.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-4)
+    np.testing.assert_allclose(res.numpy(), x.numpy())
+
+
+def test_fused_rms_norm_residual_bias():
+    x = _t((2, 8, 32), 1)
+    r = _t((2, 8, 32), 2)
+    b = _t((32,), 3)
+    w = _t((32,), 4)
+    out, res = FI.fused_rms_norm(x, w, None, 1e-6, -1, bias=b, residual=r)
+    v = x.numpy() + b.numpy() + r.numpy()
+    np.testing.assert_allclose(res.numpy(), v, atol=1e-5)
+    ms = np.mean(v * v, axis=-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy(), v / np.sqrt(ms + 1e-6) * w.numpy(),
+                               atol=1e-4)
+
+
+def test_fused_layer_norm():
+    x = _t((3, 7, 48), 5)
+    w = _t((48,), 6)
+    b = _t((48,), 7)
+    out, res = FI.fused_layer_norm(x, w, b, 1e-5, begin_norm_axis=-1)
+    v = x.numpy().astype(np.float64)
+    mean = v.mean(-1, keepdims=True)
+    var = v.var(-1, keepdims=True)
+    ref = (v - mean) / np.sqrt(var + 1e-5) * w.numpy() + b.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-4)
+
+
+def test_fused_rope_roundtrip_grad():
+    q = _t((2, 16, 4, 32), 8)
+    k = _t((2, 16, 4, 32), 9)
+    q.stop_gradient = False
+    out_q, out_k, _ = FI.fused_rotary_position_embedding(q, k)
+    assert tuple(out_q.shape) == (2, 16, 4, 32)
+    # rotation preserves pairwise norms
+    def pair_norm(a, neox=True):
+        a = a.reshape(a.shape[0], a.shape[1], a.shape[2], -1, 2)
+        return np.sqrt((a ** 2).sum(-1))
+    np.testing.assert_allclose(
+        pair_norm(out_q.numpy()), pair_norm(q.numpy()), atol=1e-4)
+    (out_q.sum()).backward()
+    assert q.grad is not None
+
+
+def test_fused_rope_half_style():
+    q = _t((1, 8, 2, 16), 10)
+    out_q, _, _ = FI.fused_rotary_position_embedding(
+        q, use_neox_rotary_style=False)
+    d = 16
+    inv = 1.0 / 10000.0 ** (np.arange(0, d // 2) * 2.0 / d)
+    ang = np.arange(8)[:, None] * inv[None, :]
+    ang = np.concatenate([ang, ang], -1)
+    cos, sin = np.cos(ang), np.sin(ang)
+    xv = q.numpy()
+    x1, x2 = xv[..., : d // 2], xv[..., d // 2:]
+    rot = np.concatenate([-x2, x1], -1)
+    ref = xv * cos[None, :, None, :] + rot * sin[None, :, None, :]
+    np.testing.assert_allclose(out_q.numpy(), ref, atol=1e-4)
+
+
+def test_fused_rms_norm_norm_bias_no_residual():
+    # regression: norm_bias without residual used to IndexError
+    x = _t((2, 4, 32), 20)
+    w = _t((32,), 21)
+    nb = _t((32,), 22)
+    out, _ = FI.fused_rms_norm(x, w, nb, 1e-6, -1)
+    v = x.numpy()
+    ms = np.mean(v * v, -1, keepdims=True)
+    ref = v / np.sqrt(ms + 1e-6) * w.numpy() + nb.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-4)
+
+
+def test_fused_rope_explicit_tables():
+    # regression: user-supplied sin/cos used to be swapped
+    q = _t((1, 8, 2, 16), 23)
+    d, s = 16, 8
+    inv = 1.0 / 10000.0 ** (np.arange(0, d // 2) * 2.0 / d)
+    ang = np.repeat(np.arange(s)[:, None] * inv[None, :], 2, -1)
+    sin = paddle.to_tensor(np.sin(ang).astype("float32"))
+    cos = paddle.to_tensor(np.cos(ang).astype("float32"))
+    out_explicit, _, _ = FI.fused_rotary_position_embedding(
+        q, sin=sin, cos=cos)
+    out_default, _, _ = FI.fused_rotary_position_embedding(q)
+    np.testing.assert_allclose(out_explicit.numpy(), out_default.numpy(),
+                               atol=1e-5)
+
+
+def test_fused_rope_position_ids_beyond_seq():
+    # regression: default tables with position ids >= seq_len gave NaN
+    q = _t((1, 6, 2, 16), 26)
+    pid = paddle.to_tensor((np.arange(6) + 4).astype("int32")[None])
+    out, _, _ = FI.fused_rotary_position_embedding(q, position_ids=pid)
+    assert np.isfinite(out.numpy()).all()
+    # must equal slicing a longer sequence at those positions
+    q10_np = np.zeros((1, 10, 2, 16), "float32")
+    q10_np[:, 4:10] = q.numpy()
+    out10, _, _ = FI.fused_rotary_position_embedding(
+        paddle.to_tensor(q10_np))
+    np.testing.assert_allclose(out.numpy(), out10.numpy()[:, 4:10],
+                               atol=1e-5)
+
+
+def test_fused_rope_position_ids():
+    # per-example position ids must rotate each batch row by its own table
+    q = _t((2, 6, 2, 16), 24)
+    pid = paddle.to_tensor(
+        np.stack([np.arange(6), np.arange(6) + 4]).astype("int32"))
+    out, _, _ = FI.fused_rotary_position_embedding(q, position_ids=pid)
+    # row 1 must differ from what row-0 positions would give it
+    out_row0_pos, _, _ = FI.fused_rotary_position_embedding(
+        q, position_ids=paddle.to_tensor(
+            np.stack([np.arange(6), np.arange(6)]).astype("int32")))
+    assert not np.allclose(out.numpy()[1], out_row0_pos.numpy()[1])
+    np.testing.assert_allclose(out.numpy()[0], out_row0_pos.numpy()[0],
+                               atol=1e-6)
+
+
+def test_recompute_plain_callable_grads():
+    # regression: recompute(lambda) used to drop parameter grads
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.recompute import recompute
+    paddle.seed(0)
+    lin = nn.Linear(8, 8)
+    x = _t((4, 8), 25)
+    y = recompute(lambda t: lin(t), x)
+    y.sum().backward()
+    assert lin.weight.grad is not None
+    ref = lin(x)
+    np.testing.assert_allclose(y.numpy(), ref.numpy(), atol=1e-5)
+
+
+def test_swiglu():
+    x = _t((4, 32), 11)
+    out = FI.swiglu(x)
+    a, b = np.split(x.numpy(), 2, axis=-1)
+    silu = a / (1 + np.exp(-a)) * b
+    np.testing.assert_allclose(out.numpy(), silu, atol=1e-5)
+    y = _t((4, 32), 12)
+    out2 = FI.swiglu(x, y)
+    xv = x.numpy()
+    np.testing.assert_allclose(out2.numpy(),
+                               xv / (1 + np.exp(-xv)) * y.numpy(), atol=1e-5)
+
+
+def test_fused_matmul_bias_linear_activation():
+    x = _t((4, 8), 13)
+    w = _t((8, 16), 14)
+    b = _t((16,), 15)
+    out = FI.fused_matmul_bias(x, w, b)
+    np.testing.assert_allclose(out.numpy(), x.numpy() @ w.numpy() + b.numpy(),
+                               atol=1e-4)
+    out2 = FI.fused_linear_activation(x, w, b, activation="relu")
+    np.testing.assert_allclose(
+        out2.numpy(), np.maximum(x.numpy() @ w.numpy() + b.numpy(), 0),
+        atol=1e-4)
+
+
+def test_fused_dropout_add_eval():
+    x = _t((4, 8), 16)
+    y = _t((4, 8), 17)
+    out = FI.fused_dropout_add(x, y, p=0.5, training=False)
+    np.testing.assert_allclose(out.numpy(), x.numpy() + y.numpy(), atol=1e-6)

@@ -1,0 +1,220 @@
+"""Pallas fused-kernel correctness tests (interpreter mode on CPU).
+
+The reference validates its fused CUDA kernels against unfused compositions
+(e.g. ``test/legacy_test/test_flash_attention.py`` checks flash_attn vs a
+naive softmax attention); we do the same: each Pallas kernel is compared —
+forward and gradients — against the plain-XLA composition it replaces.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas import flash_attention as fa
+from paddle_tpu.ops.pallas import norms, rope
+
+
+def _ref_sdpa(q, k, v, causal):
+    from paddle_tpu.nn.functional.attention import _sdpa_xla
+    return _sdpa_xla(q, k, v, causal=causal)
+
+
+def _rand(shape, dtype=jnp.float32, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).normal(size=shape), dtype=dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_forward(causal):
+    q = _rand((2, 70, 4, 32), seed=1)
+    k = _rand((2, 70, 4, 32), seed=2)
+    v = _rand((2, 70, 4, 32), seed=3)
+    out = fa.flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = _ref_sdpa(q, k, v, causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_cross_lengths():
+    # kv longer than q (decode-with-prefix shape): causal offset path
+    q = _rand((1, 17, 2, 32), seed=1)
+    k = _rand((1, 40, 2, 32), seed=2)
+    v = _rand((1, 40, 2, 32), seed=3)
+    out = fa.flash_attention(q, k, v, causal=True, interpret=True)
+    ref = _ref_sdpa(q, k, v, True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_gqa():
+    q = _rand((2, 33, 8, 32), seed=1)
+    k = _rand((2, 33, 2, 32), seed=2)
+    v = _rand((2, 33, 2, 32), seed=3)
+    out = fa.flash_attention(q, k, v, causal=True, interpret=True)
+    ref = _ref_sdpa(q, k, v, True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grads(causal):
+    q = _rand((1, 37, 2, 32), seed=4)
+    k = _rand((1, 37, 2, 32), seed=5)
+    v = _rand((1, 37, 2, 32), seed=6)
+
+    def loss_pl(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=causal, interpret=True)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(_ref_sdpa(q, k, v, causal)))
+
+    gp = jax.grad(loss_pl, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(a, b, atol=3e-5, rtol=3e-5)
+
+
+def test_flash_attention_gqa_grads():
+    q = _rand((1, 21, 4, 32), seed=7)
+    k = _rand((1, 21, 2, 32), seed=8)
+    v = _rand((1, 21, 2, 32), seed=9)
+
+    def loss_pl(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=True, interpret=True)
+        return jnp.sum(o * o)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(_ref_sdpa(q, k, v, True)))
+
+    gp = jax.grad(loss_pl, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(a, b, atol=3e-5, rtol=3e-5)
+
+
+def test_flash_attention_bf16():
+    q = _rand((1, 64, 2, 64), jnp.bfloat16, seed=1)
+    k = _rand((1, 64, 2, 64), jnp.bfloat16, seed=2)
+    v = _rand((1, 64, 2, 64), jnp.bfloat16, seed=3)
+    out = fa.flash_attention(q, k, v, causal=True, interpret=True)
+    ref = _ref_sdpa(q, k, v, True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(out.astype(jnp.float32),
+                               ref.astype(jnp.float32), atol=3e-2, rtol=3e-2)
+
+
+# --------------------------------------------------------------------------
+def _ref_rms(x, w, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(ms + eps)).astype(x.dtype) * w
+
+
+def _ref_ln(x, w, b, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return out.astype(x.dtype) * w + b
+
+
+def test_rms_norm_fwd_bwd():
+    x = _rand((6, 37, 128), seed=1)
+    w = _rand((128,), seed=2) + 1.0
+
+    out = norms.rms_norm(x, w, interpret=True)
+    np.testing.assert_allclose(out, _ref_rms(x, w), atol=1e-5, rtol=1e-5)
+
+    def lp(x, w):
+        return jnp.sum(jnp.sin(norms.rms_norm(x, w, interpret=True)))
+
+    def lr(x, w):
+        return jnp.sum(jnp.sin(_ref_rms(x, w)))
+
+    gp = jax.grad(lp, argnums=(0, 1))(x, w)
+    gr = jax.grad(lr, argnums=(0, 1))(x, w)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+
+def test_layer_norm_fwd_bwd():
+    x = _rand((300, 64), seed=3)  # non-multiple of row block: padding path
+    w = _rand((64,), seed=4) + 1.0
+    b = _rand((64,), seed=5)
+
+    out = norms.layer_norm(x, w, b, interpret=True)
+    np.testing.assert_allclose(out, _ref_ln(x, w, b), atol=1e-5, rtol=1e-5)
+
+    def lp(x, w, b):
+        return jnp.sum(jnp.cos(norms.layer_norm(x, w, b, interpret=True)))
+
+    def lr(x, w, b):
+        return jnp.sum(jnp.cos(_ref_ln(x, w, b)))
+
+    gp = jax.grad(lp, argnums=(0, 1, 2))(x, w, b)
+    gr = jax.grad(lr, argnums=(0, 1, 2))(x, w, b)
+    for a, b_ in zip(gp, gr):
+        np.testing.assert_allclose(a, b_, atol=2e-5, rtol=2e-5)
+
+
+# --------------------------------------------------------------------------
+def _rope_tables(s, d, base=10000.0):
+    inv = 1.0 / base ** (np.arange(0, d // 2) * 2.0 / d)
+    ang = np.arange(s)[:, None] * inv[None, :]
+    ang = np.concatenate([ang, ang], axis=-1)  # neox tiling
+    return jnp.asarray(np.cos(ang), jnp.float32), \
+        jnp.asarray(np.sin(ang), jnp.float32)
+
+
+def _ref_rope_neox(x, cos, sin):
+    d = x.shape[-1]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    return x * c + rot * s
+
+
+def test_rope_interleaved():
+    # pair (2i, 2i+1): rot[2i] = -x[2i+1], rot[2i+1] = x[2i]
+    x = _rand((1, 16, 2, 32), seed=8)
+    d = 32
+    inv = 1.0 / 10000.0 ** (np.arange(0, d // 2) * 2.0 / d)
+    ang = np.repeat(np.arange(16)[:, None] * inv[None, :], 2, axis=-1)
+    cos = jnp.asarray(np.cos(ang), jnp.float32)
+    sin = jnp.asarray(np.sin(ang), jnp.float32)
+    out = rope.apply_rope(x, cos, sin, use_neox=False, interpret=True)
+    xe = np.asarray(x).reshape(1, 16, 2, d // 2, 2)
+    rot = np.stack([-xe[..., 1], xe[..., 0]], -1).reshape(1, 16, 2, d)
+    ref = np.asarray(x) * np.asarray(cos)[None, :, None, :] + \
+        rot * np.asarray(sin)[None, :, None, :]
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_rope_batched_tables():
+    # per-example tables [B, S, D] (the position_ids path)
+    x = _rand((2, 8, 2, 16), seed=9)
+    cos, sin = _rope_tables(32, 16)
+    pid = np.stack([np.arange(8), np.arange(8) + 3])  # shifted positions
+    cb = jnp.asarray(np.asarray(cos)[pid])
+    sb = jnp.asarray(np.asarray(sin)[pid])
+    out = rope.apply_rope(x, cb, sb, interpret=True)
+    for b in range(2):
+        ref = _ref_rope_neox(x[b:b + 1], cb[b], sb[b])
+        np.testing.assert_allclose(out[b:b + 1], ref, atol=1e-5, rtol=1e-5)
+
+
+def test_rope_fwd_bwd():
+    x = _rand((2, 48, 4, 64), seed=6)
+    cos, sin = _rope_tables(48, 64)
+
+    out = rope.apply_rope(x, cos, sin, interpret=True)
+    ref = _ref_rope_neox(x, cos, sin)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def lp(x):
+        return jnp.sum(jnp.sin(rope.apply_rope(x, cos, sin, interpret=True)))
+
+    def lr(x):
+        return jnp.sum(jnp.sin(_ref_rope_neox(x, cos, sin)))
+
+    np.testing.assert_allclose(jax.grad(lp)(x), jax.grad(lr)(x),
+                               atol=2e-5, rtol=2e-5)
