@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() NaN-free
+_LANE = 8  # trailing lane width for per-row stats (Mosaic tile alignment)
 
 
 def _block_sizes(sq, sk):
@@ -55,7 +56,9 @@ def _pad_to(x, axis, mult):
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                 sq, sk, bq, bk):
     """One (batch, q-head, q-block) program: stream k/v blocks with online
-    softmax. Block shapes: q/o [1,1,bq,D]; k/v [1,1,Skp,D]; lse [1,1,bq]."""
+    softmax. Block shapes: q/o [1,1,bq,D]; k/v [1,1,Skp,D]; lse
+    [1,1,bq,LANE] (Mosaic needs the trailing dims tile-aligned, so the
+    per-row logsumexp is replicated across a small lane axis)."""
     iq = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * scale       # [bq, D]
     offset = sk - sq                                   # causal diagonal shift
@@ -98,7 +101,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 
     l_safe = jnp.where(l_f == 0.0, 1.0, l_f)           # padded q rows
     o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m_f + jnp.log(l_safe))[:, 0]
+    lse_ref[0, 0] = jnp.broadcast_to(m_f + jnp.log(l_safe), (bq, _LANE))
 
 
 def _fwd(q, k, v, scale, causal, interpret):
@@ -127,15 +130,16 @@ def _fwd(q, k, v, scale, causal, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
-            pl.BlockSpec((1, 1, bq), lambda ib, ih, iq: (ib, ih, iq)),
+            pl.BlockSpec((1, 1, bq, _LANE),
+                         lambda ib, ih, iq: (ib, ih, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, sqp, d), q.dtype),
-            jax.ShapeDtypeStruct((b, hq, sqp), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, sqp, _LANE), jnp.float32),
         ],
         interpret=interpret,
     )(qp, kp, vp)
-    return o[:, :, :sq], lse[:, :, :sq]
+    return o[:, :, :sq], lse[:, :, :sq, 0]
 
 
 # --------------------------------------------------------------------------
@@ -163,8 +167,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         qb = q_ref[0, 0, pl.ds(iq * bq, bq), :].astype(jnp.float32) * scale
         dob = do_ref[0, 0, pl.ds(iq * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(iq * bq, bq)]        # [bq]
-        dlt = delta_ref[0, 0, pl.ds(iq * bq, bq)]
+        lse = lse_ref[0, 0, pl.ds(iq * bq, bq), 0:1]   # [bq, 1]
+        dlt = delta_ref[0, 0, pl.ds(iq * bq, bq), 0:1]
         s = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bq, bk]
@@ -172,14 +176,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = (cols < sk) & (rows < sq)
         if causal:
             mask = mask & (rows + offset >= cols)
-        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dv = dv + jax.lax.dot_general(
             p, dob, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bk, D]
         dp = jax.lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bq, bk]
-        ds = p * (dp - dlt[:, None])                   # [bq, bk]
+        ds = p * (dp - dlt)                            # [bq, bk]
         dk = dk + jax.lax.dot_general(
             ds, qb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bk, D]
@@ -197,8 +201,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     iq = pl.program_id(2)
     qb = q_ref[0, 0].astype(jnp.float32) * scale       # [bq, D]
     dob = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]                                # [bq]
-    dlt = delta_ref[0, 0]
+    lse = lse_ref[0, 0, :, 0:1]                        # [bq, 1]
+    dlt = delta_ref[0, 0, :, 0:1]
     offset = sk - sq
 
     nk = pl.cdiv(sk, bk)
@@ -220,11 +224,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = cols < sk
         if causal:
             mask = mask & (rows + offset >= cols)
-        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - dlt[:, None])
+        ds = p * (dp - dlt)
         return dq + jax.lax.dot_general(
             ds, kb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -247,20 +251,25 @@ def _bwd(scale, causal, interpret, res, g):
 
     qp = _pad_to(q, 2, bq)
     dop = _pad_to(do, 2, bq)
-    lsep = _pad_to(lse, 2, bq)
-    dltp = _pad_to(delta, 2, bq)
     kp = _pad_to(k, 2, bk)
     vp = _pad_to(v, 2, bk)
     sqp, skp = qp.shape[2], kp.shape[2]
+    # per-row stats carried lane-replicated [B, H, Sqp, _LANE] (tiling rule)
+    lsep = jnp.broadcast_to(_pad_to(lse, 2, bq)[..., None],
+                            (b, hq, sqp, _LANE))
+    dltp = jnp.broadcast_to(_pad_to(delta, 2, bq)[..., None],
+                            (b, hq, sqp, _LANE))
 
     # --- dk/dv: grid over k blocks; one output copy per q head, summed
     # over the GQA group afterwards (B*Hq programs write disjoint slices).
     kernel = functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                                sq=sq, sk=sk, bq=bq, bk=bk)
-    kv_spec = pl.BlockSpec((1, 1, skp, d),
-                           lambda ib, ih, ikb, _rep=rep: (ib, ih // _rep, 0, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, bk, d),
+        lambda ib, ih, ikb, _rep=rep: (ib, ih // _rep, ikb, 0))
     q_full = pl.BlockSpec((1, 1, sqp, d), lambda ib, ih, ikb: (ib, ih, 0, 0))
-    v1_full = pl.BlockSpec((1, 1, sqp), lambda ib, ih, ikb: (ib, ih, 0))
+    v1_full = pl.BlockSpec((1, 1, sqp, _LANE),
+                           lambda ib, ih, ikb: (ib, ih, 0, 0))
     dkh, dvh = pl.pallas_call(
         kernel,
         grid=(b, hq, skp // bk),
@@ -287,7 +296,8 @@ def _bwd(scale, causal, interpret, res, g):
     qb_spec = pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq: (ib, ih, iq, 0))
     kv_spec = pl.BlockSpec((1, 1, skp, d),
                            lambda ib, ih, iq, _rep=rep: (ib, ih // _rep, 0, 0))
-    v1_spec = pl.BlockSpec((1, 1, bq), lambda ib, ih, iq: (ib, ih, iq))
+    v1_spec = pl.BlockSpec((1, 1, bq, _LANE),
+                           lambda ib, ih, iq: (ib, ih, iq, 0))
     dq = pl.pallas_call(
         kernel,
         grid=(b, hq, sqp // bq),

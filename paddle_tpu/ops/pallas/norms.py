@@ -52,7 +52,9 @@ def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dwp_ref, *, eps):
     # dx = rstd * (wg - xhat * mean(wg * xhat))
     c = jnp.mean(wg * xhat, axis=1, keepdims=True)
     dx_ref[:] = (rstd * (wg - xhat * c)).astype(dx_ref.dtype)
-    dwp_ref[:] = jnp.sum(g * xhat, axis=0, keepdims=True)  # partial dw
+    # partial dw, tile-aligned: an (8, h) block whose rows replicate the sum
+    dwp_ref[0] = jnp.broadcast_to(
+        jnp.sum(g * xhat, axis=0, keepdims=True), (8, xhat.shape[1]))
 
 
 def _rms_call(x, w, eps, interpret):
@@ -100,12 +102,12 @@ def _rms2d_bwd(eps, interpret, res, g):
                   pl.BlockSpec((br, 1), lambda i: (i, 0)),
                   pl.BlockSpec((br, h), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
-                   pl.BlockSpec((1, h), lambda i: (i, 0))],
+                   pl.BlockSpec((1, 8, h), lambda i: (i, 0, 0))],
         out_shape=[jax.ShapeDtypeStruct(xp.shape, x.dtype),
-                   jax.ShapeDtypeStruct((grid[0], h), jnp.float32)],
+                   jax.ShapeDtypeStruct((grid[0], 8, h), jnp.float32)],
         interpret=interpret,
     )(xp, w[None, :], rp, gp)
-    return dx[:rows], jnp.sum(dwp, axis=0).astype(w.dtype)
+    return dx[:rows], jnp.sum(dwp[:, 0], axis=0).astype(w.dtype)
 
 
 _rms2d.defvjp(_rms2d_fwd, _rms2d_bwd)
@@ -149,8 +151,10 @@ def _ln_bwd_kernel(x_ref, w_ref, mean_ref, rstd_ref, g_ref,
     c1 = jnp.mean(wg, axis=1, keepdims=True)
     c2 = jnp.mean(wg * xhat, axis=1, keepdims=True)
     dx_ref[:] = (rstd * (wg - c1 - xhat * c2)).astype(dx_ref.dtype)
-    dwp_ref[:] = jnp.sum(g * xhat, axis=0, keepdims=True)
-    dbp_ref[:] = jnp.sum(g, axis=0, keepdims=True)
+    h = xhat.shape[1]
+    dwp_ref[0] = jnp.broadcast_to(
+        jnp.sum(g * xhat, axis=0, keepdims=True), (8, h))
+    dbp_ref[0] = jnp.broadcast_to(jnp.sum(g, axis=0, keepdims=True), (8, h))
 
 
 def _ln_call(x, w, b, eps, interpret):
@@ -204,15 +208,15 @@ def _ln2d_bwd(eps, interpret, res, g):
                   pl.BlockSpec((br, 1), lambda i: (i, 0)),
                   pl.BlockSpec((br, h), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
-                   pl.BlockSpec((1, h), lambda i: (i, 0)),
-                   pl.BlockSpec((1, h), lambda i: (i, 0))],
+                   pl.BlockSpec((1, 8, h), lambda i: (i, 0, 0)),
+                   pl.BlockSpec((1, 8, h), lambda i: (i, 0, 0))],
         out_shape=[jax.ShapeDtypeStruct(xp.shape, x.dtype),
-                   jax.ShapeDtypeStruct((grid[0], h), jnp.float32),
-                   jax.ShapeDtypeStruct((grid[0], h), jnp.float32)],
+                   jax.ShapeDtypeStruct((grid[0], 8, h), jnp.float32),
+                   jax.ShapeDtypeStruct((grid[0], 8, h), jnp.float32)],
         interpret=interpret,
     )(xp, w[None, :], mp, rp, gp)
-    return (dx[:rows], jnp.sum(dwp, axis=0).astype(w.dtype),
-            jnp.sum(dbp, axis=0).astype(w.dtype))
+    return (dx[:rows], jnp.sum(dwp[:, 0], axis=0).astype(w.dtype),
+            jnp.sum(dbp[:, 0], axis=0).astype(w.dtype))
 
 
 _ln2d.defvjp(_ln2d_fwd, _ln2d_bwd)

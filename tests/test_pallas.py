@@ -90,6 +90,29 @@ def test_flash_attention_gqa_grads():
         np.testing.assert_allclose(a, b, atol=3e-5, rtol=3e-5)
 
 
+def test_flash_attention_multiblock_grads():
+    # seq > 128: multiple q/k blocks + padding (the tiled code paths the
+    # single-block shapes above never reach)
+    q = _rand((1, 300, 2, 16), seed=10)
+    k = _rand((1, 300, 2, 16), seed=11)
+    v = _rand((1, 300, 2, 16), seed=12)
+    out = fa.flash_attention(q, k, v, causal=True, interpret=True)
+    ref = _ref_sdpa(q, k, v, True)
+    np.testing.assert_allclose(out, ref, atol=3e-5, rtol=3e-5)
+
+    def loss_pl(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=True, interpret=True)
+        return jnp.sum(o * o)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(_ref_sdpa(q, k, v, True)))
+
+    gp = jax.grad(loss_pl, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
 def test_flash_attention_bf16():
     q = _rand((1, 64, 2, 64), jnp.bfloat16, seed=1)
     k = _rand((1, 64, 2, 64), jnp.bfloat16, seed=2)
