@@ -31,6 +31,7 @@ from . import jit  # noqa: F401
 from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
 from . import incubate  # noqa: F401
+from . import static  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from . import framework  # noqa: F401
 from .framework import save, load  # noqa: F401

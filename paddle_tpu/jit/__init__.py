@@ -27,6 +27,7 @@ How it works (see also ``core/tensor.py`` ``_tracker``):
 from __future__ import annotations
 
 import logging
+import os
 import warnings
 from typing import Any, Callable
 
@@ -399,10 +400,13 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     """``paddle.jit.to_static`` analog (reference ``jit/api.py:135``)."""
     def deco(fn):
         if isinstance(fn, StaticFunction):
+            if input_spec is not None:
+                fn._input_spec = input_spec
             return fn
         import functools
         sf = StaticFunction(fn, build_strategy, backend, full_graph)
         functools.update_wrapper(sf, fn, updated=[])
+        sf._input_spec = input_spec
         return sf
 
     if function is not None:
@@ -432,19 +436,261 @@ class BuildStrategy:
         self.enable_inplace = True
 
 
-# --- save / load (inference export) ---------------------------------------
+# --- save / load (inference program export) --------------------------------
+# ``paddle.jit.save`` analog (reference ``jit/api.py:744`` -> TranslatedLayer
+# ``:1246``): the traced program is exported as serialized StableHLO via
+# jax.export (the TPU-native ProgramDesc: SURVEY §7 maps ProgramDesc/PIR to
+# StableHLO as the IR). Format:
+#   {path}.pdmodel   pickle {stablehlo: bytes, param_names, out_struct, ...}
+#   {path}.pdiparams the parameter/buffer state dict (framework.save format)
+# ``jit.load`` rebuilds a TranslatedLayer that executes the program without
+# the original Python class.
+
+class _ExportTracker:
+    """Substitutes traced values for the captured parameter tensors during
+    program export; state writes are swallowed (the exported program is a
+    pure inference function)."""
+
+    def __init__(self, mapping):
+        self.map = mapping
+        self.env: dict[int, Any] = {}
+
+    def on_create(self, t):
+        pass
+
+    def on_read(self, t):
+        tid = id(t)
+        if tid in self.map:
+            return self.map[tid]
+        if tid in self.env:
+            return self.env[tid]
+        return t._data
+
+    def on_write(self, t, val):
+        self.env[id(t)] = val
+
+    def on_grad_write(self, t):
+        pass
+
+    def add_host_sync(self, fn):
+        pass
+
+
+def _encode_structure(out):
+    """Picklable descriptor of the output pytree; Tensors become indices."""
+    counter = [0]
+
+    def enc(o):
+        if isinstance(o, Tensor):
+            i = counter[0]
+            counter[0] += 1
+            return ("t", i)
+        if isinstance(o, (list, tuple)):
+            return ("seq", type(o).__name__, [enc(x) for x in o])
+        if isinstance(o, dict):
+            return ("d", {k: enc(v) for k, v in o.items()})
+        return ("c", o)
+    return enc(out), counter[0]
+
+
+def _decode_structure(desc, tensors):
+    kind = desc[0]
+    if kind == "t":
+        return tensors[desc[1]]
+    if kind == "seq":
+        seq = [_decode_structure(x, tensors) for x in desc[2]]
+        return tuple(seq) if desc[1] == "tuple" else seq
+    if kind == "d":
+        return {k: _decode_structure(v, tensors) for k, v in desc[1].items()}
+    return desc[1]
+
+
+def _spec_avals(specs):
+    """InputSpecs -> jax avals; None dims become symbolic dimensions (one
+    shared symbol per position index so equal batch dims stay equal)."""
+    from jax import export as jexport
+    has_dynamic = any(d is None for s in specs for d in s.shape)
+    if not has_dynamic:
+        return [jax.ShapeDtypeStruct(s.shape, jnp.dtype(s.dtype))
+                for s in specs], False
+    scope = jexport.SymbolicScope()
+    avals = []
+    for si, s in enumerate(specs):
+        parts = []
+        for di, d in enumerate(s.shape):
+            parts.append(f"d{di}" if d is None else str(d))
+        shape = jexport.symbolic_shape(",".join(parts) or "", scope=scope)
+        avals.append(jax.ShapeDtypeStruct(shape, jnp.dtype(s.dtype)))
+    return avals, True
+
+
+def _resolve_input_spec(fn_or_layer, input_spec):
+    from ..static import InputSpec
+    if input_spec is None:
+        target = fn_or_layer
+        from ..nn import Layer
+        if isinstance(fn_or_layer, Layer):
+            target = getattr(type(fn_or_layer).forward, "__wrapped__",
+                             fn_or_layer.forward)
+        input_spec = getattr(target, "_input_spec", None)
+    if input_spec is None:
+        raise ValueError(
+            "jit.save needs an input_spec: pass input_spec=[InputSpec(...)]"
+            " to jit.save or to @to_static")
+    specs = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            specs.append(s)
+        elif isinstance(s, Tensor):
+            specs.append(InputSpec.from_tensor(s))
+        else:
+            raise TypeError(f"input_spec entries must be InputSpec/Tensor, "
+                            f"got {type(s).__name__}")
+    return specs
+
+
 def save(layer, path, input_spec=None, **config):
-    """``paddle.jit.save`` analog (reference ``jit/api.py:744``): exports
-    state dict now; StableHLO program export lands with the inference
-    engine."""
+    """Export ``layer`` (or a ``@to_static`` function) as a standalone
+    inference program + parameters (reference ``jit/api.py:744``)."""
+    import pickle
+
     from .. import framework as fw
+    from ..core.autograd import no_grad
     from ..nn import Layer
+    from jax import export as jexport
+
+    specs = _resolve_input_spec(layer, input_spec)
+
     if isinstance(layer, Layer):
-        fw.save(layer.state_dict(), path + ".pdparams")
+        named = layer.state_dict()
+        fn = layer
     else:
-        raise TypeError("jit.save expects a Layer")
+        fn = layer.fn if isinstance(layer, StaticFunction) else layer
+        if not callable(fn):
+            raise TypeError("jit.save expects a Layer or a callable")
+        # discover captured state with a probe run on example inputs
+        d = _DiscoveryTracker()
+        ex_args = [Tensor(jnp.asarray(s._example())) for s in specs]
+        old = tensor_mod.set_tracker(d)
+        try:
+            with no_grad():
+                fn(*ex_args)
+        finally:
+            tensor_mod.set_tracker(old)
+        named = {f"var_{i}": t for i, t in enumerate(
+            t for t in d.inputs if not any(t is a for a in ex_args))}
+
+    names = list(named)
+    ptensors = [named[n] for n in names]
+
+    def pure(param_vals, *input_vals):
+        tr = _ExportTracker(
+            {id(t): v for t, v in zip(ptensors, param_vals)})
+        old = tensor_mod.set_tracker(tr)
+        try:
+            with no_grad():
+                out = fn(*[Tensor(v) for v in input_vals])
+        finally:
+            tensor_mod.set_tracker(old)
+        flat = _flatten_tensors(out, [])
+        return [tr.env.get(id(t), t._data) for t in flat], out
+
+    def pure_vals(param_vals, *input_vals):
+        return pure(param_vals, *input_vals)[0]
+
+    param_vals = [t._read() for t in ptensors]
+    avals, symbolic = _spec_avals(specs)
+    param_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype)
+                   for v in param_vals]
+    try:
+        exported = jexport.export(jax.jit(pure_vals))(param_avals, *avals)
+    except Exception:
+        if not symbolic:
+            raise
+        # model not shape-polymorphic (static reshapes etc.): fall back to
+        # the example's concrete shapes
+        warnings.warn("jit.save: symbolic-shape export failed; exporting "
+                      "with concrete example shapes instead")
+        avals = [jax.ShapeDtypeStruct(
+            tuple(2 if d is None else d for d in s.shape),
+            jnp.dtype(s.dtype)) for s in specs]
+        exported = jexport.export(jax.jit(pure_vals))(param_avals, *avals)
+
+    # run once concretely to learn the output structure
+    with no_grad():
+        _, out_example = pure(param_vals,
+                              *[jnp.zeros([2 if d is None else d
+                                           for d in s.shape],
+                                          jnp.dtype(s.dtype))
+                                for s in specs])
+    out_struct, n_out = _encode_structure(out_example)
+
+    meta = {
+        "format": "pdtpu.jit.v1",
+        "stablehlo": bytes(exported.serialize()),
+        "param_names": names,
+        "out_struct": out_struct,
+        "n_out": n_out,
+        "in_specs": [(s.shape, s.dtype, s.name) for s in specs],
+    }
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f, protocol=4)
+    fw.save(dict(zip(names, ptensors)), path + ".pdiparams")
+
+
+class TranslatedLayer:
+    """A loaded inference program (reference TranslatedLayer,
+    ``jit/api.py:1246``): callable without the original model code."""
+
+    def __init__(self, meta, params):
+        from jax import export as jexport
+        self._exported = jexport.deserialize(bytearray(meta["stablehlo"]))
+        self._names = meta["param_names"]
+        self._out_struct = meta["out_struct"]
+        self._params = params
+        self._call = jax.jit(
+            lambda pv, *xs: self._exported.call(pv, *xs))
+
+    def __call__(self, *inputs):
+        return self.forward(*inputs)
+
+    def forward(self, *inputs):
+        vals = [x._read() if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in inputs]
+        pv = [self._params[n]._read() for n in self._names]
+        outs = self._call(pv, *vals)
+        tensors = [Tensor(o, stop_gradient=True) for o in outs]
+        return _decode_structure(self._out_struct, tensors)
+
+    def state_dict(self):
+        return dict(self._params)
+
+    def set_state_dict(self, sd):
+        for k, v in sd.items():
+            if k in self._params:
+                self._params[k]._data = (v._read() if isinstance(v, Tensor)
+                                         else jnp.asarray(v))
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is an inference program; "
+                           "training requires the original model code")
 
 
 def load(path, **config):
+    """Load a ``jit.save``d program as a TranslatedLayer (reference
+    ``jit/api.py:1246``)."""
+    import pickle
+
     from .. import framework as fw
-    return fw.load(path + ".pdparams")
+    with open(path + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    if meta.get("format") != "pdtpu.jit.v1":
+        raise ValueError(f"{path}.pdmodel is not a pdtpu jit export")
+    params = fw.load(path + ".pdiparams")
+    return TranslatedLayer(meta, params)

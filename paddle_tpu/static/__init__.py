@@ -1,0 +1,60 @@
+"""``paddle.static`` parity surface.
+
+The reference's static-graph API (``python/paddle/base/framework.py:5768``
+Program, ``executor.py:1162`` Executor) is a whole execution mode; on TPU
+the jit capture cache *is* the static mode (SURVEY §7: "ProgramDesc/PIR +
+StandaloneExecutor -> StableHLO/jaxpr as the IR; jit compile cache as the
+executor"). This module provides the pieces user code actually touches:
+``InputSpec`` (reference ``python/paddle/static/input_spec.py``) and thin
+Program/Executor shims that delegate to the dynamic engine.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+
+class InputSpec:
+    """Shape/dtype/name signature of a program input (reference
+    ``python/paddle/static/input_spec.py``). ``None`` dims are dynamic —
+    ``jit.save`` exports them as symbolic dimensions."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(None if (d is None or (isinstance(d, int) and d < 0))
+                           else int(d) for d in shape)
+        self.dtype = str(np.dtype(convert_dtype(dtype)))
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        d = tensor._data if isinstance(tensor, Tensor) else tensor
+        return cls(tuple(d.shape), str(d.dtype), name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+    def batch(self, batch_size=None):
+        return InputSpec((batch_size,) + self.shape, self.dtype, self.name)
+
+    def unbatch(self):
+        if not self.shape:
+            raise ValueError("unbatch: 0-d spec")
+        return InputSpec(self.shape[1:], self.dtype, self.name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+    def _example(self, dyn=2):
+        """Concrete zeros for the discovery run (None dims -> ``dyn``)."""
+        shape = tuple(dyn if d is None else d for d in self.shape)
+        if "int" in self.dtype:
+            return np.zeros(shape, self.dtype)
+        return np.zeros(shape, self.dtype)
+
+
+__all__ = ["InputSpec"]

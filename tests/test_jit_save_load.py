@@ -1,0 +1,141 @@
+"""jit.save/load program export tests (reference ``paddle.jit.save/load``
+``python/paddle/jit/api.py:744,1246``; test pattern from
+``test/dygraph_to_static/test_save_inference_model.py``: save, reload,
+compare outputs — including in a fresh process without the model class)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static import InputSpec
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def test_save_load_same_outputs(tmp_path):
+    paddle.seed(0)
+    net = SmallNet()
+    net.eval()
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 16])])
+    assert os.path.exists(path + ".pdmodel")
+    assert os.path.exists(path + ".pdiparams")
+
+    loaded = paddle.jit.load(path)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(5, 16)).astype("float32"))
+    ref = net(x)
+    out = loaded(x)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+    # dynamic batch: a different batch size runs through the same program
+    x2 = paddle.to_tensor(
+        np.random.default_rng(1).normal(size=(9, 16)).astype("float32"))
+    np.testing.assert_allclose(loaded(x2).numpy(), net(x2).numpy(),
+                               atol=1e-5)
+
+
+def test_save_load_fresh_process(tmp_path):
+    paddle.seed(1)
+    net = SmallNet()
+    net.eval()
+    path = str(tmp_path / "fresh")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 16])])
+    x = np.random.default_rng(2).normal(size=(3, 16)).astype("float32")
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.save(str(tmp_path / "x.npy"), x)
+    np.save(str(tmp_path / "ref.npy"), ref)
+    # a fresh interpreter with no SmallNet definition
+    code = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+loaded = paddle.jit.load({path!r})
+x = np.load({str(tmp_path / 'x.npy')!r})
+out = loaded(paddle.to_tensor(x))
+ref = np.load({str(tmp_path / 'ref.npy')!r})
+np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+print("FRESH_OK")
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in sys.path if p] + [env.get("PYTHONPATH", "")])
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=300, env=env)
+    assert "FRESH_OK" in r.stdout, r.stdout + r.stderr
+
+
+def test_save_static_function(tmp_path):
+    paddle.seed(2)
+    net = SmallNet()
+    net.eval()
+
+    @paddle.jit.to_static(input_spec=[InputSpec([None, 16], name="x")])
+    def infer(x):
+        return net(x) * 2.0
+
+    path = str(tmp_path / "fn")
+    paddle.jit.save(infer, path)
+    loaded = paddle.jit.load(path)
+    x = paddle.to_tensor(
+        np.random.default_rng(3).normal(size=(4, 16)).astype("float32"))
+    np.testing.assert_allclose(loaded(x).numpy(), (net(x) * 2.0).numpy(),
+                               atol=1e-5)
+
+
+def test_save_multi_output_structure(tmp_path):
+    class TwoHead(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(8, 3)
+            self.b = nn.Linear(8, 5)
+
+        def forward(self, x):
+            return {"a": self.a(x), "b": [self.b(x), x.sum()]}
+
+    paddle.seed(3)
+    net = TwoHead()
+    net.eval()
+    path = str(tmp_path / "multi")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 8])])
+    loaded = paddle.jit.load(path)
+    x = paddle.to_tensor(
+        np.random.default_rng(4).normal(size=(2, 8)).astype("float32"))
+    ref = net(x)
+    out = loaded(x)
+    np.testing.assert_allclose(out["a"].numpy(), ref["a"].numpy(), atol=1e-5)
+    np.testing.assert_allclose(out["b"][0].numpy(), ref["b"][0].numpy(),
+                               atol=1e-5)
+    np.testing.assert_allclose(out["b"][1].numpy(), ref["b"][1].numpy(),
+                               atol=1e-5)
+
+
+def test_save_requires_spec(tmp_path):
+    net = SmallNet()
+    with pytest.raises(ValueError, match="input_spec"):
+        paddle.jit.save(net, str(tmp_path / "nospec"))
+
+
+def test_translated_layer_train_raises(tmp_path):
+    paddle.seed(4)
+    net = SmallNet()
+    net.eval()
+    path = str(tmp_path / "t")
+    paddle.jit.save(net, path, input_spec=[InputSpec([1, 16])])
+    loaded = paddle.jit.load(path)
+    with pytest.raises(RuntimeError):
+        loaded.train()
