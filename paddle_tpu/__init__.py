@@ -32,6 +32,11 @@ from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
 from . import incubate  # noqa: F401
 from . import static  # noqa: F401
+from . import metric  # noqa: F401
+from . import vision  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model  # noqa: F401
+from .hapi import callbacks  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from . import framework  # noqa: F401
 from .framework import save, load  # noqa: F401

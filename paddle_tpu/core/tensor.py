@@ -191,8 +191,21 @@ class Tensor:
         from .autograd import run_backward
         run_backward([self], [grad_tensor], retain_graph=retain_graph)
 
-    def clear_grad(self):
-        self._grad = None
+    def clear_grad(self, set_to_zero=False):
+        """Drop (default) or zero the gradient. ``set_to_zero=True`` zeroes
+        in place, keeping the grad object's identity stable — required for
+        jit-captured gradient accumulation, where the compiled program
+        threads the grad buffer as donated state across calls."""
+        if set_to_zero and self._grad is not None:
+            import jax.numpy as jnp
+            z = jnp.zeros_like(self._grad._read())
+            if _tracker is not None:
+                _tracker.on_write(self._grad, z)
+            else:
+                self._grad._data = z
+            self._grad._node = None
+        else:
+            self._grad = None
 
     def clear_gradient(self, set_to_zero=False):
         if set_to_zero and self._grad is not None:
@@ -204,7 +217,15 @@ class Tensor:
         if self._grad is None:
             self._grad = Tensor(g, stop_gradient=True)
         else:
-            self._grad = Tensor(self._grad._read() + g, stop_gradient=True)
+            # accumulate IN PLACE (reference semantics: grads accumulate
+            # into the same var). Keeping the grad object's identity stable
+            # also lets the jit capture thread it as program state.
+            acc = self._grad._read() + g
+            if _tracker is not None:
+                _tracker.on_write(self._grad, acc)
+            else:
+                self._grad._data = acc
+            self._grad._node = None
         if _tracker is not None:
             _tracker.on_grad_write(self)
 

@@ -1,0 +1,5 @@
+"""``paddle.hapi`` — high-level API (reference ``python/paddle/hapi/``)."""
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401
+
+__all__ = ["Model", "callbacks"]

@@ -226,8 +226,12 @@ class _Executable:
             grad_vals = []
             for t in grad_owners:
                 g = t._grad
-                grad_vals.append(g._data if g is not None
-                                 else jnp.zeros_like(t._data))
+                if g is None:
+                    grad_vals.append(jnp.zeros_like(t._data))
+                else:
+                    # in-place accumulated grads live in the replay env
+                    # (object identity stable); fresh grads hold tracers
+                    grad_vals.append(tr.env.get(id(g), g._data))
             return (tuple(ret_vals) + tuple(state_vals) + tuple(arg_vals) +
                     tuple(grad_vals))
 

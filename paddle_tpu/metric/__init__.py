@@ -1,0 +1,196 @@
+"""``paddle.metric`` parity (reference ``python/paddle/metric/metrics.py``:
+Metric base :46, Accuracy :184, Precision :310, Recall :407, Auc :499).
+
+Metrics are host-side accumulators: the compiled train/eval step returns
+predictions, and ``update`` runs on numpy values — keeping metric state out
+of the XLA program (the reference likewise updates them in Python between
+``_C_ops`` calls).
+"""
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x._read())
+    return np.asarray(x)
+
+
+class Metric(abc.ABC):
+    """Base class (reference ``metrics.py:46``)."""
+
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def name(self):
+        ...
+
+    @abc.abstractmethod
+    def update(self, *args):
+        ...
+
+    @abc.abstractmethod
+    def accumulate(self):
+        ...
+
+    @abc.abstractmethod
+    def reset(self):
+        ...
+
+    def compute(self, *args):
+        """Optional pre-processing of (pred, label) — runs inside the
+        compiled step when used through hapi; defaults to identity."""
+        return args
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (reference ``metrics.py:184``)."""
+
+    def __init__(self, topk=(1,), name=None, *args, **kwargs):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred = _np(pred)
+        label = _np(label)
+        # top-maxk indices, descending
+        idx = np.argsort(-pred, axis=-1)[..., : self.maxk]
+        if label.ndim == pred.ndim:
+            if label.shape[-1] == pred.shape[-1] and pred.shape[-1] > 1:
+                label = np.argmax(label, axis=-1)  # one-hot / soft labels
+            else:
+                label = label[..., 0]              # [N, 1] index labels
+        correct = idx == label[..., None]
+        return correct.astype("float32")
+
+    def update(self, correct, *args):
+        correct = _np(correct)
+        accs = []
+        num = int(np.prod(correct.shape[:-1]))
+        for i, k in enumerate(self.topk):
+            c = correct[..., :k].sum()
+            accs.append(float(c) / max(num, 1))
+            self.total[i] += float(c)
+            self.count[i] += num
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision (reference ``metrics.py:310``)."""
+
+    def __init__(self, name="precision", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.rint(_np(preds)).astype("int32").reshape(-1)
+        labels = _np(labels).astype("int32").reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall (reference ``metrics.py:407``)."""
+
+    def __init__(self, name="recall", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.rint(_np(preds)).astype("int32").reshape(-1)
+        labels = _np(labels).astype("int32").reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC AUC via thresholded confusion bins (reference ``metrics.py:499``,
+    same bucketed algorithm)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc",
+                 *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _np(preds)
+        if preds.ndim == 2:          # [N, 2] class probs -> P(class 1)
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = _np(labels).reshape(-1)
+        bins = np.clip((preds * self.num_thresholds).astype("int64"),
+                       0, self.num_thresholds)
+        pos = labels > 0.5
+        np.add.at(self._stat_pos, bins[pos], 1)
+        np.add.at(self._stat_neg, bins[~pos], 1)
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, "int64")
+        self._stat_neg = np.zeros(self.num_thresholds + 1, "int64")
+
+    def accumulate(self):
+        tot_pos = tot_neg = 0.0
+        auc = 0.0
+        # walk thresholds from high to low, trapezoid over (fp, tp)
+        for i in range(self.num_thresholds, -1, -1):
+            p = float(self._stat_pos[i])
+            n = float(self._stat_neg[i])
+            auc += n * (tot_pos + p / 2.0)
+            tot_pos += p
+            tot_neg += n
+        denom = tot_pos * tot_neg
+        return auc / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]

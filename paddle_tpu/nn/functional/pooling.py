@@ -59,13 +59,18 @@ def _pool(name, x, nd, kernel_size, stride, padding, ceil_mode, data_format,
             padcfg = [(0, 0)] * ndim
             for ax, p in zip(_spatial_axes(nd, channel_last, ndim), pads):
                 padcfg[ax] = p
+        # init must be a CONCRETE numpy scalar: lax.reduce_window only
+        # routes to its differentiable max/add monoid primitives when it
+        # can recognize (computation, init) — a device-array init forces
+        # the generic primitive, whose vjp fails under an outer jit trace
         if kind == "max":
-            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) \
-                else jnp.iinfo(v.dtype).min
+            init = (v.dtype.type(-np.inf)
+                    if jnp.issubdtype(v.dtype, jnp.floating)
+                    else v.dtype.type(jnp.iinfo(v.dtype).min))
             return jax.lax.reduce_window(
-                v, jnp.asarray(init, v.dtype), jax.lax.max, win, strd, padcfg)
+                v, init, jax.lax.max, win, strd, padcfg)
         s = jax.lax.reduce_window(
-            v, jnp.asarray(0, v.dtype), jax.lax.add, win, strd, padcfg)
+            v, v.dtype.type(0), jax.lax.add, win, strd, padcfg)
         if divisor_override:
             return s / divisor_override
         if exclusive and padcfg != "SAME":

@@ -174,9 +174,12 @@ class Optimizer:
     def _update(self, p, w, g, lr):
         raise NotImplementedError
 
-    def clear_grad(self, set_to_zero=True):
+    def clear_grad(self, set_to_zero=False):
+        # NOTE: the reference defaults set_to_zero=True (zero in place);
+        # we default to dropping the buffer — zeroing is opt-in for
+        # jit-captured gradient accumulation (hapi accumulate_grad_batches).
         for p in self._parameters:
-            p.clear_grad()
+            p.clear_grad(set_to_zero=set_to_zero)
 
     clear_gradients = clear_grad
 

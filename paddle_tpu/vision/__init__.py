@@ -1,0 +1,6 @@
+"""``paddle.vision`` parity namespace (reference ``python/paddle/vision/``)."""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+
+__all__ = ["datasets", "models", "transforms"]

@@ -1,0 +1,113 @@
+"""``paddle.vision.datasets`` parity (reference
+``python/paddle/vision/datasets/mnist.py:29``, ``cifar.py:33``).
+
+No network egress in this environment, so datasets read standard local
+files (MNIST idx / CIFAR pickle formats) from ``image_path``/``data_file``
+and raise a clear error when absent instead of downloading.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+
+def _require(path, what):
+    if path is None or not os.path.exists(path):
+        raise RuntimeError(
+            f"{what} not found at {path!r}. This environment has no "
+            f"network access: place the standard dataset files locally and "
+            f"pass their path (download=False semantics).")
+    return path
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+class MNIST(Dataset):
+    """reference ``mnist.py:29``: items are (image HW1 float32-able, label).
+    ``image_path``/``label_path`` point at the idx(.gz) files."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend="cv2"):
+        self.mode = mode.lower()
+        self.transform = transform
+        image_path = _require(image_path, f"{self.NAME} images")
+        label_path = _require(label_path, f"{self.NAME} labels")
+        self.images = _read_idx(image_path)        # [N, 28, 28] uint8
+        self.labels = _read_idx(label_path).astype("int64")
+
+    def __getitem__(self, idx):
+        img = self.images[idx][:, :, None]          # HWC
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype("float32")
+        return img, np.asarray([self.labels[idx]], dtype="int64")
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """reference ``cifar.py:33``: reads the python-pickle tar.gz batches."""
+
+    _train_members = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_members = ["test_batch"]
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        self.mode = mode.lower()
+        self.transform = transform
+        data_file = _require(data_file, "cifar archive")
+        members = (self._train_members if self.mode == "train"
+                   else self._test_members)
+        images, labels = [], []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if base in members:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    images.append(np.asarray(d[b"data"], dtype=np.uint8))
+                    labels.extend(d.get(b"labels", d.get(b"fine_labels")))
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.images = self.images.transpose(0, 2, 3, 1)  # HWC
+        self.labels = np.asarray(labels, dtype="int64")
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype("float32")
+        return img, np.asarray([self.labels[idx]], dtype="int64")
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    _train_members = ["train"]
+    _test_members = ["test"]
+
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]

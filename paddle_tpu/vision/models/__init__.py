@@ -1,0 +1,19 @@
+"""``paddle.vision.models`` parity (reference ``python/paddle/vision/models/``:
+lenet.py, resnet.py, vgg.py, alexnet.py, mobilenetv2.py). Same
+architectures and constructor surfaces; ``pretrained=True`` is rejected
+(no weight hub in this environment — load weights with
+``paddle.load``/``set_state_dict`` instead).
+"""
+from .lenet import LeNet
+from .resnet import (ResNet, BasicBlock, BottleneckBlock, resnet18,
+                     resnet34, resnet50, resnet101, resnet152)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from .alexnet import AlexNet, alexnet
+from .mobilenetv2 import MobileNetV2, mobilenet_v2
+
+__all__ = [
+    "LeNet", "ResNet", "BasicBlock", "BottleneckBlock", "resnet18",
+    "resnet34", "resnet50", "resnet101", "resnet152", "VGG", "vgg11",
+    "vgg13", "vgg16", "vgg19", "AlexNet", "alexnet", "MobileNetV2",
+    "mobilenet_v2",
+]

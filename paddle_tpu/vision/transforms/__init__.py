@@ -1,0 +1,276 @@
+"""``paddle.vision.transforms`` parity (reference
+``python/paddle/vision/transforms/transforms.py`` Compose :150, ToTensor
+:295, Resize :370, RandomHorizontalFlip :789, Normalize :886, Transpose
+:978, RandomCrop :620, CenterCrop :750, Pad :1025).
+
+Numpy/PIL-free implementation: images are HWC uint8/float numpy arrays (the
+DataLoader collates numpy anyway); interpolation is nearest/bilinear via
+vectorized numpy — host-side preprocessing stays off the TPU.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+def _as_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def to_tensor(img, data_format="CHW"):
+    img = _as_hwc(img)
+    if img.dtype == np.uint8:
+        img = img.astype("float32") / 255.0
+    else:
+        img = img.astype("float32")
+    if data_format == "CHW":
+        img = np.transpose(img, (2, 0, 1))
+    return img
+
+
+class ToTensor(BaseTransform):
+    """HWC [0,255] -> CHW float32 [0,1] (reference ``ToTensor:295``).
+    Returns numpy (collated to device tensors by the DataLoader)."""
+
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+def resize(img, size, interpolation="bilinear"):
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        if h <= w:
+            oh, ow = size, max(1, int(size * w / h))
+        else:
+            oh, ow = max(1, int(size * h / w)), size
+    else:
+        oh, ow = size
+    if (oh, ow) == (h, w):
+        return img
+    if interpolation == "nearest":
+        ry = (np.arange(oh) * (h / oh)).astype(int).clip(0, h - 1)
+        rx = (np.arange(ow) * (w / ow)).astype(int).clip(0, w - 1)
+        return img[ry][:, rx]
+    # bilinear
+    y = (np.arange(oh) + 0.5) * h / oh - 0.5
+    x = (np.arange(ow) + 0.5) * w / ow - 0.5
+    y0 = np.clip(np.floor(y).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(x).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(y - y0, 0, 1)[:, None, None]
+    wx = np.clip(x - x0, 0, 1)[None, :, None]
+    im = img.astype("float32")
+    out = (im[y0][:, x0] * (1 - wy) * (1 - wx) +
+           im[y1][:, x0] * wy * (1 - wx) +
+           im[y0][:, x1] * (1 - wy) * wx +
+           im[y1][:, x1] * wy * wx)
+    if img.dtype == np.uint8:
+        return np.rint(out).clip(0, 255).astype(np.uint8)
+    return out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+def center_crop(img, output_size):
+    img = _as_hwc(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    h, w = img.shape[:2]
+    th, tw = output_size
+    i = max(0, (h - th) // 2)
+    j = max(0, (w - tw) // 2)
+    return img[i:i + th, j:j + tw]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if self.padding is not None:
+            img = pad(img, self.padding, self.fill, self.padding_mode)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            img = pad(img, (max(0, (tw - w)), max(0, (th - h))), self.fill,
+                      self.padding_mode)
+            h, w = img.shape[:2]
+        i = random.randint(0, max(0, h - th))
+        j = random.randint(0, max(0, w - tw))
+        return img[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return _as_hwc(img)[:, ::-1].copy()
+        return _as_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return _as_hwc(img)[::-1].copy()
+        return _as_hwc(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    img = np.asarray(img, dtype="float32")
+    mean = np.asarray(mean, dtype="float32")
+    std = np.asarray(std, dtype="float32")
+    if data_format == "CHW":
+        shape = (-1, 1, 1)
+    else:
+        shape = (1, 1, -1)
+    return (img - mean.reshape(shape)) / std.reshape(shape)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, (int, float)):
+            mean = [mean] * 3
+        if isinstance(std, (int, float)):
+            std = [std] * 3
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _as_hwc(img)
+    if isinstance(padding, int):
+        pl = pr = pt = pb = padding
+    elif len(padding) == 2:
+        pl = pr = padding[0]
+        pt = pb = padding[1]
+    else:
+        pl, pt, pr, pb = padding
+    widths = [(pt, pb), (pl, pr), (0, 0)]
+    if padding_mode == "constant":
+        return np.pad(img, widths, constant_values=fill)
+    return np.pad(img, widths, mode={"reflect": "reflect",
+                                     "edge": "edge",
+                                     "symmetric": "symmetric"}[padding_mode])
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.transpose(_as_hwc(img), self.order)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = random.uniform(*self.scale) * area
+            ar = np.exp(random.uniform(np.log(self.ratio[0]),
+                                       np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target_area * ar)))
+            th = int(round(np.sqrt(target_area / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = random.randint(0, h - th)
+                j = random.randint(0, w - tw)
+                crop = img[i:i + th, j:j + tw]
+                return resize(crop, self.size, self.interpolation)
+        return resize(center_crop(img, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        alpha = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        img = _as_hwc(img)
+        out = img.astype("float32") * alpha
+        if img.dtype == np.uint8:
+            return out.clip(0, 255).astype("uint8")
+        return out
+
+
+__all__ = [
+    "Compose", "BaseTransform", "ToTensor", "to_tensor", "Resize", "resize",
+    "CenterCrop", "center_crop", "RandomCrop", "RandomHorizontalFlip",
+    "RandomVerticalFlip", "Normalize", "normalize", "Pad", "pad",
+    "Transpose", "RandomResizedCrop", "BrightnessTransform",
+]

@@ -1,0 +1,272 @@
+"""hapi Model / metric / vision tests (reference test patterns:
+``test/legacy_test/test_hapi_*`` — fit on a small dataset, metric
+accumulate checks, model forward shapes)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+from paddle_tpu.vision import models, transforms
+
+
+class RandomClsDataset(Dataset):
+    """Synthetic separable 2-class data."""
+
+    def __init__(self, n=64, dim=16, classes=4, seed=0, centers_seed=42):
+        self.centers = np.random.default_rng(centers_seed).normal(
+            size=(classes, dim)).astype("float32") * 3
+        rng = np.random.default_rng(seed)
+        self.labels = rng.integers(0, classes, n).astype("int64")
+        self.x = (self.centers[self.labels] +
+                  rng.normal(size=(n, dim)).astype("float32") * 0.1)
+
+    def __getitem__(self, i):
+        return self.x[i], np.asarray([self.labels[i]], "int64")
+
+    def __len__(self):
+        return len(self.x)
+
+
+def test_model_fit_evaluate_predict():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    train = RandomClsDataset(n=64, seed=0)
+    val = RandomClsDataset(n=32, seed=1)
+    model.fit(train, epochs=3, batch_size=16, verbose=0)
+    res = model.evaluate(val, batch_size=16, verbose=0)
+    assert res["eval_acc"] > 0.9, res
+    preds = model.predict(val, batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (32, 4)
+
+
+def test_model_save_load(tmp_path):
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(8, 3))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss())
+    p = str(tmp_path / "ckpt")
+    model.save(p)
+    net2 = nn.Sequential(nn.Linear(8, 3))
+    model2 = paddle.Model(net2)
+    model2.prepare(
+        optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net2.parameters()),
+        loss=nn.CrossEntropyLoss())
+    model2.load(p)
+    x = paddle.to_tensor(np.ones((2, 8), "float32"))
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), atol=1e-6)
+
+
+def test_early_stopping():
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(16, 4))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.SGD(learning_rate=0.0,  # never improves
+                                       parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    es = paddle.callbacks.EarlyStopping(monitor="eval_loss", mode="min",
+                                        patience=1, verbose=0,
+                                        save_best_model=False)
+    data = RandomClsDataset(n=32, seed=3)
+    model.fit(data, eval_data=data, epochs=10, batch_size=16, verbose=0,
+              callbacks=[es])
+    assert model.stop_training
+
+
+def test_accuracy_topk():
+    m = Accuracy(topk=(1, 2))
+    pred = paddle.to_tensor(np.asarray(
+        [[0.1, 0.7, 0.2], [0.8, 0.1, 0.1]], "float32"))
+    label = paddle.to_tensor(np.asarray([[2], [0]], "int64"))
+    m.update(m.compute(pred, label))
+    top1, top2 = m.accumulate()
+    assert abs(top1 - 0.5) < 1e-6
+    assert abs(top2 - 1.0) < 1e-6
+
+
+def test_precision_recall_auc():
+    p, r, a = Precision(), Recall(), Auc()
+    preds = np.asarray([0.9, 0.8, 0.2, 0.6], "float32")
+    labels = np.asarray([1, 0, 1, 1], "int64")
+    p.update(preds, labels)
+    r.update(preds, labels)
+    a.update(preds, labels)
+    assert abs(p.accumulate() - 2 / 3) < 1e-6
+    assert abs(r.accumulate() - 2 / 3) < 1e-6
+    assert 0.0 <= a.accumulate() <= 1.0
+
+
+@pytest.mark.parametrize("factory,ch,size,classes", [
+    (models.LeNet, 1, 28, 10),
+    (lambda: models.resnet18(num_classes=7), 3, 32, 7),
+    (lambda: models.mobilenet_v2(num_classes=5), 3, 32, 5),
+])
+def test_vision_models_forward(factory, ch, size, classes):
+    paddle.seed(0)
+    net = factory()
+    net.eval()
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(2, ch, size, size))
+        .astype("float32"))
+    out = net(x)
+    assert tuple(out.shape) == (2, classes)
+
+
+def test_resnet50_bottleneck_shapes():
+    paddle.seed(0)
+    net = models.resnet50(num_classes=3)
+    net.eval()
+    x = paddle.to_tensor(np.zeros((1, 3, 64, 64), "float32"))
+    assert tuple(net(x).shape) == (1, 3)
+    # bottleneck expansion: layer1 output channels = 256
+    assert net.layer1[0].conv3.weight.shape[0] == 256
+
+
+def test_pretrained_rejected():
+    with pytest.raises(ValueError, match="pretrained"):
+        models.resnet18(pretrained=True)
+
+
+def test_transforms_pipeline():
+    t = transforms.Compose([
+        transforms.Resize(36),
+        transforms.CenterCrop(32),
+        transforms.ToTensor(),
+        transforms.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5]),
+    ])
+    img = np.random.default_rng(0).integers(0, 255, (48, 64, 3), "uint8")
+    out = t(img)
+    assert out.shape == (3, 32, 32)
+    assert out.dtype == np.float32
+    assert -1.01 <= out.min() and out.max() <= 1.01
+
+
+def test_transforms_resize_aspect():
+    img = np.zeros((40, 80, 3), "uint8")
+    out = transforms.resize(img, 20)
+    assert out.shape[:2] == (20, 40)
+
+
+def test_random_crop_pad():
+    img = np.ones((10, 10, 1), "uint8")
+    out = transforms.RandomCrop(8)(img)
+    assert out.shape == (8, 8, 1)
+    out2 = transforms.Pad(2)(img)
+    assert out2.shape == (14, 14, 1)
+
+
+def test_lenet_with_model_fit():
+    """Config-1 class smoke: LeNet through the hapi surface (reference
+    test_hapi pattern: Model(LeNet()).fit(MNIST))."""
+
+    class FakeMNIST(Dataset):
+        def __init__(self, n=32):
+            rng = np.random.default_rng(0)
+            self.x = rng.normal(size=(n, 1, 28, 28)).astype("float32")
+            self.y = rng.integers(0, 10, n).astype("int64")
+
+        def __getitem__(self, i):
+            return self.x[i], np.asarray([self.y[i]], "int64")
+
+        def __len__(self):
+            return len(self.x)
+
+    paddle.seed(0)
+    net = models.LeNet()
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    model.fit(FakeMNIST(), epochs=1, batch_size=16, verbose=0)
+    out = model.predict_batch([np.zeros((2, 1, 28, 28), "float32")])
+    assert tuple(out.shape) == (2, 10)
+
+
+def test_grad_accumulation_parity():
+    """accumulate_grad_batches=k @ bs=b must match one step @ bs=k*b, and
+    both accumulation step variants must compile (no eager fallback)."""
+    import warnings
+
+    def build():
+        paddle.seed(0)
+        return nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 4))
+
+    ds = RandomClsDataset(n=32)
+    net_a = build()
+    ma = paddle.Model(net_a)
+    ma.prepare(paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=net_a.parameters()),
+               nn.CrossEntropyLoss())
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        ma.fit(ds, batch_size=4, epochs=2, shuffle=False, verbose=0,
+               accumulate_grad_batches=4)
+        assert not [x for x in w if "eager fallback" in str(x.message)]
+
+    net_b = build()
+    mb = paddle.Model(net_b)
+    mb.prepare(paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=net_b.parameters()),
+               nn.CrossEntropyLoss())
+    mb.fit(ds, batch_size=16, epochs=2, shuffle=False, verbose=0)
+    for pa, pb in zip(net_a.parameters(), net_b.parameters()):
+        np.testing.assert_allclose(np.asarray(pa._read()),
+                                   np.asarray(pb._read()), atol=1e-5)
+
+
+def test_evaluate_without_loss():
+    """Metrics-only prepare: no bogus eval_loss, metrics still reported."""
+    net = nn.Linear(16, 4)
+    m = paddle.Model(net)
+    m.prepare(metrics=Accuracy())
+    res = m.evaluate(RandomClsDataset(n=16), batch_size=8, verbose=0)
+    assert "eval_loss" not in res and "eval_acc" in res
+
+
+def test_predict_empty_dataset():
+    class Empty(Dataset):
+        def __len__(self):
+            return 0
+
+        def __getitem__(self, i):
+            raise IndexError
+
+    m = paddle.Model(nn.Linear(4, 2))
+    m.prepare()
+    assert m.predict(Empty(), verbose=0) == []
+
+
+def test_auc_negative_preds_no_wraparound():
+    a = Auc(num_thresholds=10)
+    a.update(np.array([-0.5, 1.7, 0.9, 0.1]), np.array([0, 1, 1, 0]))
+    assert a._stat_neg[0] == 1 and a._stat_pos[10] == 1
+    assert 0.0 <= a.accumulate() <= 1.0
+
+
+def test_accuracy_duplicate_topk():
+    acc = Accuracy(topk=(1, 1))
+    acc.update(np.array([[1.0, 0.0], [1.0, 0.0]]))
+    assert acc.accumulate() == [1.0, 1.0]
+
+
+def test_resize_rounds_not_truncates():
+    img = np.full((4, 4, 1), 127, "uint8")
+    img[::2] = 128  # interpolated values land at x.5 boundaries
+    out = transforms.resize(img, (2, 2), "bilinear")
+    assert out.dtype == np.uint8
+    assert int(out.max()) >= 127  # truncation bias would pull everything down
