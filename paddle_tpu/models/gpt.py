@@ -66,6 +66,10 @@ class GPTAttention(Layer):
             h, h, weight_attr=_init_normal(0.02 / math.sqrt(2 * cfg.num_layers)))
         self.dropout = cfg.dropout
         self.use_flash = cfg.use_flash_attention
+        # context parallelism (ring attention over an sp mesh axis) —
+        # wired by shard_gpt(..., context_parallel=True)
+        self._cp_mesh = None
+        self._cp_axes = (None, None, None)  # (sp, dp, mp)
 
     def forward(self, x):
         from .. import ops
@@ -73,10 +77,16 @@ class GPTAttention(Layer):
         qkv = self.qkv(x)
         qkv = ops.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = ops.unbind(qkv, axis=2)  # each [b, s, heads, head_dim]
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True,
-            dropout_p=self.dropout if self.training else 0.0,
-            backend=None if self.use_flash else "xla")
+        if self._cp_mesh is not None:
+            sp, dp, mp = self._cp_axes
+            out = F.ring_flash_attention(
+                q, k, v, mesh=self._cp_mesh, sp_axis=sp, batch_axes=dp,
+                head_axis=mp, is_causal=True)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True,
+                dropout_p=self.dropout if self.training else 0.0,
+                backend=None if self.use_flash else "xla")
         out = ops.reshape(out, [b, s, h])
         return self.proj(out)
 
@@ -193,7 +203,7 @@ def ops_reshape(x, shape):
 # --- GSPMD sharding recipe (the fleet-TP analog for this model) ------------
 
 def shard_gpt(model: GPTForCausalLM, mesh, dp_axis="dp", mp_axis="mp",
-              sp_axis=None):
+              sp_axis=None, context_parallel=False):
     """Pin Megatron-style shardings over ``mesh`` (a ProcessMesh).
 
     Column-parallel: qkv / fc1 weights shard output dim over mp.
@@ -203,11 +213,25 @@ def shard_gpt(model: GPTForCausalLM, mesh, dp_axis="dp", mp_axis="mp",
     reference hand-codes in ``mp_ops.py`` (SURVEY D14). dp/sp axes shard the
     *data* (batch/sequence), applied by the caller on inputs; parameters
     stay replicated over dp/sp (pure DP; use fleet sharding stages for ZeRO).
+
+    ``context_parallel=True`` (requires ``sp_axis``) switches every attention
+    layer to ring attention over the sp axis — K/V blocks rotate on ICI and
+    the [S, S] score matrix never materializes, the long-context mode (the
+    reference's sep/segment-parallel axis, ``fleet/base/topology.py:65``).
     """
     from ..distributed.auto_parallel.api import (Replicate, Shard,
                                                  shard_parameter)
 
     names = mesh.dim_names
+    if context_parallel:
+        if sp_axis not in names:
+            raise ValueError("context_parallel requires sp_axis in the mesh")
+        for blk in model.gpt.blocks:
+            blk.attn._cp_mesh = mesh
+            blk.attn._cp_axes = (
+                sp_axis,
+                dp_axis if dp_axis in names else None,
+                mp_axis if mp_axis in names else None)
     if mp_axis not in names:
         return model
     mp_dim = names.index(mp_axis)

@@ -29,3 +29,4 @@ from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, flash_attn_qkvpacked,
     flash_attn_unpadded, sdp_kernel,
 )
+from .ring_attention import ring_flash_attention  # noqa: F401
