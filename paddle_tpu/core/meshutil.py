@@ -1,0 +1,16 @@
+"""Small shard_map helpers shared by the manual-collective modules
+(ring attention, pipeline, MoE)."""
+from __future__ import annotations
+
+from jax import lax
+
+
+def pvary(xs, axes):
+    """Mark values as varying over the given manual mesh axes (shard_map's
+    vma type system; the API name differs across jax versions)."""
+    axes = tuple(axes)
+    if not axes:
+        return xs
+    if hasattr(lax, "pcast"):
+        return lax.pcast(xs, axes, to="varying")
+    return lax.pvary(xs, axes)

@@ -16,6 +16,9 @@ from .layers.mpu import (  # noqa: F401
     ParallelCrossEntropy,
 )
 from .sharding_optimizer import DygraphShardingOptimizer  # noqa: F401
+from .pipeline import (  # noqa: F401
+    PipelinedBlocks, PipelineLayer, LayerDesc, functional_call,
+)
 from .recompute import (  # noqa: F401
     recompute, recompute_sequential, recompute_hybrid,
 )

@@ -1,0 +1,261 @@
+"""Pipeline parallelism — SPMD GPipe over a ``pp`` mesh axis.
+
+Capability analog of the reference's pipeline stack (SURVEY D15-D17):
+``python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py``
+(schedules, 1F1B :663), ``parallel_layers/pp_layers.py`` (PipelineLayer /
+LayerDesc), ``pp_utils/p2p_communication.py`` (stage P2P). The reference
+runs one process per stage and hand-schedules NCCL send/recv; here the
+whole pipeline is ONE SPMD program:
+
+- the repeated block stack's parameters are stacked into ``[L, ...]``
+  arrays sharded ``Shard(0)`` over the ``pp`` axis — stage assignment IS
+  the sharding;
+- a ``jax.shard_map`` + ``lax.scan`` runs the classic fill-drain (GPipe)
+  schedule: at tick ``t`` stage ``i`` computes microbatch ``t - i`` and
+  hands its activation to stage ``i+1`` via ``lax.ppermute`` (ICI
+  neighbor hop — the p2p_communication analog);
+- backward is JAX's transpose of the scan: activations flow backward
+  through reversed ppermutes, giving the mirrored drain-fill schedule
+  without a hand-written 1F1B engine. ``jax.checkpoint`` on the per-layer
+  body keeps the live set to O(microbatch) per stage.
+
+Bubble fraction is the textbook ``(pp-1)/(M+pp-1)`` — raise
+``num_microbatches`` to amortize, exactly as with the reference's GPipe
+mode.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ...core import state
+from ...core import tensor as tensor_mod
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+
+
+def functional_call(layer: Layer, param_vals: dict, *args):
+    """Run ``layer.forward`` as a PURE function of ``param_vals``
+    (name -> raw array), torch.func.functional_call-style.
+
+    Used to trace a Layer's computation with externally-managed (stacked /
+    sliced / traced) parameter values: the layer's parameter tensors are
+    temporarily re-pointed at ``param_vals``, the tape and any jit-capture
+    tracker are disabled (the caller owns differentiation — usually the
+    dispatch funnel's ``jax.vjp`` around the enclosing composite op), and
+    the original buffers are restored afterwards."""
+    params = dict(layer.named_parameters())
+    missing = set(params) - set(param_vals)
+    if missing:
+        raise ValueError(f"functional_call missing values for {missing}")
+    originals = {n: p._data for n, p in params.items()}
+    old_tracker = tensor_mod.set_tracker(None)
+    old_grad = state.set_grad_enabled(False)
+    try:
+        for n, p in params.items():
+            p._data = param_vals[n]
+        out = layer(*[a if isinstance(a, Tensor) else Tensor(a)
+                      for a in args])
+    finally:
+        state.set_grad_enabled(old_grad)
+        tensor_mod.set_tracker(old_tracker)
+        for n, p in params.items():
+            p._data = originals[n]
+    return out._data if isinstance(out, Tensor) else out
+
+
+from ...core.meshutil import pvary as _pvary
+
+
+class PipelinedBlocks(Layer):
+    """A stack of ``num_layers`` structurally-identical blocks executed as
+    an SPMD pipeline (see module docstring). The per-leaf parameters are
+    stored STACKED (``[L, *shape]``) so ``Shard(0)`` over the pp axis
+    assigns ``L/pp`` consecutive layers to each stage — the analog of the
+    reference PipelineLayer's segment allocation (``pp_layers.py``
+    ``_segment_network``).
+
+    ``block_factory()`` must build one block Layer; blocks may not carry
+    buffers or active dropout (single-program pipelining threads only
+    parameters; RNG-bearing blocks would constant-fold their keys).
+    """
+
+    def __init__(self, block_factory: Callable[[], Layer], num_layers: int,
+                 mesh=None, pp_axis: str = "pp", num_microbatches: int = 1,
+                 remat: bool = True):
+        super().__init__()
+        self.num_layers = num_layers
+        self.pp_axis = pp_axis
+        self.num_microbatches = num_microbatches
+        self.remat = remat
+        self._mesh = None
+        self.template = block_factory()
+        if any(True for _ in self.template.named_buffers()):
+            raise ValueError("PipelinedBlocks: blocks must be buffer-free "
+                             "(running stats can't thread the pipeline)")
+        # stack L independent initializations leaf-wise -> [L, *shape]
+        inits = [self.template] + [block_factory()
+                                   for _ in range(num_layers - 1)]
+        self._names = [n for n, _ in self.template.named_parameters()]
+        for n in self._names:
+            leaves = [dict(b.named_parameters())[n]._read() for b in inits]
+            stacked = Tensor(jnp.stack(leaves, axis=0), stop_gradient=False)
+            self.add_parameter(self._mangle(n), _as_param(stacked))
+        if mesh is not None:
+            self.shard(mesh, pp_axis)
+
+    @staticmethod
+    def _mangle(name: str) -> str:
+        return "stacked__" + name.replace(".", "__")
+
+    def stacked_parameter(self, name: str):
+        return self._parameters[self._mangle(name)]
+
+    def shard(self, mesh, pp_axis: str = "pp"):
+        """Pin Shard(0) over ``pp_axis`` on every stacked leaf."""
+        from ..auto_parallel.api import Replicate, Shard, shard_parameter
+        self._mesh = mesh
+        self.pp_axis = pp_axis
+        dim = mesh.dim_names.index(pp_axis)
+        pl = [Replicate()] * mesh.ndim
+        pl[dim] = Shard(0)
+        for n in self._names:
+            shard_parameter(self.stacked_parameter(n), mesh, pl)
+        return self
+
+    # -- the schedule --------------------------------------------------
+    def forward(self, x, batch_axes=None):
+        if self._mesh is None:
+            raise RuntimeError("call .shard(mesh, pp_axis) first")
+        mesh = self._mesh
+        jmesh = getattr(mesh, "jmesh", mesh)
+        pp = dict(zip(jmesh.axis_names, jmesh.devices.shape))[self.pp_axis]
+        M = self.num_microbatches
+        L, ax = self.num_layers, self.pp_axis
+        if L % pp:
+            raise ValueError(f"num_layers {L} not divisible by pp {pp}")
+        template, names = self.template, self._names
+        remat = self.remat
+        if isinstance(batch_axes, str):
+            batch_tuple = (batch_axes,)
+        else:
+            batch_tuple = tuple(batch_axes or ())
+        vary_axes = (ax,) + batch_tuple
+
+        leaf_tensors = [self.stacked_parameter(n) for n in names]
+
+        def impl(xv, *leaves):
+            b = xv.shape[0]
+            if b % M:
+                raise ValueError(f"batch {b} not divisible by "
+                                 f"num_microbatches {M}")
+            xm = xv.reshape((M, b // M) + xv.shape[1:])
+
+            def block_apply(h, layer_leaves):
+                vals = dict(zip(names, layer_leaves))
+                y = functional_call(template, vals, h)
+                return y, None
+
+            if remat:
+                block_apply = jax.checkpoint(block_apply)
+
+            def local(xloc, *lvs):
+                i = lax.axis_index(ax)
+                mb_shape = xloc.shape[1:]
+
+                def tick(carry, t):
+                    h_in, outputs = carry
+                    inject = xloc[jnp.clip(t, 0, M - 1)]
+                    h = jnp.where(i == 0, inject, h_in)
+                    y, _ = lax.scan(block_apply, h, lvs)
+                    m_out = t - (pp - 1)
+                    idx = jnp.clip(m_out, 0, M - 1)
+                    valid = (i == pp - 1) & (m_out >= 0)
+                    cur = lax.dynamic_index_in_dim(outputs, idx, 0,
+                                                   keepdims=False)
+                    outputs = lax.dynamic_update_index_in_dim(
+                        outputs, jnp.where(valid, y, cur), idx, 0)
+                    nxt = lax.ppermute(y, ax,
+                                       [(r, (r + 1) % pp)
+                                        for r in range(pp)])
+                    return (nxt, outputs), None
+
+                h0 = jnp.zeros(mb_shape, xloc.dtype)
+                out0 = jnp.zeros((M,) + mb_shape, xloc.dtype)
+                h0, out0 = _pvary((h0, out0), vary_axes)
+                (_, outputs), _ = lax.scan(tick, (h0, out0),
+                                           jnp.arange(M + pp - 1))
+                # results live on the last stage; replicate over pp
+                outputs = lax.psum(
+                    jnp.where(i == pp - 1, outputs, 0), ax)
+                return outputs
+
+            xspec = P(None, batch_axes, *([None] * (xv.ndim - 1)))
+            lspec = tuple(P(ax) for _ in leaves)
+            out = jax.shard_map(local, mesh=jmesh,
+                                in_specs=(xspec,) + lspec,
+                                out_specs=xspec)(xm, *leaves)
+            return out.reshape((b,) + xv.shape[1:])
+
+        return apply("pipelined_blocks", impl, x, *leaf_tensors)
+
+
+def _as_param(t: Tensor):
+    from ...core.tensor import Parameter
+    if isinstance(t, Parameter):
+        return t
+    return Parameter(t._read(), trainable=True)
+
+
+class LayerDesc:
+    """Reference ``pp_layers.py`` LayerDesc parity: a deferred layer
+    constructor (so each pipeline instantiation builds fresh params)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class PipelineLayer(Layer):
+    """Reference ``PipelineLayer`` parity for HOMOGENEOUS descs: every
+    ``LayerDesc`` must build the same block structure (the transformer
+    case pipeline parallelism exists for). Heterogeneous pre/post layers
+    (embedding, head) belong OUTSIDE — run them unsharded around this
+    stack, as ``GPTForCausalLMPipe`` does (reference keeps them in
+    first/last stages; with GSPMD they simply stay on their own sharding).
+    """
+
+    def __init__(self, layers, num_stages=None, mesh=None, pp_axis="pp",
+                 num_microbatches=1, remat=True):
+        super().__init__()
+        descs = list(layers)
+        if not descs:
+            raise ValueError("PipelineLayer needs at least one LayerDesc")
+        if not all(isinstance(d, LayerDesc) for d in descs):
+            raise TypeError("PipelineLayer(layers=...) takes LayerDesc "
+                            "items (wrap eager layers in LayerDesc)")
+        first = descs[0]
+        if any(d.layer_cls is not first.layer_cls or d.args != first.args
+               or d.kwargs != first.kwargs for d in descs[1:]):
+            raise NotImplementedError(
+                "SPMD pipelining requires structurally identical blocks; "
+                "move heterogeneous prologue/epilogue layers outside the "
+                "PipelineLayer")
+        self.blocks = PipelinedBlocks(first.build_layer, len(descs),
+                                      mesh=mesh, pp_axis=pp_axis,
+                                      num_microbatches=num_microbatches,
+                                      remat=remat)
+
+    def forward(self, x, batch_axes=None):
+        return self.blocks(x, batch_axes=batch_axes)

@@ -200,6 +200,59 @@ def ops_reshape(x, shape):
     return ops.reshape(x, shape)
 
 
+class GPTForCausalLMPipe(Layer):
+    """Pipeline-parallel GPT (analog of the reference trainers'
+    ``GPTForCausalLMPipe`` built on ``PipelineLayer``, and of SURVEY
+    D15-D17). The transformer stack runs as an SPMD GPipe over the
+    ``pp_axis`` (see ``fleet/pipeline.py``); embeddings, final norm and
+    the tied LM head stay outside the pipelined region on their own
+    shardings (dp over batch)."""
+
+    def __init__(self, cfg: GPTConfig, mesh, pp_axis: str = "pp",
+                 dp_axis=None, num_microbatches: int = 1):
+        super().__init__()
+        if cfg.dropout:
+            raise NotImplementedError(
+                "pipelined GPT requires dropout=0 (single-program "
+                "pipelining threads parameters, not RNG state)")
+        from dataclasses import replace
+
+        from ..distributed.fleet.pipeline import PipelinedBlocks
+
+        self.cfg = cfg
+        self.dp_axis = dp_axis
+        blk_cfg = replace(cfg, recompute=False)  # pipeline owns remat
+        self.wte = Embedding(cfg.vocab_size, cfg.hidden_size,
+                             weight_attr=_init_normal(0.02))
+        self.wpe = Embedding(cfg.max_seq_len, cfg.hidden_size,
+                             weight_attr=_init_normal(0.02))
+        self.blocks = PipelinedBlocks(lambda: GPTBlock(blk_cfg),
+                                      cfg.num_layers, mesh=mesh,
+                                      pp_axis=pp_axis,
+                                      num_microbatches=num_microbatches)
+        self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def logits(self, input_ids) -> Tensor:
+        from .. import ops
+        s = input_ids.shape[1]
+        pos = ops.arange(0, s, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.blocks(x, batch_axes=self.dp_axis)
+        h = self.ln_f(x)
+        return ops.matmul(h, self.wte.weight, transpose_y=True)
+
+    def forward(self, input_ids, labels=None):
+        logits = self.logits(input_ids)
+        if labels is None:
+            return logits
+        return F.cross_entropy(
+            ops_reshape(logits, [-1, self.cfg.vocab_size]),
+            ops_reshape(labels, [-1]))
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+
 # --- GSPMD sharding recipe (the fleet-TP analog for this model) ------------
 
 def shard_gpt(model: GPTForCausalLM, mesh, dp_axis="dp", mp_axis="mp",

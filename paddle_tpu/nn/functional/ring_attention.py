@@ -29,14 +29,11 @@ from jax.sharding import PartitionSpec as P
 from ...core.dispatch import apply
 
 
+from ...core.meshutil import pvary as _pvary_impl
+
+
 def _pvary(xs, axes):
-    """Mark values as varying over the manual mesh axes (shard_map's vma
-    type system; API name differs across jax versions)."""
-    if not axes:
-        return xs
-    if hasattr(lax, "pvary"):
-        return lax.pvary(xs, axes)
-    return lax.pcast(xs, axes, to="varying")
+    return _pvary_impl(xs, axes)
 
 
 def _ring_attention_local(q, k, v, axis, causal, scale, remat=True,
