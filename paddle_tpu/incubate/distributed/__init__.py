@@ -1,0 +1,4 @@
+"""``paddle.incubate.distributed`` parity namespace."""
+from . import models  # noqa: F401
+
+__all__ = ["models"]

@@ -1,0 +1,162 @@
+"""Mixture-of-Experts with expert parallelism (the ``ep`` mesh axis).
+
+Capability analog of the reference MoE stack (SURVEY D18):
+``python/paddle/incubate/distributed/models/moe/moe_layer.py`` (MoELayer),
+``gate/{naive,switch,gshard}_gate.py``, and the
+``global_scatter/global_gather`` dispatch collectives
+(``paddle/distributed/utils/moe_utils.py``). The reference routes tokens
+with explicit NCCL all-to-alls; here dispatch/combine are capacity-bucketed
+einsums (the GShard formulation) over expert-stacked ``[E, ...]`` weights
+sharded ``Shard(0)`` over the ``ep`` axis — XLA's partitioner emits the
+all-to-alls when token shardings (dp) and expert shardings (ep) meet in
+the dispatch einsum, and they ride ICI.
+
+Top-k routing with renormalized combine weights, per-expert capacity
+``C = ceil(k * N / E * capacity_factor)``, overflow tokens dropped
+(GShard/Switch semantics), and the switch-style load-balance auxiliary
+loss ``E * sum(importance * load)``.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import apply
+from ....core.tensor import Parameter, Tensor
+from ....nn.layer import Layer
+from ....nn.layers import Linear
+
+
+def _one_hot(idx, n, dtype=jnp.float32):
+    return jax.nn.one_hot(idx, n, dtype=dtype)
+
+
+def moe_dispatch_combine(gates, k, capacity):
+    """Build dispatch/combine tensors from gate probabilities.
+
+    gates: [N, E] softmax probabilities. Returns (dispatch [N, E, C] 0/1,
+    combine [N, E, C] weights, aux_loss scalar). Slot 0 (top-1 choices)
+    fills capacity first, then slot 1, matching the reference gshard gate's
+    priority order."""
+    n, e = gates.shape
+    gval, gidx = jax.lax.top_k(gates, k)          # [N, k]
+    gval = gval / jnp.maximum(gval.sum(-1, keepdims=True), 1e-9)
+
+    counts = jnp.zeros((e,), jnp.float32)
+    dispatch = jnp.zeros((n, e, capacity), jnp.float32)
+    combine = jnp.zeros((n, e, capacity), jnp.float32)
+    for slot in range(k):
+        oh = _one_hot(gidx[:, slot], e)           # [N, E]
+        pos = jnp.cumsum(oh, axis=0) - 1 + counts[None, :]
+        keep = (pos < capacity).astype(jnp.float32) * oh
+        counts = counts + keep.sum(axis=0)
+        pos_kept = (pos * keep).sum(-1).astype(jnp.int32)  # [N]
+        slot_disp = keep[:, :, None] * _one_hot(pos_kept, capacity)[:, None]
+        dispatch = dispatch + slot_disp
+        combine = combine + gval[:, slot, None, None] * slot_disp
+
+    # switch-style load balancing on the top-1 assignment
+    importance = gates.mean(axis=0)               # [E]
+    load = _one_hot(gidx[:, 0], e).mean(axis=0)   # [E]
+    aux = e * jnp.sum(importance * load)
+    return dispatch, combine, aux
+
+
+class MoEMLP(Layer):
+    """Expert-parallel feed-forward mixture — drop-in for a dense FFN.
+
+    Expert weights are stacked ``[E, ...]``; ``shard(mesh, ep_axis)`` pins
+    ``Shard(0)`` so each ep rank owns ``E/ep`` experts (the reference's
+    per-rank expert placement, ``moe_layer.py`` MoELayer). After forward,
+    ``self.aux_loss`` holds the load-balance loss of the last call."""
+
+    def __init__(self, hidden_size, intermediate_size, num_experts,
+                 top_k=2, capacity_factor=1.25, mesh=None, ep_axis="ep",
+                 weight_attr=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.gate = Linear(hidden_size, num_experts, bias_attr=False,
+                           weight_attr=weight_attr)
+        e, h, i = num_experts, hidden_size, intermediate_size
+        from ....nn import initializer as I
+        init = (weight_attr if weight_attr is not None
+                else I.Normal(std=0.02))
+
+        def mk(shape):
+            return Parameter(init(shape, jnp.float32), trainable=True)
+
+        self.w1 = mk((e, h, i))
+        self.b1 = Parameter(jnp.zeros((e, i), jnp.float32), trainable=True)
+        self.w2 = mk((e, i, h))
+        self.b2 = Parameter(jnp.zeros((e, h), jnp.float32), trainable=True)
+        self.aux_loss = None
+        if mesh is not None:
+            self.shard(mesh, ep_axis)
+
+    def shard(self, mesh, ep_axis="ep"):
+        from ....distributed.auto_parallel.api import (Replicate, Shard,
+                                                       shard_parameter)
+        dim = mesh.dim_names.index(ep_axis)
+        pl = [Replicate()] * mesh.ndim
+        pl[dim] = Shard(0)
+        for p in (self.w1, self.b1, self.w2, self.b2):
+            shard_parameter(p, mesh, pl)
+        return self
+
+    def forward(self, x):
+        e, k, cf = self.num_experts, self.top_k, self.capacity_factor
+        shape = tuple(x.shape)
+        n_tokens = int(shape[0] if len(shape) == 2
+                       else math.prod(shape[:-1]))
+        capacity = max(int(math.ceil(k * n_tokens / e * cf)), 1)
+
+        def impl(xv, wg, w1, b1, w2, b2):
+            flat = xv.reshape(n_tokens, xv.shape[-1])
+            logits = flat @ wg
+            gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            dispatch, combine, aux = moe_dispatch_combine(gates, k, capacity)
+            # [N,E,C] x [N,H] -> [E,C,H]: the all-to-all point (XLA emits
+            # it when flat is dp-sharded and w1 is ep-sharded)
+            expert_in = jnp.einsum("nec,nh->ech", dispatch,
+                                   flat.astype(jnp.float32))
+            hdn = jax.nn.gelu(
+                jnp.einsum("ech,ehi->eci", expert_in, w1) + b1[:, None])
+            y = jnp.einsum("eci,eih->ech", hdn, w2) + b2[:, None]
+            out = jnp.einsum("nec,ech->nh", combine, y)
+            return out.astype(xv.dtype).reshape(shape), aux
+
+        out, aux = apply("moe_mlp", impl, x, self.gate.weight, self.w1,
+                         self.b1, self.w2, self.b2)
+        self.aux_loss = aux
+        return out
+
+
+class MoELayer(Layer):
+    """Reference ``MoELayer`` parity surface: wraps a gate spec + expert
+    shape into the einsum-dispatch ``MoEMLP``. ``gate`` may be "switch"
+    (top-1) or "gshard" (top-2), matching the reference gate classes."""
+
+    def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
+                 capacity_factor=1.25, mesh=None, ep_axis="ep",
+                 recompute_interval=0, **kwargs):
+        super().__init__()
+        if isinstance(gate, str):
+            if gate not in ("switch", "gshard", "naive"):
+                raise ValueError(f"unknown gate {gate!r}")
+            top_k = 1 if gate == "switch" else 2
+        else:
+            top_k = int(getattr(gate, "top_k", 2))
+        self.moe = MoEMLP(d_model, d_hidden, num_experts, top_k=top_k,
+                          capacity_factor=capacity_factor, mesh=mesh,
+                          ep_axis=ep_axis)
+
+    @property
+    def aux_loss(self):
+        return self.moe.aux_loss
+
+    def forward(self, x):
+        return self.moe(x)

@@ -38,6 +38,11 @@ class GPTConfig:
     tie_embeddings: bool = True
     use_flash_attention: bool = True
     recompute: bool = False  # activation recompute per block (jax.checkpoint)
+    # MoE (0 = dense FFN). Experts shard over the ep axis via shard_gpt.
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         if self.intermediate_size == 0:
@@ -110,7 +115,13 @@ class GPTBlock(Layer):
         self.ln1 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
         self.attn = GPTAttention(cfg)
         self.ln2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
-        self.mlp = GPTMLP(cfg)
+        if cfg.num_experts > 0:
+            from ..incubate.distributed.models.moe import MoEMLP
+            self.mlp = MoEMLP(cfg.hidden_size, cfg.intermediate_size,
+                              cfg.num_experts, top_k=cfg.moe_top_k,
+                              capacity_factor=cfg.moe_capacity_factor)
+        else:
+            self.mlp = GPTMLP(cfg)
         self.drop = Dropout(cfg.dropout)
         self._recompute = cfg.recompute
 
@@ -180,9 +191,16 @@ class GPTForCausalLM(Layer):
         logits = self.logits(input_ids)
         if labels is None:
             return logits
-        return F.cross_entropy(
+        loss = F.cross_entropy(
             ops_reshape(logits, [-1, self.cfg.vocab_size]),
             ops_reshape(labels, [-1]))
+        if self.cfg.num_experts > 0 and self.cfg.moe_aux_weight:
+            from .. import ops
+            for blk in self.gpt.blocks:
+                aux = getattr(blk.mlp, "aux_loss", None)
+                if aux is not None:
+                    loss = loss + self.cfg.moe_aux_weight * aux
+        return loss
 
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())
@@ -256,7 +274,7 @@ class GPTForCausalLMPipe(Layer):
 # --- GSPMD sharding recipe (the fleet-TP analog for this model) ------------
 
 def shard_gpt(model: GPTForCausalLM, mesh, dp_axis="dp", mp_axis="mp",
-              sp_axis=None, context_parallel=False):
+              sp_axis=None, context_parallel=False, ep_axis=None):
     """Pin Megatron-style shardings over ``mesh`` (a ProcessMesh).
 
     Column-parallel: qkv / fc1 weights shard output dim over mp.
@@ -276,6 +294,11 @@ def shard_gpt(model: GPTForCausalLM, mesh, dp_axis="dp", mp_axis="mp",
                                                  shard_parameter)
 
     names = mesh.dim_names
+    if ep_axis is not None and ep_axis in names:
+        from ..incubate.distributed.models.moe import MoEMLP
+        for blk in model.gpt.blocks:
+            if isinstance(blk.mlp, MoEMLP):
+                blk.mlp.shard(mesh, ep_axis)
     if context_parallel:
         if sp_axis not in names:
             raise ValueError("context_parallel requires sp_axis in the mesh")
@@ -302,10 +325,11 @@ def shard_gpt(model: GPTForCausalLM, mesh, dp_axis="dp", mp_axis="mp",
         shard_parameter(blk.attn.qkv.bias, mesh, pl(0))
         shard_parameter(blk.attn.proj.weight, mesh, pl(0))
         shard_parameter(blk.attn.proj.bias, mesh, rep)
-        shard_parameter(blk.mlp.fc1.weight, mesh, pl(1))
-        shard_parameter(blk.mlp.fc1.bias, mesh, pl(0))
-        shard_parameter(blk.mlp.fc2.weight, mesh, pl(0))
-        shard_parameter(blk.mlp.fc2.bias, mesh, rep)
+        if hasattr(blk.mlp, "fc1"):  # dense FFN (MoE shards over ep above)
+            shard_parameter(blk.mlp.fc1.weight, mesh, pl(1))
+            shard_parameter(blk.mlp.fc1.bias, mesh, pl(0))
+            shard_parameter(blk.mlp.fc2.weight, mesh, pl(0))
+            shard_parameter(blk.mlp.fc2.bias, mesh, rep)
     if model.lm_head is not None:
         shard_parameter(model.lm_head.weight, mesh, pl(1))
     return model
