@@ -355,7 +355,13 @@ def gather_nd(x, index):
 @primitive
 def take_along_axis(x, indices, axis, broadcast=True):
     if broadcast:
-        shape = list(jnp.broadcast_shapes(x.shape, indices.shape))
+        # broadcast indices against x on every dim EXCEPT axis (reference
+        # take_along_axis broadcast semantics)
+        xs = list(x.shape)
+        xs[axis] = 1
+        ishape = list(indices.shape)
+        ishape[axis] = 1
+        shape = list(jnp.broadcast_shapes(tuple(xs), tuple(ishape)))
         shape[axis] = indices.shape[axis]
         indices = jnp.broadcast_to(indices, shape)
     return jnp.take_along_axis(x, indices, axis=axis)
@@ -517,7 +523,10 @@ def mode(x, axis=-1, keepdim=False):
     counts = jnp.sum(s[..., :, None] == s[..., None, :], axis=-1)
     pick = jnp.argmax(counts, axis=-1, keepdims=True)
     out = jnp.take_along_axis(s, pick, axis=-1)
-    idx = jnp.argmax(jnp.asarray(xm == out, jnp.int32), axis=-1, keepdims=True)
+    # index of the LAST occurrence (reference/torch mode convention)
+    n = xm.shape[-1]
+    idx = n - 1 - jnp.argmax(
+        jnp.asarray(xm == out, jnp.int32)[..., ::-1], axis=-1, keepdims=True)
     out = jnp.moveaxis(out, -1, axis)
     idx = jnp.moveaxis(idx, -1, axis)
     if not keepdim:

@@ -1,0 +1,174 @@
+"""OpTest harness — see package docstring. Reference
+``test/legacy_test/op_test.py`` (OpTest :420, check_output :2765,
+check_grad :2975)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+
+def _to_np(x):
+    from ..core.tensor import Tensor
+    if isinstance(x, Tensor):
+        return np.asarray(x._read())
+    return np.asarray(x)
+
+
+def _flat_outputs(out):
+    if isinstance(out, (list, tuple)):
+        return [o for o in out if o is not None]
+    return [out]
+
+
+@dataclass
+class OpSpec:
+    """One table-driven op case.
+
+    ``fn(*tensors, **kwargs)`` is the paddle_tpu callable; ``ref`` the
+    numpy reference (same signature over ndarrays). ``inputs`` are numpy
+    arrays (or shapes to fill with the default rng). ``grad`` lists input
+    indices to gradient-check (empty = forward-only, e.g. integer ops)."""
+    name: str
+    fn: Callable
+    ref: Callable
+    inputs: Sequence[Any]
+    kwargs: dict = field(default_factory=dict)
+    grad: Sequence[int] = ()
+    atol: float = 1e-5
+    rtol: float = 1e-5
+    bf16: bool = True
+    bf16_atol: float = 2e-2
+    bf16_rtol: float = 2e-2
+    grad_atol: float = 5e-3
+    jit: bool = True
+
+
+class OpTest:
+    """Programmatic harness; also usable as a mixin in hand-written tests."""
+
+    rng = np.random.default_rng(20240730)
+
+    # ---- forward --------------------------------------------------------
+    @classmethod
+    def check_output(cls, fn, ref, inputs, kwargs=None, atol=1e-5,
+                     rtol=1e-5, jit=True):
+        import paddle_tpu as paddle
+
+        kwargs = kwargs or {}
+        tensors = [paddle.to_tensor(np.asarray(x)) for x in inputs]
+        got = _flat_outputs(fn(*tensors, **kwargs))
+        want = _flat_outputs(ref(*[np.asarray(x) for x in inputs], **kwargs))
+        assert len(got) == len(want), (
+            f"output arity {len(got)} != reference {len(want)}")
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(_to_np(g), np.asarray(w), atol=atol,
+                                       rtol=rtol, err_msg="eager forward")
+        if jit:
+            static = paddle.jit.to_static(
+                lambda *ts: fn(*ts, **kwargs), full_graph=True)
+            got_j = _flat_outputs(static(*[paddle.to_tensor(np.asarray(x))
+                                           for x in inputs]))
+            for g, w in zip(got_j, want):
+                np.testing.assert_allclose(
+                    _to_np(g), np.asarray(w), atol=atol, rtol=rtol,
+                    err_msg="jit forward")
+
+    # ---- bfloat16 (TPU-native dtype) -----------------------------------
+    @classmethod
+    def check_bf16(cls, fn, ref, inputs, kwargs=None, atol=2e-2, rtol=2e-2):
+        import jax.numpy as jnp
+
+        import paddle_tpu as paddle
+
+        kwargs = kwargs or {}
+        tensors = []
+        for x in inputs:
+            x = np.asarray(x)
+            t = paddle.to_tensor(x)
+            if x.dtype == np.float32:
+                t = t.astype("bfloat16")
+            tensors.append(t)
+        got = _flat_outputs(fn(*tensors, **kwargs))
+        want = _flat_outputs(ref(*[np.asarray(x) for x in inputs], **kwargs))
+        for g, w in zip(got, want):
+            gv = _to_np(g.astype("float32") if hasattr(g, "astype") else g)
+            np.testing.assert_allclose(gv, np.asarray(w, np.float32),
+                                       atol=atol, rtol=rtol,
+                                       err_msg="bf16 forward")
+
+    # ---- gradients ------------------------------------------------------
+    @classmethod
+    def check_grad(cls, fn, inputs, wrt=(0,), kwargs=None, eps=1e-3,
+                   atol=5e-3, rtol=5e-3):
+        """Tape backward vs central-difference numeric gradient of
+        ``L = sum(fn(x) * proj)`` with a fixed random projection (the
+        reference's user_defined_grad_outputs pattern)."""
+        import paddle_tpu as paddle
+
+        kwargs = kwargs or {}
+        inputs = [np.asarray(x) for x in inputs]
+        proj = None
+
+        def loss_np(*arrs):
+            nonlocal proj
+            tensors = [paddle.to_tensor(a) for a in arrs]
+            out = _flat_outputs(fn(*tensors, **kwargs))
+            vals = [_to_np(o).astype(np.float64) for o in out]
+            if proj is None:
+                proj = [cls.rng.normal(size=v.shape) for v in vals]
+            return sum(float((v * p).sum()) for v, p in zip(vals, proj))
+
+        loss_np(*inputs)  # fix proj
+
+        # analytic grads through the tape
+        tensors = []
+        for i, a in enumerate(inputs):
+            t = paddle.to_tensor(a)
+            if i in wrt:
+                t.stop_gradient = False
+            tensors.append(t)
+        out = _flat_outputs(fn(*tensors, **kwargs))
+        loss = None
+        for o, p in zip(out, proj):
+            term = (o * paddle.to_tensor(p.astype(np.float32))).sum()
+            loss = term if loss is None else loss + term
+        loss.backward()
+
+        for i in wrt:
+            a = inputs[i]
+            num = np.zeros(a.size, np.float64)
+            flat = a.reshape(-1)
+            for j in range(a.size):
+                orig = flat[j]
+                flat[j] = orig + eps
+                fp = loss_np(*inputs)
+                flat[j] = orig - eps
+                fm = loss_np(*inputs)
+                flat[j] = orig
+                num[j] = (fp - fm) / (2 * eps)
+            got = _to_np(tensors[i].grad).reshape(-1)
+            np.testing.assert_allclose(
+                got, num.astype(np.float32), atol=atol, rtol=rtol,
+                err_msg=f"gradient wrt input {i}")
+
+
+def run_op_specs(specs: Sequence[OpSpec]):
+    """Run a table of OpSpecs, aggregating failures with op names."""
+    failures = []
+    for s in specs:
+        try:
+            OpTest.check_output(s.fn, s.ref, s.inputs, s.kwargs,
+                                atol=s.atol, rtol=s.rtol, jit=s.jit)
+            if s.bf16:
+                OpTest.check_bf16(s.fn, s.ref, s.inputs, s.kwargs,
+                                  atol=s.bf16_atol, rtol=s.bf16_rtol)
+            if s.grad:
+                OpTest.check_grad(s.fn, s.inputs, wrt=tuple(s.grad),
+                                  kwargs=s.kwargs, atol=s.grad_atol,
+                                  rtol=s.grad_atol)
+        except Exception as e:  # noqa: BLE001 — aggregate, report all
+            failures.append((s.name, f"{type(e).__name__}: {e}"))
+    assert not failures, "op failures:\n" + "\n".join(
+        f"  {n}: {m[:500]}" for n, m in failures)

@@ -1,0 +1,397 @@
+"""Table-driven op coverage through the OpTest harness (the analog of the
+reference's ~1300 ``test_*_op.py`` files built on ``op_test.py:420``).
+
+Each family runs: eager forward vs numpy, jit forward vs numpy, bfloat16
+at loose tolerance, and (where listed) tape-vs-numeric gradients."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+from paddle_tpu.testing import OpSpec, run_op_specs
+
+R = np.random.default_rng(7)
+
+
+def f32(*shape, lo=-2.0, hi=2.0):
+    return (R.uniform(lo, hi, shape)).astype("float32")
+
+
+def pos(*shape, lo=0.1, hi=3.0):
+    return R.uniform(lo, hi, shape).astype("float32")
+
+
+def i32(*shape, lo=0, hi=8):
+    return R.integers(lo, hi, shape).astype("int32")
+
+
+def test_unary_math_ops():
+    import scipy.special as sp
+    x = f32(3, 4)
+    p = pos(3, 4)
+    u = f32(3, 4, lo=-0.9, hi=0.9)
+    specs = [
+        OpSpec("abs", ops.abs, np.abs, [x], grad=(0,)),
+        OpSpec("exp", ops.exp, np.exp, [x], grad=(0,)),
+        OpSpec("expm1", ops.expm1, np.expm1, [x]),
+        OpSpec("log", ops.log, np.log, [p], grad=(0,)),
+        OpSpec("log2", ops.log2, np.log2, [p]),
+        OpSpec("log10", ops.log10, np.log10, [p]),
+        OpSpec("log1p", ops.log1p, np.log1p, [p]),
+        OpSpec("sqrt", ops.sqrt, np.sqrt, [p], grad=(0,)),
+        OpSpec("rsqrt", ops.rsqrt, lambda a: 1 / np.sqrt(a), [p]),
+        OpSpec("square", ops.square, np.square, [x], grad=(0,)),
+        OpSpec("reciprocal", ops.reciprocal, lambda a: 1 / a, [p]),
+        OpSpec("sin", ops.sin, np.sin, [x], grad=(0,)),
+        OpSpec("cos", ops.cos, np.cos, [x], grad=(0,)),
+        OpSpec("tan", ops.tan, np.tan, [u]),
+        OpSpec("asin", ops.asin, np.arcsin, [u]),
+        OpSpec("acos", ops.acos, np.arccos, [u]),
+        OpSpec("atan", ops.atan, np.arctan, [x]),
+        OpSpec("sinh", ops.sinh, np.sinh, [x]),
+        OpSpec("cosh", ops.cosh, np.cosh, [x]),
+        OpSpec("tanh", ops.tanh, np.tanh, [x], grad=(0,)),
+        OpSpec("asinh", ops.asinh, np.arcsinh, [x]),
+        OpSpec("acosh", ops.acosh, np.arccosh, [pos(3, 4, lo=1.1)]),
+        OpSpec("atanh", ops.atanh, np.arctanh, [u]),
+        OpSpec("ceil", ops.ceil, np.ceil, [x], bf16=False),
+        OpSpec("floor", ops.floor, np.floor, [x], bf16=False),
+        OpSpec("round", ops.round, np.round, [x], bf16=False),
+        OpSpec("trunc", ops.trunc, np.trunc, [x], bf16=False),
+        OpSpec("sign", ops.sign, np.sign, [x], bf16=False),
+        OpSpec("neg", ops.neg, np.negative, [x]),
+        OpSpec("frac", ops.frac, lambda a: a - np.trunc(a), [x], bf16=False),
+        OpSpec("erf", ops.erf, sp.erf, [x], grad=(0,)),
+        OpSpec("erfinv", ops.erfinv, sp.erfinv, [u]),
+        OpSpec("lgamma", ops.lgamma, sp.gammaln, [p]),
+        OpSpec("digamma", ops.digamma, sp.digamma, [p]),
+        OpSpec("i0", ops.i0, sp.i0, [x]),
+        OpSpec("i1", ops.i1, sp.i1, [x]),
+        OpSpec("deg2rad", ops.deg2rad, np.deg2rad, [x]),
+        OpSpec("rad2deg", ops.rad2deg, np.rad2deg, [x]),
+        OpSpec("angle", ops.angle, np.angle, [x]),
+        OpSpec("nan_to_num", ops.nan_to_num, np.nan_to_num,
+               [np.array([[np.nan, 1.0, np.inf, -np.inf]], "float32")]),
+        OpSpec("clip", ops.clip, lambda a, min, max: np.clip(a, min, max),
+               [x], {"min": -0.5, "max": 0.5}),
+        OpSpec("scale", ops.scale,
+               lambda a, scale, bias: a * scale + bias, [x],
+               {"scale": 2.0, "bias": 0.5}, grad=(0,)),
+        OpSpec("stanh", ops.stanh,
+               lambda a, scale_a=0.67, scale_b=1.7159:
+               scale_b * np.tanh(scale_a * a), [x]),
+    ]
+    run_op_specs(specs)
+
+
+def test_binary_math_ops():
+    x, y = f32(3, 4), f32(3, 4)
+    p, q = pos(3, 4), pos(3, 4)
+    specs = [
+        OpSpec("add", ops.add, np.add, [x, y], grad=(0, 1)),
+        OpSpec("subtract", ops.subtract, np.subtract, [x, y], grad=(0, 1)),
+        OpSpec("multiply", ops.multiply, np.multiply, [x, y], grad=(0, 1)),
+        OpSpec("divide", ops.divide, np.divide, [x, q], grad=(0, 1)),
+        OpSpec("pow", ops.pow, lambda a, y: np.power(a, y), [p],
+               {"y": 2.0}, grad=(0,)),
+        OpSpec("maximum", ops.maximum, np.maximum, [x, y]),
+        OpSpec("minimum", ops.minimum, np.minimum, [x, y]),
+        OpSpec("fmax", ops.fmax, np.fmax, [x, y]),
+        OpSpec("fmin", ops.fmin, np.fmin, [x, y]),
+        OpSpec("mod", ops.mod, np.mod, [x, q]),
+        OpSpec("floor_divide", ops.floor_divide, np.floor_divide, [x, q]),
+        OpSpec("atan2", ops.atan2, np.arctan2, [x, y]),
+        OpSpec("hypot", ops.hypot, np.hypot, [x, y]),
+        OpSpec("copysign", ops.copysign, np.copysign, [x, y]),
+        OpSpec("heaviside", ops.heaviside, np.heaviside, [x, y]),
+        OpSpec("nextafter", ops.nextafter, np.nextafter, [x, y],
+               bf16=False),
+        OpSpec("logaddexp", ops.logaddexp, np.logaddexp, [x, y]),
+        OpSpec("lerp", ops.lerp,
+               lambda a, b, w: a + w * (b - a), [x, y, np.float32(0.3)],
+               bf16=False),
+        OpSpec("gcd", ops.gcd, np.gcd, [i32(3, 4, lo=1, hi=20),
+                                        i32(3, 4, lo=1, hi=20)],
+               bf16=False),
+        OpSpec("lcm", ops.lcm, np.lcm, [i32(3, 4, lo=1, hi=10),
+                                        i32(3, 4, lo=1, hi=10)],
+               bf16=False),
+    ]
+    run_op_specs(specs)
+
+
+def test_reduction_ops():
+    x = f32(3, 4, 5)
+    specs = [
+        OpSpec("sum", ops.sum, lambda a, axis=None: np.sum(a, axis), [x],
+               {"axis": 1}, grad=(0,)),
+        OpSpec("mean", ops.mean, lambda a, axis=None: np.mean(a, axis),
+               [x], {"axis": 2}, grad=(0,)),
+        OpSpec("max", ops.max, lambda a, axis=None: np.max(a, axis), [x],
+               {"axis": 0}),
+        OpSpec("min", ops.min, lambda a, axis=None: np.min(a, axis), [x],
+               {"axis": 0}),
+        OpSpec("prod", ops.prod, lambda a, axis=None: np.prod(a, axis),
+               [f32(2, 3)], {"axis": 1}),
+        OpSpec("std", ops.std, lambda a: np.std(a, ddof=1), [x],
+               rtol=1e-4),
+        OpSpec("var", ops.var, lambda a: np.var(a, ddof=1), [x],
+               rtol=1e-4),
+        OpSpec("median", ops.median, np.median, [f32(3, 5)]),
+        OpSpec("nanmean", ops.nanmean, np.nanmean,
+               [np.array([[1, np.nan, 3.0]], "float32")]),
+        OpSpec("nansum", ops.nansum, np.nansum,
+               [np.array([[1, np.nan, 3.0]], "float32")]),
+        OpSpec("logsumexp", ops.logsumexp,
+               lambda a: np.log(np.sum(np.exp(a))), [x], rtol=1e-4),
+        OpSpec("amax", ops.amax, lambda a, axis=None: np.max(a, axis),
+               [x], {"axis": 1}),
+        OpSpec("amin", ops.amin, lambda a, axis=None: np.min(a, axis),
+               [x], {"axis": 1}),
+        OpSpec("count_nonzero", ops.count_nonzero,
+               lambda a: np.count_nonzero(a),
+               [np.array([[0, 1, 2, 0]], "float32")], bf16=False),
+        OpSpec("cumsum", ops.cumsum,
+               lambda a, axis=None: np.cumsum(a, axis), [x], {"axis": 1},
+               grad=(0,)),
+        OpSpec("cumprod", ops.cumprod,
+               lambda a, dim=None: np.cumprod(a, dim), [f32(2, 3)],
+               {"dim": 1}),
+        OpSpec("logcumsumexp", ops.logcumsumexp,
+               lambda a, axis=0:
+               np.log(np.cumsum(np.exp(a.astype(np.float64)),
+                                axis)).astype(np.float32),
+               [x], {"axis": 1}, rtol=1e-4),
+        OpSpec("quantile", ops.quantile,
+               lambda a, q: np.quantile(a, q), [f32(3, 5)], {"q": 0.5},
+               bf16=False),
+    ]
+    run_op_specs(specs)
+
+
+def test_manipulation_ops():
+    x = f32(3, 4, 5)
+    specs = [
+        OpSpec("reshape", ops.reshape,
+               lambda a, shape: a.reshape(shape), [x],
+               {"shape": [4, 15]}, grad=(0,)),
+        OpSpec("transpose", ops.transpose,
+               lambda a, perm: np.transpose(a, perm), [x],
+               {"perm": [2, 0, 1]}, grad=(0,)),
+        OpSpec("flatten", ops.flatten, lambda a: a.reshape(-1), [x]),
+        OpSpec("squeeze", ops.squeeze, np.squeeze, [f32(3, 1, 4)]),
+        OpSpec("unsqueeze", ops.unsqueeze,
+               lambda a, axis: np.expand_dims(a, axis), [x], {"axis": 1}),
+        OpSpec("flip", ops.flip, lambda a, axis: np.flip(a, axis), [x],
+               {"axis": 1}),
+        OpSpec("roll", ops.roll,
+               lambda a, shifts, axis: np.roll(a, shifts, axis), [x],
+               {"shifts": 2, "axis": 1}),
+        OpSpec("rot90", ops.rot90, lambda a: np.rot90(a), [f32(3, 4)]),
+        OpSpec("tile", ops.tile,
+               lambda a, repeat_times: np.tile(a, repeat_times), [x],
+               {"repeat_times": [2, 1, 1]}),
+        OpSpec("broadcast_to", ops.broadcast_to,
+               lambda a, shape: np.broadcast_to(a, shape), [f32(1, 4)],
+               {"shape": [3, 4]}),
+        OpSpec("moveaxis", ops.moveaxis,
+               lambda a, source, destination:
+               np.moveaxis(a, source, destination), [x],
+               {"source": 0, "destination": 2}),
+        OpSpec("swapaxes", ops.swapaxes,
+               lambda a, axis0, axis1: np.swapaxes(a, axis0, axis1), [x],
+               {"axis0": 0, "axis1": 2}),
+        OpSpec("t", ops.t, np.transpose, [f32(3, 4)]),
+        OpSpec("tril", ops.tril, np.tril, [f32(4, 4)]),
+        OpSpec("triu", ops.triu, np.triu, [f32(4, 4)]),
+        OpSpec("diag", ops.diag, np.diag, [f32(4, 4)]),
+        OpSpec("diagonal", ops.diagonal,
+               lambda a: np.diagonal(a, 0, 0, 1), [f32(4, 4)]),
+        OpSpec("trace", ops.trace, np.trace, [f32(4, 4)]),
+        OpSpec("kron", ops.kron, np.kron, [f32(2, 2), f32(3, 3)]),
+        OpSpec("repeat_interleave", ops.repeat_interleave,
+               lambda a, repeats, axis: np.repeat(a, repeats, axis), [x],
+               {"repeats": 2, "axis": 1}),
+        OpSpec("take_along_axis", ops.take_along_axis,
+               lambda a, idx, axis: np.take_along_axis(a, idx, axis),
+               [f32(3, 5), R.integers(0, 5, (3, 2)).astype("int64")],
+               {"axis": 1}, bf16=False),
+        OpSpec("gather", ops.gather,
+               lambda a, idx, axis=0: np.take(a, idx, axis),
+               [f32(5, 3), np.array([0, 2, 4], "int64")], bf16=False),
+        OpSpec("index_select", ops.index_select,
+               lambda a, index, axis=0: np.take(a, index, axis),
+               [f32(5, 3), np.array([1, 3], "int64")], {"axis": 0},
+               bf16=False),
+        OpSpec("masked_select", ops.masked_select,
+               lambda a, m: a[m],
+               [f32(3, 4), R.uniform(size=(3, 4)) > 0.5], bf16=False,
+               jit=False),  # dynamic output shape: host-side op
+        OpSpec("where", ops.where,
+               lambda c, a, b: np.where(c, a, b),
+               [R.uniform(size=(3, 4)) > 0.5, f32(3, 4), f32(3, 4)],
+               bf16=False),
+        OpSpec("concat", lambda a, b, **kw: ops.concat([a, b], **kw),
+               lambda a, b, axis=0: np.concatenate([a, b], axis),
+               [f32(2, 3), f32(2, 3)], {"axis": 1}),
+        OpSpec("stack", lambda a, b, **kw: ops.stack([a, b], **kw),
+               lambda a, b, axis=0: np.stack([a, b], axis),
+               [f32(2, 3), f32(2, 3)], {"axis": 0}),
+        OpSpec("split", lambda a: ops.split(a, 2, axis=1),
+               lambda a: np.split(a, 2, axis=1), [f32(2, 4)]),
+        OpSpec("chunk", lambda a: ops.chunk(a, 2, axis=0),
+               lambda a: np.split(a, 2, axis=0), [f32(4, 3)]),
+        OpSpec("unbind", lambda a: ops.unbind(a, axis=0),
+               lambda a: list(a), [f32(3, 4)]),
+        OpSpec("unstack", lambda a: ops.unstack(a, axis=0),
+               lambda a: list(a), [f32(3, 4)]),
+        OpSpec("pad", ops.pad,
+               lambda a, pad: np.pad(a, [(0, 0), (1, 2)]),
+               [f32(2, 3)], {"pad": [1, 2]}),
+        OpSpec("one_hot", ops.one_hot,
+               lambda a, num_classes: np.eye(num_classes,
+                                             dtype=np.float32)[a],
+               [np.array([0, 2, 1], "int64")], {"num_classes": 3},
+               bf16=False),
+    ]
+    run_op_specs(specs)
+
+
+def test_logic_compare_ops():
+    x, y = f32(3, 4), f32(3, 4)
+    b1 = R.uniform(size=(3, 4)) > 0.5
+    b2 = R.uniform(size=(3, 4)) > 0.5
+    ii = i32(3, 4)
+    specs = [
+        OpSpec("equal", ops.equal, np.equal, [x, x], bf16=False),
+        OpSpec("not_equal", ops.not_equal, np.not_equal, [x, y],
+               bf16=False),
+        OpSpec("less_than", ops.less_than, np.less, [x, y], bf16=False),
+        OpSpec("less_equal", ops.less_equal, np.less_equal, [x, y],
+               bf16=False),
+        OpSpec("greater_than", ops.greater_than, np.greater, [x, y],
+               bf16=False),
+        OpSpec("greater_equal", ops.greater_equal, np.greater_equal,
+               [x, y], bf16=False),
+        OpSpec("logical_and", ops.logical_and, np.logical_and, [b1, b2],
+               bf16=False),
+        OpSpec("logical_or", ops.logical_or, np.logical_or, [b1, b2],
+               bf16=False),
+        OpSpec("logical_xor", ops.logical_xor, np.logical_xor, [b1, b2],
+               bf16=False),
+        OpSpec("logical_not", ops.logical_not, np.logical_not, [b1],
+               bf16=False),
+        OpSpec("bitwise_and", ops.bitwise_and, np.bitwise_and, [ii, ii],
+               bf16=False),
+        OpSpec("bitwise_or", ops.bitwise_or, np.bitwise_or, [ii, ii],
+               bf16=False),
+        OpSpec("bitwise_xor", ops.bitwise_xor, np.bitwise_xor, [ii, ii],
+               bf16=False),
+        OpSpec("bitwise_not", ops.bitwise_not, np.bitwise_not, [ii],
+               bf16=False),
+        OpSpec("isnan", ops.isnan, np.isnan,
+               [np.array([1.0, np.nan], "float32")], bf16=False),
+        OpSpec("isinf", ops.isinf, np.isinf,
+               [np.array([1.0, np.inf], "float32")], bf16=False),
+        OpSpec("isfinite", ops.isfinite, np.isfinite,
+               [np.array([1.0, np.inf, np.nan], "float32")], bf16=False),
+        OpSpec("maximum_int", ops.maximum, np.maximum, [ii, ii],
+               bf16=False),
+    ]
+    run_op_specs(specs)
+
+
+def test_linalg_ops():
+    a = f32(3, 3) + 3 * np.eye(3, dtype="float32")  # well-conditioned
+    x, y = f32(3, 4), f32(4, 5)
+    specs = [
+        OpSpec("matmul", ops.matmul, lambda p, q: p @ q, [x, y],
+               grad=(0, 1), grad_atol=2e-2),
+        OpSpec("mm", ops.mm, lambda p, q: p @ q, [x, y]),
+        OpSpec("bmm", ops.bmm, lambda p, q: p @ q,
+               [f32(2, 3, 4), f32(2, 4, 5)]),
+        OpSpec("dot", ops.dot, np.dot, [f32(5), f32(5)]),
+        OpSpec("mv", ops.mv, lambda m, v: m @ v, [f32(3, 4), f32(4)]),
+        OpSpec("outer", ops.outer, np.outer, [f32(3), f32(4)]),
+        OpSpec("inner", ops.inner, np.inner, [f32(3), f32(3)]),
+        OpSpec("cross", ops.cross, lambda p, q: np.cross(p, q),
+               [f32(3), f32(3)]),
+        OpSpec("det", ops.det, np.linalg.det, [a], rtol=1e-4,
+               bf16=False),
+        OpSpec("inverse", ops.inverse, np.linalg.inv, [a], rtol=1e-3,
+               atol=1e-4, bf16=False),
+        OpSpec("norm", ops.norm, lambda m: np.linalg.norm(m), [x],
+               rtol=1e-4),
+        OpSpec("matrix_power", ops.matrix_power,
+               lambda m, n: np.linalg.matrix_power(m, n), [a], {"n": 2},
+               rtol=1e-4, bf16=False),
+        OpSpec("solve", ops.solve, np.linalg.solve, [a, f32(3, 2)],
+               rtol=1e-3, atol=1e-4, bf16=False),
+        OpSpec("slogdet", ops.slogdet,
+               lambda m: np.stack(np.linalg.slogdet(m)), [a], rtol=1e-4,
+               bf16=False),
+        OpSpec("multi_dot", lambda p, q, r: ops.multi_dot([p, q, r]),
+               lambda p, q, r: p @ q @ r,
+               [f32(2, 3), f32(3, 4), f32(4, 2)], rtol=1e-4),
+        OpSpec("einsum", lambda p, q: ops.einsum("ij,jk->ik", p, q),
+               lambda p, q: p @ q, [x, y], rtol=1e-4),
+        OpSpec("tensordot", ops.tensordot,
+               lambda p, q, axes=2: np.tensordot(p, q, axes),
+               [f32(2, 3, 4), f32(3, 4, 5)], rtol=1e-4),
+    ]
+    run_op_specs(specs)
+
+
+def test_search_sort_ops():
+    x = f32(3, 5)
+    specs = [
+        OpSpec("argmax", ops.argmax,
+               lambda a, axis=None: np.argmax(a, axis), [x], {"axis": 1},
+               bf16=False),
+        OpSpec("argmin", ops.argmin,
+               lambda a, axis=None: np.argmin(a, axis), [x], {"axis": 1},
+               bf16=False),
+        OpSpec("argsort", ops.argsort,
+               lambda a, axis=-1: np.argsort(a, axis), [x], bf16=False),
+        OpSpec("sort", ops.sort, lambda a, axis=-1: np.sort(a, axis),
+               [x]),
+        OpSpec("topk", lambda a: ops.topk(a, 2),
+               lambda a: (np.sort(a, -1)[:, ::-1][:, :2],
+                          np.argsort(-a, -1)[:, :2]), [x], bf16=False),
+        OpSpec("searchsorted", ops.searchsorted, np.searchsorted,
+               [np.sort(f32(8)), f32(4)], bf16=False),
+        OpSpec("nonzero", ops.nonzero,
+               lambda a: np.stack(np.nonzero(a), -1),
+               [np.array([[0, 1], [2, 0]], "float32")], bf16=False,
+               jit=False),
+        OpSpec("unique", lambda a: ops.unique(a),
+               lambda a: np.unique(a),
+               [np.array([3, 1, 2, 1, 3], "float32")], bf16=False,
+               jit=False),
+        OpSpec("kthvalue", lambda a: ops.kthvalue(a, 2),
+               lambda a: (np.sort(a, -1)[:, 1],
+                          np.argsort(a, -1)[:, 1]), [x], bf16=False),
+        OpSpec("mode", lambda a: ops.mode(a),
+               lambda a: _np_mode(a),
+               [np.array([[1, 2, 2], [3, 3, 1]], "float32")], bf16=False),
+        OpSpec("bincount", ops.bincount, np.bincount,
+               [np.array([0, 1, 1, 3], "int64")], bf16=False,
+               jit=False),
+        OpSpec("histogram", lambda a: ops.histogram(a, bins=4, min=0,
+                                                    max=4),
+               lambda a: np.histogram(a, bins=4, range=(0, 4))[0],
+               [np.array([0.5, 1.5, 1.7, 3.2], "float32")], bf16=False),
+    ]
+    run_op_specs(specs)
+
+
+def _np_mode(a):
+    vals = []
+    idxs = []
+    for row in a:
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        # paddle mode returns the LAST index of the mode value
+        idx = np.where(row == best)[0][-1]
+        vals.append(best)
+        idxs.append(idx)
+    return np.asarray(vals, a.dtype), np.asarray(idxs, np.int64)
