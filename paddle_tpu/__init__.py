@@ -33,6 +33,9 @@ from . import distributed  # noqa: F401
 from . import incubate  # noqa: F401
 from . import static  # noqa: F401
 from . import metric  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import distribution  # noqa: F401
 from . import vision  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model  # noqa: F401

@@ -1,0 +1,21 @@
+"""``paddle.distribution`` parity.
+
+Analog of ``python/paddle/distribution/`` (Distribution base
+``distribution.py:44``, Normal/Uniform/Categorical/Bernoulli/Beta/
+Dirichlet/Gamma/..., ``kl.py`` kl_divergence + register_kl). TPU-native:
+densities are jnp expressions behind the dispatch funnel (so log_prob is
+differentiable and jit-fusible); sampling draws from the framework PRNG
+(``paddle.seed``) via ``jax.random``.
+"""
+from .distributions import (  # noqa: F401
+    Distribution, Normal, Uniform, Bernoulli, Categorical, Beta,
+    Dirichlet, Gamma, Exponential, Laplace, LogNormal, Gumbel, Cauchy,
+    Geometric, Poisson, Multinomial, kl_divergence, register_kl,
+)
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+    "Beta", "Dirichlet", "Gamma", "Exponential", "Laplace", "LogNormal",
+    "Gumbel", "Cauchy", "Geometric", "Poisson", "Multinomial",
+    "kl_divergence", "register_kl",
+]

@@ -1,0 +1,671 @@
+"""Distribution classes. Reference ``python/paddle/distribution/*.py``
+(each class docstring cites its file)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import state
+from ..core.dispatch import unwrap
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x._read()
+    return jnp.asarray(x, jnp.float32)
+
+
+def _wrap(v):
+    return Tensor(v) if not isinstance(v, Tensor) else v
+
+
+def _key():
+    return state.default_rng.next_key()
+
+
+def _shape_of(sample_shape, *params):
+    base = jnp.broadcast_shapes(*[jnp.shape(p) for p in params])
+    return tuple(sample_shape) + base
+
+
+class Distribution:
+    """Base class (reference ``distribution/distribution.py:44``)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from .. import ops
+        return ops.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """Reference ``distribution/normal.py``."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.loc, self.scale)
+        return _wrap(self.loc + self.scale *
+                     jax.random.normal(_key(), shp))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+        return apply(
+            "normal_log_prob",
+            lambda v: (-((v - self.loc) ** 2) / (2 * self.scale ** 2)
+                       - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi)),
+            value)
+
+    def entropy(self):
+        v = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return _wrap(jnp.broadcast_to(v, self.batch_shape))
+
+
+class Uniform(Distribution):
+    """Reference ``distribution/uniform.py``."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return _wrap((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return _wrap((self.high - self.low) ** 2 / 12)
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.low, self.high)
+        return _wrap(jax.random.uniform(_key(), shp) *
+                     (self.high - self.low) + self.low)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+
+        def impl(v):
+            inside = (v >= self.low) & (v <= self.high)
+            return jnp.where(inside, -jnp.log(self.high - self.low),
+                             -jnp.inf)
+        return apply("uniform_log_prob", impl, value)
+
+    def entropy(self):
+        return _wrap(jnp.log(self.high - self.low) +
+                     jnp.zeros(self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    """Reference ``distribution/bernoulli.py``."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(jnp.shape(self.probs))
+
+    @property
+    def mean(self):
+        return _wrap(self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.probs)
+        return _wrap(jax.random.bernoulli(
+            _key(), self.probs, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+        eps = 1e-7
+        p = jnp.clip(self.probs, eps, 1 - eps)
+        return apply(
+            "bernoulli_log_prob",
+            lambda v: v * jnp.log(p) + (1 - v) * jnp.log1p(-p), value)
+
+    def entropy(self):
+        eps = 1e-7
+        p = jnp.clip(self.probs, eps, 1 - eps)
+        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    """Reference ``distribution/categorical.py`` (logits input)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        self._logp = jax.nn.log_softmax(self.logits, axis=-1)
+        super().__init__(jnp.shape(self.logits)[:-1])
+
+    @property
+    def probs_(self):
+        return jnp.exp(self._logp)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.categorical(_key(), self.logits,
+                                            shape=shp))
+
+    def probs(self, value):
+        from .. import ops
+        return ops.exp(self.log_prob(value))
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+
+        def impl(v):
+            vi = v.astype(jnp.int32)
+            logp = jnp.broadcast_to(self._logp,
+                                    vi.shape + self._logp.shape[-1:])
+            return jnp.take_along_axis(logp, vi[..., None], -1)[..., 0]
+        return apply("categorical_log_prob", impl, value)
+
+    def entropy(self):
+        return _wrap(-jnp.sum(jnp.exp(self._logp) * self._logp, -1))
+
+
+class Beta(Distribution):
+    """Reference ``distribution/beta.py``."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return _wrap(self.alpha * self.beta / (s * s * (s + 1)))
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.alpha, self.beta)
+        return _wrap(jax.random.beta(_key(), self.alpha, self.beta, shp))
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+        a, b = self.alpha, self.beta
+
+        def impl(v):
+            lbeta = (jax.scipy.special.gammaln(a) +
+                     jax.scipy.special.gammaln(b) -
+                     jax.scipy.special.gammaln(a + b))
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
+        return apply("beta_log_prob", impl, value)
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        a, b = self.alpha, self.beta
+        lbeta = gammaln(a) + gammaln(b) - gammaln(a + b)
+        return _wrap(lbeta - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                     + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    """Reference ``distribution/dirichlet.py``."""
+
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+        super().__init__(jnp.shape(self.concentration)[:-1],
+                         jnp.shape(self.concentration)[-1:])
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return _wrap(c / c.sum(-1, keepdims=True))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.dirichlet(_key(), self.concentration,
+                                          shp))
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+        c = self.concentration
+
+        def impl(v):
+            from jax.scipy.special import gammaln
+            norm = gammaln(c).sum(-1) - gammaln(c.sum(-1))
+            return ((c - 1) * jnp.log(v)).sum(-1) - norm
+        return apply("dirichlet_log_prob", impl, value)
+
+
+class Gamma(Distribution):
+    """Reference ``distribution/gamma.py`` (concentration/rate)."""
+
+    def __init__(self, concentration, rate):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.concentration / self.rate ** 2)
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.concentration, self.rate)
+        return _wrap(jax.random.gamma(_key(), self.concentration, shp) /
+                     self.rate)
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+        a, r = self.concentration, self.rate
+
+        def impl(v):
+            from jax.scipy.special import gammaln
+            return (a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v -
+                    gammaln(a))
+        return apply("gamma_log_prob", impl, value)
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        a, r = self.concentration, self.rate
+        return _wrap(a - jnp.log(r) + gammaln(a) + (1 - a) * digamma(a))
+
+
+class Exponential(Distribution):
+    """Reference ``distribution/exponential.py`` (rate)."""
+
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(jnp.shape(self.rate))
+
+    @property
+    def mean(self):
+        return _wrap(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.rate ** -2)
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.rate)
+        return _wrap(jax.random.exponential(_key(), shp) / self.rate)
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+        return apply("exponential_log_prob",
+                     lambda v: jnp.log(self.rate) - self.rate * v, value)
+
+    def entropy(self):
+        return _wrap(1.0 - jnp.log(self.rate))
+
+
+class Laplace(Distribution):
+    """Reference ``distribution/laplace.py``."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(2 * self.scale ** 2,
+                                      self.batch_shape))
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.loc, self.scale)
+        return _wrap(self.loc + self.scale *
+                     jax.random.laplace(_key(), shp))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+        return apply(
+            "laplace_log_prob",
+            lambda v: -jnp.abs(v - self.loc) / self.scale -
+            jnp.log(2 * self.scale), value)
+
+    def entropy(self):
+        return _wrap(1 + jnp.log(2 * self.scale) +
+                     jnp.zeros(self.batch_shape))
+
+
+class LogNormal(Distribution):
+    """Reference ``distribution/lognormal.py``."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return _wrap((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def sample(self, shape=()):
+        return _wrap(jnp.exp(self._normal.sample(shape)._read()))
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+
+        def impl(v):
+            lv = jnp.log(v)
+            return (-((lv - self.loc) ** 2) / (2 * self.scale ** 2)
+                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi)
+                    - lv)
+        return apply("lognormal_log_prob", impl, value)
+
+
+class Gumbel(Distribution):
+    """Reference ``distribution/gumbel.py``."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.loc + self.scale * np.euler_gamma)
+
+    @property
+    def variance(self):
+        return _wrap((math.pi ** 2 / 6) * self.scale ** 2 +
+                     jnp.zeros(self.batch_shape))
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.loc, self.scale)
+        return _wrap(self.loc + self.scale *
+                     jax.random.gumbel(_key(), shp))
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+
+        def impl(v):
+            z = (v - self.loc) / self.scale
+            return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+        return apply("gumbel_log_prob", impl, value)
+
+
+class Cauchy(Distribution):
+    """Reference ``distribution/cauchy.py``."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.loc, self.scale)
+        return _wrap(self.loc + self.scale *
+                     jax.random.cauchy(_key(), shp))
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+
+        def impl(v):
+            z = (v - self.loc) / self.scale
+            return -jnp.log(math.pi * self.scale * (1 + z * z))
+        return apply("cauchy_log_prob", impl, value)
+
+    def entropy(self):
+        return _wrap(jnp.log(4 * math.pi * self.scale) +
+                     jnp.zeros(self.batch_shape))
+
+
+class Geometric(Distribution):
+    """Reference ``distribution/geometric.py`` (k failures before the
+    first success, k in {0, 1, ...})."""
+
+    def __init__(self, probs):
+        self.probs = _t(probs)
+        super().__init__(jnp.shape(self.probs))
+
+    @property
+    def mean(self):
+        return _wrap((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return _wrap((1 - self.probs) / self.probs ** 2)
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.probs)
+        u = jax.random.uniform(_key(), shp, minval=1e-7, maxval=1.0)
+        return _wrap(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+        return apply(
+            "geometric_log_prob",
+            lambda v: v * jnp.log1p(-self.probs) + jnp.log(self.probs),
+            value)
+
+
+class Poisson(Distribution):
+    """Reference ``distribution/poisson.py``."""
+
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(jnp.shape(self.rate))
+
+    @property
+    def mean(self):
+        return _wrap(self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.rate)
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.rate)
+        return _wrap(jax.random.poisson(_key(), self.rate,
+                                        shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+
+        def impl(v):
+            from jax.scipy.special import gammaln
+            return v * jnp.log(self.rate) - self.rate - gammaln(v + 1)
+        return apply("poisson_log_prob", impl, value)
+
+
+class Multinomial(Distribution):
+    """Reference ``distribution/multinomial.py``."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(unwrap(total_count))
+        self.probs = _t(probs)
+        super().__init__(jnp.shape(self.probs)[:-1],
+                         jnp.shape(self.probs)[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    def sample(self, shape=()):
+        logits = jnp.log(self.probs)
+        draws = jax.random.categorical(
+            _key(), logits, shape=tuple(shape) + (self.total_count,) +
+            self.batch_shape)
+        k = self.probs.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(
+            axis=len(tuple(shape)))
+        return _wrap(counts)
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+
+        def impl(v):
+            from jax.scipy.special import gammaln
+            return (gammaln(v.sum(-1) + 1) - gammaln(v + 1).sum(-1) +
+                    (v * jnp.log(self.probs)).sum(-1))
+        return apply("multinomial_log_prob", impl, value)
+
+
+# --- KL divergence registry (reference ``distribution/kl.py``) -------------
+
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Reference ``kl.py register_kl`` decorator."""
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """Reference ``kl.py kl_divergence`` — registry dispatch with MRO
+    fallback."""
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_p, var_q = p.scale ** 2, q.scale ** 2
+    out = (jnp.log(q.scale / p.scale) +
+           (var_p + (p.loc - q.loc) ** 2) / (2 * var_q) - 0.5)
+    return _wrap(out)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    out = jnp.log((q.high - q.low) / (p.high - p.low))
+    return _wrap(jnp.where((q.low <= p.low) & (p.high <= q.high), out,
+                           jnp.inf))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    eps = 1e-7
+    a = jnp.clip(p.probs, eps, 1 - eps)
+    b = jnp.clip(q.probs, eps, 1 - eps)
+    out = a * (jnp.log(a) - jnp.log(b)) + \
+        (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b))
+    return _wrap(out)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    out = jnp.sum(jnp.exp(p._logp) * (p._logp - q._logp), axis=-1)
+    return _wrap(out)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return _wrap(jnp.log(1 / r) + r - 1)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    from jax.scipy.special import digamma, gammaln
+    out = ((p.concentration - q.concentration) * digamma(p.concentration)
+           - gammaln(p.concentration) + gammaln(q.concentration)
+           + q.concentration * (jnp.log(p.rate) - jnp.log(q.rate))
+           + p.concentration * (q.rate / p.rate - 1))
+    return _wrap(out)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    from jax.scipy.special import digamma, gammaln
+
+    def lbeta(a, b):
+        return gammaln(a) + gammaln(b) - gammaln(a + b)
+    sp = p.alpha + p.beta
+    out = (lbeta(q.alpha, q.beta) - lbeta(p.alpha, p.beta)
+           + (p.alpha - q.alpha) * digamma(p.alpha)
+           + (p.beta - q.beta) * digamma(p.beta)
+           + (q.alpha - p.alpha + q.beta - p.beta) * digamma(sp))
+    return _wrap(out)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    d = jnp.abs(p.loc - q.loc)
+    out = (jnp.log(q.scale / p.scale) + d / q.scale +
+           p.scale / q.scale * jnp.exp(-d / p.scale) - 1)
+    return _wrap(out)

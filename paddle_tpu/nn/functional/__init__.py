@@ -30,3 +30,8 @@ from .attention import (  # noqa: F401
     flash_attn_unpadded, sdp_kernel,
 )
 from .ring_attention import ring_flash_attention  # noqa: F401
+from .vision_ops import (  # noqa: F401
+    grid_sample, affine_grid, fold, channel_shuffle, temporal_shift,
+    sequence_mask, logit, pairwise_distance, soft_margin_loss,
+    multi_label_soft_margin_loss, gaussian_nll_loss, poisson_nll_loss,
+)

@@ -1,0 +1,239 @@
+"""Vision/warping/sequence functionals closing the ops.yaml gaps:
+grid_sample (reference ``paddle/phi/kernels/gpu/grid_sample_kernel.cu``),
+affine_grid, fold (col2im), channel_shuffle, temporal_shift,
+sequence_mask, plus small math/loss functionals (logit,
+pairwise_distance, soft_margin_loss, multi_label_soft_margin_loss,
+gaussian_nll_loss, poisson_nll_loss)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive
+
+
+@primitive("grid_sample")
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """x: [N, C, H, W]; grid: [N, Hg, Wg, 2] in [-1, 1] (xy order).
+    Reference ``nn/functional/vision.py grid_sample``."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"mode must be bilinear|nearest, got {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"bad padding_mode {padding_mode!r}")
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+
+    def unnorm(g, size):
+        if align_corners:
+            return (g + 1) * (size - 1) / 2
+        return ((g + 1) * size - 1) / 2
+
+    fx = unnorm(gx, w)
+    fy = unnorm(gy, h)
+
+    def reflect(p, size):
+        if align_corners:
+            span = 2 * (size - 1)
+            p = jnp.abs(jnp.mod(p, span))
+            return jnp.where(p > size - 1, span - p, p)
+        span = 2 * size
+        p = jnp.mod(p + 0.5, span)
+        p = jnp.abs(p) - 0.5
+        p = jnp.where(p > size - 0.5, span - 1 - p - 0.5, p)
+        return jnp.clip(p, 0, size - 1)
+
+    if padding_mode == "reflection":
+        fx = reflect(fx, w)
+        fy = reflect(fy, h)
+
+    def gather2d(ix, iy):
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        batch = jnp.arange(n).reshape(n, 1, 1)
+        vals = x[batch, :, iyc, ixc]           # [N, Hg, Wg, C]
+        if padding_mode == "zeros":
+            inb = ((ix >= 0) & (ix <= w - 1) &
+                   (iy >= 0) & (iy <= h - 1))
+            vals = vals * inb[..., None].astype(vals.dtype)
+        return vals
+
+    if mode == "nearest":
+        out = gather2d(jnp.round(fx).astype(jnp.int32),
+                       jnp.round(fy).astype(jnp.int32))
+    else:
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (fx - x0)[..., None]
+        wy = (fy - y0)[..., None]
+        out = (gather2d(x0, y0) * (1 - wx) * (1 - wy) +
+               gather2d(x1, y0) * wx * (1 - wy) +
+               gather2d(x0, y1) * (1 - wx) * wy +
+               gather2d(x1, y1) * wx * wy)
+    return jnp.moveaxis(out, -1, 1)            # [N, C, Hg, Wg]
+
+
+@primitive("affine_grid")
+def affine_grid(theta, out_shape, align_corners=True):
+    """theta: [N, 2, 3]; out_shape: [N, C, H, W] -> grid [N, H, W, 2].
+    Reference ``nn/functional/vision.py affine_grid``."""
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def linspace(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = linspace(h)
+    xs = linspace(w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    return jnp.einsum("hwk,nik->nhwi", base, theta)
+
+
+@primitive("fold")
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im — inverse of unfold (reference ``nn/functional/common.py``
+    fold). x: [N, C*kh*kw, L] -> [N, C, H, W]."""
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    oh, ow = pair(output_sizes)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    ph, pw = pair(paddings)
+    dh, dw = pair(dilations)
+    n, ckk, llen = x.shape
+    c = ckk // (kh * kw)
+    nh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    nw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    assert nh * nw == llen, f"fold: L={llen} != {nh}*{nw}"
+    cols = x.reshape(n, c, kh, kw, nh, nw)
+    out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dh
+            wj = j * dw
+            out = out.at[:, :, hi:hi + nh * sh:sh,
+                         wj:wj + nw * sw:sw].add(cols[:, :, i, j])
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+@primitive("channel_shuffle")
+def channel_shuffle(x, groups, data_format="NCHW"):
+    """Reference ``nn/functional/vision.py channel_shuffle``."""
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        return x.reshape(n, groups, c // groups, h, w) \
+                .swapaxes(1, 2).reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    return x.reshape(n, h, w, groups, c // groups) \
+            .swapaxes(3, 4).reshape(n, h, w, c)
+
+
+@primitive("temporal_shift")
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """Reference ``nn/functional/extension.py temporal_shift``."""
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    v = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    back = jnp.concatenate([v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])],
+                           axis=1)
+    fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, c1:c2]),
+                           v[:, :-1, c1:c2]], axis=1)
+    out = jnp.concatenate([back, fwd, v[:, :, c2:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    if data_format != "NCHW":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@primitive("sequence_mask")
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    """Reference ``nn/functional/extension.py sequence_mask``."""
+    from ...core.dtype import convert_dtype
+    ml = int(maxlen) if maxlen is not None else None
+    if ml is None:
+        raise ValueError(
+            "sequence_mask on TPU requires an explicit maxlen (static "
+            "shapes); pass maxlen=int(lengths.max())")
+    pos = jnp.arange(ml)
+    mask = pos[None, :] < lengths[..., None]
+    return mask.astype(convert_dtype(dtype) or jnp.int64)
+
+
+@primitive("logit")
+def logit(x, eps=None):
+    """Reference ``tensor/ops.py logit``."""
+    if eps is not None:
+        x = jnp.clip(x, eps, 1 - eps)
+    return jnp.log(x) - jnp.log1p(-x)
+
+
+@primitive("pairwise_distance")
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    """Reference ``nn/functional/distance.py``."""
+    d = x - y + epsilon
+    out = jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+    if keepdim:
+        out = out[..., None]
+    return out
+
+
+@primitive("soft_margin_loss")
+def soft_margin_loss(input, label, reduction="mean"):
+    """Reference ``nn/functional/loss.py soft_margin_loss``."""
+    loss = jnp.log1p(jnp.exp(-label * input))
+    return _reduce(loss, reduction)
+
+
+@primitive("multi_label_soft_margin_loss")
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):
+    loss = -(label * jax.nn.log_sigmoid(input) +
+             (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    loss = loss.mean(axis=-1)
+    return _reduce(loss, reduction)
+
+
+@primitive("gaussian_nll_loss")
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    import math
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + (label - input) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * math.log(2 * math.pi)
+    return _reduce(loss, reduction)
+
+
+@primitive("poisson_nll_loss")
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = (label * jnp.log(label) - label +
+                    0.5 * jnp.log(2 * jnp.pi * label))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    if reduction == "none":
+        return loss
+    raise ValueError(f"bad reduction {reduction!r}")

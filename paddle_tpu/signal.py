@@ -1,0 +1,131 @@
+"""``paddle.signal`` parity — short-time Fourier transforms.
+
+Analog of ``python/paddle/signal.py`` (stft :153, istft :309; frame/
+overlap_add kernels ``paddle/phi/kernels/funcs/frame_functor.h``).
+TPU-native: framing is a gather with static window counts, the FFT is the
+XLA FFT HLO — the whole transform stays fusible under jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dispatch import primitive
+
+
+@primitive("frame")
+def frame(x, frame_length, hop_length, axis=-1):
+    """Slice overlapping frames (reference ``signal.py`` frame op)."""
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+    n = x.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    out = x[..., idx]                       # [..., num_frames, frame_len]
+    out = jnp.swapaxes(out, -1, -2)         # [..., frame_len, num_frames]
+    if axis not in (-1, x.ndim - 1):
+        out = jnp.moveaxis(out, -1, axis)
+    return out
+
+
+@primitive("overlap_add")
+def overlap_add(x, hop_length, axis=-1):
+    """Inverse of ``frame`` (reference overlap_add op)."""
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+    frame_len, num = x.shape[-2], x.shape[-1]
+    out_len = (num - 1) * hop_length + frame_len
+    seg = jnp.swapaxes(x, -1, -2)           # [..., num, frame_len]
+    out = jnp.zeros(x.shape[:-2] + (out_len,), x.dtype)
+    for i in range(num):                    # static unroll: num is static
+        out = out.at[..., i * hop_length:i * hop_length + frame_len].add(
+            seg[..., i, :])
+    if axis not in (-1, x.ndim - 1):
+        out = jnp.moveaxis(out, -1, axis)
+    return out
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Reference ``signal.py:153``. x: [batch?, signal_len] real or complex;
+    returns [batch?, n_fft//2+1 or n_fft, num_frames] complex."""
+    from . import ops
+    from .core.tensor import Tensor
+
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    @primitive("stft")
+    def impl(xv, wv=None):
+        v = xv
+        if center:
+            pad = n_fft // 2
+            v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        fr = frame.raw(v, n_fft, hop_length)        # [..., n_fft, frames]
+        if wv is not None:
+            w = wv
+            if win_length < n_fft:
+                lpad = (n_fft - win_length) // 2
+                w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+            fr = fr * w[:, None]
+        fr = jnp.swapaxes(fr, -1, -2)               # [..., frames, n_fft]
+        if onesided and not jnp.iscomplexobj(fr):
+            sp = jnp.fft.rfft(fr, axis=-1)
+        else:
+            sp = jnp.fft.fft(fr, axis=-1)
+        if normalized:
+            sp = sp / jnp.sqrt(jnp.asarray(n_fft, sp.real.dtype))
+        return jnp.swapaxes(sp, -1, -2)             # [..., freq, frames]
+
+    args = [x] if window is None else [x, window]
+    return impl(*args)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Reference ``signal.py:309`` — least-squares inverse with window
+    envelope normalization."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    @primitive("istft")
+    def impl(xv, wv=None):
+        sp = jnp.swapaxes(xv, -1, -2)               # [..., frames, freq]
+        if normalized:
+            sp = sp * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            fr = jnp.fft.irfft(sp, n=n_fft, axis=-1)
+        else:
+            fr = jnp.fft.ifft(sp, n=n_fft, axis=-1)
+            if not return_complex:
+                fr = fr.real
+        if wv is not None:
+            w = wv
+            if win_length < n_fft:
+                lpad = (n_fft - win_length) // 2
+                w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        else:
+            w = jnp.ones((n_fft,), fr.dtype)
+        fr = fr * w
+        fr = jnp.swapaxes(fr, -1, -2)               # [..., n_fft, frames]
+        y = overlap_add.raw(fr, hop_length)
+        # window-square envelope for COLA normalization
+        wsq = jnp.broadcast_to((w * w)[:, None], fr.shape[-2:])
+        env = overlap_add.raw(wsq, hop_length)
+        y = y / jnp.where(env > 1e-11, env, 1.0)
+        if center:
+            pad = n_fft // 2
+            y = y[..., pad:y.shape[-1] - pad]
+        if length is not None:
+            y = y[..., :length]
+        return y
+
+    args = [x] if window is None else [x, window]
+    return impl(*args)
+
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]

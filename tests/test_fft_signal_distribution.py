@@ -1,0 +1,245 @@
+"""fft / signal / distribution / vision-functional coverage (reference
+test patterns: ``test/legacy_test/test_fft.py``, ``test_stft_op.py``,
+``test/distribution/test_distribution_*.py``, ``test_grid_sampler_op.py``)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+R = np.random.default_rng(11)
+
+
+# --- fft -------------------------------------------------------------------
+
+def test_fft_roundtrip_and_numpy_parity():
+    x = R.normal(size=(4, 16)).astype("float32")
+    X = paddle.fft.fft(paddle.to_tensor(x.astype("complex64")))
+    np.testing.assert_allclose(np.asarray(X._read()), np.fft.fft(x),
+                               atol=1e-4)
+    back = paddle.fft.ifft(X)
+    np.testing.assert_allclose(np.asarray(back._read()).real, x, atol=1e-5)
+
+    for norm in ("backward", "ortho", "forward"):
+        Xr = paddle.fft.rfft(paddle.to_tensor(x), norm=norm)
+        np.testing.assert_allclose(np.asarray(Xr._read()),
+                                   np.fft.rfft(x, norm=norm), atol=1e-4)
+        rec = paddle.fft.irfft(Xr, n=16, norm=norm)
+        np.testing.assert_allclose(np.asarray(rec._read()), x, atol=1e-5)
+
+
+def test_fft2_fftn_shift():
+    x = R.normal(size=(3, 8, 8)).astype("float32")
+    np.testing.assert_allclose(
+        np.asarray(paddle.fft.fft2(
+            paddle.to_tensor(x.astype("complex64")))._read()),
+        np.fft.fft2(x), atol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(paddle.fft.rfftn(paddle.to_tensor(x))._read()),
+        np.fft.rfftn(x), atol=1e-3)
+    s = paddle.fft.fftshift(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(s._read()), np.fft.fftshift(x),
+                               atol=0)
+    f = paddle.fft.fftfreq(8, d=0.5)
+    np.testing.assert_allclose(np.asarray(f._read()),
+                               np.fft.fftfreq(8, d=0.5), atol=1e-7)
+
+
+def test_fft_grad_flows():
+    x = paddle.to_tensor(R.normal(size=(8,)).astype("float32"))
+    x.stop_gradient = False
+    y = paddle.fft.rfft(x)
+    from paddle_tpu import ops
+    loss = ops.sum(ops.as_real(y) ** 2)
+    loss.backward()
+    assert x.grad is not None
+    # Parseval: d/dx sum|X|^2 = 2*N*x for rfft needs care; just check finite
+    assert np.isfinite(np.asarray(x.grad._read())).all()
+
+
+def test_stft_istft_roundtrip():
+    x = R.normal(size=(2, 256)).astype("float32")
+    window = np.hanning(64).astype("float32")
+    spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=64, hop_length=16,
+                              window=paddle.to_tensor(window))
+    assert tuple(spec.shape) == (2, 33, 256 // 16 + 1)
+    rec = paddle.signal.istft(spec, n_fft=64, hop_length=16,
+                              window=paddle.to_tensor(window), length=256)
+    # COLA reconstruction: interior matches closely
+    np.testing.assert_allclose(np.asarray(rec._read())[:, 32:-32],
+                               x[:, 32:-32], atol=1e-4)
+
+
+# --- distributions ---------------------------------------------------------
+
+def test_normal_distribution():
+    import scipy.stats as st
+    d = paddle.distribution.Normal(1.0, 2.0)
+    v = np.array([0.5, 1.0, 3.0], "float32")
+    np.testing.assert_allclose(
+        np.asarray(d.log_prob(paddle.to_tensor(v))._read()),
+        st.norm.logpdf(v, 1.0, 2.0), atol=1e-5)
+    np.testing.assert_allclose(float(d.entropy()._read()),
+                               st.norm.entropy(1.0, 2.0), atol=1e-5)
+    paddle.seed(0)
+    s = d.sample([20000])
+    assert abs(float(np.asarray(s._read()).mean()) - 1.0) < 0.06
+
+
+def test_more_distribution_logprobs():
+    import scipy.stats as st
+    cases = [
+        (paddle.distribution.Uniform(0.0, 2.0), np.array([0.5, 1.5], "f4"),
+         st.uniform.logpdf([0.5, 1.5], 0, 2)),
+        (paddle.distribution.Bernoulli(0.3), np.array([0.0, 1.0], "f4"),
+         st.bernoulli.logpmf([0, 1], 0.3)),
+        (paddle.distribution.Beta(2.0, 3.0), np.array([0.2, 0.7], "f4"),
+         st.beta.logpdf([0.2, 0.7], 2, 3)),
+        (paddle.distribution.Gamma(2.0, 3.0), np.array([0.5, 1.0], "f4"),
+         st.gamma.logpdf([0.5, 1.0], 2, scale=1 / 3)),
+        (paddle.distribution.Exponential(1.5), np.array([0.5, 2.0], "f4"),
+         st.expon.logpdf([0.5, 2.0], scale=1 / 1.5)),
+        (paddle.distribution.Laplace(0.0, 1.5), np.array([-1.0, 2.0], "f4"),
+         st.laplace.logpdf([-1.0, 2.0], 0, 1.5)),
+        (paddle.distribution.LogNormal(0.2, 0.8), np.array([0.5, 2.0], "f4"),
+         st.lognorm.logpdf([0.5, 2.0], 0.8, scale=np.exp(0.2))),
+        (paddle.distribution.Gumbel(0.5, 2.0), np.array([0.0, 3.0], "f4"),
+         st.gumbel_r.logpdf([0.0, 3.0], 0.5, 2.0)),
+        (paddle.distribution.Cauchy(0.0, 1.0), np.array([0.5, -2.0], "f4"),
+         st.cauchy.logpdf([0.5, -2.0])),
+        (paddle.distribution.Poisson(3.0), np.array([2.0, 5.0], "f4"),
+         st.poisson.logpmf([2, 5], 3.0)),
+        (paddle.distribution.Geometric(0.4), np.array([0.0, 3.0], "f4"),
+         st.geom.logpmf([1, 4], 0.4)),  # scipy geom counts trials
+    ]
+    for d, v, want in cases:
+        got = np.asarray(d.log_prob(paddle.to_tensor(v))._read())
+        np.testing.assert_allclose(got, want, atol=1e-4,
+                                   err_msg=type(d).__name__)
+
+
+def test_categorical_and_multinomial():
+    logits = np.log(np.array([0.2, 0.3, 0.5], "float32"))
+    c = paddle.distribution.Categorical(logits)
+    lp = np.asarray(c.log_prob(paddle.to_tensor(
+        np.array([0, 2], "int64")))._read())
+    np.testing.assert_allclose(lp, np.log([0.2, 0.5]), atol=1e-5)
+    np.testing.assert_allclose(
+        float(c.entropy()._read()),
+        -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5)),
+        atol=1e-5)
+    m = paddle.distribution.Multinomial(10, np.array([0.2, 0.8], "f4"))
+    paddle.seed(1)
+    s = np.asarray(m.sample([500])._read())
+    assert s.shape == (500, 2) and (s.sum(-1) == 10).all()
+    assert abs(s[:, 1].mean() - 8.0) < 0.3
+
+
+def test_kl_divergence():
+    import scipy.stats as st
+    p = paddle.distribution.Normal(0.0, 1.0)
+    q = paddle.distribution.Normal(1.0, 2.0)
+    got = float(paddle.distribution.kl_divergence(p, q)._read())
+    # closed form
+    want = np.log(2.0) + (1 + 1.0) / (2 * 4.0) - 0.5
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    b1 = paddle.distribution.Beta(2.0, 3.0)
+    b2 = paddle.distribution.Beta(4.0, 1.5)
+    kl = float(paddle.distribution.kl_divergence(b1, b2)._read())
+    # monte-carlo cross-check
+    paddle.seed(0)
+    xs = np.asarray(b1.sample([100000])._read()).clip(1e-5, 1 - 1e-5)
+    mc = (st.beta.logpdf(xs, 2, 3) - st.beta.logpdf(xs, 4, 1.5)).mean()
+    assert abs(kl - mc) < 0.02
+    with pytest.raises(NotImplementedError):
+        paddle.distribution.kl_divergence(p, b1)
+
+
+# --- vision functionals ----------------------------------------------------
+
+def test_grid_sample_identity_and_torch_parity():
+    import torch
+    x = R.normal(size=(2, 3, 5, 7)).astype("float32")
+    # identity grid reproduces the input
+    theta = np.tile(np.array([[1, 0, 0], [0, 1, 0]], "float32"), (2, 1, 1))
+    grid = F.affine_grid(paddle.to_tensor(theta), [2, 3, 5, 7],
+                         align_corners=True)
+    out = F.grid_sample(paddle.to_tensor(x), grid, align_corners=True)
+    np.testing.assert_allclose(np.asarray(out._read()), x, atol=1e-5)
+
+    # random grid vs torch
+    grid_np = R.uniform(-1.2, 1.2, (2, 4, 6, 2)).astype("float32")
+    for mode in ("bilinear", "nearest"):
+        for pad in ("zeros", "border", "reflection"):
+            ours = F.grid_sample(paddle.to_tensor(x),
+                                 paddle.to_tensor(grid_np), mode=mode,
+                                 padding_mode=pad, align_corners=True)
+            ref = torch.nn.functional.grid_sample(
+                torch.tensor(x), torch.tensor(grid_np), mode=mode,
+                padding_mode="reflection" if pad == "reflection" else pad,
+                align_corners=True)
+            np.testing.assert_allclose(np.asarray(ours._read()),
+                                       ref.numpy(), atol=1e-4,
+                                       err_msg=f"{mode}/{pad}")
+
+
+def test_fold_inverts_unfold():
+    x = R.normal(size=(2, 3, 8, 8)).astype("float32")
+    cols = F.unfold(paddle.to_tensor(x), kernel_sizes=2, strides=2)
+    back = F.fold(cols, output_sizes=8, kernel_sizes=2, strides=2)
+    np.testing.assert_allclose(np.asarray(back._read()), x, atol=1e-5)
+
+
+def test_channel_shuffle_and_sequence_mask():
+    x = np.arange(2 * 4 * 2 * 2, dtype="float32").reshape(2, 4, 2, 2)
+    out = F.channel_shuffle(paddle.to_tensor(x), groups=2)
+    import torch
+    ref = torch.nn.functional.channel_shuffle(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(np.asarray(out._read()), ref, atol=0)
+
+    m = F.sequence_mask(paddle.to_tensor(np.array([1, 3], "int64")),
+                        maxlen=4)
+    np.testing.assert_allclose(np.asarray(m._read()),
+                               [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+
+def test_misc_losses_and_logit():
+    import torch
+    import torch.nn.functional as TF
+    x = R.normal(size=(4, 5)).astype("float32")
+    y = np.sign(R.normal(size=(4, 5))).astype("float32")
+    got = float(F.soft_margin_loss(paddle.to_tensor(x),
+                                   paddle.to_tensor(y))._read())
+    want = TF.soft_margin_loss(torch.tensor(x), torch.tensor(y)).item()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    lbl = (R.uniform(size=(4, 5)) > 0.5).astype("float32")
+    got = float(F.multi_label_soft_margin_loss(
+        paddle.to_tensor(x), paddle.to_tensor(lbl))._read())
+    want = TF.multilabel_soft_margin_loss(torch.tensor(x),
+                                          torch.tensor(lbl)).item()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    p = R.uniform(0.05, 0.95, (6,)).astype("float32")
+    np.testing.assert_allclose(
+        np.asarray(F.logit(paddle.to_tensor(p))._read()),
+        np.log(p / (1 - p)), atol=1e-5)
+
+    var = R.uniform(0.5, 2.0, (4, 5)).astype("float32")
+    got = float(F.gaussian_nll_loss(paddle.to_tensor(x),
+                                    paddle.to_tensor(lbl),
+                                    paddle.to_tensor(var))._read())
+    want = TF.gaussian_nll_loss(torch.tensor(x), torch.tensor(lbl),
+                                torch.tensor(var)).item()
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    got = float(F.poisson_nll_loss(paddle.to_tensor(x),
+                                   paddle.to_tensor(lbl))._read())
+    want = TF.poisson_nll_loss(torch.tensor(x), torch.tensor(lbl)).item()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    d = float(F.pairwise_distance(paddle.to_tensor(x),
+                                  paddle.to_tensor(lbl))._read().sum())
+    want = TF.pairwise_distance(torch.tensor(x),
+                                torch.tensor(lbl)).sum().item()
+    np.testing.assert_allclose(d, want, rtol=1e-4)
