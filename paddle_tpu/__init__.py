@@ -36,6 +36,9 @@ from . import metric  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import distribution  # noqa: F401
+from . import profiler  # noqa: F401
+from . import device  # noqa: F401
+from .device import set_device, get_device  # noqa: F401
 from . import vision  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model  # noqa: F401

@@ -81,6 +81,10 @@ def _check_nan_inf(name, vals):
                     f"paddle/fluid/eager/nan_inf_utils.h)")
 
 
+# set by paddle_tpu.profiler while recording: fn(name, t0_ns, t1_ns)
+_profile_hook = None
+
+
 def apply(name: str, fn: Callable, *args, **kwargs):
     """Run op ``fn`` over (unwrapped) args; record grad node if needed.
 
@@ -88,6 +92,17 @@ def apply(name: str, fn: Callable, *args, **kwargs):
     unwrapped to its value (read through the jit tracker) but NOT
     differentiated — ops must take differentiable operands positionally.
     """
+    if _profile_hook is not None:
+        import time as _time
+        _t0 = _time.perf_counter_ns()
+        try:
+            return _apply(name, fn, *args, **kwargs)
+        finally:
+            _profile_hook(name, _t0, _time.perf_counter_ns())
+    return _apply(name, fn, *args, **kwargs)
+
+
+def _apply(name: str, fn: Callable, *args, **kwargs):
     tensors, spec = _flatten(args)
     vals = [t._read() for t in tensors]
     if kwargs:

@@ -1,0 +1,221 @@
+"""``paddle.device`` parity — device control, synchronization, memory stats.
+
+Capability analog of SURVEY C4 (DeviceContext pool -> PJRT owns
+streams/contexts; this is the user-facing surface), C7 (allocator stats ->
+PJRT ``memory_stats``), C30 (DeviceEvent -> PJRT futures +
+``block_until_ready``). Reference ``python/paddle/device/__init__.py``
+(set_device/get_device/synchronize), ``device/cuda/__init__.py``
+(memory stats, Event/Stream).
+
+TPU-native notes: XLA/PJRT dispatches asynchronously on its own streams —
+``synchronize`` drains by blocking on a sentinel transfer; Stream objects
+are accepted for API compatibility but scheduling is PJRT's (the analog of
+the reference's stream-safe allocator is buffer donation, already used by
+the jit executor).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+_current = None
+
+
+def _backend_devices():
+    return jax.devices()
+
+
+def get_all_device_type():
+    kinds = []
+    for d in jax.devices():
+        if d.platform not in kinds:
+            kinds.append(d.platform)
+    return kinds
+
+
+def get_all_custom_device_type():
+    return [p for p in get_all_device_type() if p not in ("cpu",)]
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device() if not d.startswith("cpu")]
+
+
+def device_count(device_type: Optional[str] = None) -> int:
+    if device_type is None:
+        return len(jax.devices())
+    return len([d for d in jax.devices() if d.platform == device_type])
+
+
+def set_device(device: str):
+    """Reference ``device/__init__.py set_device`` — pins the default
+    placement for new tensors. Accepts "cpu", "tpu", "tpu:0", ...; the
+    reference's "gpu:N" spelling maps to the accelerator backend."""
+    global _current
+    name = device.replace("gpu", _accel_platform())
+    plat, _, idx = name.partition(":")
+    devs = [d for d in jax.devices() if d.platform == plat] or jax.devices()
+    dev = devs[int(idx)] if idx else devs[0]
+    jax.config.update("jax_default_device", dev)
+    _current = f"{dev.platform}:{dev.id}"
+    return _current
+
+
+def _accel_platform():
+    for d in jax.devices():
+        if d.platform != "cpu":
+            return d.platform
+    return "cpu"
+
+
+def get_device() -> str:
+    if _current is not None:
+        return _current
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def _resolve(device=None):
+    if device is None:
+        plat, _, idx = get_device().partition(":")
+    else:
+        plat, _, idx = str(device).partition(":")
+    devs = [d for d in jax.devices() if d.platform == plat] or jax.devices()
+    return devs[int(idx)] if idx else devs[0]
+
+
+def synchronize(device=None):
+    """Drain outstanding device work: block on a sentinel transfer queued
+    behind everything PJRT has in flight."""
+    dev = _resolve(device)
+    jax.block_until_ready(jax.device_put(np.zeros(()), dev))
+
+
+# --- memory stats (C7; reference device/cuda memory APIs) ------------------
+
+def _mem_stats(device=None) -> dict:
+    dev = _resolve(device)
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    return stats or {}
+
+
+def memory_allocated(device=None) -> int:
+    """Reference ``cuda.memory_allocated`` analog (HBM bytes in use)."""
+    return int(_mem_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    return int(_mem_stats(device).get("peak_bytes_in_use",
+                                      memory_allocated(device)))
+
+
+def memory_reserved(device=None) -> int:
+    s = _mem_stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    return max_memory_allocated(device)
+
+
+def empty_cache():
+    """PJRT owns the allocator; live buffers are freed by GC. Provided for
+    API parity (reference ``cuda.empty_cache``)."""
+    import gc
+    gc.collect()
+
+
+# --- events/streams (C30) --------------------------------------------------
+
+class Event:
+    """Reference ``device.Event``. PJRT has no user event objects; record
+    drains the queue and timestamps — correct elapsed_time semantics for
+    the common bench pattern, at the cost of a sync per record."""
+
+    def __init__(self, device=None, enable_timing=True, blocking=False):
+        self.device = device
+        self._ts: Optional[float] = None
+
+    def record(self, stream=None):
+        synchronize(self.device)
+        self._ts = time.perf_counter()
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def elapsed_time(self, end_event: "Event") -> float:
+        if self._ts is None or end_event._ts is None:
+            raise RuntimeError("both events must be recorded")
+        return (end_event._ts - self._ts) * 1000.0
+
+
+class Stream:
+    """API-parity shim: XLA/PJRT schedules its own streams; work items
+    submitted 'to' this stream run on the default queue."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def query(self) -> bool:
+        return True
+
+    def record_event(self, event: Optional[Event] = None) -> Event:
+        event = event or Event(self.device)
+        event.record(self)
+        return event
+
+    def wait_event(self, event: Event):
+        event.synchronize()
+
+    def wait_stream(self, stream: "Stream"):
+        stream.synchronize()
+
+
+def current_stream(device=None) -> Stream:
+    return Stream(device)
+
+
+def set_stream(stream: Stream):
+    return stream
+
+
+class cuda:  # namespace parity: paddle.device.cuda.*
+    Event = Event
+    Stream = Stream
+    synchronize = staticmethod(synchronize)
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    empty_cache = staticmethod(empty_cache)
+
+    @staticmethod
+    def device_count():
+        return device_count(_accel_platform())
+
+    @staticmethod
+    def current_stream(device=None):
+        return Stream(device)
+
+
+__all__ = [
+    "set_device", "get_device", "get_all_device_type",
+    "get_all_custom_device_type", "get_available_device",
+    "get_available_custom_device", "device_count", "synchronize",
+    "memory_allocated", "max_memory_allocated", "memory_reserved",
+    "max_memory_reserved", "empty_cache", "Event", "Stream",
+    "current_stream", "set_stream", "cuda",
+]

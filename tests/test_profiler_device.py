@@ -1,0 +1,81 @@
+"""Profiler + device API tests (reference patterns:
+``test/legacy_test/test_profiler.py``, ``test_newprofiler.py``,
+``test_cuda_max_memory_allocated.py``)."""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+
+
+def test_scheduler_state_machine():
+    sched = profiler.make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                                    skip_first=1)
+    S = profiler.ProfilerState
+    states = [sched(i) for i in range(7)]
+    assert states == [S.CLOSED, S.CLOSED, S.READY, S.RECORD,
+                      S.RECORD_AND_RETURN, S.CLOSED, S.CLOSED]
+
+
+def test_profiler_records_ops_and_exports(tmp_path):
+    traced = []
+    p = profiler.Profiler(
+        targets=[profiler.ProfilerTarget.CPU],
+        scheduler=profiler.make_scheduler(closed=0, ready=0, record=2,
+                                          repeat=1),
+        on_trace_ready=lambda prof: traced.append(prof))
+    p.reset()
+    x = paddle.to_tensor(np.ones((4, 4), "float32"))
+    with p:
+        for _ in range(2):
+            with profiler.RecordEvent("my_span"):
+                y = x @ x + x
+            p.step(num_samples=4)
+    assert traced, "on_trace_ready never fired"
+    table = p.summary()
+    assert "my_span" in table
+    assert "matmul" in table  # per-op dispatch events recorded
+    out = p.export(str(tmp_path / "trace.json"))
+    data = json.load(open(out))
+    names = {e["name"] for e in data["traceEvents"]}
+    assert "my_span" in names and "matmul" in names
+    bench = p.benchmark()
+    assert bench["steps"] == 2 and bench["ips"] > 0
+
+
+def test_profiler_hook_removed_after_stop():
+    from paddle_tpu.core import dispatch
+    assert dispatch._profile_hook is None
+    p = profiler.Profiler().start()
+    assert dispatch._profile_hook is not None
+    p.stop()
+    assert dispatch._profile_hook is None
+
+
+def test_device_api():
+    dev = paddle.get_device()
+    assert ":" in dev
+    assert paddle.device.device_count() >= 1
+    assert paddle.device.get_all_device_type()
+    paddle.device.synchronize()
+    # memory stats: zeros on backends without memory_stats, ints otherwise
+    assert isinstance(paddle.device.memory_allocated(), int)
+    assert paddle.device.max_memory_allocated() >= \
+        paddle.device.memory_allocated() or \
+        paddle.device.max_memory_allocated() == 0
+
+
+def test_event_elapsed_time():
+    e1 = paddle.device.Event()
+    e2 = paddle.device.Event()
+    e1.record()
+    x = paddle.to_tensor(np.ones((64, 64), "float32"))
+    for _ in range(3):
+        x = x @ x * 0.01
+    e2.record()
+    assert e1.elapsed_time(e2) > 0
+    s = paddle.device.current_stream()
+    s.synchronize()
+    assert s.query()
