@@ -1,0 +1,162 @@
+"""ctypes bindings for the native IO runtime (``loader.cc``).
+
+Compiled on first use with g++ (cached next to the source); every entry
+point degrades to a numpy fallback when the toolchain is unavailable, so
+the framework stays importable anywhere. ctypes releases the GIL for the
+duration of each call — the C++ thread pool overlaps preprocessing with
+Python execution, the design point of the reference's C++ reader stack.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libpaddle_tpu_io.so")
+_SRC = os.path.join(_HERE, "loader.cc")
+_lib = None
+_lock = threading.Lock()
+
+
+def _build() -> str:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return _SO
+
+
+def load():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except Exception:
+            return None
+        lib.pdtpu_normalize_u8.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
+        lib.pdtpu_nhwc_to_nchw.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64]
+        lib.pdtpu_gather_rows.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64]
+        lib.pdtpu_queue_new.restype = ctypes.c_void_p
+        lib.pdtpu_queue_new.argtypes = [ctypes.c_int64]
+        lib.pdtpu_queue_free.argtypes = [ctypes.c_void_p]
+        lib.pdtpu_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                         ctypes.c_int64]
+        lib.pdtpu_queue_push.restype = ctypes.c_int
+        lib.pdtpu_queue_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        ctypes.c_int64]
+        lib.pdtpu_queue_pop.restype = ctypes.c_int64
+        lib.pdtpu_queue_size.argtypes = [ctypes.c_void_p]
+        lib.pdtpu_queue_size.restype = ctypes.c_int64
+        lib.pdtpu_queue_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def normalize_batch(src: np.ndarray, mean, std, to_chw: bool = True
+                    ) -> np.ndarray:
+    """uint8 [N,H,W,C] -> float32 normalized [N,C,H,W] (or NHWC)."""
+    assert src.dtype == np.uint8 and src.ndim == 4
+    n, h, w, c = src.shape
+    mean = np.ascontiguousarray(mean, np.float32).reshape(c)
+    std = np.ascontiguousarray(std, np.float32).reshape(c)
+    lib = load()
+    if lib is None:  # numpy fallback
+        out = (src.astype(np.float32) - mean) / std
+        return np.ascontiguousarray(out.transpose(0, 3, 1, 2)) \
+            if to_chw else out
+    src = np.ascontiguousarray(src)
+    shape = (n, c, h, w) if to_chw else (n, h, w, c)
+    dst = np.empty(shape, np.float32)
+    lib.pdtpu_normalize_u8(
+        src.ctypes.data, dst.ctypes.data, n, h, w, c,
+        mean.ctypes.data, std.ctypes.data, int(to_chw))
+    return dst
+
+
+def nhwc_to_nchw(src: np.ndarray) -> np.ndarray:
+    assert src.dtype == np.float32 and src.ndim == 4
+    n, h, w, c = src.shape
+    lib = load()
+    if lib is None:
+        return np.ascontiguousarray(src.transpose(0, 3, 1, 2))
+    src = np.ascontiguousarray(src)
+    dst = np.empty((n, c, h, w), np.float32)
+    lib.pdtpu_nhwc_to_nchw(src.ctypes.data, dst.ctypes.data, n, h, w, c)
+    return dst
+
+
+def gather_rows(base: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """out[i] = base[idx[i]] — the shuffled-batch collate hot path."""
+    base = np.ascontiguousarray(base)
+    idx = np.ascontiguousarray(idx, np.int64)
+    lib = load()
+    if lib is None:
+        return base[idx].copy()
+    row_bytes = base.nbytes // base.shape[0]
+    out = np.empty((len(idx),) + base.shape[1:], base.dtype)
+    lib.pdtpu_gather_rows(base.ctypes.data, idx.ctypes.data,
+                          out.ctypes.data, len(idx), row_bytes)
+    return out
+
+
+class NativeQueue:
+    """Bounded blocking queue of numpy payloads backed by the C++ queue
+    (the reference blocking_queue.h analog). Arbitrary-array handoff:
+    payloads are raw bytes; callers keep shape/dtype."""
+
+    def __init__(self, capacity: int = 8):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native IO library unavailable")
+        self._lib = lib
+        self._q = lib.pdtpu_queue_new(capacity)
+
+    def push(self, arr: np.ndarray) -> bool:
+        arr = np.ascontiguousarray(arr)
+        return bool(self._lib.pdtpu_queue_push(self._q, arr.ctypes.data,
+                                               arr.nbytes))
+
+    def pop(self, nbytes: int, dtype=np.uint8, shape=None):
+        out = np.empty(nbytes, np.uint8)
+        got = self._lib.pdtpu_queue_pop(self._q, out.ctypes.data, nbytes)
+        if got < 0:
+            return None
+        payload = out[:got]
+        if shape is not None:
+            payload = payload.view(dtype).reshape(shape)
+        return payload
+
+    def size(self) -> int:
+        return int(self._lib.pdtpu_queue_size(self._q))
+
+    def close(self):
+        self._lib.pdtpu_queue_close(self._q)
+
+    def __del__(self):
+        try:
+            self._lib.pdtpu_queue_close(self._q)
+            self._lib.pdtpu_queue_free(self._q)
+        except Exception:
+            pass

@@ -191,6 +191,28 @@ class Normalize(BaseTransform):
         return normalize(img, self.mean, self.std, self.data_format)
 
 
+class BatchNormalize:
+    """Batched uint8 [N,H,W,C] -> normalized float32 [N,C,H,W] through the
+    native IO runtime (``io/native/loader.cc``): multithreaded, GIL-free —
+    the collate-side hot path of an image input pipeline. Falls back to
+    numpy when the native library is unavailable."""
+
+    def __init__(self, mean, std, to_chw=True):
+        self.mean = mean
+        self.std = std
+        self.to_chw = to_chw
+
+    def __call__(self, batch):
+        import numpy as _np
+
+        from ...io import native as _native
+        batch = _np.asarray(batch)
+        if batch.ndim != 4 or batch.dtype != _np.uint8:
+            raise ValueError("BatchNormalize expects a uint8 NHWC batch")
+        return _native.normalize_batch(batch, self.mean, self.std,
+                                       to_chw=self.to_chw)
+
+
 def pad(img, padding, fill=0, padding_mode="constant"):
     img = _as_hwc(img)
     if isinstance(padding, int):
