@@ -1,0 +1,15 @@
+"""``paddle.audio`` parity — spectral features and window functions.
+
+Analog of ``python/paddle/audio/`` (``functional/window.py``,
+``functional/functional.py`` hz_to_mel/mel_frequencies/compute_fbank_matrix,
+``features/layers.py`` Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC).
+Built on the framework stft (XLA FFT), so feature extraction is
+jit-fusible and differentiable end-to-end.
+"""
+from . import functional  # noqa: F401
+from .features import (  # noqa: F401
+    LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram,
+)
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

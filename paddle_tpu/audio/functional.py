@@ -1,0 +1,131 @@
+"""Audio functional ops (reference ``python/paddle/audio/functional/``)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Reference ``functional/window.py get_window``: hann/hamming/
+    blackman/bartlett/bohman/gaussian/general_gaussian/exponential/
+    taylor/kaiser/tukey supported by scipy — we implement the common set
+    natively and defer the exotic ones to scipy.signal when present."""
+    n = win_length
+    if isinstance(window, tuple):
+        name, *params = window
+    else:
+        name, params = window, []
+    m = n if not fftbins else n + 1
+    k = np.arange(m)
+    if name == "hann":
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * k / (m - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * k / (m - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * k / (m - 1))
+             + 0.08 * np.cos(4 * math.pi * k / (m - 1)))
+    elif name == "bartlett":
+        w = 1 - np.abs(2 * k / (m - 1) - 1)
+    elif name == "rect" or name == "boxcar" or name == "ones":
+        w = np.ones(m)
+    else:
+        from scipy.signal import get_window as sp_get
+        w = sp_get(window if params == [] else (name, *params), m,
+                   fftbins=False)
+    if fftbins:
+        w = w[:-1]
+    return Tensor(jnp.asarray(w, jnp.float32))
+
+
+def hz_to_mel(freq, htk=False):
+    """Reference ``functional.py hz_to_mel`` (slaney default)."""
+    scalar = not hasattr(freq, "__len__")
+    f = np.asarray(freq, np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10) /
+                                            min_log_hz) / logstep, out)
+    return float(out) if scalar else out
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not hasattr(mel, "__len__")
+    m = np.asarray(mel, np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                       out)
+    return float(out) if scalar else out
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                       n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return np.linspace(0, sr / 2, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Reference ``functional.py compute_fbank_matrix`` -> [n_mels,
+    1 + n_fft//2] triangular filters."""
+    f_max = f_max or sr / 2
+    fft_f = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    fb = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        fb *= enorm[:, None]
+    return Tensor(jnp.asarray(fb, jnp.float32))
+
+
+def power_to_db(magnitude, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """Reference ``functional.py power_to_db``."""
+    from .. import ops
+    x = magnitude if isinstance(magnitude, Tensor) else Tensor(magnitude)
+    log_spec = 10.0 * ops.log10(ops.maximum(x, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        log_spec = ops.maximum(log_spec, ops.max(log_spec) - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    """DCT-II basis [n_mels, n_mfcc] (reference ``functional.py``)."""
+    k = np.arange(n_mels)[:, None]
+    f = np.arange(n_mfcc)[None, :]
+    dct = np.cos(math.pi / n_mels * (k + 0.5) * f) * 2.0
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(1.0 / (2.0 * n_mels))
+    return Tensor(jnp.asarray(dct, jnp.float32))
+
+
+__all__ = ["get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
+           "fft_frequencies", "compute_fbank_matrix", "power_to_db",
+           "create_dct"]

@@ -1,0 +1,247 @@
+"""``paddle.quantization`` parity — QAT fake-quant, PTQ observers, and
+weight-only int8 inference ops.
+
+Capability analog of ``python/paddle/quantization/`` (QuantConfig
+``config.py``, QAT ``qat.py``, PTQ ``ptq.py``, abs-max quanters
+``quanters/abs_max.py``, observers ``observers/abs_max.py``) and the
+``weight_quantize/weight_dequantize/weight_only_linear`` ops
+(``paddle/phi/kernels/gpu/weight_only_linear_kernel.cu``).
+
+TPU-native mechanics: fake-quant uses the straight-through estimator
+expressed as ``x + stop_gradient(q(x) - x)`` on the tape (no custom
+backward kernel needed); weight-only int8 stores per-channel abs-max
+scales and dequantizes into the matmul, which XLA fuses into one HBM pass.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+# --- weight-only ops -------------------------------------------------------
+
+@primitive("weight_quantize")
+def _weight_quantize_impl(w, algo="weight_only_int8"):
+    if algo not in ("weight_only_int8", "abs_max", "weight_only_int4"):
+        raise ValueError(f"unsupported algo {algo!r}")
+    bits = 4 if algo == "weight_only_int4" else 8
+    qmax = (1 << (bits - 1)) - 1
+    scale = jnp.max(jnp.abs(w), axis=0) / qmax  # per out-channel [out]
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(w / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def weight_quantize(w, algo="weight_only_int8"):
+    """w: [in, out] float -> (int8 weights, [out] scales)."""
+    return _weight_quantize_impl(w, algo=algo)
+
+
+@primitive("weight_dequantize")
+def weight_dequantize(qw, scale, algo="weight_only_int8",
+                      out_dtype="float32"):
+    from ..core.dtype import convert_dtype
+    return (qw.astype(jnp.float32) * scale).astype(
+        convert_dtype(out_dtype) or jnp.float32)
+
+
+@primitive("weight_only_linear")
+def weight_only_linear(x, qweight, scale, bias=None,
+                       weight_dtype="int8"):
+    """y = x @ dequant(qweight) + bias; the dequant feeds the MXU matmul
+    directly (one fused HBM pass under XLA)."""
+    w = qweight.astype(x.dtype) * scale.astype(x.dtype)
+    y = x @ w
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+# --- fake quant (QAT) ------------------------------------------------------
+
+def fake_quant(x, scale, bits=8):
+    """Straight-through fake quantization on the tape."""
+    from .. import ops
+    qmax = float((1 << (bits - 1)) - 1)
+    s = scale if isinstance(scale, Tensor) else Tensor(jnp.asarray(scale))
+    q = ops.clip(ops.round(x / s * qmax), -qmax - 1, qmax) / qmax * s
+    d = q - x
+    d.stop_gradient = True  # STE: grad flows through x alone
+    return x + d
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """Reference ``quanters/abs_max.py`` — moving-average abs-max scale +
+    fake quant in training; frozen scale in eval."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
+                 name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bits = bit_length
+        self._scale = 1.0
+        self._initialized = False
+
+    def scale(self):
+        return self._scale
+
+    def forward(self, x):
+        if self.training:
+            cur = float(np.abs(np.asarray(x._read())).max()) or 1e-8
+            if not self._initialized:
+                self._scale = cur
+                self._initialized = True
+            else:
+                r = self.moving_rate
+                self._scale = r * self._scale + (1 - r) * cur
+        return fake_quant(x, self._scale, self.bits)
+
+
+class AbsmaxObserver(Layer):
+    """Reference ``observers/abs_max.py`` — PTQ calibration observer:
+    collects abs-max, passes activations through unchanged."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.bits = quant_bits
+        self._max = 0.0
+
+    def scale(self):
+        qmax = float((1 << (self.bits - 1)) - 1)
+        return (self._max or 1e-8) / qmax
+
+    def forward(self, x):
+        self._max = max(self._max,
+                        float(np.abs(np.asarray(x._read())).max()))
+        return x
+
+
+class QuantConfig:
+    """Reference ``config.py`` QuantConfig (global + per-layer rules)."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_configs: list = []
+
+    def add_layer_config(self, layer=None, activation=None, weight=None):
+        self._layer_configs.append((layer, activation, weight))
+
+    def _factories_for(self, layer):
+        for targets, act, wt in self._layer_configs:
+            ts = targets if isinstance(targets, (list, tuple)) else [targets]
+            if any(layer is t or isinstance(t, type) and isinstance(layer, t)
+                   for t in ts):
+                return act, wt
+        return self.activation, self.weight
+
+
+def _make(factory):
+    if factory is None:
+        return None
+    if isinstance(factory, type):
+        return factory()
+    try:  # QuanterFactory-style: callable returning a quanter
+        return factory()
+    except TypeError:
+        return factory
+
+
+class QuantedLinear(Layer):
+    """QAT wrapper for Linear (reference ``nn/quant/qat/linear.py``)."""
+
+    def __init__(self, linear, act_quanter, weight_quanter):
+        super().__init__()
+        self.inner = linear
+        self.act_q = act_quanter
+        self.w_q = weight_quanter
+
+    def forward(self, x):
+        from ..nn import functional as F
+        if self.act_q is not None:
+            x = self.act_q(x)
+        w = self.inner.weight
+        if self.w_q is not None:
+            w = self.w_q(w)
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, conv, act_quanter, weight_quanter):
+        super().__init__()
+        self.inner = conv
+        self.act_q = act_quanter
+        self.w_q = weight_quanter
+
+    def forward(self, x):
+        from ..nn import functional as F
+        if self.act_q is not None:
+            x = self.act_q(x)
+        w = self.inner.weight
+        if self.w_q is not None:
+            w = self.w_q(w)
+        return F.conv2d(x, w, self.inner.bias,
+                        stride=self.inner._stride,
+                        padding=self.inner._padding,
+                        dilation=self.inner._dilation,
+                        groups=self.inner._groups)
+
+
+def _wrap_layers(model: Layer, config: QuantConfig, cls_map):
+    from ..nn.layers import Conv2D, Linear
+    for name, child in list(model._sub_layers.items()):
+        if isinstance(child, Linear):
+            act, wt = config._factories_for(child)
+            model._sub_layers[name] = QuantedLinear(
+                child, _make(act), _make(wt))
+        elif isinstance(child, Conv2D):
+            act, wt = config._factories_for(child)
+            model._sub_layers[name] = QuantedConv2D(
+                child, _make(act), _make(wt))
+        else:
+            _wrap_layers(child, config, cls_map)
+    return model
+
+
+class QAT:
+    """Reference ``qat.py`` — quantize() wraps layers with fake-quant."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace=True) -> Layer:
+        return _wrap_layers(model, self.config, None)
+
+    def convert(self, model: Layer, inplace=True) -> Layer:
+        """Strip wrappers, baking nothing (fake-quant is simulation);
+        reference convert() emits an inference program — ours returns the
+        plain layers for jit.save."""
+        for name, child in list(model._sub_layers.items()):
+            if isinstance(child, (QuantedLinear, QuantedConv2D)):
+                model._sub_layers[name] = child.inner
+            else:
+                self.convert(child)
+        return model
+
+
+class PTQ:
+    """Reference ``ptq.py`` — observer insertion, calibration, convert."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace=True) -> Layer:
+        return _wrap_layers(model, self.config, None)
+
+    convert = QAT.convert
+
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
+    "AbsmaxObserver", "QuantedLinear", "QuantedConv2D", "fake_quant",
+    "weight_quantize", "weight_dequantize", "weight_only_linear",
+]

@@ -1,0 +1,118 @@
+"""``paddle.text`` parity — text datasets + decode ops.
+
+Analog of ``python/paddle/text/`` (datasets: Imdb/Conll05/...) and the
+sequence-decode ops ``viterbi_decode`` (``paddle/phi/kernels/
+viterbi_decode_kernel.h``) and ``gather_tree`` (beam-search trace-back).
+Datasets ship as synthetic-capable loaders: the reference downloads
+corpora; in an air-gapped image we generate deterministic corpora with
+identical structure (document in each class docstring).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive
+from ..io import Dataset
+
+
+@primitive("gather_tree")
+def gather_tree(ids, parents):
+    """Beam-search trace-back (reference ``nn/decode gather_tree``):
+    ids/parents: [max_time, batch, beam] -> full sequences by walking
+    parent pointers from the last step."""
+    t, b, k = ids.shape
+
+    def step(carry, inp):
+        beams = carry                      # [batch, beam] current beam idx
+        id_t, par_t = inp                  # each [batch, beam]
+        out = jnp.take_along_axis(id_t, beams, axis=-1)
+        nxt = jnp.take_along_axis(par_t, beams, axis=-1)
+        return nxt, out
+
+    last = jnp.broadcast_to(jnp.arange(k, dtype=ids.dtype), (b, k))
+    _, outs = jax.lax.scan(step, last, (ids[::-1], parents[::-1]))
+    return outs[::-1]
+
+
+@primitive("viterbi_decode")
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True):
+    """CRF viterbi decode (reference ``text/viterbi_decode.py``):
+    potentials [B, T, N] emissions, transition [N(+2), N(+2)] -> (scores,
+    paths [B, T]). With include_bos_eos_tag, the last two transition rows/
+    cols are BOS/EOS (reference convention)."""
+    b, t, n = potentials.shape
+    if include_bos_eos_tag:
+        trans = transition_params[:n, :n]
+        bos = transition_params[n, :n] if transition_params.shape[0] > n \
+            else jnp.zeros((n,))
+        eos = transition_params[:n, n + 1] \
+            if transition_params.shape[1] > n + 1 else jnp.zeros((n,))
+    else:
+        trans, bos, eos = transition_params, 0.0, 0.0
+
+    alpha0 = potentials[:, 0] + bos        # [B, N]
+
+    def step(alpha, emit):
+        scores = alpha[:, :, None] + trans[None]      # [B, N, N]
+        best = jnp.max(scores, axis=1) + emit
+        back = jnp.argmax(scores, axis=1)
+        return best, back
+
+    alpha, backs = jax.lax.scan(step, alpha0,
+                                jnp.swapaxes(potentials[:, 1:], 0, 1))
+    alpha = alpha + eos
+    last = jnp.argmax(alpha, axis=-1)                 # [B]
+    score = jnp.max(alpha, axis=-1)
+
+    def walk(state, back_t):
+        prev = jnp.take_along_axis(back_t, state[:, None], -1)[:, 0]
+        return prev, prev
+
+    _, path_rev = jax.lax.scan(walk, last, backs[::-1])
+    paths = jnp.concatenate([path_rev[::-1], last[None]], axis=0)
+    return score, jnp.swapaxes(paths, 0, 1).astype(jnp.int64)
+
+
+class _SyntheticTextDataset(Dataset):
+    """Deterministic synthetic corpus with the reference dataset's
+    (tokens, label) structure — documented stand-in for the downloadable
+    corpora (zero-egress image)."""
+
+    num_classes = 2
+    vocab_size = 1000
+
+    def __init__(self, mode="train", n=256, seq_len=64, seed=0):
+        rng = np.random.default_rng(
+            seed + (0 if mode == "train" else 1))
+        self.labels = rng.integers(0, self.num_classes,
+                                   n).astype("int64")
+        # class-conditional unigram skew so models can actually learn
+        base = rng.random((self.num_classes, self.vocab_size))
+        base = base / base.sum(-1, keepdims=True)
+        self.tokens = np.stack([
+            rng.choice(self.vocab_size, seq_len, p=base[c])
+            for c in self.labels]).astype("int64")
+
+    def __getitem__(self, i):
+        return self.tokens[i], np.asarray([self.labels[i]], "int64")
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Imdb(_SyntheticTextDataset):
+    """Reference ``text/datasets/imdb.py`` structure (binary sentiment)."""
+
+
+class Imikolov(_SyntheticTextDataset):
+    """Reference ``text/datasets/imikolov.py`` (LM ngrams)."""
+
+    def __getitem__(self, i):
+        toks = self.tokens[i]
+        return toks[:-1], toks[1:]
+
+
+__all__ = ["gather_tree", "viterbi_decode", "Imdb", "Imikolov"]

@@ -1,0 +1,95 @@
+"""Quantization tests (reference patterns: ``test/quantization/test_qat.py``,
+``test_ptq.py``, ``test_weight_only_linear.py``)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (QAT, PTQ, AbsmaxObserver,
+                                     FakeQuanterWithAbsMaxObserver,
+                                     QuantConfig, QuantedLinear, fake_quant,
+                                     weight_dequantize, weight_only_linear,
+                                     weight_quantize)
+
+R = np.random.default_rng(3)
+
+
+def test_weight_quantize_roundtrip():
+    w = paddle.to_tensor(R.normal(size=(16, 8)).astype("float32"))
+    qw, scale = weight_quantize(w)
+    assert str(qw.dtype).endswith("int8") and tuple(scale.shape) == (8,)
+    deq = weight_dequantize(qw, scale)
+    err = np.abs(np.asarray(deq._read()) - np.asarray(w._read())).max()
+    # int8 per-channel: error bounded by scale/2
+    assert err <= float(np.asarray(scale._read()).max()) * 0.51
+
+
+def test_weight_only_linear_matches_dequant_matmul():
+    x = paddle.to_tensor(R.normal(size=(4, 16)).astype("float32"))
+    w = paddle.to_tensor(R.normal(size=(16, 8)).astype("float32"))
+    b = paddle.to_tensor(R.normal(size=(8,)).astype("float32"))
+    qw, scale = weight_quantize(w)
+    y = weight_only_linear(x, qw, scale, b)
+    ref = np.asarray(x._read()) @ np.asarray(
+        weight_dequantize(qw, scale)._read()) + np.asarray(b._read())
+    np.testing.assert_allclose(np.asarray(y._read()), ref, atol=1e-5)
+    # quantization error vs full precision stays small
+    full = np.asarray(x._read()) @ np.asarray(w._read())
+    rel = np.abs(np.asarray(y._read()) - np.asarray(b._read()) - full)
+    assert rel.mean() < 0.05
+
+
+def test_fake_quant_ste_gradient():
+    x = paddle.to_tensor(R.normal(size=(5, 5)).astype("float32"))
+    x.stop_gradient = False
+    y = fake_quant(x, 2.0)
+    # values quantized
+    q = np.asarray(y._read())
+    steps = q / (2.0 / 127)
+    np.testing.assert_allclose(steps, np.round(steps), atol=1e-3)
+    y.sum().backward()
+    # STE: gradient is identity (ones)
+    np.testing.assert_allclose(np.asarray(x.grad._read()),
+                               np.ones((5, 5)), atol=1e-6)
+
+
+def test_qat_quantize_train_convert():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    q = QAT(QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                        weight=FakeQuanterWithAbsMaxObserver))
+    net = q.quantize(net)
+    assert isinstance(net[0], QuantedLinear)
+    net.train()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    xs = R.normal(size=(32, 8)).astype("float32")
+    ys = (xs.sum(-1) > 0).astype("int64")
+    lossf = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(30):
+        out = net(paddle.to_tensor(xs))
+        loss = lossf(out, paddle.to_tensor(ys))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8, losses[::10]
+    net = q.convert(net)
+    assert isinstance(net[0], nn.Linear)
+    out = net(paddle.to_tensor(xs))
+    assert tuple(out.shape) == (32, 2)
+
+
+def test_ptq_observer_calibration():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(4, 4))
+    p = PTQ(QuantConfig(activation=AbsmaxObserver, weight=None))
+    net = p.quantize(net)
+    net.eval()
+    big = np.zeros((2, 4), "float32")
+    big[0, 0] = 6.35
+    net(paddle.to_tensor(big))
+    obs = net[0].act_q
+    np.testing.assert_allclose(obs.scale(), 6.35 / 127, rtol=1e-5)
+    p.convert(net)
+    assert isinstance(net[0], nn.Linear)

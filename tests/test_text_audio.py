@@ -1,0 +1,95 @@
+"""text / audio module tests (reference patterns:
+``test/legacy_test/test_viterbi_decode_op.py``, ``test_gather_tree_op.py``,
+``test/legacy_test/test_audio_functions.py``)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+R = np.random.default_rng(5)
+
+
+def test_gather_tree():
+    # example from the reference gather_tree docs
+    ids = np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]],
+                   "int64")
+    parents = np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]],
+                        [[0, 0], [0, 1]]], "int64")
+    out = paddle.text.gather_tree(paddle.to_tensor(ids),
+                                  paddle.to_tensor(parents))
+    want = np.array([[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]],
+                    "int64")
+    np.testing.assert_array_equal(np.asarray(out._read()), want)
+
+
+def _brute_viterbi(emis, trans, bos, eos):
+    t, n = emis.shape
+    import itertools
+    best, best_s = None, -np.inf
+    for path in itertools.product(range(n), repeat=t):
+        s = bos[path[0]] + emis[0, path[0]]
+        for i in range(1, t):
+            s += trans[path[i - 1], path[i]] + emis[i, path[i]]
+        s += eos[path[-1]]
+        if s > best_s:
+            best, best_s = path, s
+    return best_s, list(best)
+
+
+def test_viterbi_decode_matches_bruteforce():
+    n, t = 3, 4
+    emis = R.normal(size=(2, t, n)).astype("float32")
+    full = R.normal(size=(n + 2, n + 2)).astype("float32")
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(full))
+    bos = full[n, :n]
+    eos = full[:n, n + 1]
+    for b in range(2):
+        ws, wp = _brute_viterbi(emis[b], full[:n, :n], bos, eos)
+        np.testing.assert_allclose(float(np.asarray(scores._read())[b]),
+                                   ws, atol=1e-4)
+        assert list(np.asarray(paths._read())[b]) == wp
+
+
+def test_text_datasets():
+    ds = paddle.text.Imdb(mode="train", n=32, seq_len=16)
+    toks, label = ds[0]
+    assert toks.shape == (16,) and label.shape == (1,)
+    lm = paddle.text.Imikolov(n=8, seq_len=16)
+    x, y = lm[0]
+    np.testing.assert_array_equal(x[1:], y[:-1])
+
+
+def test_mel_and_window_functions():
+    import scipy.signal
+    af = paddle.audio.functional
+    w = np.asarray(af.get_window("hann", 64)._read())
+    np.testing.assert_allclose(
+        w, scipy.signal.get_window("hann", 64, fftbins=True), atol=1e-6)
+    # librosa-convention slaney mel round trip
+    freqs = np.array([0.0, 500.0, 1000.0, 4000.0])
+    np.testing.assert_allclose(af.mel_to_hz(af.hz_to_mel(freqs)), freqs,
+                               rtol=1e-6)
+    assert abs(af.hz_to_mel(1000.0, htk=True) - 1000.0) < 1.0
+    fb = np.asarray(af.compute_fbank_matrix(16000, 512, 40)._read())
+    assert fb.shape == (40, 257) and (fb >= 0).all() and fb.sum() > 0
+
+
+def test_audio_feature_layers():
+    sr = 16000
+    tone = np.sin(2 * np.pi * 440 *
+                  np.arange(sr // 4) / sr).astype("float32")[None]
+    spec = paddle.audio.Spectrogram(n_fft=512)(paddle.to_tensor(tone))
+    assert tuple(spec.shape)[1] == 257
+    # peak bin at 440 Hz
+    peak = int(np.asarray(spec._read())[0].mean(-1).argmax())
+    assert abs(peak - round(440 * 512 / sr)) <= 1
+    mel = paddle.audio.MelSpectrogram(sr=sr, n_fft=512, n_mels=40)(
+        paddle.to_tensor(tone))
+    assert tuple(mel.shape)[1] == 40
+    logmel = paddle.audio.LogMelSpectrogram(sr=sr, n_fft=512, n_mels=40)(
+        paddle.to_tensor(tone))
+    assert np.isfinite(np.asarray(logmel._read())).all()
+    mfcc = paddle.audio.MFCC(sr=sr, n_mfcc=13, n_fft=512, n_mels=40)(
+        paddle.to_tensor(tone))
+    assert tuple(mfcc.shape)[1] == 13
