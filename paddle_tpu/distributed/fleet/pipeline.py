@@ -56,19 +56,29 @@ def functional_call(layer: Layer, param_vals: dict, *args):
     if missing:
         raise ValueError(f"functional_call missing values for {missing}")
     originals = {n: p._data for n, p in params.items()}
+
+    def wrap(a):
+        if isinstance(a, (tuple, list)):
+            return type(a)(wrap(e) for e in a)
+        return a if isinstance(a, Tensor) else Tensor(a)
+
+    def unwrap(o):
+        if isinstance(o, (tuple, list)):
+            return type(o)(unwrap(e) for e in o)
+        return o._data if isinstance(o, Tensor) else o
+
     old_tracker = tensor_mod.set_tracker(None)
     old_grad = state.set_grad_enabled(False)
     try:
         for n, p in params.items():
             p._data = param_vals[n]
-        out = layer(*[a if isinstance(a, Tensor) else Tensor(a)
-                      for a in args])
+        out = layer(*[wrap(a) for a in args])
     finally:
         state.set_grad_enabled(old_grad)
         tensor_mod.set_tracker(old_tracker)
         for n, p in params.items():
             p._data = originals[n]
-    return out._data if isinstance(out, Tensor) else out
+    return unwrap(out)
 
 
 from ...core.meshutil import pvary as _pvary

@@ -1,0 +1,485 @@
+"""Recurrent layers — SimpleRNN/LSTM/GRU cells and sequence wrappers.
+
+Capability analog of ``python/paddle/nn/layer/rnn.py`` (RNNCellBase :234,
+SimpleRNNCell :260, LSTMCell :860 [i,f,g,o gate order], GRUCell :1055
+[r,z,c], RNN :1280, BiRNN :1350, SimpleRNN/LSTM/GRU :1430+) and the cudnn
+rnn kernel (``paddle/phi/kernels/gpu/rnn_kernel.cu.cc``). TPU-native: the
+time loop is ONE ``lax.scan`` per layer-direction inside a single
+dispatched primitive — XLA compiles the whole unrolled recurrence with the
+cell's matmuls batched on the MXU; the generic ``RNN`` wrapper runs ANY
+user cell by functionalizing it (``functional_call``), the analog of the
+reference's Python control-flow RNN wrapper but trace-compiled instead of
+eagerly stepped.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from . import initializer as I
+from .layer import Layer
+
+
+class RNNCellBase(Layer):
+    """Reference ``rnn.py RNNCellBase``."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from .. import ops
+        shape = shape or self.state_shape
+        batch = batch_ref.shape[batch_dim_idx]
+
+        def build(s):
+            if isinstance(s, tuple) and s and isinstance(s[0], tuple):
+                return tuple(build(e) for e in s)
+            if isinstance(s, (list, tuple)) and s and \
+                    isinstance(s[0], (list, tuple)):
+                return tuple(build(tuple(e)) for e in s)
+            return ops.full([batch] + list(s), init_value,
+                            dtype=dtype or "float32")
+
+        s = self.state_shape
+        if isinstance(s[0], (list, tuple)):
+            return tuple(build(tuple(e)) for e in s)
+        return build(tuple(s))
+
+
+def _uniform_param(layer, shape, attr, std):
+    if attr is False:
+        p = layer.create_parameter(shape, None,
+                                   default_initializer=I.Constant(1.0))
+        p.stop_gradient = True
+        return p
+    return layer.create_parameter(shape, attr,
+                                  default_initializer=I.Uniform(-std, std))
+
+
+class SimpleRNNCell(RNNCellBase):
+    """Reference ``rnn.py:260`` — h' = act(W_ih x + b_ih + W_hh h + b_hh)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if hidden_size <= 0:
+            raise ValueError("hidden_size must be positive")
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = _uniform_param(self, (hidden_size, input_size),
+                                        weight_ih_attr, std)
+        self.weight_hh = _uniform_param(self, (hidden_size, hidden_size),
+                                        weight_hh_attr, std)
+        self.bias_ih = _uniform_param(self, (hidden_size,), bias_ih_attr,
+                                      std)
+        self.bias_hh = _uniform_param(self, (hidden_size,), bias_hh_attr,
+                                      std)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        self.activation = activation
+
+    @staticmethod
+    def _step(p, x, h, activation="tanh"):
+        z = (x @ p["weight_ih"].T + p["bias_ih"] +
+             h @ p["weight_hh"].T + p["bias_hh"])
+        return jnp.tanh(z) if activation == "tanh" else jax.nn.relu(z)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def impl(x, h, wi, wh, bi, bh):
+            return self._step(
+                {"weight_ih": wi, "weight_hh": wh, "bias_ih": bi,
+                 "bias_hh": bh}, x, h, self.activation)
+
+        h = apply("simple_rnn_cell", impl, inputs, states, self.weight_ih,
+                  self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    """Reference ``rnn.py:860`` — gates split [i, f, g, o]."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        if hidden_size <= 0:
+            raise ValueError("hidden_size must be positive")
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = _uniform_param(
+            self, (4 * hidden_size, input_size), weight_ih_attr, std)
+        self.weight_hh = _uniform_param(
+            self, (4 * hidden_size, hidden_size), weight_hh_attr, std)
+        self.bias_ih = _uniform_param(self, (4 * hidden_size,),
+                                      bias_ih_attr, std)
+        self.bias_hh = _uniform_param(self, (4 * hidden_size,),
+                                      bias_hh_attr, std)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+
+    @staticmethod
+    def _step(p, x, hc):
+        h, c = hc
+        gates = (x @ p["weight_ih"].T + p["bias_ih"] +
+                 h @ p["weight_hh"].T + p["bias_hh"])
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c2 = f * c + i * jnp.tanh(g)
+        h2 = o * jnp.tanh(c2)
+        return h2, (h2, c2)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h0, c0 = states
+
+        def impl(x, h, c, wi, wh, bi, bh):
+            _, (h2, c2) = self._step(
+                {"weight_ih": wi, "weight_hh": wh, "bias_ih": bi,
+                 "bias_hh": bh}, x, (h, c))
+            return h2, c2
+
+        h, c = apply("lstm_cell", impl, inputs, h0, c0, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    """Reference ``rnn.py:1055`` — gates [r, z, c];
+    h' = z*h + (1-z)*tanh(W_ic x + b_ic + r*(W_hc h + b_hc))."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        if hidden_size <= 0:
+            raise ValueError("hidden_size must be positive")
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = _uniform_param(
+            self, (3 * hidden_size, input_size), weight_ih_attr, std)
+        self.weight_hh = _uniform_param(
+            self, (3 * hidden_size, hidden_size), weight_hh_attr, std)
+        self.bias_ih = _uniform_param(self, (3 * hidden_size,),
+                                      bias_ih_attr, std)
+        self.bias_hh = _uniform_param(self, (3 * hidden_size,),
+                                      bias_hh_attr, std)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+
+    @staticmethod
+    def _step(p, x, h):
+        xg = x @ p["weight_ih"].T + p["bias_ih"]
+        hg = h @ p["weight_hh"].T + p["bias_hh"]
+        xr, xz, xc = jnp.split(xg, 3, axis=-1)
+        hr, hz, hc = jnp.split(hg, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        cand = jnp.tanh(xc + r * hc)
+        return z * h + (1 - z) * cand
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def impl(x, h, wi, wh, bi, bh):
+            return self._step(
+                {"weight_ih": wi, "weight_hh": wh, "bias_ih": bi,
+                 "bias_hh": bh}, x, h)
+
+        h = apply("gru_cell", impl, inputs, states, self.weight_ih,
+                  self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+# --- sequence wrappers -----------------------------------------------------
+
+def _cell_kind(cell):
+    if isinstance(cell, LSTMCell):
+        return "lstm"
+    if isinstance(cell, GRUCell):
+        return "gru"
+    if isinstance(cell, SimpleRNNCell):
+        return "rnn"
+    return "custom"
+
+
+def _run_layer(cell, inputs, init_states, reverse=False,
+               sequence_length=None, time_major=False):
+    """One layer-direction as a single primitive: lax.scan over time."""
+    kind = _cell_kind(cell)
+    params = dict(cell.named_parameters())
+    names = list(params)
+    is_tuple_state = kind == "lstm" or (
+        kind == "custom" and isinstance(init_states, (tuple, list)))
+
+    if kind == "custom":
+        from ..distributed.fleet.pipeline import functional_call
+
+    act = getattr(cell, "activation", "tanh")
+
+    def impl(xv, *rest):
+        if is_tuple_state:
+            h0, c0 = rest[0], rest[1]
+            w = rest[2:len(names) + 2]
+            sl = rest[len(names) + 2] if sequence_length is not None \
+                else None
+        else:
+            h0 = rest[0]
+            w = rest[1:len(names) + 1]
+            sl = rest[len(names) + 1] if sequence_length is not None \
+                else None
+        p = dict(zip(names, w))
+        xs = xv if time_major else jnp.swapaxes(xv, 0, 1)  # [T, B, I]
+        t_len = xs.shape[0]
+        if reverse:
+            xs = xs[::-1]
+
+        def masked(t, new, old):
+            if sl is None:
+                return new
+            # time index for masking honors the reversal
+            real_t = (t_len - 1 - t) if reverse else t
+            m = (real_t < sl)[:, None].astype(new.dtype)
+            return m * new + (1 - m) * old
+
+        def step(carry, inp):
+            t, x_t = inp
+            if kind == "lstm":
+                h, c = carry
+                _, (h2, c2) = LSTMCell._step(p, x_t, (h, c))
+                h2, c2 = masked(t, h2, h), masked(t, c2, c)
+                return (h2, c2), h2
+            if kind == "gru":
+                h = carry
+                h2 = masked(t, GRUCell._step(p, x_t, h), h)
+                return h2, h2
+            if kind == "rnn":
+                h = carry
+                h2 = masked(t, SimpleRNNCell._step(p, x_t, h, act), h)
+                return h2, h2
+            # custom cell: functionalize its forward
+            out, new_states = None, None
+            res = functional_call(cell, p, x_t, carry)
+            out, new_states = res
+            if isinstance(new_states, (tuple, list)):
+                new_states = tuple(
+                    masked(t, n, o) for n, o in zip(new_states, carry))
+            else:
+                new_states = masked(t, new_states, carry)
+            return new_states, out
+
+        carry0 = (h0, c0) if is_tuple_state else h0
+        carry, outs = jax.lax.scan(step, carry0,
+                                   (jnp.arange(t_len), xs))
+        if sl is not None:
+            # zero outputs past each sequence's length
+            real_t = (t_len - 1 - jnp.arange(t_len)) if reverse \
+                else jnp.arange(t_len)
+            m = (real_t[:, None] < sl[None, :]).astype(outs.dtype)
+            outs = outs * m[..., None]
+        if reverse:
+            outs = outs[::-1]
+        if not time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        if is_tuple_state:
+            return outs, carry[0], carry[1]
+        return outs, carry
+
+    args = [inputs]
+    if is_tuple_state:
+        args += [init_states[0], init_states[1]]
+    else:
+        args += [init_states]
+    args += [params[n] for n in names]
+    if sequence_length is not None:
+        args += [sequence_length]
+    res = apply("rnn_scan", impl, *args)
+    if is_tuple_state:
+        outs, h, c = res
+        return outs, (h, c)
+    outs, h = res
+    return outs, h
+
+
+class RNN(Layer):
+    """Reference ``rnn.py RNN`` — wraps a single cell over a sequence."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        if initial_states is None:
+            batch_idx = 1 if self.time_major else 0
+            initial_states = self.cell.get_initial_states(
+                inputs, batch_dim_idx=batch_idx)
+        return _run_layer(self.cell, inputs, initial_states,
+                          reverse=self.is_reverse,
+                          sequence_length=sequence_length,
+                          time_major=self.time_major)
+
+
+class BiRNN(Layer):
+    """Reference ``rnn.py BiRNN``."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from .. import ops
+        batch_idx = 1 if self.time_major else 0
+        if initial_states is None:
+            states_fw = self.cell_fw.get_initial_states(
+                inputs, batch_dim_idx=batch_idx)
+            states_bw = self.cell_bw.get_initial_states(
+                inputs, batch_dim_idx=batch_idx)
+        else:
+            states_fw, states_bw = initial_states
+        out_fw, st_fw = _run_layer(self.cell_fw, inputs, states_fw,
+                                   reverse=False,
+                                   sequence_length=sequence_length,
+                                   time_major=self.time_major)
+        out_bw, st_bw = _run_layer(self.cell_bw, inputs, states_bw,
+                                   reverse=True,
+                                   sequence_length=sequence_length,
+                                   time_major=self.time_major)
+        return ops.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Shared multilayer/direction machinery of SimpleRNN/LSTM/GRU
+    (reference ``rnn.py RNNBase``)."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError("direction must be forward|bidirect")
+        self.mode = mode
+        self.num_layers = num_layers
+        self.bidirectional = direction != "forward"
+        self.time_major = time_major
+        self.dropout = dropout
+        self.hidden_size = hidden_size
+        num_dir = 2 if self.bidirectional else 1
+
+        def mk(in_sz):
+            kw = dict(weight_ih_attr=weight_ih_attr,
+                      weight_hh_attr=weight_hh_attr,
+                      bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+            if mode == "LSTM":
+                return LSTMCell(in_sz, hidden_size, **kw)
+            if mode == "GRU":
+                return GRUCell(in_sz, hidden_size, **kw)
+            return SimpleRNNCell(in_sz, hidden_size,
+                                 activation=activation, **kw)
+
+        self.cells = []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * num_dir
+            for d in range(num_dir):
+                cell = mk(in_sz)
+                self.add_sublayer(f"cell_{layer}_{d}", cell)
+                self.cells.append(cell)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import ops
+        from .functional import dropout as F_dropout
+        num_dir = 2 if self.bidirectional else 1
+        batch_idx = 1 if self.time_major else 0
+        batch = inputs.shape[batch_idx]
+        lstm = self.mode == "LSTM"
+
+        def init_for(idx):
+            if initial_states is None:
+                return self.cells[idx].get_initial_states(
+                    inputs, batch_dim_idx=batch_idx)
+            if lstm:
+                h, c = initial_states
+                return (h[idx], c[idx])
+            return initial_states[idx]
+
+        x = inputs
+        finals = []
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(num_dir):
+                idx = layer * num_dir + d
+                o, st = _run_layer(self.cells[idx], x, init_for(idx),
+                                   reverse=(d == 1),
+                                   sequence_length=sequence_length,
+                                   time_major=self.time_major)
+                outs.append(o)
+                finals.append(st)
+            x = outs[0] if num_dir == 1 else ops.concat(outs, axis=-1)
+            if self.dropout and layer < self.num_layers - 1 \
+                    and self.training:
+                x = F_dropout(x, p=self.dropout, training=True)
+        if lstm:
+            h = ops.stack([st[0] for st in finals], axis=0)
+            c = ops.stack([st[1] for st in finals], axis=0)
+            return x, (h, c)
+        return x, ops.stack(finals, axis=0)
+
+
+class SimpleRNN(_RNNBase):
+    """Reference ``rnn.py SimpleRNN``."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation,
+                         **kwargs)
+
+
+class LSTM(_RNNBase):
+    """Reference ``rnn.py LSTM``."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    """Reference ``rnn.py GRU``."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
