@@ -456,6 +456,172 @@ class Lamb(Optimizer):
 
 
 class LBFGS(Optimizer):
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "LBFGS is out of scope for the TPU backend for now")
+    """Limited-memory BFGS with two-loop recursion and optional
+    strong-Wolfe line search (reference ``python/paddle/optimizer/lbfgs.py``:
+    LBFGS :120, ``_strong_wolfe`` :247). Full-batch optimizer:
+    ``step(closure)`` re-evaluates the loss/gradient as the line search
+    probes points — closure must zero grads, run backward, return loss."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, parameters=parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None or 'strong_wolfe'")
+        self.max_iter = max_iter
+        self.max_eval = max_eval or max_iter * 5 // 4
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s: list = []
+        self._y: list = []
+        self._prev_flat_grad = None
+
+    # -- flat parameter/grad views (float32 working precision) ---------
+    def _trainable(self):
+        return [p for p in self._parameters
+                if getattr(p, "trainable", True) and not p.stop_gradient]
+
+    def _flat_params(self):
+        return jnp.concatenate(
+            [p._read().astype(jnp.float32).ravel()
+             for p in self._trainable()])
+
+    def _flat_grad(self):
+        gs = []
+        for p in self._trainable():
+            g = p.grad
+            gs.append(jnp.zeros(p._read().size, jnp.float32) if g is None
+                      else g._read().astype(jnp.float32).ravel())
+        return jnp.concatenate(gs)
+
+    def _set_flat_params(self, flat):
+        off = 0
+        for p in self._trainable():
+            v = p._read()
+            n = v.size
+            p._write(flat[off:off + n].reshape(v.shape).astype(v.dtype))
+            off += n
+
+    def _dir_deriv(self, flat_grad, d):
+        return float(jnp.dot(flat_grad, d))
+
+    def _eval(self, closure, x, t, d):
+        self._set_flat_params(x + t * d)
+        loss = float(closure())
+        g = self._flat_grad()
+        return loss, g
+
+    def step(self, closure):
+        import numpy as _np
+        with_ls = self.line_search_fn == "strong_wolfe"
+        lr = float(self.get_lr())
+        loss = float(closure())
+        flat_grad = self._flat_grad()
+        evals = 1
+        if float(jnp.abs(flat_grad).max()) <= self.tol_grad:
+            return loss
+
+        for it in range(self.max_iter):
+            # two-loop recursion
+            q = flat_grad
+            alphas = []
+            for s, y in zip(reversed(self._s), reversed(self._y)):
+                rho = 1.0 / max(float(jnp.dot(y, s)), 1e-10)
+                a = rho * float(jnp.dot(s, q))
+                alphas.append((a, rho, s, y))
+                q = q - a * y
+            if self._y:
+                y_last, s_last = self._y[-1], self._s[-1]
+                gamma = float(jnp.dot(s_last, y_last)) / max(
+                    float(jnp.dot(y_last, y_last)), 1e-10)
+                q = q * gamma
+            for a, rho, s, y in reversed(alphas):
+                b = rho * float(jnp.dot(y, q))
+                q = q + s * (a - b)
+            d = -q
+            gtd = self._dir_deriv(flat_grad, d)
+            if gtd > -self.tol_change:
+                break
+
+            x0 = self._flat_params()
+            t = lr if (self._s or it > 0) else min(
+                1.0, 1.0 / max(float(jnp.abs(flat_grad).sum()), 1e-10)) * lr
+            if with_ls:
+                t, loss_new, grad_new, ls_evals = _strong_wolfe(
+                    lambda tt: self._eval(closure, x0, tt, d), t, d,
+                    loss, flat_grad, gtd)
+                evals += ls_evals
+            else:
+                loss_new, grad_new = self._eval(closure, x0, t, d)
+                evals += 1
+            self._set_flat_params(x0 + t * d)
+
+            s = t * d
+            ygrad = grad_new - flat_grad
+            if float(jnp.dot(s, ygrad)) > 1e-10:
+                self._s.append(s)
+                self._y.append(ygrad)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if (abs(loss_new - loss) < self.tol_change
+                    or float(jnp.abs(grad_new).max()) <= self.tol_grad
+                    or evals >= self.max_eval):
+                loss, flat_grad = loss_new, grad_new
+                break
+            loss, flat_grad = loss_new, grad_new
+        self._prev_flat_grad = flat_grad
+        return loss
+
+    def _update(self, p, w, g, lr):  # pragma: no cover - step() overridden
+        raise RuntimeError("LBFGS.step requires a closure")
+
+
+def _strong_wolfe(evaluate, t, d, f0, g0, gtd0, c1=1e-4, c2=0.9,
+                  max_ls=25):
+    """Strong-Wolfe cubic line search (reference ``lbfgs.py:247``).
+    ``evaluate(t)`` -> (loss, flat_grad) at x0 + t*d."""
+    import jax.numpy as jnp
+
+    def dd(g):
+        return float(jnp.dot(g, d))
+
+    f_prev, g_prev, t_prev = f0, g0, 0.0
+    evals = 0
+    bracket = None
+    for _ in range(max_ls):
+        f_new, g_new = evaluate(t)
+        evals += 1
+        if f_new > f0 + c1 * t * gtd0 or (evals > 1 and f_new >= f_prev):
+            bracket = (t_prev, t, f_prev, f_new, g_prev, g_new)
+            break
+        if abs(dd(g_new)) <= -c2 * gtd0:
+            return t, f_new, g_new, evals
+        if dd(g_new) >= 0:
+            bracket = (t, t_prev, f_new, f_prev, g_new, g_prev)
+            break
+        t_prev, f_prev, g_prev = t, f_new, g_new
+        t = t * 2.0
+    else:
+        return t, f_new, g_new, evals
+
+    lo, hi, f_lo, f_hi, g_lo, g_hi = bracket
+    for _ in range(max_ls):
+        t = 0.5 * (lo + hi)
+        f_new, g_new = evaluate(t)
+        evals += 1
+        if f_new > f0 + c1 * t * gtd0 or f_new >= f_lo:
+            hi, f_hi, g_hi = t, f_new, g_new
+        else:
+            if abs(dd(g_new)) <= -c2 * gtd0:
+                return t, f_new, g_new, evals
+            if dd(g_new) * (hi - lo) >= 0:
+                hi, f_hi, g_hi = lo, f_lo, g_lo
+            lo, f_lo, g_lo = t, f_new, g_new
+        if abs(hi - lo) < 1e-9:
+            break
+    return t, f_new, g_new, evals

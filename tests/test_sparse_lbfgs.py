@@ -1,0 +1,131 @@
+"""paddle.sparse + LBFGS tests (reference patterns:
+``test/legacy_test/test_sparse_*_op.py``, ``test_lbfgs.py``)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+R = np.random.default_rng(13)
+
+
+def _rand_sparse_dense(shape=(4, 6), density=0.3):
+    dense = R.normal(size=shape).astype("float32")
+    dense[R.uniform(size=shape) > density] = 0.0
+    return dense
+
+
+def test_coo_create_and_roundtrip():
+    dense = _rand_sparse_dense()
+    sp = paddle.sparse.to_sparse_coo(paddle.to_tensor(dense))
+    assert sp.is_sparse_coo() and sp.nnz == int((dense != 0).sum())
+    np.testing.assert_allclose(np.asarray(sp.to_dense()._read()), dense)
+    # explicit construction
+    idx = np.array([[0, 1, 2], [1, 2, 0]], "int64")
+    vals = np.array([1.0, 2.0, 3.0], "float32")
+    sp2 = paddle.sparse.sparse_coo_tensor(idx, vals, [3, 3])
+    want = np.zeros((3, 3), "float32")
+    want[idx[0], idx[1]] = vals
+    np.testing.assert_allclose(np.asarray(sp2.to_dense()._read()), want)
+    np.testing.assert_array_equal(np.asarray(sp2.indices()._read()), idx)
+    np.testing.assert_allclose(np.asarray(sp2.values()._read()), vals)
+
+
+def test_csr_create_and_convert():
+    crows = np.array([0, 2, 3, 5], "int64")
+    cols = np.array([1, 3, 2, 0, 1], "int64")
+    vals = np.array([1, 2, 3, 4, 5], "float32")
+    sp = paddle.sparse.sparse_csr_tensor(crows, cols, vals, [3, 4])
+    assert sp.is_sparse_csr()
+    want = np.zeros((3, 4), "float32")
+    want[0, 1], want[0, 3], want[1, 2], want[2, 0], want[2, 1] = 1, 2, 3, 4, 5
+    np.testing.assert_allclose(np.asarray(sp.to_dense()._read()), want)
+    coo = sp.to_sparse_coo()
+    np.testing.assert_allclose(np.asarray(coo.to_dense()._read()), want)
+    back = coo.to_sparse_csr()
+    np.testing.assert_allclose(np.asarray(back.to_dense()._read()), want)
+
+
+def test_sparse_elementwise_and_unary():
+    d = _rand_sparse_dense()
+    a = paddle.sparse.to_sparse_coo(paddle.to_tensor(d))
+    b = paddle.sparse.to_sparse_coo(paddle.to_tensor(d * 2))
+    s = paddle.sparse.add(a, b)
+    np.testing.assert_allclose(np.asarray(s.to_dense()._read()), d * 3,
+                               atol=1e-6)
+    m = paddle.sparse.multiply(a, b)
+    np.testing.assert_allclose(np.asarray(m.to_dense()._read()),
+                               d * d * 2, atol=1e-5)
+    r = paddle.sparse.relu(a)
+    np.testing.assert_allclose(np.asarray(r.to_dense()._read()),
+                               np.maximum(d, 0), atol=1e-6)
+    r2 = paddle.sparse.nn.ReLU()(a)
+    np.testing.assert_allclose(np.asarray(r2.to_dense()._read()),
+                               np.maximum(d, 0), atol=1e-6)
+
+
+def test_sparse_matmul_and_masked_matmul():
+    d = _rand_sparse_dense((5, 4))
+    sp = paddle.sparse.to_sparse_coo(paddle.to_tensor(d))
+    w = R.normal(size=(4, 3)).astype("float32")
+    out = paddle.sparse.matmul(sp, paddle.to_tensor(w))
+    np.testing.assert_allclose(np.asarray(out._read()), d @ w, atol=1e-5)
+
+    mask = paddle.sparse.to_sparse_coo(paddle.to_tensor(
+        _rand_sparse_dense((5, 3), 0.4)))
+    x = R.normal(size=(5, 4)).astype("float32")
+    y = R.normal(size=(4, 3)).astype("float32")
+    got = paddle.sparse.masked_matmul(paddle.to_tensor(x),
+                                      paddle.to_tensor(y), mask)
+    full = x @ y
+    want = np.where(np.asarray(mask.to_dense()._read()) != 0, full, 0)
+    np.testing.assert_allclose(np.asarray(got.to_dense()._read()), want,
+                               atol=1e-5)
+
+
+def test_lbfgs_quadratic_converges():
+    """LBFGS must solve a convex quadratic to high precision in a few
+    steps (far beyond first-order SGD at the same budget)."""
+    paddle.seed(0)
+    A = R.normal(size=(6, 6)).astype("float32")
+    A = (A @ A.T + 6 * np.eye(6)).astype("float32")
+    b = R.normal(size=(6,)).astype("float32")
+    x = paddle.to_tensor(np.zeros(6, "float32"))
+    x.stop_gradient = False
+    opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=30,
+                                 line_search_fn="strong_wolfe",
+                                 parameters=[x])
+
+    At, bt = paddle.to_tensor(A), paddle.to_tensor(b)
+
+    def closure():
+        opt.clear_grad()
+        loss = 0.5 * (x * (At @ x)).sum() - (bt * x).sum()
+        loss.backward()
+        return loss
+
+    opt.step(closure)
+    sol = np.linalg.solve(A, b)
+    np.testing.assert_allclose(np.asarray(x._read()), sol, atol=1e-3)
+
+
+def test_lbfgs_trains_small_net():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    xs = R.normal(size=(32, 4)).astype("float32")
+    ys = (xs[:, :1] * 2 - xs[:, 1:2]).astype("float32")
+    opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=10,
+                                 line_search_fn="strong_wolfe",
+                                 parameters=net.parameters())
+    xt, yt = paddle.to_tensor(xs), paddle.to_tensor(ys)
+
+    def closure():
+        opt.clear_grad()
+        loss = ((net(xt) - yt) ** 2).mean()
+        loss.backward()
+        return loss
+
+    first = float(closure())
+    for _ in range(3):
+        last = opt.step(closure)
+    assert last < first * 0.1, (first, last)
