@@ -43,6 +43,7 @@ from . import quantization  # noqa: F401
 from . import text  # noqa: F401
 from . import audio  # noqa: F401
 from . import sparse  # noqa: F401
+from .core import errors  # noqa: F401
 from . import vision  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model  # noqa: F401

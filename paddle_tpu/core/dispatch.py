@@ -85,6 +85,20 @@ def _check_nan_inf(name, vals):
 _profile_hook = None
 
 
+def _reraise_with_op_context(name, vals, e):
+    """Attach operator context (SURVEY C2 enforce): which op, what
+    operand shapes/dtypes. Framework errors and jit-capture control-flow
+    exceptions pass through untouched."""
+    from . import errors as _errors
+    if isinstance(e, _errors.EnforceNotMet):
+        raise
+    # GraphBreak etc. steer the jit fallback machinery — never wrap
+    if type(e).__name__ == "GraphBreak":
+        raise
+    raise _errors.InvalidArgumentError(
+        _errors.op_error_context(name, vals, e)) from e
+
+
 def apply(name: str, fn: Callable, *args, **kwargs):
     """Run op ``fn`` over (unwrapped) args; record grad node if needed.
 
@@ -122,7 +136,10 @@ def _apply(name: str, fn: Callable, *args, **kwargs):
                 if grad_on and not t.stop_gradient and _is_float(vals[i])]
 
     if not diff_idx:
-        out_vals = fn(*_rebuild(spec, vals), **kwargs)
+        try:
+            out_vals = fn(*_rebuild(spec, vals), **kwargs)
+        except Exception as e:
+            _reraise_with_op_context(name, vals, e)
         return _wrap_outputs(name, out_vals, node=None, any_grad=False)
 
     def pure(*dvals):
@@ -131,7 +148,10 @@ def _apply(name: str, fn: Callable, *args, **kwargs):
             merged[i] = dv
         return fn(*_rebuild(spec, merged), **kwargs)
 
-    out_vals, vjp_fn = jax.vjp(pure, *[vals[i] for i in diff_idx])
+    try:
+        out_vals, vjp_fn = jax.vjp(pure, *[vals[i] for i in diff_idx])
+    except Exception as e:
+        _reraise_with_op_context(name, vals, e)
     out, node_outs = _wrap_outputs(name, out_vals, node=..., any_grad=True)
     node = Node(
         name, vjp_fn,

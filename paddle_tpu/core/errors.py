@@ -1,0 +1,117 @@
+"""Structured errors — the PADDLE_ENFORCE analog (SURVEY C2).
+
+Reference ``paddle/phi/core/enforce.h`` (PADDLE_ENFORCE_* macros) and
+``paddle/phi/core/errors.h`` (typed error codes). Python-first shape: a
+typed exception hierarchy (each also subclassing the builtin exception
+user code would except), ``enforce_*`` check helpers for op/layer
+implementations, and an op-context wrapper used by the dispatch funnel so
+a failing kernel reports WHICH op failed with WHAT operand shapes/dtypes
+— the enforce context stack trace of the reference, minus the C++ frames.
+"""
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+
+class EnforceNotMet(RuntimeError):
+    """Base of all framework errors (reference ``EnforceNotMet``)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet, PermissionError):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class FatalError(EnforceNotMet):
+    pass
+
+
+def enforce(cond: bool, msg: str, exc=InvalidArgumentError):
+    """PADDLE_ENFORCE: raise ``exc`` with ``msg`` unless ``cond``."""
+    if not cond:
+        raise exc(msg)
+
+
+def enforce_eq(a, b, what: str = "value"):
+    if a != b:
+        raise InvalidArgumentError(
+            f"{what} mismatch: expected {b!r}, got {a!r}")
+
+
+def enforce_gt(a, b, what: str = "value"):
+    if not a > b:
+        raise InvalidArgumentError(f"{what} must be > {b!r}, got {a!r}")
+
+
+def enforce_ge(a, b, what: str = "value"):
+    if not a >= b:
+        raise InvalidArgumentError(f"{what} must be >= {b!r}, got {a!r}")
+
+
+def enforce_shape(x, expected: Sequence, what: str = "tensor"):
+    """Check a shape against a pattern; ``None``/-1 dims are wildcards."""
+    shape = tuple(getattr(x, "shape", x))
+    if len(shape) != len(expected) or any(
+            e not in (None, -1) and int(e) != int(s)
+            for s, e in zip(shape, expected)):
+        raise InvalidArgumentError(
+            f"{what}: expected shape {list(expected)}, got {list(shape)}")
+
+
+def enforce_dtype(x, allowed, what: str = "tensor"):
+    d = str(getattr(x, "dtype", x))
+    allowed = [allowed] if isinstance(allowed, str) else list(allowed)
+    if not any(a in d for a in allowed):
+        raise InvalidArgumentError(
+            f"{what}: dtype must be one of {allowed}, got {d}")
+
+
+def _describe(v: Any) -> str:
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is None:
+        return repr(v)[:40]
+    return f"{dtype}[{','.join(str(s) for s in shape)}]"
+
+
+def op_error_context(name: str, vals: Sequence, err: Exception) -> str:
+    """Build the operator-context message the dispatch funnel attaches
+    (the enforce context stack of the reference)."""
+    args = ", ".join(_describe(v) for v in vals)
+    return (f"Error raised by operator '{name}' with operands ({args}).\n"
+            f"  {type(err).__name__}: {err}")

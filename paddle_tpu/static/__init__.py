@@ -57,4 +57,117 @@ class InputSpec:
         return np.zeros(shape, self.dtype)
 
 
-__all__ = ["InputSpec"]
+class Program:
+    """API-parity shim of ``base/framework.py:5768``. On TPU the program
+    IS the jit compile cache (SURVEY §7); a standalone mutable op-list
+    program does not exist. Inference programs loaded via
+    ``load_inference_model`` are runnable through ``Executor.run``."""
+
+    def __init__(self, translated=None, feed_names=None, fetch_names=None):
+        self._translated = translated
+        self._feed_names = feed_names or []
+        self._fetch_names = fetch_names or []
+
+    def clone(self, for_test=False):
+        return Program(self._translated, self._feed_names,
+                       self._fetch_names)
+
+    def global_block(self):
+        raise NotImplementedError(
+            "paddle_tpu has no mutable block IR: build models eagerly and "
+            "compile with paddle.jit.to_static (the static-mode analog); "
+            "export/serve with jit.save / static.save_inference_model")
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+class Executor:
+    """Reference ``executor.py:1162`` surface. Runs inference programs
+    loaded by ``load_inference_model``; ``run`` on the default (empty)
+    program explains the dynamic-first migration path."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True):
+        program = program or _default_main
+        if program._translated is None:
+            raise NotImplementedError(
+                "static graph construction is served by jit.to_static on "
+                "this backend; Executor.run executes programs loaded via "
+                "static.load_inference_model")
+        feed = feed or {}
+        args = [feed[n] for n in program._feed_names]
+        outs = program._translated(*args)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        if return_numpy:
+            outs = [np.asarray(o._read()) for o in outs]
+        return list(outs)
+
+    def close(self):
+        pass
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Reference ``static.data``: in the dynamic-first flow this is an
+    ``InputSpec`` (exactly what jit.to_static/jit.save consume)."""
+    return InputSpec(shape, dtype, name)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Reference ``static/io.py save_inference_model``. Dynamic-first
+    form: ``feed_vars`` = list of InputSpec, ``fetch_vars`` = the Layer or
+    @to_static function to export (the reference's static-Variable form
+    has no analog without a block IR)."""
+    from .. import jit
+    layer = fetch_vars
+    specs = list(feed_vars) if feed_vars else None
+    jit.save(layer, path_prefix, input_spec=specs)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Reference ``static/io.py load_inference_model`` -> (program,
+    feed_names, fetch_names); run via ``Executor.run``."""
+    from .. import jit
+    tl = jit.load(path_prefix)
+    # exported avals = flattened [params..., inputs...]
+    n_in = len(tl._exported.in_avals) - len(tl._names)
+    feed_names = [f"x{i}" for i in range(n_in)]
+    prog = Program(tl, feed_names, ["out"])
+    return prog, feed_names, prog._fetch_names
+
+
+def scope_guard(scope):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+def global_scope():
+    return None
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+__all__ = [
+    "InputSpec", "Program", "Executor", "data", "default_main_program",
+    "default_startup_program", "save_inference_model",
+    "load_inference_model", "scope_guard", "global_scope",
+    "CompiledProgram",
+]

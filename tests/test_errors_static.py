@@ -1,0 +1,61 @@
+"""Error/enforce system + static API tests (reference patterns:
+``test/cpp/phi/core/test_enforce.cc``, ``test_inference_model_io.py``)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import errors
+
+
+def test_enforce_helpers():
+    errors.enforce(True, "fine")
+    with pytest.raises(errors.InvalidArgumentError):
+        errors.enforce(False, "nope")
+    with pytest.raises(ValueError):  # typed errors subclass builtins
+        errors.enforce_eq(3, 4, "rank")
+    errors.enforce_shape(np.zeros((2, 3)), [None, 3])
+    with pytest.raises(errors.InvalidArgumentError):
+        errors.enforce_shape(np.zeros((2, 3)), [2, 4])
+    errors.enforce_dtype(np.zeros((1,), "float32"), ["float32", "bfloat16"])
+    with pytest.raises(errors.InvalidArgumentError):
+        errors.enforce_dtype(np.zeros((1,), "int32"), "float32")
+
+
+def test_op_errors_carry_context():
+    a = paddle.to_tensor(np.zeros((2, 3), "float32"))
+    b = paddle.to_tensor(np.zeros((4, 5), "float32"))
+    with pytest.raises(errors.EnforceNotMet) as ei:
+        paddle.matmul(a, b)
+    msg = str(ei.value)
+    assert "matmul" in msg and "2,3" in msg and "4,5" in msg
+    # still catchable as the builtin class
+    with pytest.raises(ValueError):
+        paddle.matmul(a, b)
+
+
+def test_static_data_and_executor_guidance():
+    spec = paddle.static.data("x", [None, 8], "float32")
+    assert spec.shape == (None, 8)
+    exe = paddle.static.Executor()
+    with pytest.raises(NotImplementedError):
+        exe.run(feed={}, fetch_list=[])
+    prog = paddle.static.default_main_program()
+    assert prog.clone() is not prog
+
+
+def test_save_load_inference_model_roundtrip(tmp_path):
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net.eval()
+    prefix = str(tmp_path / "infer")
+    spec = [paddle.static.InputSpec([None, 4], "float32", "x")]
+    paddle.static.save_inference_model(prefix, spec, net)
+
+    exe = paddle.static.Executor()
+    prog, feed_names, fetch_names = paddle.static.load_inference_model(
+        prefix, exe)
+    x = np.random.default_rng(0).normal(size=(3, 4)).astype("float32")
+    out = exe.run(prog, feed={feed_names[0]: x}, fetch_list=fetch_names)
+    ref = np.asarray(net(paddle.to_tensor(x))._read())
+    np.testing.assert_allclose(out[0], ref, atol=1e-5)
