@@ -1,0 +1,20 @@
+"""``paddle.distributed.launch`` parity — the multi-host launcher.
+
+Capability analog of SURVEY D19-D20 (``python/paddle/distributed/launch/``
+main.py/controllers, fleetrun) and the elastic controller
+(``distributed/fleet/elastic/``). TPU-native topology: ONE controller
+process per host (PJRT owns the local chips), federated by JAX's
+coordination service — ``jax.distributed.initialize(coordinator, n, id)``
+replaces the reference's TCPStore rendezvous + per-GPU worker spawn.
+
+``python -m paddle_tpu.distributed.launch --nnodes N --node_rank I
+--master host:port train.py`` sets the env contract
+(``PADDLE_TRAINERS_NUM``/``PADDLE_TRAINER_ID``/``PADDLE_MASTER``), brings
+the child up, and — the failure-detection half — watches it, restarting
+up to ``--max_restart_times`` on nonzero exit (the elastic manager's
+restart path; scale-out elasticity is a coordinator-service capability,
+not a launcher one, on TPU pods).
+"""
+from .main import launch, main  # noqa: F401
+
+__all__ = ["launch", "main"]

@@ -1,0 +1,119 @@
+"""Launcher implementation (see package docstring)."""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="multi-host launcher (one controller per host)")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", "1")),
+                   help="number of hosts")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--master", default=os.environ.get("PADDLE_MASTER", ""),
+                   help="coordinator host:port (required when nnodes > 1)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="controllers per host (1 on TPU: PJRT owns chips)")
+    p.add_argument("--max_restart_times", type=int, default=0,
+                   help="elastic: restart a failed child up to N times")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--run_mode", default="collective")  # parity: accepted
+    p.add_argument("--devices", default=None)           # parity: accepted
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _child_env(args, local_rank):
+    env = dict(os.environ)
+    world = args.nnodes * args.nproc_per_node
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    env.update({
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_NNODES": str(args.nnodes),
+        "PADDLE_NODE_RANK": str(args.node_rank),
+    })
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+        # the jax coordination-service contract consumed by
+        # init_parallel_env on multi-host pods
+        env.setdefault("JAX_COORDINATOR_ADDRESS", args.master)
+        env.setdefault("JAX_NUM_PROCESSES", str(world))
+        env.setdefault("JAX_PROCESS_ID", str(rank))
+    return env
+
+
+def launch(argv=None):
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    if args.nnodes > 1 and not args.master:
+        raise SystemExit("--master host:port is required for nnodes > 1")
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    procs = []
+    for lr in range(args.nproc_per_node):
+        cmd = [sys.executable, args.script] + args.script_args
+        stdout = None
+        if args.log_dir:
+            stdout = open(os.path.join(
+                args.log_dir, f"worker.{args.node_rank}.{lr}.log"), "ab")
+        procs.append([subprocess.Popen(cmd, env=_child_env(args, lr),
+                                       stdout=stdout, stderr=stdout),
+                      0, stdout, lr])
+
+    def terminate_all():
+        for rec in procs:
+            if rec[0].poll() is None:
+                rec[0].send_signal(signal.SIGTERM)
+
+    exit_code = 0
+    try:
+        while True:
+            alive = False
+            for rec in procs:
+                proc, restarts, stdout, lr = rec
+                code = proc.poll()
+                if code is None:
+                    alive = True
+                elif code != 0:
+                    if restarts < args.max_restart_times:
+                        # elastic restart path (reference fleet/elastic
+                        # manager watchdog)
+                        rec[1] += 1
+                        print(f"[launch] worker {lr} exited {code}; "
+                              f"restart {rec[1]}/{args.max_restart_times}",
+                              file=sys.stderr)
+                        rec[0] = subprocess.Popen(
+                            [sys.executable, args.script]
+                            + args.script_args,
+                            env=_child_env(args, lr), stdout=stdout,
+                            stderr=stdout)
+                        alive = True
+                    else:
+                        exit_code = code
+                        terminate_all()
+                        return exit_code
+            if not alive:
+                return exit_code
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        terminate_all()
+        return 130
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()
