@@ -130,13 +130,28 @@ def _discover_params(function, args, kwargs):
     return params
 
 
+_POLICIES = {
+    None: None,
+    "full": None,  # rematerialize everything (reference behavior)
+    # save MXU matmul outputs, recompute only elementwise ops — trades a
+    # little HBM for skipping the expensive half of the re-forward
+    "dots_saveable": "dots_saveable",
+    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+}
+
+
 def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
-              **kwargs):
+              policy=None, **kwargs):
     """Run ``function(*args)`` with its activations rematerialized in
     backward. ``function`` may be a bound ``Layer`` method (parameters come
     from the owning layer), a ``Layer``, or any callable (parameters are
     discovered by a probe run); they are threaded as explicit
-    differentiable inputs of the checkpointed region."""
+    differentiable inputs of the checkpointed region.
+
+    ``policy`` (TPU extension over the reference signature): a
+    ``jax.checkpoint_policies`` name — "full" (default, the reference's
+    recompute-everything), or "dots_saveable" to keep matmul outputs and
+    recompute only the cheap elementwise ops."""
     params = _discover_params(function, args, kwargs)
     tensor_args = [a for a in args if isinstance(a, Tensor)]
     arg_ids = {id(a) for a in tensor_args}
@@ -157,7 +172,12 @@ def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
         return tuple(sub.writes.get(id(o), o._data)
                      for o in out if isinstance(o, Tensor))
 
-    ckpt = jax.checkpoint(run_block)
+    if policy not in _POLICIES:
+        raise ValueError(f"unknown recompute policy {policy!r}; "
+                         f"one of {sorted(k for k in _POLICIES if k)}")
+    pol_name = _POLICIES[policy]
+    pol = getattr(jax.checkpoint_policies, pol_name) if pol_name else None
+    ckpt = jax.checkpoint(run_block, policy=pol)
     return apply("recompute", lambda *vals: ckpt(*vals), *all_inputs)
 
 

@@ -242,6 +242,7 @@ class _Executable:
         n_args = len(arg_tensors)
         donate = tuple(i for i, t in enumerate(ordered)
                        if i >= n_args and id(t) in written_ids)
+        self._pure = pure  # re-used by jit.multi_step's scanned window
         self.compiled = jax.jit(pure, donate_argnums=donate)
         # force tracing now so failures surface at capture time
         try:
@@ -698,3 +699,6 @@ def load(path, **config):
         raise ValueError(f"{path}.pdmodel is not a pdtpu jit export")
     params = fw.load(path + ".pdiparams")
     return TranslatedLayer(meta, params)
+
+
+from .multi_step import multi_step  # noqa: E402,F401

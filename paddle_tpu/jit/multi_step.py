@@ -1,0 +1,108 @@
+"""Multi-step execution: K train steps as ONE device program.
+
+TPU-native counterpart of the reference's dataloader+executor step loop:
+under a single-controller with a network-attached chip every executable
+launch pays a host round trip (the PJRT-client analog of kernel-launch
+overhead). ``multi_step`` folds a window of K steps of an already-captured
+``jit.to_static`` function into one ``lax.scan``: the per-step state
+(params, optimizer moments, RNG) threads through the scan carry entirely
+on-device, batches are fed as stacked scan inputs, and only the final
+state and the per-step outputs return to the host. Step-time overhead
+drops from O(K) round trips to O(1).
+
+Constraints: every step must hit the SAME compiled specialization (same
+shapes/dtypes/modes), and host-side hooks that normally run between steps
+(LR-scheduler sync) apply once for the window — `.step()` the scheduler
+K times afterwards, as the training loop already does per batch.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def multi_step(static_fn, arg_batches: Sequence[Sequence], donate=True):
+    """Run ``static_fn`` (a ``@jit.to_static`` function) over
+    ``arg_batches`` — a sequence of per-step positional-arg tuples with
+    identical shapes — in one compiled scan. Returns the list of per-step
+    outputs (device-resident until read). State tensors captured by the
+    step (parameters, moments, RNG) hold the post-window values, exactly
+    as if the steps had been dispatched one by one."""
+    if hasattr(static_fn, "_cache"):           # StaticFunction itself
+        wrapped = static_fn
+    else:                                      # bound-method partial
+        wrapped = getattr(static_fn, "__wrapped__", None)
+    if wrapped is None or not hasattr(wrapped, "_cache"):
+        raise TypeError("multi_step expects a jit.to_static function")
+    if not arg_batches:
+        return []
+    first = tuple(arg_batches[0])
+    # ensure the specialization exists (capture/compile on the first batch)
+    out0 = static_fn(*first)
+    key = wrapped._cache_key(first, {})
+    exe = wrapped._cache.get(key)
+    if exe is None:
+        raise RuntimeError(
+            "step did not compile (eager fallback) — multi_step needs the "
+            "compiled path; fix the graph break first")
+    rest = [tuple(b) for b in arg_batches[1:]]
+    if not rest:
+        return [out0]
+
+    n_args = len(first)
+    n_ret = exe.n_ret
+    state_ts = exe.state_out_tensors
+    capt = exe.capt_state
+    pos_in_capt = {id(t): i for i, t in enumerate(capt)}
+    # carry = the written subset of captured state, by capt index
+    carry_idx = [pos_in_capt[id(t)] for t in state_ts]
+    carry_set = set(carry_idx)
+    const_idx = [i for i in range(len(capt)) if i not in carry_set]
+    pure = exe._pure
+
+    cache = getattr(exe, "_multi_step_cache", None)
+    if cache is None:
+        cache = exe._multi_step_cache = {}
+    runner = cache.get((len(rest), donate))
+    if runner is None:
+        def window(carry_vals, const_vals, *stacks):
+            def body(carry, xs):
+                vals = list(xs)
+                state = [None] * len(capt)
+                for i, v in zip(carry_idx, carry):
+                    state[i] = v
+                for i, v in zip(const_idx, const_vals):
+                    state[i] = v
+                outs = pure(*vals, *state)
+                ret = outs[:n_ret]
+                new_state = outs[n_ret:n_ret + len(state_ts)]
+                return list(new_state), tuple(ret)
+
+            carry, rets = jax.lax.scan(body, list(carry_vals), stacks)
+            return carry, rets
+
+        runner = jax.jit(window, donate_argnums=(0,) if donate else ())
+        cache[(len(rest), donate)] = runner
+
+    for sync in exe.discovery.host_syncs:
+        sync()
+    stacks = tuple(
+        jnp.stack([jnp.asarray(b[i]._read() if isinstance(b[i], Tensor)
+                               else b[i]) for b in rest])
+        for i in range(n_args))
+    carry_vals = [capt[i]._read() for i in carry_idx]
+    const_vals = [capt[i]._read() for i in const_idx]
+    final_carry, rets = runner(carry_vals, const_vals, *stacks)
+    # write the post-window state back onto the captured tensors
+    for i, v in zip(carry_idx, final_carry):
+        capt[i]._data = v
+        capt[i]._node = None
+    outs = [out0]
+    for s in range(len(rest)):
+        step_ret = [Tensor(r[s]) for r in rets]
+        outs.append(exe.ret_rebuild(step_ret))
+    return outs

@@ -38,6 +38,7 @@ class GPTConfig:
     tie_embeddings: bool = True
     use_flash_attention: bool = True
     recompute: bool = False  # activation recompute per block (jax.checkpoint)
+    recompute_policy: str = "full"  # or "dots_saveable" (keep matmul outs)
     # MoE (0 = dense FFN). Experts shard over the ep axis via shard_gpt.
     num_experts: int = 0
     moe_top_k: int = 2
@@ -124,6 +125,9 @@ class GPTBlock(Layer):
             self.mlp = GPTMLP(cfg)
         self.drop = Dropout(cfg.dropout)
         self._recompute = cfg.recompute
+        self._recompute_policy = (cfg.recompute_policy
+                                  if cfg.recompute_policy != "full"
+                                  else None)
 
     def _inner(self, x):
         x = x + self.drop(self.attn(self.ln1(x)))
@@ -133,7 +137,7 @@ class GPTBlock(Layer):
     def forward(self, x):
         if self._recompute and self.training:
             from ..distributed.fleet.recompute import recompute
-            return recompute(self._inner, x)
+            return recompute(self._inner, x, policy=self._recompute_policy)
         return self._inner(x)
 
 

@@ -205,3 +205,43 @@ def test_no_silent_retrace_per_step():
     exe = list(step._cache.values())[0]
     # 1 capture trace (+1 tolerated sharding-stabilization retrace)
     assert exe.trace_count <= 2, f"retraced {exe.trace_count} times"
+
+
+def test_multi_step_matches_sequential():
+    """jit.multi_step: K steps in one scanned program == K dispatches."""
+    import paddle_tpu.nn as nn
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    lossf = nn.CrossEntropyLoss()
+    rng = np.random.default_rng(0)
+    batches = [(pt.to_tensor(rng.normal(size=(4, 8)).astype("float32")),
+                pt.to_tensor(rng.integers(0, 2, (4,)).astype("int64")))
+               for _ in range(5)]
+    sd = {k: np.asarray(v._read()).copy()
+          for k, v in net.state_dict().items()}
+
+    def make_step():
+        optim = opt.Adam(learning_rate=1e-2,
+                         parameters=net.parameters())
+
+        @pt.jit.to_static
+        def step(x, y):
+            loss = lossf(net(x), y)
+            loss.backward()
+            optim.step()
+            optim.clear_grad()
+            return loss
+        return step
+
+    step = make_step()
+    ref = [float(step(*b)) for b in batches]
+    ref_params = {k: np.asarray(v._read()).copy()
+                  for k, v in net.state_dict().items()}
+
+    for k, v in net.state_dict().items():
+        v._write(sd[k])
+    outs = pt.jit.multi_step(make_step(), batches)
+    np.testing.assert_allclose([float(o) for o in outs], ref, rtol=1e-5)
+    for k, v in net.state_dict().items():
+        np.testing.assert_allclose(np.asarray(v._read()), ref_params[k],
+                                   atol=1e-6)
