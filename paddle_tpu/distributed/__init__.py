@@ -27,6 +27,8 @@ from .auto_parallel.api import shard_parameter, to_static  # noqa: F401
 
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import launch  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 
 
 def get_world_process_group():
