@@ -44,6 +44,10 @@ from . import text  # noqa: F401
 from . import audio  # noqa: F401
 from . import sparse  # noqa: F401
 from .core import errors  # noqa: F401
+from . import inference  # noqa: F401
+from . import utils  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import version  # noqa: F401
 from . import vision  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model  # noqa: F401
@@ -90,6 +94,37 @@ def is_compiled_with_xpu():
 def is_compiled_with_tpu():
     import jax
     return jax.devices()[0].platform in ("tpu", "axon")
+
+
+def iinfo(dtype):
+    """Reference ``paddle.iinfo``."""
+    from .core.dtype import convert_dtype
+    return _np.iinfo(_np.dtype(convert_dtype(dtype)))
+
+
+def finfo(dtype):
+    """Reference ``paddle.finfo`` (works for bfloat16 via ml_dtypes)."""
+    import ml_dtypes
+    from .core.dtype import convert_dtype
+    d = convert_dtype(dtype)
+    try:
+        return _np.finfo(_np.dtype(d))
+    except Exception:
+        return ml_dtypes.finfo(d)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Reference ``paddle.batch`` (legacy reader combinator)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
 
 
 def summary(layer, input_size=None, dtypes=None):

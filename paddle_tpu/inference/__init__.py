@@ -1,0 +1,111 @@
+"""``paddle.inference`` parity — the deployment Predictor API (SURVEY C28).
+
+Analog of ``python/paddle/inference/wrapper.py`` (Config, create_predictor,
+Predictor/Tensor handles; native engine ``paddle/fluid/inference/api/``).
+TPU-native: a Predictor wraps a ``jit.save``d StableHLO program
+(TranslatedLayer) — XLA is the inference engine; Config's GPU/TensorRT
+toggles are accepted and ignored (XLA owns those decisions), memory/zero-
+copy handles are the program's device buffers.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Config:
+    """Reference ``paddle.inference.Config(prog_file, params_file)`` or
+    ``Config(model_dir)``; we key off the jit.save path prefix."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file is None:
+            raise ValueError("Config requires the jit.save path prefix")
+        # accept either the prefix or the .pdmodel path
+        self.path_prefix = str(prog_file).removesuffix(".pdmodel")
+        self._switches = {}
+
+    # accepted-for-parity toggles (XLA owns device placement/fusion)
+    def enable_use_gpu(self, *a, **k):
+        self._switches["gpu"] = True
+
+    def disable_gpu(self):
+        self._switches["gpu"] = False
+
+    def enable_memory_optim(self, *a, **k):
+        self._switches["memory_optim"] = True
+
+    def switch_ir_optim(self, flag=True):
+        self._switches["ir_optim"] = flag
+
+    def enable_tensorrt_engine(self, *a, **k):
+        self._switches["trt"] = True
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._switches["cpu_threads"] = n
+
+
+class _IOTensor:
+    """Reference inference Tensor handle (copy_from_cpu/copy_to_cpu)."""
+
+    def __init__(self):
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(np.asarray(self._value).shape)
+
+    def reshape(self, shape):
+        self._value = np.asarray(self._value).reshape(shape)
+
+
+class Predictor:
+    """Reference ``paddle.inference.Predictor`` surface over a loaded
+    StableHLO program."""
+
+    def __init__(self, config: Config):
+        from .. import jit
+        self._layer = jit.load(config.path_prefix)
+        n_in = len(self._layer._exported.in_avals) - len(self._layer._names)
+        self._input_names = [f"x{i}" for i in range(n_in)]
+        self._inputs = {n: _IOTensor() for n in self._input_names}
+        self._outputs = []
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def run(self, inputs=None):
+        if inputs is not None:  # list-of-arrays convenience form
+            for n, v in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(v)
+        args = [self._inputs[n].copy_to_cpu() for n in self._input_names]
+        out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = []
+        for o in outs:
+            h = _IOTensor()
+            h.copy_from_cpu(np.asarray(o._read() if isinstance(o, Tensor)
+                                       else o))
+            self._outputs.append(h)
+        return [h.copy_to_cpu() for h in self._outputs]
+
+    def get_output_names(self):
+        return [f"out{i}" for i in range(len(self._outputs))]
+
+    def get_output_handle(self, name):
+        return self._outputs[int(name.removeprefix("out"))]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+__all__ = ["Config", "Predictor", "create_predictor"]

@@ -1,0 +1,6 @@
+"""``paddle.regularizer`` namespace (reference
+``python/paddle/regularizer.py``) — re-exports the decay classes the
+optimizers consume."""
+from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

@@ -1,0 +1,86 @@
+"""``paddle.utils`` parity: dlpack interchange, deprecated decorator,
+try_import, unique_name (reference ``python/paddle/utils/``)."""
+from __future__ import annotations
+
+import functools
+import importlib
+import itertools
+import warnings
+
+from ..core.tensor import Tensor
+from . import dlpack  # noqa: F401
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Reference ``utils/deprecated.py`` decorator."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API '{fn.__qualname__}' is deprecated since {since}"
+            if update_to:
+                msg += f", use '{update_to}' instead"
+            if reason:
+                msg += f" ({reason})"
+            if level < 2:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            else:
+                raise RuntimeError(msg)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def try_import(module_name, err_msg=None):
+    """Reference ``utils/lazy_import.py``."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"required optional package {module_name!r} is not "
+            f"installed") from None
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self._counters = {}
+
+    def __call__(self, key):
+        c = self._counters.setdefault(key, itertools.count())
+        return f"{key}_{next(c)}"
+
+
+generate = _UniqueNameGenerator()
+
+
+class unique_name:
+    """Reference ``base/unique_name.py`` surface."""
+    generate = staticmethod(generate)
+
+    @staticmethod
+    def guard(prefix=None):
+        import contextlib
+        return contextlib.nullcontext()
+
+
+def run_check():
+    """Reference ``utils/install_check.py run_check``: a tiny train step
+    on the current backend."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    loss = (net(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    import jax
+    print(f"paddle_tpu is installed successfully! "
+          f"(backend: {jax.default_backend()}, "
+          f"devices: {len(jax.devices())})")
+
+
+__all__ = ["deprecated", "try_import", "unique_name", "generate",
+           "run_check", "dlpack"]

@@ -1,0 +1,29 @@
+"""``paddle.version`` parity (reference ``python/paddle/version/``)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+commit = "tpu-native"
+cuda_version = "False"
+cudnn_version = "False"
+istaged = False
+with_pip_cuda_libraries = "OFF"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("backend: XLA/TPU")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def xpu():
+    return "False"

@@ -1,0 +1,193 @@
+"""``paddle.vision.ops`` — detection ops.
+
+Analog of ``python/paddle/vision/ops.py`` (nms :1586, roi_align :1081,
+roi_pool, box_coder; CUDA kernels ``paddle/phi/kernels/gpu/nms_kernel.cu``,
+``roi_align_kernel.cu``). TPU split: roi_align/roi_pool/box_coder are
+dense gather/interpolate math (jit-fusible, differentiable); nms is a
+host-side op (data-dependent output size — the reference's GPU kernel
+also serializes on a bitmask reduction), run where detection
+postprocessing lives.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive, unwrap
+from ..core.tensor import Tensor
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Reference ``vision/ops.py nms``: returns kept box indices. With
+    ``scores`` sorts descending first; with categories runs per-class."""
+    b = np.asarray(unwrap(boxes))
+    n = len(b)
+    if scores is not None:
+        order = np.argsort(-np.asarray(unwrap(scores)))
+    else:
+        order = np.arange(n)
+
+    def iou(a, rest):
+        x1 = np.maximum(a[0], rest[:, 0])
+        y1 = np.maximum(a[1], rest[:, 1])
+        x2 = np.minimum(a[2], rest[:, 2])
+        y2 = np.minimum(a[3], rest[:, 3])
+        inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+        area_a = (a[2] - a[0]) * (a[3] - a[1])
+        area_r = (rest[:, 2] - rest[:, 0]) * (rest[:, 3] - rest[:, 1])
+        return inter / np.maximum(area_a + area_r - inter, 1e-10)
+
+    if category_idxs is not None:
+        cats = np.asarray(unwrap(category_idxs))
+        keep_all = []
+        for c in (categories if categories is not None
+                  else np.unique(cats)):
+            sel = order[cats[order] == c]
+            keep_all.extend(_nms_greedy(b, sel, iou, iou_threshold))
+        keep = np.asarray(sorted(
+            keep_all,
+            key=lambda i: -np.asarray(unwrap(scores))[i]
+            if scores is not None else i), np.int64)
+    else:
+        keep = np.asarray(_nms_greedy(b, order, iou, iou_threshold),
+                          np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def _nms_greedy(boxes, order, iou, thr):
+    keep = []
+    order = list(order)
+    while order:
+        i = order.pop(0)
+        keep.append(i)
+        if not order:
+            break
+        rest = np.asarray(order)
+        ious = iou(boxes[i], boxes[rest])
+        order = [j for j, v in zip(order, ious) if v <= thr]
+    return keep
+
+
+@primitive("roi_align")
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """x [N,C,H,W]; boxes [R,4] (x1,y1,x2,y2); boxes_num [N] rois per
+    image. Bilinear average pooling per output bin (reference
+    ``roi_align_kernel``)."""
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    n, c, h, w = x.shape
+    r = boxes.shape[0]
+    # map each roi to its image
+    counts = boxes_num.astype(jnp.int32)
+    img_idx = jnp.repeat(jnp.arange(n), counts, total_repeat_length=r)
+
+    off = 0.5 if aligned else 0.0
+    bx = boxes * spatial_scale
+    x1, y1, x2, y2 = bx[:, 0] - off, bx[:, 1] - off, bx[:, 2] - off, \
+        bx[:, 3] - off
+    rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+    rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+    bin_w = rw / ow
+    bin_h = rh / oh
+    s = sampling_ratio if sampling_ratio > 0 else 2
+    # sample points per bin: [oh*s] x [ow*s] grid per roi
+    gy = (jnp.arange(oh * s) + 0.5) / s  # in bin units
+    gx = (jnp.arange(ow * s) + 0.5) / s
+    ys = y1[:, None] + gy[None, :] * bin_h[:, None]  # [R, oh*s]
+    xs = x1[:, None] + gx[None, :] * bin_w[:, None]  # [R, ow*s]
+
+    def bilinear(img, yy, xx):
+        # img [C,H,W]; yy [P], xx [Q] -> [C,P,Q]
+        y0 = jnp.clip(jnp.floor(yy), 0, h - 1).astype(jnp.int32)
+        x0 = jnp.clip(jnp.floor(xx), 0, w - 1).astype(jnp.int32)
+        y1_ = jnp.clip(y0 + 1, 0, h - 1)
+        x1_ = jnp.clip(x0 + 1, 0, w - 1)
+        wy = jnp.clip(yy - y0, 0, 1)[None, :, None]
+        wx = jnp.clip(xx - x0, 0, 1)[None, None, :]
+        g = lambda yi, xi: img[:, yi][:, :, xi]
+        return (g(y0, x0) * (1 - wy) * (1 - wx) + g(y1_, x0) * wy * (1 - wx)
+                + g(y0, x1_) * (1 - wy) * wx + g(y1_, x1_) * wy * wx)
+
+    def per_roi(i):
+        img = x[img_idx[i]]
+        samp = bilinear(img, ys[i], xs[i])          # [C, oh*s, ow*s]
+        samp = samp.reshape(c, oh, s, ow, s)
+        return samp.mean(axis=(2, 4))               # [C, oh, ow]
+
+    return jax.vmap(per_roi)(jnp.arange(r))
+
+
+@primitive("roi_pool")
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Max pooling per bin (reference roi_pool) via dense sampling max."""
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    n, c, h, w = x.shape
+    r = boxes.shape[0]
+    counts = boxes_num.astype(jnp.int32)
+    img_idx = jnp.repeat(jnp.arange(n), counts, total_repeat_length=r)
+    bx = jnp.round(boxes * spatial_scale).astype(jnp.int32)
+    s = 4  # samples per bin side
+
+    def per_roi(i):
+        x1, y1, x2, y2 = bx[i, 0], bx[i, 1], bx[i, 2], bx[i, 3]
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        gy = y1 + (jnp.arange(oh * s) + 0.5) / (oh * s) * rh
+        gx = x1 + (jnp.arange(ow * s) + 0.5) / (ow * s) * rw
+        yi = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
+        img = x[img_idx[i]]
+        samp = img[:, yi][:, :, xi].reshape(c, oh, s, ow, s)
+        return samp.max(axis=(2, 4))
+
+    return jax.vmap(per_roi)(jnp.arange(r))
+
+
+@primitive("box_coder")
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0):
+    """Reference ``vision/ops box_coder`` (SSD-style box transforms)."""
+    pb = prior_box
+    pw = pb[:, 2] - pb[:, 0] + (0.0 if box_normalized else 1.0)
+    ph = pb[:, 3] - pb[:, 1] + (0.0 if box_normalized else 1.0)
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+    var = prior_box_var if prior_box_var is not None else \
+        jnp.ones_like(pb)
+    if code_type == "encode_center_size":
+        tb = target_box
+        tw = tb[:, 2] - tb[:, 0] + (0.0 if box_normalized else 1.0)
+        th = tb[:, 3] - tb[:, 1] + (0.0 if box_normalized else 1.0)
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None]) / pw[None] / var[None, :, 0],
+            (tcy[:, None] - pcy[None]) / ph[None] / var[None, :, 1],
+            jnp.log(tw[:, None] / pw[None]) / var[None, :, 2],
+            jnp.log(th[:, None] / ph[None]) / var[None, :, 3],
+        ], axis=-1)
+        return out
+    # decode_center_size: target [R, P, 4] deltas -> boxes
+    tb = target_box
+    dcx = tb[..., 0] * var[None, :, 0] * pw[None] + pcx[None]
+    dcy = tb[..., 1] * var[None, :, 1] * ph[None] + pcy[None]
+    dw = jnp.exp(tb[..., 2] * var[None, :, 2]) * pw[None]
+    dh = jnp.exp(tb[..., 3] * var[None, :, 3]) * ph[None]
+    return jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                      dcx + dw * 0.5 - (0.0 if box_normalized else 1.0),
+                      dcy + dh * 0.5 - (0.0 if box_normalized else 1.0)],
+                     axis=-1)
+
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder"]

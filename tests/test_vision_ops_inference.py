@@ -1,0 +1,188 @@
+"""vision.ops / inference / utils namespace tests (reference patterns:
+``test_nms_op.py``, ``test_roi_align_op.py``, ``test_inference_api.py``)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.vision import ops as vops
+
+R = np.random.default_rng(17)
+
+
+def _rand_boxes(n, size=64):
+    xy = R.uniform(0, size - 8, (n, 2)).astype("float32")
+    wh = R.uniform(4, 16, (n, 2)).astype("float32")
+    return np.concatenate([xy, xy + wh], -1)
+
+
+def _iou_matrix(a, b):
+    x1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+    area = lambda v: (v[:, 2] - v[:, 0]) * (v[:, 3] - v[:, 1])
+    return inter / (area(a)[:, None] + area(b)[None, :] - inter + 1e-10)
+
+
+def _nms_ref(boxes, scores, thr):
+    order = list(np.argsort(-scores))
+    keep = []
+    while order:
+        i = order.pop(0)
+        keep.append(i)
+        ious = _iou_matrix(boxes[i:i + 1], boxes[order])[0]
+        order = [j for j, v in zip(order, ious) if v <= thr]
+    return np.asarray(keep, np.int64)
+
+
+def test_nms_matches_bruteforce():
+    boxes = _rand_boxes(40)
+    scores = R.uniform(size=(40,)).astype("float32")
+    keep = np.asarray(vops.nms(paddle.to_tensor(boxes), 0.5,
+                               scores=paddle.to_tensor(scores))._read())
+    np.testing.assert_array_equal(keep, _nms_ref(boxes, scores, 0.5))
+    # kept boxes are mutually below the IoU threshold
+    kb = boxes[keep]
+    m = _iou_matrix(kb, kb)
+    np.fill_diagonal(m, 0)
+    assert m.max() <= 0.5 + 1e-6
+
+
+def test_nms_topk_and_categories():
+    boxes = _rand_boxes(30)
+    scores = R.uniform(size=(30,)).astype("float32")
+    cats = R.integers(0, 3, 30)
+    keep = np.asarray(vops.nms(paddle.to_tensor(boxes), 0.5,
+                               scores=paddle.to_tensor(scores),
+                               category_idxs=paddle.to_tensor(cats),
+                               categories=[0, 1, 2], top_k=5)._read())
+    assert len(keep) <= 5
+    # per-class greedy reference, merged by score
+    ref = []
+    for c in (0, 1, 2):
+        idx = np.where(cats == c)[0]
+        ref.extend(idx[_nms_ref(boxes[idx], scores[idx], 0.5)])
+    ref = sorted(ref, key=lambda i: -scores[i])[:len(keep)]
+    np.testing.assert_array_equal(keep, ref)
+
+
+def _roi_align_ref(x, boxes, img_idx, out, scale, s):
+    n, c, h, w = x.shape
+    res = np.zeros((len(boxes), c, out, out), "float32")
+
+    def bilinear(img, y, xq):
+        y0, x0 = int(np.floor(y)), int(np.floor(xq))
+        y0c, x0c = np.clip(y0, 0, h - 1), np.clip(x0, 0, w - 1)
+        y1c, x1c = np.clip(y0 + 1, 0, h - 1), np.clip(x0 + 1, 0, w - 1)
+        wy, wx = np.clip(y - y0, 0, 1), np.clip(xq - x0, 0, 1)
+        return (img[:, y0c, x0c] * (1 - wy) * (1 - wx)
+                + img[:, y1c, x0c] * wy * (1 - wx)
+                + img[:, y0c, x1c] * (1 - wy) * wx
+                + img[:, y1c, x1c] * wy * wx)
+
+    for r, b in enumerate(boxes):
+        img = x[img_idx[r]]
+        x1, y1, x2, y2 = b * scale - 0.5
+        bw, bh = max(x2 - x1, 1e-3), max(y2 - y1, 1e-3)
+        for oy in range(out):
+            for ox in range(out):
+                acc = 0.0
+                for sy in range(s):
+                    for sx in range(s):
+                        yy = y1 + (oy + (sy + 0.5) / s) * bh / out
+                        xx = x1 + (ox + (sx + 0.5) / s) * bw / out
+                        acc += bilinear(img, yy, xx)
+                res[r, :, oy, ox] = acc / (s * s)
+    return res
+
+
+def test_roi_align_matches_bruteforce():
+    x = R.normal(size=(2, 3, 16, 16)).astype("float32")
+    boxes = _rand_boxes(5, 14).astype("float32")
+    boxes_num = np.array([3, 2], "int32")
+    out = vops.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(boxes_num), output_size=4,
+                         spatial_scale=1.0, sampling_ratio=2,
+                         aligned=True)
+    img_idx = np.repeat(np.arange(2), boxes_num)
+    ref = _roi_align_ref(x, boxes, img_idx, 4, 1.0, 2)
+    np.testing.assert_allclose(np.asarray(out._read()), ref, atol=1e-4)
+
+
+def test_roi_pool_shape():
+    x = R.normal(size=(1, 2, 16, 16)).astype("float32")
+    boxes = _rand_boxes(3, 14)
+    out = vops.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                        paddle.to_tensor(np.array([3], "int32")), 4)
+    assert tuple(out.shape) == (3, 2, 4, 4)
+    assert np.isfinite(np.asarray(out._read())).all()
+
+
+def test_box_coder_roundtrip():
+    priors = _rand_boxes(6)
+    targets = _rand_boxes(4)
+    var = np.ones((6, 4), "float32")
+    enc = vops.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                         paddle.to_tensor(targets),
+                         code_type="encode_center_size")
+    dec = vops.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                         enc, code_type="decode_center_size")
+    got = np.asarray(dec._read())  # [T, P, 4]
+    for t in range(4):
+        for p in range(6):
+            np.testing.assert_allclose(got[t, p], targets[t], atol=1e-3)
+
+
+def test_inference_predictor_roundtrip(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(6, 12), nn.ReLU(), nn.Linear(12, 3))
+    net.eval()
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix,
+                    input_spec=[paddle.static.InputSpec([None, 6],
+                                                        "float32")])
+    cfg = paddle.inference.Config(prefix)
+    cfg.enable_memory_optim()
+    pred = paddle.inference.create_predictor(cfg)
+    names = pred.get_input_names()
+    x = R.normal(size=(4, 6)).astype("float32")
+    pred.get_input_handle(names[0]).copy_from_cpu(x)
+    outs = pred.run()
+    ref = np.asarray(net(paddle.to_tensor(x))._read())
+    np.testing.assert_allclose(outs[0], ref, atol=1e-5)
+    h = pred.get_output_handle(pred.get_output_names()[0])
+    np.testing.assert_allclose(h.copy_to_cpu(), ref, atol=1e-5)
+
+
+def test_utils_and_misc():
+    import warnings
+
+    from paddle_tpu.utils import deprecated, unique_name
+    from paddle_tpu.utils.dlpack import from_dlpack, to_dlpack
+
+    @deprecated(update_to="paddle.new_api", since="2.0")
+    def old():
+        return 42
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old() == 42
+        assert any("deprecated" in str(x.message) for x in w)
+
+    n1, n2 = unique_name.generate("fc"), unique_name.generate("fc")
+    assert n1 != n2
+
+    t = paddle.to_tensor(np.arange(6, dtype="float32"))
+    back = from_dlpack(to_dlpack(t))
+    np.testing.assert_allclose(np.asarray(back._read()),
+                               np.arange(6, dtype="float32"))
+
+    assert paddle.iinfo("int32").max == 2**31 - 1
+    assert paddle.finfo("float32").eps > 0
+    assert paddle.finfo("bfloat16").bits == 16
+
+    r = paddle.batch(lambda: iter(range(5)), batch_size=2)
+    assert list(r()) == [[0, 1], [2, 3], [4]]
+    assert paddle.version.full_version
