@@ -65,12 +65,31 @@ class ParallelEnv:
         return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
 
 
+_dist_initialized = False
+
+
 def init_parallel_env():
     """Reference ``parallel.py:943``: bring up the default process group.
 
-    Multi-host TPU pods: call ``jax.distributed.initialize`` first (the
-    launcher does) — the coordination service replaces TCPStore rendezvous.
+    Multi-host: when the launcher's env contract is present
+    (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``,
+    set by ``paddle_tpu.distributed.launch --master ...``), federate the
+    per-host controllers via ``jax.distributed.initialize`` — the
+    coordination service replaces TCPStore rendezvous; afterwards
+    ``jax.devices()`` spans the whole pod and every collective/GSPMD path
+    is pod-wide automatically.
     """
+    global _dist_initialized
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if addr and nproc > 1 and not _dist_initialized:
+        already = getattr(jax._src.distributed.global_state, "client",
+                          None) is not None
+        if not already:
+            jax.distributed.initialize(
+                coordinator_address=addr, num_processes=nproc,
+                process_id=int(os.environ.get("JAX_PROCESS_ID", "0")))
+        _dist_initialized = True
     return _coll._ensure_world()
 
 
