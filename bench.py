@@ -66,6 +66,19 @@ def main():
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters())
 
+    if on_tpu:
+        # tune the flash-attention block sizes for this model's shapes
+        # (measured once per device+shape, persisted; the captured train
+        # step then picks the winner from the cache at trace time)
+        import jax.numpy as jnp
+
+        from paddle_tpu.incubate import autotune
+        from paddle_tpu.ops.pallas import flash_attention as fa
+        autotune.set_config({"kernel": {"enable": True}})
+        probe = jnp.zeros((batch, seq, cfg.num_heads, cfg.head_dim),
+                          jnp.bfloat16)
+        fa.flash_attention(probe, probe, probe, causal=True)
+
     @paddle.jit.to_static
     def train_step(ids, labels):
         with amp.auto_cast(level="O1", dtype="bfloat16"):

@@ -1,5 +1,6 @@
 """``paddle.incubate`` parity namespace (reference ``python/paddle/incubate``)."""
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
+from . import autotune  # noqa: F401
 
-__all__ = ["nn", "distributed"]
+__all__ = ["nn", "distributed", "autotune"]

@@ -1,0 +1,6 @@
+"""``paddle.incubate.autotune`` parity (reference
+``python/paddle/incubate/autotune.py:25`` set_config) — fronting the
+Pallas kernel autotuner (``ops/pallas/autotune.py``, SURVEY C14)."""
+from ..ops.pallas.autotune import enabled, set_config  # noqa: F401
+
+__all__ = ["set_config", "enabled"]

@@ -1,0 +1,109 @@
+"""Kernel autotuning (SURVEY C14 — reference
+``python/paddle/incubate/autotune.py`` set_config + the cached kernel
+autotune of ``paddle/phi/kernels/autotune/switch_autotune.h``,
+``cache.h``).
+
+TPU shape: Pallas kernels have block-size free parameters; the autotuner
+times each candidate configuration on the real shapes the model runs
+(two calls per candidate — the first compiles, the second measures a
+host-synced median of repeats) and persists the winner per
+(device kind, op, shape signature) in a JSON cache so later processes
+skip the sweep. Disabled by default (the reference's autotune is also
+opt-in); enable with ``paddle_tpu.incubate.autotune.set_config(
+{"kernel": {"enable": True}})`` or ``PDTPU_AUTOTUNE=1``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Optional, Sequence
+
+_config = {"kernel": {"enable": os.environ.get("PDTPU_AUTOTUNE") == "1",
+                      "tuning_range": [1, 10]}}
+_cache: Optional[dict] = None
+_CACHE_PATH = os.path.join(
+    os.environ.get("PDTPU_CACHE_DIR",
+                   os.path.expanduser("~/.cache/paddle_tpu")),
+    "autotune.json")
+
+
+def set_config(config=None):
+    """Reference ``incubate/autotune.py set_config`` (kernel section)."""
+    if config is None:
+        _config["kernel"]["enable"] = True
+        return
+    if isinstance(config, str):  # file form
+        with open(config) as f:
+            config = json.load(f)
+    if "kernel" in config:
+        _config["kernel"].update(config["kernel"])
+
+
+def enabled() -> bool:
+    return bool(_config["kernel"]["enable"])
+
+
+def _load_cache() -> dict:
+    global _cache
+    if _cache is None:
+        try:
+            with open(_CACHE_PATH) as f:
+                _cache = json.load(f)
+        except Exception:
+            _cache = {}
+    return _cache
+
+
+def _store_cache():
+    try:
+        os.makedirs(os.path.dirname(_CACHE_PATH), exist_ok=True)
+        with open(_CACHE_PATH, "w") as f:
+            json.dump(_cache, f)
+    except Exception:
+        pass  # cache is an optimization, never an error
+
+
+def _device_kind() -> str:
+    import jax
+    d = jax.devices()[0]
+    return str(getattr(d, "device_kind", d.platform))
+
+
+def autotune(op: str, signature: str, candidates: Sequence,
+             run: Callable, repeats: int = 3):
+    """Pick the fastest candidate for ``run(candidate)`` and cache it.
+
+    ``run`` must execute the kernel to completion (host-synced) — it is
+    called once per candidate for warmup/compile and ``repeats`` times
+    for timing. Failing candidates (e.g. VMEM overflow) are skipped.
+    Returns the winning candidate (cached on later calls)."""
+    key = f"{_device_kind()}|{op}|{signature}"
+    cache = _load_cache()
+    if key in cache:
+        idx = cache[key]
+        if 0 <= idx < len(candidates):
+            return candidates[idx]
+    best, best_t = None, float("inf")
+    for i, cand in enumerate(candidates):
+        try:
+            run(cand)  # compile + warm
+            ts = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                run(cand)
+                ts.append(time.perf_counter() - t0)
+            t = sorted(ts)[len(ts) // 2]
+        except Exception:
+            continue
+        if t < best_t:
+            best, best_t, best_i = cand, t, i
+    if best is None:
+        raise RuntimeError(f"autotune: every candidate failed for {op} "
+                           f"{signature}")
+    cache[key] = best_i
+    _store_cache()
+    return best
+
+
+__all__ = ["set_config", "enabled", "autotune"]

@@ -104,12 +104,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[0, 0] = jnp.broadcast_to(m_f + jnp.log(l_safe), (bq, _LANE))
 
 
-def _fwd(q, k, v, scale, causal, interpret):
+def _fwd(q, k, v, scale, causal, interpret, blocks=None):
     """q [B,Hq,Sq,D]; k,v [B,Hk,Sk,D] -> (o [B,Hq,Sq,D], lse [B,Hq,Sq])."""
     b, hq, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
     rep = hq // hk
-    bq, bk = _block_sizes(sq, sk)
+    bq, bk = blocks if blocks is not None else _block_sizes(sq, sk)
+    bq, bk = min(bq, sq), min(bk, sk)
     qp = _pad_to(q, 2, bq)
     kp = _pad_to(k, 2, bk)
     vp = _pad_to(v, 2, bk)
@@ -238,13 +239,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd(scale, causal, interpret, res, g):
+def _bwd(scale, causal, interpret, blocks, res, g):
     q, k, v, o, lse = res
     do = g
     b, hq, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
     rep = hq // hk
-    bq, bk = _block_sizes(sq, sk)
+    bq, bk = blocks if blocks is not None else _block_sizes(sq, sk)
+    bq, bk = min(bq, sq), min(bk, sk)
 
     # delta_i = rowsum(dO * O): the FA2 precompute — one fused XLA reduce
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -313,25 +315,71 @@ def _bwd(scale, causal, interpret, res, g):
 # --------------------------------------------------------------------------
 # public API
 # --------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_bhsd(q, k, v, scale, causal, interpret):
-    o, _ = _fwd(q, k, v, scale, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, scale, causal, interpret, blocks=None):
+    o, _ = _fwd(q, k, v, scale, causal, interpret, blocks)
     return o
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, interpret):
-    o, lse = _fwd(q, k, v, scale, causal, interpret)
+def _flash_fwd_rule(q, k, v, scale, causal, interpret, blocks=None):
+    o, lse = _fwd(q, k, v, scale, causal, interpret, blocks)
     return o, (q, k, v, o, lse)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
+_TUNE_CANDIDATES = ((128, 128), (128, 256), (256, 128), (256, 256),
+                    (128, 512), (512, 128))
+
+
+def _autotuned_blocks(qt, kt, scale, causal):
+    """Block-size selection through the autotune cache (SURVEY C14; see
+    autotune.py). Under a trace (tracer inputs) only cache HITS apply —
+    the shapes are static so the key is known; the measuring sweep runs
+    when inputs are concrete (first eager call, or an explicit warmup
+    like bench.py's)."""
+    from . import autotune as at
+    b, h, sq, d = qt.shape
+    sk = kt.shape[2]
+    cands = [c for c in _TUNE_CANDIDATES if c[0] <= sq and c[1] <= sk]
+    if len(cands) <= 1:
+        return None
+    sig = f"b{b}h{h}sq{sq}sk{sk}d{d}c{int(causal)}"
+    key = f"{at._device_kind()}|flash_attention|{sig}"
+    cached = at._load_cache().get(key)
+    if cached is not None and 0 <= cached < len(cands):
+        return tuple(cands[cached])
+    if isinstance(qt, jax.core.Tracer):
+        return None  # no timing possible mid-trace; use defaults
+    runners = {}
+
+    def run(cand):
+        # chain several applications inside ONE jit so kernel-time
+        # differences dominate per-dispatch host latency
+        f = runners.get(cand)
+        if f is None:
+            def chained(a, bb, cc, _cand=tuple(cand)):
+                y = a
+                for _ in range(8):
+                    y = _flash_bhsd(y, bb, cc, scale, causal, False, _cand)
+                return y
+            f = runners[cand] = jax.jit(chained)
+        out = f(qt, kt, kt)
+        float(jax.device_get(out.ravel()[0]))  # true host sync
+
+    return tuple(at.autotune("flash_attention", sig, cands, run))
+
+
+def flash_attention(q, k, v, causal=False, scale=None, interpret=None,
+                    blocks=None):
     """Flash attention in paddle layout [batch, seq, num_heads, head_dim].
 
     ``num_heads(q)`` may be a multiple of ``num_heads(k) == num_heads(v)``
     (grouped-query attention). Returns [batch, seq_q, num_heads, head_dim].
+    ``blocks``: optional (block_q, block_k) override; with autotuning
+    enabled (``incubate.autotune.set_config``) the best pair is measured
+    on-device and cached per shape.
     """
     if interpret is None:
         from . import use_interpret
@@ -346,5 +394,10 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
     qt = jnp.swapaxes(q, 1, 2)  # -> [B, H, S, D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    o = _flash_bhsd(qt, kt, vt, float(scale), bool(causal), bool(interpret))
+    if blocks is None and not interpret:
+        from . import autotune as at
+        if at.enabled():
+            blocks = _autotuned_blocks(qt, kt, float(scale), bool(causal))
+    o = _flash_bhsd(qt, kt, vt, float(scale), bool(causal),
+                    bool(interpret), blocks)
     return jnp.swapaxes(o, 1, 2)
