@@ -1,0 +1,53 @@
+"""TensorArray ops (SURVEY C8 — reference ``python/paddle/tensor/array.py``
+array_read/array_write/array_length/create_array over the C++
+TensorArray). Eager-first framing: a TensorArray is a Python list of
+Tensors (exactly what the reference's dygraph mode does); inside
+``jit.to_static`` capture the list ops trace like any other Python
+structure, with static indices."""
+from __future__ import annotations
+
+from ..core.dispatch import unwrap
+from ..core.tensor import Tensor
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """Reference ``create_array``."""
+    arr = []
+    if initialized_list is not None:
+        for t in initialized_list:
+            arr.append(t if isinstance(t, Tensor) else Tensor(t))
+    return arr
+
+
+def array_length(array) -> int:
+    """Reference ``array_length``."""
+    return len(array)
+
+
+def array_write(x, i, array=None):
+    """Reference ``array_write``: write ``x`` at index ``i`` (appending
+    when ``i == len``)."""
+    if array is None:
+        array = []
+    i = int(unwrap(i))
+    if i > len(array):
+        raise IndexError(
+            f"array_write index {i} out of range (length {len(array)})")
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    if i == len(array):
+        array.append(x)
+    else:
+        array[i] = x
+    return array
+
+
+def array_read(array, i) -> Tensor:
+    """Reference ``array_read``."""
+    i = int(unwrap(i))
+    if not 0 <= i < len(array):
+        raise IndexError(
+            f"array_read index {i} out of range (length {len(array)})")
+    return array[i]
+
+
+__all__ = ["create_array", "array_length", "array_write", "array_read"]

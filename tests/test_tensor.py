@@ -186,3 +186,20 @@ def test_flags():
             _ = pp.log(pp.to_tensor([-1.0]))
     finally:
         pp.set_flags({"check_nan_inf": False})
+
+
+def test_tensor_array_ops():
+    """TensorArray (SURVEY C8): create/write/read/length semantics."""
+    arr = pp.create_array()
+    pp.array_write(pp.to_tensor([1.0]), 0, arr)
+    pp.array_write(pp.to_tensor([2.0]), 1, arr)
+    pp.array_write(pp.to_tensor([9.0]), 0, arr)  # overwrite
+    assert pp.array_length(arr) == 2
+    assert float(np.asarray(pp.array_read(arr, 0)._read())[0]) == 9.0
+    assert float(np.asarray(pp.array_read(arr, 1)._read())[0]) == 2.0
+    with pytest.raises(IndexError):
+        pp.array_read(arr, 5)
+    with pytest.raises(IndexError):
+        pp.array_write(pp.to_tensor([0.0]), 7, arr)
+    init = pp.create_array(initialized_list=[np.zeros(2, "float32")])
+    assert pp.array_length(init) == 1
