@@ -28,6 +28,7 @@ from .auto_parallel.api import shard_parameter, to_static  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import launch  # noqa: F401
+from . import sharding  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 
 

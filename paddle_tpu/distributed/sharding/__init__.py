@@ -1,0 +1,26 @@
+"""``paddle.distributed.sharding`` parity namespace (reference
+``python/paddle/distributed/sharding/group_sharded.py``): re-exports the
+GSPMD sharding-stage implementation living with the fleet optimizer
+(``fleet/sharding_optimizer.py`` — levels os/os_g/p_g_os = ZeRO stages
+1/2/3 as sharding annotations over the ``sharding`` mesh axis)."""
+from __future__ import annotations
+
+from ..fleet.sharding_optimizer import group_sharded_parallel  # noqa: F401
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Reference ``save_group_sharded_model :249``: persists the FULL
+    (global) state — the single controller already sees global values."""
+    import os
+
+    from ... import framework as fw
+    os.makedirs(output, exist_ok=True)
+    fw.save(model.state_dict(), os.path.join(output, "model.pdmodel"))
+    if optimizer is not None:
+        inner = getattr(optimizer, "_inner", optimizer)
+        if hasattr(inner, "state_dict"):
+            fw.save(inner.state_dict(),
+                    os.path.join(output, "model.pdopt"))
+
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]

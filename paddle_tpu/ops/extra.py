@@ -1,0 +1,293 @@
+"""Op-surface completion batch (reference ``python/paddle/tensor/``:
+manipulation.py, math.py, search.py entries absent from the first op
+sweep — multiplex, crop, fill_diagonal*, renorm, dist, diff, stack
+variants, atleast_*, block_diag, signbit family, ldexp/frexp, bucketize,
+take, vander, trapezoid, combinations, edit_distance)."""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive, unwrap
+from ..core.tensor import Tensor
+
+
+@primitive
+def multiplex(inputs, index):
+    """out[i] = inputs[index[i]][i] (reference ``multiplex``)."""
+    stacked = jnp.stack(inputs, axis=0)            # [K, N, ...]
+    idx = index.reshape(-1).astype(jnp.int32)      # [N]
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
+
+
+@primitive
+def crop(x, shape=None, offsets=None):
+    """Reference ``crop``: slice ``shape`` starting at ``offsets``."""
+    shp = [int(s) for s in (unwrap(shape) if shape is not None
+                            else x.shape)]
+    shp = [x.shape[i] if s in (-1, None) else s for i, s in enumerate(shp)]
+    off = [int(o) for o in (unwrap(offsets) if offsets is not None
+                            else [0] * x.ndim)]
+    sl = tuple(builtins.slice(o, o + s) for o, s in zip(off, shp))
+    return x[sl]
+
+
+def _diag_indices(n, m, offset):
+    """Static diagonal coordinates of an [.., n, m] matrix at ``offset``."""
+    k = builtins.min(n, m - offset) if offset >= 0 else \
+        builtins.min(n + offset, m)
+    k = builtins.max(k, 0)
+    i = np.arange(k)
+    return i - builtins.min(offset, 0), i + builtins.max(offset, 0)
+
+
+@primitive
+def fill_diagonal(x, value, offset=0, wrap=False):
+    """Reference ``fill_diagonal_`` (out-of-place on this backend)."""
+    rows, cols = _diag_indices(x.shape[-2], x.shape[-1], offset)
+    if len(rows) == 0:
+        return x
+    return x.at[..., rows, cols].set(jnp.asarray(value, x.dtype))
+
+
+@primitive
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    """Reference ``fill_diagonal_tensor``: write tensor ``y`` (its last
+    dim running along the diagonal) onto the (dim1, dim2) diagonal."""
+    d1, d2 = dim1 % x.ndim, dim2 % x.ndim
+    perm = [d for d in range(x.ndim) if d not in (d1, d2)] + [d1, d2]
+    inv = np.argsort(perm)
+    xt = jnp.transpose(x, perm)
+    rows, cols = _diag_indices(xt.shape[-2], xt.shape[-1], offset)
+    if len(rows) == 0:
+        return x
+    out = xt.at[..., rows, cols].set(jnp.asarray(y, x.dtype))
+    return jnp.transpose(out, inv)
+
+
+@primitive
+def renorm(x, p, axis, max_norm):
+    """Reference ``renorm``: scale slices along ``axis`` whose p-norm
+    exceeds ``max_norm`` down to it."""
+    axes = tuple(d for d in range(x.ndim) if d != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+@primitive
+def dist(x, y, p=2.0):
+    """Reference ``dist``: p-norm of (x - y) after broadcast."""
+    d = (x - y).reshape(-1)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    if p == 0:
+        return jnp.sum(d != 0).astype(x.dtype)
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+@primitive
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    parts = [v for v in (prepend, x, append) if v is not None]
+    v = jnp.concatenate(parts, axis=axis) if len(parts) > 1 else x
+    return jnp.diff(v, n=n, axis=axis)
+
+
+@primitive
+def unflatten(x, axis, shape):
+    shp = list(x.shape)
+    axis %= x.ndim
+    return x.reshape(tuple(shp[:axis]) + tuple(int(s) for s in shape)
+                     + tuple(shp[axis + 1:]))
+
+
+@primitive
+def index_fill(x, index, axis, value):
+    idx = index.astype(jnp.int32)
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[idx].set(jnp.asarray(value, x.dtype))
+    return jnp.moveaxis(moved, 0, axis)
+
+
+def _stackish(jfn, name):
+    @primitive(name)
+    def op(inputs):
+        return jfn([jnp.asarray(v) for v in inputs])
+    return lambda x, name_=None: op(list(x))
+
+
+hstack = _stackish(jnp.hstack, "hstack")
+vstack = _stackish(jnp.vstack, "vstack")
+dstack = _stackish(jnp.dstack, "dstack")
+column_stack = _stackish(jnp.column_stack, "column_stack")
+row_stack = _stackish(jnp.vstack, "row_stack")
+
+
+def atleast_1d(*xs):
+    from ..core.dispatch import apply
+    outs = [apply("atleast_1d", jnp.atleast_1d, x) for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*xs):
+    from ..core.dispatch import apply
+    outs = [apply("atleast_2d", jnp.atleast_2d, x) for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*xs):
+    from ..core.dispatch import apply
+    outs = [apply("atleast_3d", jnp.atleast_3d, x) for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def block_diag(inputs, name=None):
+    from ..core.dispatch import apply
+    return apply("block_diag",
+                 lambda *vs: jax.scipy.linalg.block_diag(*vs), *inputs)
+
+
+@primitive
+def signbit(x):
+    return jnp.signbit(x)
+
+
+@primitive
+def isneginf(x):
+    return jnp.isneginf(x)
+
+
+@primitive
+def isposinf(x):
+    return jnp.isposinf(x)
+
+
+@primitive
+def isreal(x):
+    return jnp.isreal(x)
+
+
+@primitive
+def ldexp(x, y):
+    return jnp.ldexp(x, y.astype(jnp.int32))
+
+
+@primitive
+def frexp(x):
+    m, e = jnp.frexp(x)
+    return m, e.astype(jnp.int32)
+
+
+@primitive
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@primitive
+def take(x, index, mode="raise"):
+    """Reference ``take``: flat-index gather with clip/wrap modes."""
+    flat = x.reshape(-1)
+    idx = index.astype(jnp.int32)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = ((idx % n) + n) % n
+    else:  # raise-mode bounds checks need host sync; clip matches docs
+        idx = jnp.clip(jnp.where(idx < 0, idx + n, idx), 0, n - 1)
+    return flat[idx]
+
+
+@primitive
+def slice_scatter(x, value, axes, starts, ends, strides):
+    sl = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        sl[ax] = builtins.slice(int(st), int(en), int(sd))
+    return x.at[tuple(sl)].set(value.astype(x.dtype))
+
+
+@primitive
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+@primitive
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if x is not None:
+        return jax.scipy.integrate.trapezoid(y, x=x, axis=axis)
+    return jax.scipy.integrate.trapezoid(y, dx=dx or 1.0, axis=axis)
+
+
+@primitive
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    axis %= y.ndim
+    yl = jnp.moveaxis(y, axis, -1)
+    if x is not None:
+        xv = jnp.moveaxis(jnp.broadcast_to(x, yl.shape), -1, -1) \
+            if x.ndim == y.ndim else x
+        d = jnp.diff(xv, axis=-1)
+    else:
+        d = dx or 1.0
+    avg = (yl[..., 1:] + yl[..., :-1]) / 2.0
+    out = jnp.cumsum(avg * d, axis=-1)
+    return jnp.moveaxis(out, -1, axis)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """Reference ``combinations``: static index enumeration + gather."""
+    import itertools
+
+    from ..core.dispatch import apply
+    n = int(x.shape[0])
+    it = (itertools.combinations_with_replacement(range(n), r)
+          if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(it), np.int32).reshape(-1, r)
+    return apply("combinations", lambda v: v[jnp.asarray(idx)], x)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Reference ``nn/functional/loss.py edit_distance`` (Levenshtein,
+    batch of sequences). Host-side DP (the reference's CPU kernel is the
+    same loop); returns (distances [B,1], sequence_num)."""
+    a = np.asarray(unwrap(input))
+    b = np.asarray(unwrap(label))
+    ilen = (np.asarray(unwrap(input_length)) if input_length is not None
+            else np.full(a.shape[0], a.shape[1]))
+    llen = (np.asarray(unwrap(label_length)) if label_length is not None
+            else np.full(b.shape[0], b.shape[1]))
+    ign = set(ignored_tokens or [])
+    out = np.zeros((a.shape[0], 1), np.float32)
+    for r in range(a.shape[0]):
+        s1 = [t for t in a[r][: int(ilen[r])] if t not in ign]
+        s2 = [t for t in b[r][: int(llen[r])] if t not in ign]
+        dp = np.arange(len(s2) + 1, dtype=np.float32)
+        for i, c1 in enumerate(s1, 1):
+            prev, dp[0] = dp[0], i
+            for j, c2 in enumerate(s2, 1):
+                cur = dp[j]
+                dp[j] = builtins.min(dp[j] + 1, dp[j - 1] + 1,
+                                     prev + (c1 != c2))
+                prev = cur
+        d = dp[-1]
+        if normalized:
+            d = d / builtins.max(len(s2), 1)
+        out[r, 0] = d
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray([a.shape[0]]))
+
+
+__all__ = [
+    "multiplex", "crop", "fill_diagonal", "fill_diagonal_tensor",
+    "renorm", "dist", "diff", "unflatten", "index_fill", "hstack",
+    "vstack", "dstack", "column_stack", "row_stack", "atleast_1d",
+    "atleast_2d", "atleast_3d", "block_diag", "signbit", "isneginf",
+    "isposinf", "isreal", "ldexp", "frexp", "bucketize", "take",
+    "slice_scatter", "vander", "trapezoid", "cumulative_trapezoid",
+    "combinations", "edit_distance",
+]

@@ -9,6 +9,7 @@ import warnings
 
 from ..core.tensor import Tensor
 from . import dlpack  # noqa: F401
+from . import cpp_extension  # noqa: F401
 
 
 def deprecated(update_to="", since="", reason="", level=0):

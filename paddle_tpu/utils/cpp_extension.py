@@ -1,0 +1,110 @@
+"""``paddle.utils.cpp_extension`` parity — custom C++ ops (SURVEY C31).
+
+Reference ``python/paddle/utils/cpp_extension/`` (``load`` :dynamic JIT
+build, CppExtension/setup) building ops against the C++ framework. TPU
+split: device-side custom kernels are Pallas (`core.dispatch.primitive`
+over a ``pallas_call`` — the custom-kernel path proper); HOST-side custom
+C++ ops compile with g++ at load() time and execute through
+``jax.pure_callback``, so they compose with jit/vmap tracing while the
+C++ runs on the host (the analog of the reference's CPU custom kernels).
+
+Declared signature convention (kept deliberately C-simple): each op is
+``void f(const float* in, float* out, int64_t n)`` elementwise-style, or
+any ctypes signature the caller wires explicitly via ``bind``.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+
+class _OpModule:
+    """Result of ``load``: exposes each bound op as a framework op."""
+
+    def __init__(self, lib, name):
+        self._lib = lib
+        self.__name__ = name
+
+    def bind(self, symbol, op_impl):
+        """Register ``symbol`` with an explicit wrapper ``op_impl(lib,
+        *arrays) -> array`` as a differentiable-opaque framework op."""
+        import jax
+
+        from ..core.dispatch import apply
+
+        lib = self._lib
+
+        def op(*tensors, **kwargs):
+            from ..core.tensor import Tensor
+
+            def impl(*vals):
+                ex = vals[0]
+                out_shape = jax.ShapeDtypeStruct(ex.shape, ex.dtype)
+                return jax.pure_callback(
+                    lambda *a: op_impl(lib, *[np.asarray(x) for x in a]),
+                    out_shape, *vals, vmap_method="sequential")
+
+            return apply(symbol, impl, *tensors, **kwargs)
+
+        setattr(self, symbol, op)
+        return op
+
+    def bind_elementwise(self, symbol):
+        """Convenience for the ``void f(const float*, float*, int64_t)``
+        convention."""
+        fn = getattr(self._lib, symbol)
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+
+        def run(lib, x):
+            x = np.ascontiguousarray(x, np.float32)
+            out = np.empty_like(x)
+            fn(x.ctypes.data, out.ctypes.data, x.size)
+            return out
+
+        return self.bind(symbol, run)
+
+
+def load(name, sources, extra_cxx_cflags=None, build_directory=None,
+         verbose=False, **kwargs):
+    """Reference ``cpp_extension.load``: compile ``sources`` (C++ files)
+    into a shared library and return a module handle whose ops are bound
+    via ``bind``/``bind_elementwise``."""
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(build_dir, exist_ok=True)
+    so_path = os.path.join(build_dir, f"lib{name}.so")
+    srcs = [sources] if isinstance(sources, str) else list(sources)
+    needs_build = (not os.path.exists(so_path) or any(
+        os.path.getmtime(s) > os.path.getmtime(so_path) for s in srcs))
+    if needs_build:
+        cmd = (["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
+               + (extra_cxx_cflags or []) + srcs + ["-o", so_path])
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return _OpModule(ctypes.CDLL(so_path), name)
+
+
+class CppExtension:
+    """setup()-style descriptor (reference parity; ``load`` is the
+    JIT path actually exercised on this backend)."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Reference ``cpp_extension.setup`` minimal: builds each extension
+    eagerly via ``load`` (no pip install machinery in this image)."""
+    mods = []
+    exts = ext_modules if isinstance(ext_modules, list) else [ext_modules]
+    for ext in exts:
+        mods.append(load(name or "custom_op", ext.sources))
+    return mods
+
+
+__all__ = ["load", "setup", "CppExtension"]

@@ -1,0 +1,178 @@
+"""Coverage for the op-surface completion batch (ops/extra.py) plus the
+custom C++ op extension (SURVEY C31)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+from paddle_tpu.testing import OpSpec, run_op_specs
+
+R = np.random.default_rng(23)
+
+
+def f32(*shape):
+    return R.normal(size=shape).astype("float32")
+
+
+def test_extra_ops_table():
+    x = f32(3, 4)
+    specs = [
+        OpSpec("diff", ops.diff, lambda a: np.diff(a), [x]),
+        OpSpec("unflatten", ops.unflatten,
+               lambda a, axis, shape: a.reshape(3, 2, 2), [x],
+               {"axis": 1, "shape": [2, 2]}),
+        OpSpec("hstack", lambda a, b: ops.hstack([a, b]),
+               lambda a, b: np.hstack([a, b]), [f32(2, 3), f32(2, 3)]),
+        OpSpec("vstack", lambda a, b: ops.vstack([a, b]),
+               lambda a, b: np.vstack([a, b]), [f32(2, 3), f32(2, 3)]),
+        OpSpec("dstack", lambda a, b: ops.dstack([a, b]),
+               lambda a, b: np.dstack([a, b]), [f32(2, 3), f32(2, 3)]),
+        OpSpec("column_stack", lambda a, b: ops.column_stack([a, b]),
+               lambda a, b: np.column_stack([a, b]), [f32(4), f32(4)]),
+        OpSpec("atleast_2d", ops.atleast_2d, np.atleast_2d, [f32(5)]),
+        OpSpec("block_diag", lambda a, b: ops.block_diag([a, b]),
+               lambda a, b: np.block([
+                   [a, np.zeros((2, 3), "float32")],
+                   [np.zeros((3, 2), "float32"), b]]),
+               [f32(2, 2), f32(3, 3)]),
+        OpSpec("signbit", ops.signbit, np.signbit, [x], bf16=False),
+        OpSpec("isneginf", ops.isneginf, np.isneginf,
+               [np.array([1.0, -np.inf], "float32")], bf16=False),
+        OpSpec("isposinf", ops.isposinf, np.isposinf,
+               [np.array([1.0, np.inf], "float32")], bf16=False),
+        OpSpec("ldexp", ops.ldexp, lambda a, b: np.ldexp(a, b.astype(int)),
+               [f32(4), np.array([0, 1, 2, 3], "int32")], bf16=False),
+        OpSpec("bucketize", ops.bucketize,
+               lambda a, seq: np.searchsorted(seq, a),
+               [f32(4), np.sort(f32(6))], bf16=False),
+        OpSpec("take", ops.take,
+               lambda a, i: np.take(a.ravel(), i),
+               [x, np.array([0, 5, 11], "int32")], bf16=False),
+        OpSpec("vander", ops.vander, np.vander, [f32(4)], rtol=1e-4),
+        OpSpec("trapezoid", ops.trapezoid,
+               lambda y: np.trapezoid(y, axis=-1)
+               if hasattr(np, "trapezoid") else np.trapz(y, axis=-1),
+               [x], rtol=1e-4),
+        OpSpec("dist", ops.dist,
+               lambda a, b: np.linalg.norm((a - b).ravel()),
+               [x, f32(3, 4)], rtol=1e-4),
+        OpSpec("renorm", ops.renorm,
+               lambda a, p, axis, max_norm: a * np.minimum(
+                   1.0, max_norm / (np.abs(a ** p).sum(
+                       axis=1, keepdims=True) ** (1 / p) + 1e-7)),
+               [np.abs(f32(3, 4)) + 1], {"p": 2.0, "axis": 0,
+                                         "max_norm": 1.0}, rtol=1e-3),
+        OpSpec("fill_diagonal", ops.fill_diagonal,
+               lambda a, value: _fd_ref(a, value), [f32(4, 4)],
+               {"value": 7.0}),
+        OpSpec("crop", ops.crop,
+               lambda a, shape, offsets: a[1:3, 1:4], [f32(4, 5)],
+               {"shape": [2, 3], "offsets": [1, 1]}),
+        OpSpec("slice_scatter", ops.slice_scatter,
+               lambda a, v, axes, starts, ends, strides: _ss_ref(a, v),
+               [f32(4, 6), np.ones((4, 2), "float32")],
+               {"axes": [1], "starts": [2], "ends": [4], "strides": [1]}),
+        OpSpec("index_fill", ops.index_fill,
+               lambda a, idx, axis, value: _if_ref(a, idx, value),
+               [f32(4, 3), np.array([0, 2], "int64")],
+               {"axis": 0, "value": 5.0}, bf16=False),
+    ]
+    run_op_specs(specs)
+
+
+def _fd_ref(a, value):
+    out = a.copy()
+    np.fill_diagonal(out, value)
+    return out
+
+
+def _ss_ref(a, v):
+    out = a.copy()
+    out[:, 2:4] = v
+    return out
+
+
+def _if_ref(a, idx, value):
+    out = a.copy()
+    out[idx] = value
+    return out
+
+
+def test_multiplex_and_combinations():
+    a = f32(4, 3)
+    b = f32(4, 3)
+    idx = np.array([[0], [1], [1], [0]], "int32")
+    out = ops.multiplex([paddle.to_tensor(a), paddle.to_tensor(b)],
+                        paddle.to_tensor(idx))
+    want = np.where(idx == 0, a, b)
+    np.testing.assert_allclose(np.asarray(out._read()), want)
+
+    c = ops.combinations(paddle.to_tensor(np.arange(4, dtype="float32")))
+    np.testing.assert_allclose(
+        np.asarray(c._read()),
+        [[0, 1], [0, 2], [0, 3], [1, 2], [1, 3], [2, 3]])
+
+
+def test_frexp_and_cumulative_trapezoid():
+    x = np.array([0.5, 4.0, -3.0], "float32")
+    m, e = ops.frexp(paddle.to_tensor(x))
+    mr, er = np.frexp(x)
+    np.testing.assert_allclose(np.asarray(m._read()), mr)
+    np.testing.assert_array_equal(np.asarray(e._read()), er)
+    y = f32(2, 5)
+    got = ops.cumulative_trapezoid(paddle.to_tensor(y))
+    import scipy.integrate as si
+    np.testing.assert_allclose(np.asarray(got._read()),
+                               si.cumulative_trapezoid(y, axis=-1),
+                               atol=1e-5)
+
+
+def test_fill_diagonal_tensor_and_offsets():
+    x = np.zeros((3, 5), "float32")
+    y = np.array([1.0, 2.0, 3.0], "float32")
+    out = ops.fill_diagonal_tensor(paddle.to_tensor(x),
+                                   paddle.to_tensor(y), offset=1)
+    want = x.copy()
+    want[[0, 1, 2], [1, 2, 3]] = y
+    np.testing.assert_allclose(np.asarray(out._read()), want)
+
+
+def test_edit_distance():
+    inp = np.array([[1, 2, 3, 4], [5, 6, 7, 8]], "int64")
+    lab = np.array([[1, 2, 4, 0], [5, 6, 7, 8]], "int64")
+    d, n = ops.edit_distance(paddle.to_tensor(inp), paddle.to_tensor(lab),
+                             normalized=False,
+                             input_length=paddle.to_tensor(
+                                 np.array([4, 4], "int64")),
+                             label_length=paddle.to_tensor(
+                                 np.array([3, 4], "int64")))
+    # [1,2,3,4] vs [1,2,4]: one deletion = 1; identical: 0
+    np.testing.assert_allclose(np.asarray(d._read()), [[1.0], [0.0]])
+    assert int(np.asarray(n._read())[0]) == 2
+
+
+def test_cpp_extension_custom_op(tmp_path):
+    """SURVEY C31: compile a C++ op with g++, run it through the dispatch
+    funnel (jax.pure_callback host execution)."""
+    src = tmp_path / "my_ops.cc"
+    src.write_text("""
+        #include <cstdint>
+        extern "C" void my_relu(const float* in, float* out, int64_t n) {
+            for (int64_t i = 0; i < n; ++i)
+                out[i] = in[i] > 0.f ? in[i] : 0.f;
+        }
+    """)
+    from paddle_tpu.utils import cpp_extension
+    mod = cpp_extension.load("my_ops", str(src),
+                             build_directory=str(tmp_path))
+    my_relu = mod.bind_elementwise("my_relu")
+    x = f32(3, 4)
+    out = my_relu(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(out._read()),
+                               np.maximum(x, 0), atol=1e-6)
+
+
+def test_group_sharded_namespace():
+    import paddle_tpu.distributed as dist
+    assert callable(dist.sharding.group_sharded_parallel)
+    assert callable(dist.sharding.save_group_sharded_model)
