@@ -56,6 +56,14 @@ from .distributed.parallel import DataParallel  # noqa: F401
 from . import framework  # noqa: F401
 from .framework import save, load  # noqa: F401
 from .jit import to_static  # noqa: F401
+from . import geometric  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from . import onnx  # noqa: F401
+from .hapi import hub  # noqa: F401
+from . import tensor  # noqa: F401  (compat: paddle.tensor op namespace)
+from . import base  # noqa: F401
 
 import numpy as _np
 

@@ -1,0 +1,23 @@
+"""Compat namespace: ``paddle.base`` (reference ``python/paddle/base/``).
+
+The reference keeps framework internals here (Program/Executor/core
+bindings). On this framework those live in ``paddle_tpu.static`` (program &
+executor), ``paddle_tpu.core`` (dispatch/state), and ``paddle_tpu.framework``
+(IO); this module aliases them for call sites written against the
+reference's layout.
+"""
+from .. import framework  # noqa: F401
+from ..core import dtype as core  # noqa: F401  (dtype/Place table ~ base.core)
+from ..core import state  # noqa: F401
+from ..framework import save, load  # noqa: F401
+from ..static import Executor, Program, program_guard  # noqa: F401
+
+
+def default_main_program():
+    from .. import static
+    return static.default_main_program()
+
+
+def default_startup_program():
+    from .. import static
+    return static.default_startup_program()

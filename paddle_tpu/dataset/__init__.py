@@ -1,0 +1,10 @@
+"""Legacy reader-style datasets (reference ``python/paddle/dataset/``).
+
+Each submodule exposes ``train()``/``test()`` *reader creators* (zero-arg
+callables yielding samples) over the same on-disk formats the reference
+downloads. This runtime has no network egress, so files must be supplied
+locally (pass paths, or set ``paddle.dataset.common.DATA_HOME``).
+"""
+from . import common, mnist, uci_housing, cifar
+
+__all__ = ['common', 'mnist', 'cifar', 'uci_housing']

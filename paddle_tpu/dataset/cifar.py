@@ -1,0 +1,48 @@
+"""CIFAR reader creators (reference ``python/paddle/dataset/cifar.py``).
+
+Samples are ``(image float32 [3072] in [0, 1], label int)``.
+"""
+from __future__ import annotations
+
+import os
+
+from . import common
+
+__all__ = ['train10', 'test10', 'train100', 'test100']
+
+
+def _reader_creator(cls, archive, mode):
+    def reader():
+        ds = cls(data_file=archive, mode=mode)
+        for i in range(len(ds)):
+            img = ds.images[i].transpose(2, 0, 1)  # CHW like the reference
+            yield img.reshape(-1).astype('float32') / 255.0, int(ds.labels[i])
+    return reader
+
+
+def _archive(name):
+    return os.path.join(common.DATA_HOME, 'cifar', name)
+
+
+def train10():
+    from ..vision.datasets import Cifar10
+    return _reader_creator(Cifar10, _archive('cifar-10-python.tar.gz'),
+                           'train')
+
+
+def test10():
+    from ..vision.datasets import Cifar10
+    return _reader_creator(Cifar10, _archive('cifar-10-python.tar.gz'),
+                           'test')
+
+
+def train100():
+    from ..vision.datasets import Cifar100
+    return _reader_creator(Cifar100, _archive('cifar-100-python.tar.gz'),
+                           'train')
+
+
+def test100():
+    from ..vision.datasets import Cifar100
+    return _reader_creator(Cifar100, _archive('cifar-100-python.tar.gz'),
+                           'test')

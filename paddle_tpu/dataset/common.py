@@ -1,0 +1,36 @@
+"""Shared dataset plumbing (reference ``python/paddle/dataset/common.py``).
+
+``download`` verifies a *local* cached copy (md5-checked) instead of
+fetching — this runtime has zero egress.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+DATA_HOME = os.path.expanduser(os.environ.get(
+    "PDTPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+__all__ = ['DATA_HOME', 'md5file', 'download']
+
+
+def md5file(fname):
+    m = hashlib.md5()
+    with open(fname, 'rb') as f:
+        for chunk in iter(lambda: f.read(4096), b''):
+            m.update(chunk)
+    return m.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Resolve the locally cached file for ``url``; never fetches."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname, save_name if save_name else url.split('/')[-1])
+    if os.path.exists(filename) and (
+            not md5sum or md5file(filename) == md5sum):
+        return filename
+    raise FileNotFoundError(
+        f"dataset file {filename} not present (and this runtime has no "
+        f"network egress to fetch {url}); place the file there or pass "
+        "explicit paths to the paddle.vision.datasets classes.")

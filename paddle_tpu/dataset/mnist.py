@@ -1,0 +1,40 @@
+"""MNIST reader creators (reference ``python/paddle/dataset/mnist.py``).
+
+Samples are ``(image float32 [784] scaled to [-1, 1], label int)``,
+matching the reference reader format.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test']
+
+
+def _reader_creator(image_path, label_path):
+    from ..vision.datasets import MNIST
+
+    def reader():
+        ds = MNIST(image_path=image_path, label_path=label_path)
+        for img, label in ((ds.images[i], ds.labels[i])
+                           for i in range(len(ds))):
+            yield (img.reshape(-1).astype('float32') / 127.5 - 1.0,
+                   int(label))
+    return reader
+
+
+def _paths(split):
+    d = os.path.join(common.DATA_HOME, 'mnist')
+    return (os.path.join(d, f'{split}-images-idx3-ubyte.gz'),
+            os.path.join(d, f'{split}-labels-idx1-ubyte.gz'))
+
+
+def train():
+    return _reader_creator(*_paths('train'))
+
+
+def test():
+    return _reader_creator(*_paths('t10k'))

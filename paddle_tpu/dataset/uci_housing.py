@@ -1,0 +1,45 @@
+"""UCI housing reader creators (reference
+``python/paddle/dataset/uci_housing.py``). Samples are
+``(features float32 [13] feature-scaled, price float32 [1])``.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test']
+
+TRAIN_RATIO = 0.8
+
+
+def _load():
+    path = os.path.join(common.DATA_HOME, 'uci_housing', 'housing.data')
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} not present (no network egress to fetch it)")
+    data = np.loadtxt(path)
+    maxs, mins = data.max(axis=0), data.min(axis=0)
+    avgs = data.mean(axis=0)
+    feats = (data[:, :-1] - avgs[:-1]) / np.maximum(
+        maxs[:-1] - mins[:-1], 1e-8)
+    return feats.astype('float32'), data[:, -1:].astype('float32')
+
+
+def _reader_creator(start_frac, end_frac):
+    def reader():
+        x, y = _load()
+        n = len(x)
+        for i in range(int(n * start_frac), int(n * end_frac)):
+            yield x[i], y[i]
+    return reader
+
+
+def train():
+    return _reader_creator(0.0, TRAIN_RATIO)
+
+
+def test():
+    return _reader_creator(TRAIN_RATIO, 1.0)

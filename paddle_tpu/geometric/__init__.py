@@ -1,0 +1,26 @@
+"""Graph-learning ops (reference ``python/paddle/geometric/``).
+
+The message-passing/segment math runs as jax segment ops on device (MXU/VPU
+friendly scatter-adds XLA lowers natively); the graph-prep ops
+(reindex/sampling) are host-side input-pipeline work, as on the reference
+where they run on CPU ints — keeping data-dependent shapes out of compiled
+programs.
+"""
+from .math import segment_max, segment_mean, segment_min, segment_sum
+from .message_passing import send_u_recv, send_ue_recv, send_uv
+from .reindex import reindex_graph, reindex_heter_graph
+from .sampling import sample_neighbors, weighted_sample_neighbors
+
+__all__ = [
+    'send_u_recv',
+    'send_ue_recv',
+    'send_uv',
+    'segment_sum',
+    'segment_mean',
+    'segment_min',
+    'segment_max',
+    'reindex_graph',
+    'reindex_heter_graph',
+    'sample_neighbors',
+    'weighted_sample_neighbors',
+]

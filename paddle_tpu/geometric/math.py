@@ -1,0 +1,70 @@
+"""Segment reductions (reference ``python/paddle/geometric/math.py``:23-254).
+
+``segment_ids`` must be sorted non-decreasing (reference contract); empty
+segments produce 0 rows. The segment count is read from the concrete ids on
+the host (these are graph-prep ops; under ``jit.to_static`` capture pass a
+pre-computed dense graph instead).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive, unwrap
+
+
+def _num_segments(segment_ids) -> int:
+    ids = np.asarray(unwrap(segment_ids))
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+def seg_reduce(msg, ids, num, op, indices_are_sorted=False):
+    """Shared segment sum/mean/min/max with the reference's empty-segment
+    contract: rows receiving no message are 0 (jax's min/max identities —
+    ±inf for floats, iinfo extremes for ints — are replaced)."""
+    ids = ids.astype(jnp.int32)
+    kw = dict(num_segments=num, indices_are_sorted=indices_are_sorted)
+    if op == "sum":
+        return jax.ops.segment_sum(msg, ids, **kw)
+    if op == "mean":
+        total = jax.ops.segment_sum(msg, ids, **kw)
+        cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype),
+                                  ids, **kw)
+        return total / jnp.maximum(cnt, 1).reshape(
+            (-1,) + (1,) * (msg.ndim - 1))
+    if op == "max":
+        out = jax.ops.segment_max(msg, ids, **kw)
+    elif op == "min":
+        out = jax.ops.segment_min(msg, ids, **kw)
+    else:
+        raise ValueError(f"unsupported reduce op {op!r}")
+    cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],), jnp.int32), ids, **kw)
+    empty = (cnt == 0).reshape((-1,) + (1,) * (msg.ndim - 1))
+    return jnp.where(empty, jnp.zeros_like(out), out)
+
+
+@primitive
+def _segment_reduce(data, segment_ids, num_segments=0, op="sum"):
+    return seg_reduce(data, segment_ids, num_segments, op,
+                      indices_are_sorted=True)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids,
+                           num_segments=_num_segments(segment_ids), op="sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids,
+                           num_segments=_num_segments(segment_ids), op="mean")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids,
+                           num_segments=_num_segments(segment_ids), op="min")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids,
+                           num_segments=_num_segments(segment_ids), op="max")

@@ -1,0 +1,65 @@
+"""Gather-scatter message passing (reference
+``python/paddle/geometric/message_passing/send_recv.py``:36,187,392).
+
+``send_u_recv(x, src, dst)`` = gather ``x[src]``, reduce onto ``dst`` rows;
+``send_ue_recv`` fuses an edge-feature op into the message;
+``send_uv`` emits the per-edge message. All three are jit-safe: the default
+output row count is ``x.shape[0]`` (static), matching the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive, unwrap
+from .math import seg_reduce
+
+_MSG_OPS = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def _rows(x, out_size):
+    if out_size is None:
+        return None
+    n = int(unwrap(out_size))
+    return n if n > 0 else None
+
+
+@primitive
+def _send_u_recv(x, src_index, dst_index, reduce_op="sum", rows=None):
+    msg = jnp.take(x, src_index.astype(jnp.int32), axis=0)
+    return seg_reduce(msg, dst_index, rows or x.shape[0], reduce_op)
+
+
+@primitive
+def _send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                  reduce_op="sum", rows=None):
+    msg = _MSG_OPS[message_op](
+        jnp.take(x, src_index.astype(jnp.int32), axis=0), y)
+    return seg_reduce(msg, dst_index, rows or x.shape[0], reduce_op)
+
+
+@primitive
+def _send_uv(x, y, src_index, dst_index, message_op="add"):
+    return _MSG_OPS[message_op](
+        jnp.take(x, src_index.astype(jnp.int32), axis=0),
+        jnp.take(y, dst_index.astype(jnp.int32), axis=0))
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    return _send_u_recv(x, src_index, dst_index, reduce_op=reduce_op,
+                        rows=_rows(x, out_size))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    return _send_ue_recv(x, y, src_index, dst_index, message_op=message_op,
+                         reduce_op=reduce_op, rows=_rows(x, out_size))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    return _send_uv(x, y, src_index, dst_index, message_op=message_op)

@@ -1,0 +1,56 @@
+"""Graph reindexing (reference ``python/paddle/geometric/reindex.py``:25,139).
+
+Host-side int bookkeeping (graph prep runs in the input pipeline on TPU —
+data-dependent output shapes must stay out of compiled programs). The
+hashtable value/index buffers of the GPU fast path are accepted and ignored.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dispatch import unwrap
+from ..core.tensor import Tensor
+
+
+def _reindex(x, neighbor_lists):
+    x = np.asarray(unwrap(x)).reshape(-1)
+    mapping = {int(n): i for i, n in enumerate(x)}
+    out_nodes = list(x)
+    srcs = []
+    for neigh in neighbor_lists:
+        src = np.empty(len(neigh), dtype=np.int64)
+        for j, n in enumerate(np.asarray(neigh).reshape(-1)):
+            n = int(n)
+            idx = mapping.get(n)
+            if idx is None:
+                idx = mapping[n] = len(out_nodes)
+                out_nodes.append(n)
+            src[j] = idx
+        srcs.append(src)
+    return srcs, np.asarray(out_nodes, dtype=x.dtype)
+
+
+def _dst(count, n_inputs):
+    cnt = np.asarray(unwrap(count)).reshape(-1).astype(np.int64)
+    return np.repeat(np.arange(n_inputs, dtype=np.int64), cnt)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Renumber ``x`` + ``neighbors`` to a dense [0, n) id space; returns
+    (reindex_src, reindex_dst, out_nodes) with ``x`` ids first."""
+    n_inputs = len(np.asarray(unwrap(x)).reshape(-1))
+    srcs, out_nodes = _reindex(x, [np.asarray(unwrap(neighbors))])
+    return (Tensor(srcs[0]), Tensor(_dst(count, n_inputs)), Tensor(out_nodes))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: ``neighbors``/``count`` are per-edge-type
+    lists sharing one node id space; outputs are concatenated per type."""
+    n_inputs = len(np.asarray(unwrap(x)).reshape(-1))
+    srcs, out_nodes = _reindex(
+        x, [np.asarray(unwrap(n)) for n in neighbors])
+    dsts = [_dst(c, n_inputs) for c in count]
+    return (Tensor(np.concatenate(srcs)), Tensor(np.concatenate(dsts)),
+            Tensor(out_nodes))

@@ -1,0 +1,80 @@
+"""Neighbor sampling over CSC graphs (reference
+``python/paddle/geometric/sampling/neighbors.py``:23,172).
+
+Host-side numpy: sampling is input-pipeline work with data-dependent output
+shapes. Uses the framework RNG seed (``paddle.seed``) for reproducibility.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import state
+from ..core.dispatch import unwrap
+from ..core.tensor import Tensor
+
+
+def _rng():
+    # derive a host seed from the framework RNG stream (paddle.seed analog)
+    import jax
+    key = np.asarray(jax.random.key_data(state.default_rng.next_key()))
+    return np.random.default_rng(key.astype(np.uint32))
+
+
+def _sample(row, colptr, input_nodes, sample_size, eids, weights=None):
+    row = np.asarray(unwrap(row)).reshape(-1)
+    colptr = np.asarray(unwrap(colptr)).reshape(-1)
+    nodes = np.asarray(unwrap(input_nodes)).reshape(-1)
+    eids_np = None if eids is None else np.asarray(unwrap(eids)).reshape(-1)
+    w = None if weights is None else np.asarray(unwrap(weights)).reshape(-1)
+    rng = _rng()
+
+    out_neigh, out_eids, out_count = [], [], np.empty(len(nodes), np.int32)
+    for i, n in enumerate(nodes):
+        beg, end = int(colptr[n]), int(colptr[int(n) + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(beg, end)
+        elif w is not None:
+            # zero-weight edges are unsamplable; a node may yield fewer
+            # than sample_size neighbors
+            p = w[beg:end].astype(np.float64)
+            nz = np.flatnonzero(p > 0)
+            k = min(sample_size, len(nz))
+            if k == 0:
+                pick = np.empty(0, np.int64)
+            else:
+                pick = beg + rng.choice(
+                    nz, size=k, replace=False, p=p[nz] / p[nz].sum())
+        else:
+            pick = beg + rng.choice(deg, size=sample_size, replace=False)
+        out_count[i] = len(pick)
+        out_neigh.append(row[pick])
+        if eids_np is not None:
+            out_eids.append(eids_np[pick])
+
+    neigh = (np.concatenate(out_neigh) if out_neigh
+             else np.empty(0, row.dtype))
+    res = [Tensor(neigh), Tensor(out_count)]
+    if eids_np is not None:
+        res.append(Tensor(np.concatenate(out_eids) if out_eids
+                          else np.empty(0, row.dtype)))
+    return res
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True requires eids")
+    res = _sample(row, colptr, input_nodes, sample_size,
+                  eids if return_eids else None)
+    return tuple(res) if return_eids else (res[0], res[1])
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True requires eids")
+    res = _sample(row, colptr, input_nodes, sample_size,
+                  eids if return_eids else None, weights=edge_weight)
+    return tuple(res) if return_eids else (res[0], res[1])

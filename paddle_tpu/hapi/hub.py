@@ -1,0 +1,80 @@
+"""Model hub (reference ``python/paddle/hapi/hub.py``:174,220,263).
+
+``source='local'`` loads entrypoints from a ``hubconf.py`` in a local
+directory — fully supported. Remote sources (github/gitee) require network
+egress, which this runtime does not have; they raise with a clear message
+instead of hanging on a download.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ['list', 'help', 'load']
+
+MODULE_HUBCONF = 'hubconf.py'
+VAR_DEPENDENCY = 'dependencies'
+
+
+def _import_module(name, repo_dir):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {MODULE_HUBCONF} in {repo_dir}")
+    # namespaced so a repo dir called e.g. "models" can't shadow real modules
+    name = f"paddle_tpu_hubconf.{name}"
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def _get_module(repo_dir, source, force_reload=False):
+    if source not in ('github', 'gitee', 'local'):
+        raise ValueError(
+            f'Unknown source: "{source}". Allowed values: "github" | '
+            f'"gitee" | "local".')
+    if source != 'local':
+        raise RuntimeError(
+            f'source="{source}" needs network access, which this runtime '
+            'does not have; clone the repo and use source="local".')
+    return _import_module(os.path.basename(repo_dir), repo_dir)
+
+
+def _check_dependencies(m):
+    deps = getattr(m, VAR_DEPENDENCY, None)
+    if deps:
+        missing = [p for p in deps if importlib.util.find_spec(p) is None]
+        if missing:
+            raise RuntimeError(f'Missing dependencies: {missing}')
+
+
+def _load_entry_from_hubconf(m, name):
+    if not isinstance(name, str):
+        raise ValueError('Invalid input: model should be a str of function '
+                         'name')
+    entry = getattr(m, name, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f'Cannot find callable {name} in hubconf')
+    return entry
+
+
+def list(repo_dir, source='github', force_reload=False):
+    """All public callables defined by the repo's hubconf.py."""
+    m = _get_module(repo_dir, source, force_reload)
+    return [f for f in dir(m)
+            if callable(getattr(m, f)) and not f.startswith('_')]
+
+
+def help(repo_dir, model, source='github', force_reload=False):
+    """Docstring of one hub entrypoint."""
+    m = _get_module(repo_dir, source, force_reload)
+    return _load_entry_from_hubconf(m, model).__doc__
+
+
+def load(repo_dir, model, source='github', force_reload=False, **kwargs):
+    """Instantiate a hub entrypoint: ``entry(**kwargs)``."""
+    m = _get_module(repo_dir, source, force_reload)
+    _check_dependencies(m)
+    return _load_entry_from_hubconf(m, model)(**kwargs)

@@ -1,0 +1,10 @@
+"""ONNX export surface (reference ``python/paddle/onnx/export.py``:22).
+
+The reference delegates to the external ``paddle2onnx`` package, which has
+no analog for this backend; ``export`` raises with a pointer to
+``paddle.jit.save`` (StableHLO), the portable serialized-program path here.
+"""
+from . import export as _export_mod
+from .export import export
+
+__all__ = ['export']

@@ -1,0 +1,15 @@
+"""ONNX export surface (reference ``python/paddle/onnx/export.py``:22)."""
+from __future__ import annotations
+
+__all__ = []
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export ``layer`` to ``path``.onnx — not implemented on this
+    backend; ``paddle.jit.save`` (StableHLO export) is the portable
+    serialized-program path here."""
+    raise NotImplementedError(
+        "ONNX export is not implemented for this backend (the reference "
+        "delegates to the external paddle2onnx package); use "
+        "paddle.jit.save (StableHLO) for portable serialized inference "
+        "programs.")

@@ -47,8 +47,19 @@ def _diag_indices(n, m, offset):
 
 @primitive
 def fill_diagonal(x, value, offset=0, wrap=False):
-    """Reference ``fill_diagonal_`` (out-of-place on this backend)."""
-    rows, cols = _diag_indices(x.shape[-2], x.shape[-1], offset)
+    """Reference ``fill_diagonal_`` (out-of-place on this backend). With
+    ``wrap`` the diagonal restarts every ``m+1`` rows of a tall 2-D
+    matrix."""
+    n, m = x.shape[-2], x.shape[-1]
+    if wrap and x.ndim == 2 and n > m:
+        rs, cs = [], []
+        for block in range(0, n, m + 1):
+            r, c = _diag_indices(builtins.min(m, n - block), m, offset)
+            rs.append(r + block)
+            cs.append(c)
+        rows, cols = np.concatenate(rs), np.concatenate(cs)
+    else:
+        rows, cols = _diag_indices(n, m, offset)
     if len(rows) == 0:
         return x
     return x.at[..., rows, cols].set(jnp.asarray(value, x.dtype))
@@ -119,7 +130,7 @@ def _stackish(jfn, name):
     @primitive(name)
     def op(inputs):
         return jfn([jnp.asarray(v) for v in inputs])
-    return lambda x, name_=None: op(list(x))
+    return lambda x, name=None: op(list(x))
 
 
 hstack = _stackish(jnp.hstack, "hstack")
@@ -221,7 +232,8 @@ def vander(x, n=None, increasing=False):
 def trapezoid(y, x=None, dx=None, axis=-1):
     if x is not None:
         return jax.scipy.integrate.trapezoid(y, x=x, axis=axis)
-    return jax.scipy.integrate.trapezoid(y, dx=dx or 1.0, axis=axis)
+    return jax.scipy.integrate.trapezoid(
+        y, dx=1.0 if dx is None else dx, axis=axis)
 
 
 @primitive
@@ -229,11 +241,11 @@ def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
     axis %= y.ndim
     yl = jnp.moveaxis(y, axis, -1)
     if x is not None:
-        xv = jnp.moveaxis(jnp.broadcast_to(x, yl.shape), -1, -1) \
-            if x.ndim == y.ndim else x
+        xv = (jnp.moveaxis(jnp.broadcast_to(x, y.shape), axis, -1)
+              if x.ndim == y.ndim else x)
         d = jnp.diff(xv, axis=-1)
     else:
-        d = dx or 1.0
+        d = 1.0 if dx is None else dx
     avg = (yl[..., 1:] + yl[..., :-1]) / 2.0
     out = jnp.cumsum(avg * d, axis=-1)
     return jnp.moveaxis(out, -1, axis)

@@ -1,0 +1,215 @@
+"""Legacy reader decorators (reference ``python/paddle/reader/decorator.py``:
+45-498). A *reader creator* is a zero-arg callable returning an iterable of
+samples; these combinators compose creators. Thread-backed where the
+reference forks processes (same rationale as paddle_tpu.io: fork is hostile
+to a live PJRT client)."""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = []
+
+
+class _Raise:
+    """Exception carrier: producer threads forward errors to the consumer
+    instead of dying silently (which would truncate or hang the stream)."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def cache(reader):
+    """Cache all samples in memory on first full pass."""
+    all_data = tuple(reader())
+
+    def creator():
+        for item in all_data:
+            yield item
+    return creator
+
+
+def map_readers(func, *readers):
+    """Zip readers, map ``func`` over the per-reader samples."""
+    def creator():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return creator
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle with a ``buf_size`` reservoir."""
+    def creator():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return creator
+
+
+def chain(*readers):
+    """Concatenate readers back to back."""
+    def creator():
+        for r in readers:
+            yield from r()
+    return creator
+
+
+class ComposeNotAligned(ValueError):
+    """Raised by ``compose(check_alignment=True)`` when readers end at
+    different lengths (reference ``decorator.py`` exception of same name)."""
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flat tuples: (a, b1, b2) from [a], [(b1, b2)].
+    ``check_alignment=True`` (default) raises ComposeNotAligned if the
+    readers have different lengths; False truncates to the shortest."""
+    check_alignment = kwargs.pop('check_alignment', True)
+    _end = object()
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def creator():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+            return
+        for outputs in itertools.zip_longest(*rs, fillvalue=_end):
+            if any(o is _end for o in outputs):
+                raise ComposeNotAligned(
+                    "outputs of readers are not aligned")
+            yield sum((make_tuple(o) for o in outputs), ())
+    return creator
+
+
+def buffered(reader, size):
+    """Decouple producer/consumer through a bounded queue (thread).
+    Producer exceptions re-raise in the consumer, not die in the thread."""
+    end = object()
+
+    def creator():
+        q = _queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for d in reader():
+                    q.put(d)
+                q.put(end)
+            except BaseException as e:  # propagate to consumer
+                q.put(_Raise(e))
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is end:
+                break
+            if isinstance(e, _Raise):
+                raise e.exc
+            yield e
+    return creator
+
+
+def firstn(reader, n):
+    """Only the first ``n`` samples."""
+    def creator():
+        yield from itertools.islice(reader(), n)
+    return creator
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over samples with ``process_num`` worker threads."""
+    end = object()
+
+    def creator():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+
+        def feed():
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+                for _ in range(process_num):
+                    in_q.put(end)
+            except BaseException as e:
+                out_q.put(_Raise(e))
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is end:
+                        out_q.put(end)
+                        return
+                    i, sample = item
+                    out_q.put((i, mapper(sample)))
+            except BaseException as e:  # a dead worker must not hang the pipe
+                out_q.put(_Raise(e))
+                out_q.put(end)
+
+        threads = [threading.Thread(target=feed, daemon=True)] + [
+            threading.Thread(target=work, daemon=True)
+            for _ in range(process_num)]
+        for t in threads:
+            t.start()
+
+        finished, hold, want = 0, {}, 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            if isinstance(item, _Raise):
+                raise item.exc
+            i, mapped = item
+            if not order:
+                yield mapped
+            else:
+                hold[i] = mapped
+                while want in hold:
+                    yield hold.pop(want)
+                    want += 1
+        if order:
+            for i in sorted(hold):
+                yield hold[i]
+    return creator
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave readers concurrently (thread-backed on this runtime)."""
+    end = object()
+
+    def creator():
+        q = _queue.Queue(queue_size)
+
+        def run(r):
+            try:
+                for d in r():
+                    q.put(d)
+                q.put(end)
+            except BaseException as e:
+                q.put(_Raise(e))
+
+        for r in readers:
+            threading.Thread(target=run, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            e = q.get()
+            if e is end:
+                finished += 1
+            elif isinstance(e, _Raise):
+                raise e.exc
+            else:
+                yield e
+    return creator

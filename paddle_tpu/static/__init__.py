@@ -10,6 +10,7 @@ Program/Executor shims that delegate to the dynamic engine.
 """
 from __future__ import annotations
 
+import contextlib
 import numpy as np
 
 from ..core.dtype import convert_dtype
@@ -91,6 +92,21 @@ def default_startup_program():
     return _default_startup
 
 
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    """Reference ``base/framework.py program_guard``: swap the default
+    programs for the with-block."""
+    global _default_main, _default_startup
+    old_main, old_startup = _default_main, _default_startup
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    try:
+        yield
+    finally:
+        _default_main, _default_startup = old_main, old_startup
+
+
 class Executor:
     """Reference ``executor.py:1162`` surface. Runs inference programs
     loaded by ``load_inference_model``; ``run`` on the default (empty)
@@ -169,5 +185,5 @@ __all__ = [
     "InputSpec", "Program", "Executor", "data", "default_main_program",
     "default_startup_program", "save_inference_model",
     "load_inference_model", "scope_guard", "global_scope",
-    "CompiledProgram",
+    "CompiledProgram", "program_guard",
 ]

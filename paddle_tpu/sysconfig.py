@@ -1,0 +1,22 @@
+"""Install-tree introspection (reference ``python/paddle/sysconfig.py``:20,39).
+
+Points at this package's native runtime artifacts (the C++ IO runtime and
+custom-op toolchain build outputs live under ``paddle_tpu/lib``/``include``).
+"""
+import os
+
+__all__ = ['get_include', 'get_lib']
+
+
+def get_include():
+    """Directory containing the C headers for building custom ops against
+    the framework (created on demand by the custom-op builder)."""
+    return os.path.join(os.path.dirname(__file__), 'include')
+
+
+def get_lib():
+    """Directory containing the framework's native shared libraries
+    (e.g. ``libpaddle_tpu_io.so``, the C++ data-loader runtime)."""
+    libs = os.path.join(os.path.dirname(__file__), 'lib')
+    native = os.path.join(os.path.dirname(__file__), 'io', 'native')
+    return libs if os.path.isdir(libs) else native

@@ -1,0 +1,9 @@
+"""Compat namespace: ``paddle.tensor`` (reference ``python/paddle/tensor/``).
+
+On this framework every tensor op lives in ``paddle_tpu.ops`` (and is also
+installed as a ``Tensor`` method); this module re-exports that surface under
+the reference's module path so ``paddle.tensor.foo`` call sites work.
+"""
+from ..ops import *  # noqa: F401,F403
+from ..ops import (  # noqa: F401
+    array, creation, extra, linalg, logic, manipulation, math, random)

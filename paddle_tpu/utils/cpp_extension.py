@@ -29,9 +29,14 @@ class _OpModule:
         self._lib = lib
         self.__name__ = name
 
-    def bind(self, symbol, op_impl):
+    def bind(self, symbol, op_impl, out_spec=None):
         """Register ``symbol`` with an explicit wrapper ``op_impl(lib,
-        *arrays) -> array`` as a differentiable-opaque framework op."""
+        *arrays) -> array`` as a differentiable-opaque framework op.
+
+        ``out_spec(*avals) -> ShapeDtypeStruct`` declares the output
+        contract (the InferMeta analog); default = same shape/dtype as
+        the first input (the elementwise convention).
+        """
         import jax
 
         from ..core.dispatch import apply
@@ -39,11 +44,12 @@ class _OpModule:
         lib = self._lib
 
         def op(*tensors, **kwargs):
-            from ..core.tensor import Tensor
-
             def impl(*vals):
-                ex = vals[0]
-                out_shape = jax.ShapeDtypeStruct(ex.shape, ex.dtype)
+                if out_spec is not None:
+                    out_shape = out_spec(*vals)
+                else:
+                    ex = vals[0]
+                    out_shape = jax.ShapeDtypeStruct(ex.shape, ex.dtype)
                 return jax.pure_callback(
                     lambda *a: op_impl(lib, *[np.asarray(x) for x in a]),
                     out_shape, *vals, vmap_method="sequential")

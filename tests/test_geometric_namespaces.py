@@ -1,0 +1,180 @@
+"""paddle.geometric + small compat namespaces (hub/reader/dataset/
+sysconfig/tensor/base). Reference: python/paddle/geometric/, hapi/hub.py,
+reader/decorator.py."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# --- geometric math (reference geometric/math.py docstring examples) ----
+
+def test_segment_sum_mean_min_max():
+    data = paddle.to_tensor(
+        [[1., 2., 3.], [3., 2., 1.], [4., 5., 6.]], dtype="float32")
+    ids = paddle.to_tensor([0, 0, 1], dtype="int32")
+    np.testing.assert_allclose(
+        paddle.geometric.segment_sum(data, ids).numpy(),
+        [[4., 4., 4.], [4., 5., 6.]])
+    np.testing.assert_allclose(
+        paddle.geometric.segment_mean(data, ids).numpy(),
+        [[2., 2., 2.], [4., 5., 6.]])
+    np.testing.assert_allclose(
+        paddle.geometric.segment_min(data, ids).numpy(),
+        [[1., 2., 1.], [4., 5., 6.]])
+    np.testing.assert_allclose(
+        paddle.geometric.segment_max(data, ids).numpy(),
+        [[3., 2., 3.], [4., 5., 6.]])
+
+
+def test_segment_sum_grad():
+    data = paddle.to_tensor([[1., 2.], [3., 4.], [5., 6.]])
+    data.stop_gradient = False
+    ids = paddle.to_tensor([0, 0, 1], dtype="int32")
+    out = paddle.geometric.segment_sum(data, ids)
+    out.sum().backward()
+    np.testing.assert_allclose(data.grad.numpy(), np.ones((3, 2)))
+
+
+# --- message passing (reference send_recv.py docstring example) ---------
+
+def test_send_u_recv():
+    x = paddle.to_tensor([[0, 2, 3], [1, 4, 5], [2, 6, 7]], dtype="float32")
+    src = paddle.to_tensor([0, 1, 2, 0], dtype="int32")
+    dst = paddle.to_tensor([1, 2, 1, 0], dtype="int32")
+    out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum")
+    np.testing.assert_allclose(
+        out.numpy(), [[0, 2, 3], [2, 8, 10], [1, 4, 5]])
+    out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="mean")
+    np.testing.assert_allclose(
+        out.numpy(), [[0, 2, 3], [1, 4, 5], [1, 4, 5]])
+
+
+def test_send_u_recv_out_size_and_default_rows():
+    x = paddle.to_tensor([[0, 2, 3], [1, 4, 5], [2, 6, 7]], dtype="float32")
+    src = paddle.to_tensor([0, 2, 0], dtype="int32")
+    dst = paddle.to_tensor([1, 1, 0], dtype="int32")
+    out = paddle.geometric.send_u_recv(x, src, dst, out_size=2)
+    assert out.shape[0] == 2
+    out = paddle.geometric.send_u_recv(x, src, dst)
+    np.testing.assert_allclose(out.numpy()[2], [0, 0, 0])
+
+
+def test_send_ue_recv_and_send_uv():
+    x = paddle.to_tensor([[0., 2., 3.], [1., 4., 5.], [2., 6., 7.]])
+    y = paddle.to_tensor([1., 1., 1., 1.])
+    src = paddle.to_tensor([0, 1, 2, 0], dtype="int32")
+    dst = paddle.to_tensor([1, 2, 1, 0], dtype="int32")
+    out = paddle.geometric.send_ue_recv(
+        x, y.reshape([4, 1]), src, dst, message_op="add", reduce_op="sum")
+    np.testing.assert_allclose(
+        out.numpy(), [[1, 3, 4], [4, 10, 12], [2, 5, 6]])
+    uv = paddle.geometric.send_uv(x, x, src, dst, message_op="mul")
+    np.testing.assert_allclose(uv.numpy()[0], (x.numpy()[0] * x.numpy()[1]))
+
+
+# --- reindex + sampling (reference reindex.py docstring example) --------
+
+def test_reindex_graph():
+    x = paddle.to_tensor([0, 1, 2], dtype="int64")
+    neighbors = paddle.to_tensor([8, 9, 0, 4, 7, 6, 7], dtype="int64")
+    count = paddle.to_tensor([2, 3, 2], dtype="int32")
+    src, dst, nodes = paddle.geometric.reindex_graph(x, neighbors, count)
+    np.testing.assert_array_equal(src.numpy(), [3, 4, 0, 5, 6, 7, 6])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+    np.testing.assert_array_equal(nodes.numpy(), [0, 1, 2, 8, 9, 4, 7, 6])
+
+
+def test_reindex_heter_graph():
+    x = paddle.to_tensor([0, 1, 2], dtype="int64")
+    n1 = paddle.to_tensor([8, 9, 0, 4, 7, 6, 7], dtype="int64")
+    c1 = paddle.to_tensor([2, 3, 2], dtype="int32")
+    n2 = paddle.to_tensor([0, 2, 3], dtype="int64")
+    c2 = paddle.to_tensor([1, 1, 1], dtype="int32")
+    src, dst, nodes = paddle.geometric.reindex_heter_graph(
+        x, [n1, n2], [c1, c2])
+    assert len(src.numpy()) == 10 and len(dst.numpy()) == 10
+    np.testing.assert_array_equal(nodes.numpy()[:3], [0, 1, 2])
+
+
+def test_sample_neighbors():
+    # CSC: node 0 -> [1, 2], node 1 -> [0], node 2 -> [0, 1]
+    row = paddle.to_tensor([1, 2, 0, 0, 1], dtype="int64")
+    colptr = paddle.to_tensor([0, 2, 3, 5], dtype="int64")
+    nodes = paddle.to_tensor([0, 2], dtype="int64")
+    paddle.seed(7)
+    neigh, cnt = paddle.geometric.sample_neighbors(row, colptr, nodes,
+                                                   sample_size=1)
+    assert cnt.numpy().tolist() == [1, 1]
+    assert len(neigh.numpy()) == 2
+    neigh, cnt = paddle.geometric.sample_neighbors(row, colptr, nodes)
+    assert cnt.numpy().tolist() == [2, 2]
+    w = paddle.to_tensor([0.9, 0.1, 1.0, 0.5, 0.5], dtype="float32")
+    neigh, cnt, eids = paddle.geometric.weighted_sample_neighbors(
+        row, colptr, w, nodes, sample_size=2,
+        eids=paddle.to_tensor([0, 1, 2, 3, 4], dtype="int64"),
+        return_eids=True)
+    assert len(neigh.numpy()) == int(cnt.numpy().sum())
+    assert len(eids.numpy()) == len(neigh.numpy())
+
+
+# --- small namespaces ---------------------------------------------------
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['numpy']\n"
+        "def tiny(k=3):\n"
+        "    '''a tiny entry'''\n"
+        "    return k * 2\n")
+    assert "tiny" in paddle.hub.list(str(tmp_path), source="local")
+    assert "tiny entry" in paddle.hub.help(str(tmp_path), "tiny",
+                                           source="local")
+    assert paddle.hub.load(str(tmp_path), "tiny", source="local", k=5) == 10
+    with pytest.raises(RuntimeError):
+        paddle.hub.load(str(tmp_path), "missing", source="local")
+    with pytest.raises(RuntimeError):
+        paddle.hub.list("owner/repo", source="github")
+
+
+def test_reader_decorators():
+    def r():
+        yield from range(10)
+
+    assert list(paddle.reader.firstn(r, 4)()) == [0, 1, 2, 3]
+    assert list(paddle.reader.cache(r)()) == list(range(10))
+    assert sorted(paddle.reader.shuffle(r, 5)()) == list(range(10))
+    assert list(paddle.reader.chain(r, r)()) == list(range(10)) * 2
+    m = paddle.reader.map_readers(lambda a, b: a + b, r, r)
+    assert list(m()) == [2 * i for i in range(10)]
+    assert list(paddle.reader.buffered(r, 3)()) == list(range(10))
+    x = paddle.reader.xmap_readers(lambda v: v * v, r, 3, 4, order=True)
+    assert list(x()) == [i * i for i in range(10)]
+    c = paddle.reader.compose(r, r)
+    assert list(c())[0] == (0, 0)
+    mp = paddle.reader.multiprocess_reader([r, r])
+    assert sorted(mp()) == sorted(list(range(10)) * 2)
+
+
+def test_sysconfig_and_namespaces():
+    assert isinstance(paddle.sysconfig.get_include(), str)
+    assert os.path.isdir(paddle.sysconfig.get_lib())
+    assert paddle.tensor.concat is not None
+    assert paddle.base.Program is paddle.static.Program
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        assert paddle.static.default_main_program() is prog
+    with pytest.raises(NotImplementedError):
+        paddle.onnx.export(None, "x")
+
+
+def test_dataset_common_gating(tmp_path):
+    f = tmp_path / "blob.bin"
+    f.write_bytes(b"hello")
+    md5 = paddle.dataset.common.md5file(str(f))
+    assert len(md5) == 32
+    with pytest.raises(FileNotFoundError):
+        paddle.dataset.common.download("http://x/y.gz", "nope", "0" * 32)
+    with pytest.raises((FileNotFoundError, RuntimeError)):
+        next(paddle.dataset.mnist.train()())
