@@ -1,1 +1,5 @@
-from .api import save_state_dict, load_state_dict  # noqa: F401
+from .api import (  # noqa: F401
+    save_state_dict, load_state_dict, get_checkpoint_files)
+from .metadata import (  # noqa: F401
+    LocalTensorIndex, LocalTensorMetadata, Metadata)
+from . import api  # noqa: F401

@@ -1,17 +1,19 @@
-"""Distributed checkpoint with cross-topology reshard on load.
+"""Distributed checkpoint: per-shard files + manifest, reshard on load.
 
 Capability analog of ``python/paddle/distributed/checkpoint/
-save_state_dict.py:104`` / ``load_state_dict.py:377`` (SURVEY D23). The
-reference writes one shard-file per rank plus a metadata manifest and
-reassembles/reshards on load. Single-controller TPU: the controller sees
-the global value of every dist tensor, so the checkpoint holds global
-arrays plus each tensor's sharding metadata; loading into a *different*
-mesh topology is a ``device_put`` onto the new sharding — XLA moves the
-bytes (the reference's cross-topology reshard engine collapses into that).
+save_state_dict.py:104`` / ``load_state_dict.py:377`` (SURVEY D23). Like
+the reference, a checkpoint directory holds one data file per process
+(``{rank}_0.distcp.npz``) containing only that process's *unique* shards
+(replicas deduped by ``replica_id == 0``, the reference's ``dedup_tensor``),
+plus a ``metadata`` manifest mapping every (tensor, global_offset) shard to
+its file.
 
-For multi-host pods the same layout works per-process via
-``jax.experimental.multihost_utils`` gather; orbax-style per-shard zarr is
-a future optimization, not a semantic change.
+Loading reassembles exactly the shards overlapping each destination
+tensor and places the result onto the destination's *current* sharding
+(``device_put`` — XLA moves the bytes), so a checkpoint saved on one
+mesh topology restores onto any other: the reference's cross-topology
+reshard engine (``get_read_items``/``compute_overlap``) collapses into
+shard-gather + device_put under the single-controller model.
 """
 from __future__ import annotations
 
@@ -20,78 +22,164 @@ import pickle
 
 import jax
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...core.tensor import Tensor
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 
-_META = "meta.pkl"
-_DATA = "data.npz"
+_MANIFEST = "metadata"
 
 
-def _spec_to_meta(dist):
-    if dist is None:
-        return None
-    mesh, spec = dist
-    from ..auto_parallel.api import _to_partition_spec
-    if hasattr(mesh, "jmesh"):  # ProcessMesh
-        names = list(mesh.dim_names)
-        shape = list(mesh.shape)
-    else:  # raw jax Mesh
-        names = list(mesh.axis_names)
-        shape = [mesh.shape[n] for n in names]
-    if not isinstance(spec, P) and isinstance(spec, (list, tuple)):
-        spec = _to_partition_spec(mesh, spec)
-    entries = []
-    if isinstance(spec, P):
-        for e in spec:
-            if e is None:
-                entries.append(None)
-            elif isinstance(e, tuple):
-                entries.append(list(e))
-            else:
-                entries.append([e])
-    return {"axis_names": names, "mesh_shape": shape, "spec": entries}
+def _manifest_file(rank: int) -> str:
+    return _MANIFEST if rank == 0 else f"{_MANIFEST}.{rank}"
+
+
+def _data_file(rank: int) -> str:
+    return f"{rank}_0.distcp.npz"
+
+
+def _shard_key(key: str, offset) -> str:
+    return key + "|" + ",".join(str(int(o)) for o in offset)
+
+
+def _offsets_of(index, shape):
+    """Global offset tuple from a jax shard ``index`` (tuple of slices)."""
+    if index is None:
+        return (0,) * len(shape)
+    return tuple((s.start or 0) for s in index)
 
 
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, **kwargs):
-    """Reference ``save_state_dict.py:104``."""
+    """Reference ``save_state_dict.py:104``: write this process's unique
+    shards + the manifest. Works for replicated, fully-sharded, and
+    hybrid placements."""
     os.makedirs(path, exist_ok=True)
-    arrays, meta = {}, {}
-    for k, v in state_dict.items():
-        if isinstance(v, Tensor):
-            val = v._read()
-            arrays[k] = np.asarray(val)
-            meta[k] = _spec_to_meta(v._dist)
+    rank = jax.process_index()
+    meta = Metadata()
+    arrays = {}
+
+    for key, v in state_dict.items():
+        val = v._read() if isinstance(v, Tensor) else v
+        if isinstance(val, jax.Array) and len(val.sharding.device_set) > 1:
+            shards = [s for s in val.addressable_shards
+                      if s.replica_id == 0]  # dedup replicas
+            gshape = tuple(val.shape)
+            seen = set()
+            for s in shards:
+                off = _offsets_of(s.index, gshape)
+                if off in seen:  # same block from another device
+                    continue
+                seen.add(off)
+                block = np.asarray(s.data)
+                arrays[_shard_key(key, off)] = block
+                lm = LocalTensorMetadata(off, tuple(block.shape),
+                                         str(block.dtype))
+                meta.state_dict_metadata.setdefault(key, []).append(lm)
+                meta.storage_metadata[LocalTensorIndex(key, off)] = \
+                    _data_file(rank)
+            meta.global_shapes[key] = gshape
         else:
-            arrays[k] = np.asarray(v)
-            meta[k] = None
-    np.savez(os.path.join(path, _DATA), **arrays)
-    with open(os.path.join(path, _META), "wb") as f:
+            block = np.asarray(val)
+            off = (0,) * block.ndim
+            arrays[_shard_key(key, off)] = block
+            meta.state_dict_metadata[key] = [
+                LocalTensorMetadata(off, tuple(block.shape),
+                                    str(block.dtype))]
+            meta.storage_metadata[LocalTensorIndex(key, off)] = \
+                _data_file(rank)
+            meta.global_shapes[key] = tuple(block.shape)
+
+    np.savez(os.path.join(path, _data_file(rank)), **arrays)
+    # every process writes its own manifest piece — addressable_shards is
+    # per-process, so on a multi-host pod no single rank sees every shard;
+    # load merges all pieces (the reference's merge_state_dict_metadata)
+    with open(os.path.join(path, _manifest_file(rank)), "wb") as f:
         pickle.dump(meta, f)
+
+
+def _read_manifest(path) -> Metadata:
+    """Merge every rank's manifest piece (reference
+    ``save_state_dict.py:50`` merge_state_dict_metadata)."""
+    pieces = sorted(f for f in os.listdir(path)
+                    if f == _MANIFEST or f.startswith(_MANIFEST + "."))
+    if not pieces:
+        raise FileNotFoundError(f"no checkpoint manifest under {path}")
+    merged = Metadata()
+    for fname in pieces:
+        with open(os.path.join(path, fname), "rb") as f:
+            meta = pickle.load(f)
+        for key, lms in meta.state_dict_metadata.items():
+            have = merged.state_dict_metadata.setdefault(key, [])
+            seen = {lm.global_offset for lm in have}
+            have.extend(lm for lm in lms if lm.global_offset not in seen)
+        for idx, fn in meta.storage_metadata.items():
+            merged.storage_metadata.setdefault(idx, fn)
+        merged.global_shapes.update(meta.global_shapes)
+    return merged
+
+
+def _load_file(path, fname, cache):
+    if fname not in cache:
+        fp = os.path.join(path, fname)
+        if not os.path.exists(fp):
+            raise FileNotFoundError(
+                f"checkpoint shard file {fp} missing (saved from more "
+                "processes than are loading? copy all shard files)")
+        cache[fname] = np.load(fp)
+    return cache[fname]
+
+
+def _assemble(meta: Metadata, path, key, cache):
+    """Gather every shard of ``key`` into the global ndarray."""
+    if key not in meta.state_dict_metadata:
+        raise KeyError(f"checkpoint has no tensor '{key}'")
+    gshape = meta.global_shapes[key]
+    shards = meta.state_dict_metadata[key]
+    if len(shards) == 1 and tuple(shards[0].local_shape) == tuple(gshape):
+        fname = meta.storage_metadata[
+            LocalTensorIndex(key, shards[0].global_offset)]
+        return _load_file(path, fname, cache)[
+            _shard_key(key, shards[0].global_offset)]
+    out = np.empty(gshape, dtype=shards[0].dtype)
+    for lm in shards:
+        fname = meta.storage_metadata[
+            LocalTensorIndex(key, lm.global_offset)]
+        block = _load_file(path, fname, cache)[
+            _shard_key(key, lm.global_offset)]
+        sl = tuple(slice(o, o + s)
+                   for o, s in zip(lm.global_offset, lm.local_shape))
+        out[sl] = block
+    return out
 
 
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, **kwargs):
-    """Reference ``load_state_dict.py:377``: fills ``state_dict``'s tensors
+    """Reference ``load_state_dict.py:377``: fill ``state_dict``'s tensors
     in place, resharding each value onto the tensor's *current* placement
-    (cross-topology restore). Tensors in the checkpoint but not in
-    ``state_dict`` are ignored, matching the reference's partial-load."""
-    data = np.load(os.path.join(path, _DATA))
-    for k, t in state_dict.items():
-        if k not in data.files:
-            raise KeyError(f"checkpoint {path} has no tensor '{k}'")
-        arr = data[k]
+    (cross-topology restore). Keys in the checkpoint but not requested are
+    ignored (partial load, as the reference)."""
+    meta = _read_manifest(path)
+    cache = {}
+    for key, t in state_dict.items():
+        arr = _assemble(meta, path, key, cache)
         if isinstance(t, Tensor):
             cur = t._read()
             if not isinstance(cur, jax.core.Tracer):
-                # keep the destination topology's sharding
+                arr = arr.astype(cur.dtype)
                 sharding = getattr(cur, "sharding", None)
-                val = jax.device_put(arr.astype(cur.dtype), sharding) \
-                    if sharding is not None else arr.astype(cur.dtype)
+                val = (jax.device_put(arr, sharding)
+                       if sharding is not None else arr)
                 t._write(val)
             else:
                 t._write(arr)
         else:
-            state_dict[k] = arr
+            state_dict[key] = arr
     return state_dict
+
+
+def get_checkpoint_files(path):
+    """Reference ``load_state_dict.py:43``: (metadata files, data files)."""
+    files = os.listdir(path)
+    return (sorted(f for f in files
+                   if f == _MANIFEST or f.startswith(_MANIFEST + ".")),
+            sorted(f for f in files if f.endswith(".distcp.npz")))

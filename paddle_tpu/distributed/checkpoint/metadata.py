@@ -1,0 +1,40 @@
+"""Checkpoint manifest types (reference
+``python/paddle/distributed/checkpoint/metadata.py``:20,30,40).
+
+A checkpoint directory holds N shard data files plus one ``metadata``
+manifest. The manifest records, per tensor key, where every local shard
+sits in the global tensor (``LocalTensorMetadata``) and which file stores
+it (``storage_metadata``, keyed by ``LocalTensorIndex``).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+
+@dataclass(frozen=True)
+class LocalTensorMetadata:
+    """Placement of one local shard inside its global tensor."""
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str = "float32"
+
+
+@dataclass(frozen=True)
+class LocalTensorIndex:
+    """Identity of one local shard (tensor key + offset)."""
+    tensor_key: str
+    global_offset: Tuple[int, ...]
+
+
+@dataclass
+class Metadata:
+    # tensor key -> every shard's placement
+    state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = field(
+        default_factory=dict)
+    # shard identity -> data file holding it
+    storage_metadata: Dict[LocalTensorIndex, str] = field(
+        default_factory=dict)
+    # tensor key -> global shape (reassembly target)
+    global_shapes: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+    flat_mapping: Dict[str, Tuple[str, ...]] = field(default_factory=dict)

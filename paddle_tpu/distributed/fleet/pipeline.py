@@ -22,6 +22,16 @@ whole pipeline is ONE SPMD program:
 Bubble fraction is the textbook ``(pp-1)/(M+pp-1)`` — raise
 ``num_microbatches`` to amortize, exactly as with the reference's GPipe
 mode.
+
+Three schedules, matching the reference's set (D15):
+
+- ``forward()`` (default) — FThenB/GPipe via scan + transpose;
+- ``forward()`` with ``interleave=v > 1`` — interleaved virtual pipeline
+  (reference ``pipeline_parallel.py:912``): stages hold v round-robin
+  chunks, microbatches make v ppermute laps, bubble time shrinks by v;
+- ``train_batch()`` — fused 1F1B (reference ``:663``): forward and
+  backward micro-steps interleaved in ONE program with an O(pp) residual
+  ring instead of O(M) saved activations.
 """
 from __future__ import annotations
 
@@ -99,27 +109,59 @@ class PipelinedBlocks(Layer):
 
     def __init__(self, block_factory: Callable[[], Layer], num_layers: int,
                  mesh=None, pp_axis: str = "pp", num_microbatches: int = 1,
-                 remat: bool = True):
+                 remat: bool = True, interleave: int = 1):
         super().__init__()
         self.num_layers = num_layers
         self.pp_axis = pp_axis
         self.num_microbatches = num_microbatches
         self.remat = remat
+        self.interleave = int(interleave)
         self._mesh = None
         self.template = block_factory()
         if any(True for _ in self.template.named_buffers()):
             raise ValueError("PipelinedBlocks: blocks must be buffer-free "
                              "(running stats can't thread the pipeline)")
+        if self.interleave > 1 and mesh is None:
+            raise ValueError("interleave > 1 needs the mesh at construction "
+                             "(chunk assignment depends on the pp size)")
+        # storage order: identity for v=1; round-robin chunks for VPP so a
+        # CONTIGUOUS Shard(0) gives stage i its v chunks (layer (c*pp+i)*Lc+k
+        # at storage slot i*Lp + c*Lc + k) — the reference's interleaved
+        # stage->layers map (pipeline_parallel.py:912 virtual pipeline)
+        self.layer_order = np.arange(num_layers)
+        if self.interleave > 1:
+            pp = self._pp_size(mesh, pp_axis)
+            v = self.interleave
+            if num_layers % (v * pp):
+                raise ValueError(f"num_layers {num_layers} not divisible by "
+                                 f"interleave*pp = {v}*{pp}")
+            lc = num_layers // (v * pp)
+            self.layer_order = np.asarray(
+                [(c * pp + i) * lc + k
+                 for i in range(pp) for c in range(v) for k in range(lc)])
         # stack L independent initializations leaf-wise -> [L, *shape]
         inits = [self.template] + [block_factory()
                                    for _ in range(num_layers - 1)]
         self._names = [n for n, _ in self.template.named_parameters()]
         for n in self._names:
             leaves = [dict(b.named_parameters())[n]._read() for b in inits]
+            leaves = [leaves[j] for j in self.layer_order]
             stacked = Tensor(jnp.stack(leaves, axis=0), stop_gradient=False)
             self.add_parameter(self._mangle(n), _as_param(stacked))
         if mesh is not None:
             self.shard(mesh, pp_axis)
+
+    @staticmethod
+    def _pp_size(mesh, pp_axis):
+        jmesh = getattr(mesh, "jmesh", mesh)
+        return dict(zip(jmesh.axis_names, jmesh.devices.shape))[pp_axis]
+
+    def layer_values(self, name: str):
+        """Per-layer values of a stacked leaf in ORIGINAL layer order
+        (undoes the VPP storage permutation)."""
+        vals = self.stacked_parameter(name)._read()
+        inv = np.argsort(self.layer_order)
+        return [vals[int(j)] for j in inv]
 
     @staticmethod
     def _mangle(name: str) -> str:
@@ -140,13 +182,15 @@ class PipelinedBlocks(Layer):
             shard_parameter(self.stacked_parameter(n), mesh, pl)
         return self
 
-    # -- the schedule --------------------------------------------------
+    # -- the schedules -------------------------------------------------
     def forward(self, x, batch_axes=None):
         if self._mesh is None:
             raise RuntimeError("call .shard(mesh, pp_axis) first")
+        if self.interleave > 1:
+            return self._forward_interleaved(x, batch_axes)
         mesh = self._mesh
         jmesh = getattr(mesh, "jmesh", mesh)
-        pp = dict(zip(jmesh.axis_names, jmesh.devices.shape))[self.pp_axis]
+        pp = self._pp_size(mesh, self.pp_axis)
         M = self.num_microbatches
         L, ax = self.num_layers, self.pp_axis
         if L % pp:
@@ -216,6 +260,275 @@ class PipelinedBlocks(Layer):
 
         return apply("pipelined_blocks", impl, x, *leaf_tensors)
 
+    def _forward_interleaved(self, x, batch_axes=None):
+        """Interleaved virtual pipeline (reference
+        ``pipeline_parallel.py:912`` interleaved 1F1B's stage layout,
+        ``pp_layers.py`` virtual-pipeline chunks): each stage holds
+        ``v = interleave`` round-robin layer chunks and microbatches
+        circulate the ppermute ring ``v`` laps. Per-tick work is a chunk
+        (1/v of a stage), so the fill/drain bubble time shrinks by v —
+        the VPP bubble equation (pp-1)/(vM) vs GPipe's (pp-1)/M."""
+        mesh = self._mesh
+        jmesh = getattr(mesh, "jmesh", mesh)
+        pp = self._pp_size(mesh, self.pp_axis)
+        v, M, ax = self.interleave, self.num_microbatches, self.pp_axis
+        lc = self.num_layers // (v * pp)  # layers per chunk
+        template, names, remat = self.template, self._names, self.remat
+        batch_tuple = ((batch_axes,) if isinstance(batch_axes, str)
+                       else tuple(batch_axes or ()))
+        vary_axes = (ax,) + batch_tuple
+        leaf_tensors = [self.stacked_parameter(n) for n in names]
+
+        def impl(xv, *leaves):
+            b = xv.shape[0]
+            if b % M:
+                raise ValueError(f"batch {b} not divisible by "
+                                 f"num_microbatches {M}")
+            xm = xv.reshape((M, b // M) + xv.shape[1:])
+
+            def block_apply(h, layer_leaves):
+                vals = dict(zip(names, layer_leaves))
+                return functional_call(template, vals, h), None
+
+            if remat:
+                block_apply = jax.checkpoint(block_apply)
+
+            def chunk_apply(h, lvs, c):
+                sl = [lax.dynamic_slice_in_dim(lv, c * lc, lc, axis=0)
+                      for lv in lvs]
+                y, _ = lax.scan(block_apply, h, tuple(sl))
+                return y
+
+            def local(xloc, *lvs):
+                i = lax.axis_index(ax)
+                mb_shape = xloc.shape[1:]
+                done = v * pp  # hop count meaning "finished / empty slot"
+
+                def tick(carry, t):
+                    h, hops, mbid, inj, outputs = carry
+                    at0 = i == 0
+                    finished = hops >= done
+                    # stage 0: bank a finished microbatch, inject the next
+                    rec = at0 & finished & (mbid >= 0)
+                    oc = jnp.clip(mbid, 0, M - 1)
+                    cur = lax.dynamic_index_in_dim(outputs, oc, 0,
+                                                   keepdims=False)
+                    outputs = lax.dynamic_update_index_in_dim(
+                        outputs, jnp.where(rec, h, cur), oc, 0)
+                    take = at0 & finished & (inj < M)
+                    h = jnp.where(take, xloc[jnp.clip(inj, 0, M - 1)], h)
+                    mbid = jnp.where(take, inj,
+                                     jnp.where(finished, -1, mbid))
+                    hops = jnp.where(take, 0, hops)
+                    inj = inj + take.astype(inj.dtype)
+                    # apply this stage's chunk for the current lap
+                    active = hops < done
+                    c = jnp.clip(hops // pp, 0, v - 1)
+                    y = chunk_apply(h, lvs, c)
+                    h = jnp.where(active, y, h)
+                    hops = jnp.where(active, hops + 1, hops)
+                    ring = [(r, (r + 1) % pp) for r in range(pp)]
+                    h = lax.ppermute(h, ax, ring)
+                    hops = lax.ppermute(hops, ax, ring)
+                    mbid = lax.ppermute(mbid, ax, ring)
+                    return (h, hops, mbid, inj, outputs), None
+
+                h0 = jnp.zeros(mb_shape, xloc.dtype)
+                out0 = jnp.zeros((M,) + mb_shape, xloc.dtype)
+                carry0 = _pvary(
+                    (h0, jnp.int32(done), jnp.int32(-1), jnp.int32(0),
+                     out0), vary_axes)
+                # last microbatch M-1 enters slot (M-1)%pp at tick
+                # (M-1)%pp + ((M-1)//pp)*v*pp and is banked v*pp ticks
+                # later — run exactly until then (v*M + pp only covers
+                # M a multiple of pp)
+                t_bank = ((M - 1) % pp) + ((M - 1) // pp) * v * pp + v * pp
+                carry = lax.scan(tick, carry0,
+                                 jnp.arange(t_bank + 1))[0]
+                outputs = carry[4]
+                return lax.psum(jnp.where(i == 0, outputs, 0), ax)
+
+            xspec = P(None, batch_axes, *([None] * (xv.ndim - 1)))
+            lspec = tuple(P(ax) for _ in leaves)
+            out = jax.shard_map(local, mesh=jmesh,
+                                in_specs=(xspec,) + lspec,
+                                out_specs=xspec)(xm, *leaves)
+            return out.reshape((b,) + xv.shape[1:])
+
+        return apply("pipelined_blocks_vpp", impl, x, *leaf_tensors)
+
+    def train_batch(self, x, target, loss_fn, batch_axes=None):
+        """Fused 1F1B train step (reference ``pipeline_parallel.py:663``
+        ``train_batch`` / ``forward_backward_pipeline``): ONE SPMD program
+        runs forward and backward micro-steps interleaved, holding at most
+        ``2*pp`` microbatch residuals per stage (the 1F1B memory property
+        — vs O(M) for the scan-transpose GPipe path), recomputing each
+        chunk's vjp from the saved chunk input (recompute policy).
+
+        ``loss_fn(y, target_mb) -> scalar mean loss`` runs on the last
+        stage (closed-over tensors are constants — keep the head inside
+        the blocks or tie it to ``x``'s producer). Returns the scalar mean
+        loss; ``loss.backward()`` flows grads into the stacked leaves and
+        ``x`` through the recorded vjp, so optimizers work unchanged.
+
+        Schedule: tick ``t`` runs forward of microbatch ``t - i`` and
+        backward of microbatch ``t - (2pp - 1 - i)`` on stage ``i``;
+        activations hop forward and cotangents hop backward one ppermute
+        per tick. The last stage's loss-vjp is folded into the uniform
+        per-tick vjp by differentiating ``where(is_last, loss, <y, g>)``,
+        so every tick costs exactly one chunk fwd + one chunk vjp.
+        """
+        if self._mesh is None:
+            raise RuntimeError("call .shard(mesh, pp_axis) first")
+        if self.interleave > 1:
+            raise NotImplementedError("train_batch schedules plain 1F1B; "
+                                      "use interleave=1 (VPP forward is "
+                                      "available via __call__)")
+        mesh = self._mesh
+        jmesh = getattr(mesh, "jmesh", mesh)
+        pp = self._pp_size(mesh, self.pp_axis)
+        M, ax = self.num_microbatches, self.pp_axis
+        L = self.num_layers
+        if L % pp:
+            raise ValueError(f"num_layers {L} not divisible by pp {pp}")
+        template, names = self.template, self._names
+        batch_tuple = ((batch_axes,) if isinstance(batch_axes, str)
+                       else tuple(batch_axes or ()))
+        vary_axes = (ax,) + batch_tuple
+        sizes = dict(zip(jmesh.axis_names, jmesh.devices.shape))
+        dp_n = int(np.prod([sizes[a] for a in batch_tuple])) \
+            if batch_tuple else 1
+        leaf_tensors = [self.stacked_parameter(n) for n in names]
+
+        def impl(xv, tgt, *leaves):
+            b = xv.shape[0]
+            if b % M:
+                raise ValueError(f"batch {b} not divisible by "
+                                 f"num_microbatches {M}")
+            xm = xv.reshape((M, b // M) + xv.shape[1:])
+            tm = tgt.reshape((M, b // M) + tgt.shape[1:])
+            seed = 1.0 / (M * dp_n)
+
+            def run(xmv, tmv, *lvs_in):
+                def block_apply(h, layer_leaves):
+                    vals = dict(zip(names, layer_leaves))
+                    return functional_call(template, vals, h), None
+
+                def chunk_fwd(h, lvs):
+                    y, _ = lax.scan(block_apply, h, lvs)
+                    return y
+
+                def local(xloc, tloc, *lvs):
+                    i = lax.axis_index(ax)
+                    is_last = i == pp - 1
+                    mb_shape = xloc.shape[1:]
+                    R = 2 * pp
+                    fwd_ring = [(r, (r + 1) % pp) for r in range(pp)]
+                    bwd_ring = [(r, (r - 1) % pp) for r in range(pp)]
+
+                    def objective(h, lvs, t_mb, g):
+                        """where(is_last, seed*loss, <y, g>): its (h, lvs)
+                        vjp is the loss-vjp on the last stage and the
+                        cotangent-g chunk vjp elsewhere."""
+                        y = chunk_fwd(h, lvs)
+                        loss = loss_fn(y, t_mb)
+                        obj = jnp.where(is_last, loss * seed,
+                                        jnp.vdot(y, g))
+                        return obj, loss
+
+                    def tick(carry, t):
+                        h_fwd, g_bwd, ring, dacc, loss_acc, dx = carry
+                        # ---- forward micro-step: mb u = t - i ----
+                        u = t - i
+                        uc = jnp.clip(u, 0, M - 1)
+                        h_in = jnp.where(i == 0, xloc[uc], h_fwd)
+                        # bank the chunk input; slot t%R frees before reuse
+                        ring = lax.dynamic_update_index_in_dim(
+                            ring, h_in, t % R, 0)
+                        y = chunk_fwd(h_in, lvs)
+                        h_next = lax.ppermute(y, ax, fwd_ring)
+                        # ---- backward micro-step: mb m ----
+                        m = t - (2 * pp - 1 - i)
+                        bvalid = (m >= 0) & (m < M)
+                        mc = jnp.clip(m, 0, M - 1)
+                        slot = (t - (2 * pp - 1 - 2 * i)) % R
+                        h_saved = lax.dynamic_index_in_dim(
+                            ring, slot, 0, keepdims=False)
+                        obj, vjp, loss = jax.vjp(
+                            lambda hh, ll: objective(hh, ll, tloc[mc],
+                                                     g_bwd),
+                            h_saved, lvs, has_aux=True)
+                        dh, dlvs = vjp(_pvary(jnp.ones((), obj.dtype),
+                                              vary_axes))
+                        dacc = tuple(
+                            da + jnp.where(bvalid, dl, 0)
+                            for da, dl in zip(dacc, dlvs))
+                        loss_acc = loss_acc + jnp.where(
+                            bvalid & is_last, loss, 0.0)
+                        curx = lax.dynamic_index_in_dim(dx, mc, 0,
+                                                        keepdims=False)
+                        dx = lax.dynamic_update_index_in_dim(
+                            dx, jnp.where(bvalid & (i == 0), dh, curx),
+                            mc, 0)
+                        g_next = lax.ppermute(
+                            jnp.where(bvalid, dh, jnp.zeros_like(dh)),
+                            ax, bwd_ring)
+                        return (h_next, g_next, ring, dacc, loss_acc,
+                                dx), None
+
+                    # dacc inherits pp-varying from the leaves and stays
+                    # dp-INvarying: the vjp transpose auto-psums leaf
+                    # cotangents over dp (invarying input x varying seed),
+                    # so dl already carries the cross-dp sum
+                    dacc0 = tuple(jnp.zeros_like(lv) for lv in lvs)
+                    h0, g0, ring0, loss0, dx0 = _pvary((
+                        jnp.zeros(mb_shape, xloc.dtype),
+                        jnp.zeros(mb_shape, xloc.dtype),
+                        jnp.zeros((R,) + mb_shape, xloc.dtype),
+                        jnp.zeros((), xloc.dtype),
+                        jnp.zeros((M,) + mb_shape, xloc.dtype),
+                    ), vary_axes)
+                    carry0 = (h0, g0, ring0, dacc0, loss0, dx0)
+                    carry, _ = lax.scan(tick, carry0,
+                                        jnp.arange(M + 2 * pp - 1))
+                    _, _, _, dacc, loss_acc, dx = carry
+                    # loss lives on the last stage; grads of x on stage 0
+                    loss_out = lax.psum(
+                        jnp.where(is_last, loss_acc, 0.0), ax)
+                    dx = lax.psum(jnp.where(i == 0, dx, 0.0), ax)
+                    if batch_tuple:
+                        loss_out = lax.psum(loss_out, batch_tuple)
+                    return (loss_out, dx) + tuple(dacc)
+
+                xspec = P(None, batch_axes,
+                          *([None] * (xm.ndim - 2)))
+                tspec = P(None, batch_axes,
+                          *([None] * (tm.ndim - 2)))
+                lspec = tuple(P(ax) for _ in lvs_in)
+                outs = jax.shard_map(
+                    local, mesh=jmesh,
+                    in_specs=(xspec, tspec) + lspec,
+                    out_specs=(P(), xspec) + lspec)(xmv, tmv, *lvs_in)
+                loss, dx, dls = outs[0], outs[1], outs[2:]
+                return loss / (M * dp_n), dx, dls
+
+            @jax.custom_vjp
+            def op(xmv, *lvs_in):
+                return run(xmv, tm, *lvs_in)[0]
+
+            def op_fwd(xmv, *lvs_in):
+                loss, dx, dls = run(xmv, tm, *lvs_in)
+                return loss, (dx, dls)
+
+            def op_bwd(res, g):
+                dx, dls = res  # dx already has xm's [M, b/M, ...] shape
+                return (g * dx,) + tuple(g * dl for dl in dls)
+
+            op.defvjp(op_fwd, op_bwd)
+            return op(xm, *leaves)
+
+        return apply("pipeline_1f1b", impl, x, target, *leaf_tensors)
+
 
 def _as_param(t: Tensor):
     from ...core.tensor import Parameter
@@ -247,7 +560,7 @@ class PipelineLayer(Layer):
     """
 
     def __init__(self, layers, num_stages=None, mesh=None, pp_axis="pp",
-                 num_microbatches=1, remat=True):
+                 num_microbatches=1, remat=True, interleave=1):
         super().__init__()
         descs = list(layers)
         if not descs:
@@ -265,7 +578,12 @@ class PipelineLayer(Layer):
         self.blocks = PipelinedBlocks(first.build_layer, len(descs),
                                       mesh=mesh, pp_axis=pp_axis,
                                       num_microbatches=num_microbatches,
-                                      remat=remat)
+                                      remat=remat, interleave=interleave)
 
     def forward(self, x, batch_axes=None):
         return self.blocks(x, batch_axes=batch_axes)
+
+    def train_batch(self, x, target, loss_fn, batch_axes=None):
+        """Fused 1F1B step (see ``PipelinedBlocks.train_batch``)."""
+        return self.blocks.train_batch(x, target, loss_fn,
+                                       batch_axes=batch_axes)
