@@ -29,6 +29,10 @@ from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import launch  # noqa: F401
 from . import sharding  # noqa: F401
+from . import rpc  # noqa: F401
+from . import ps  # noqa: F401
+from . import auto_tuner  # noqa: F401
+from .store import TCPStore  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 
 

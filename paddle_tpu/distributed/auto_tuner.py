@@ -1,0 +1,144 @@
+"""Parallel-config auto-tuner (SURVEY D21; reference
+``python/paddle/distributed/auto_tuner/`` — ``tuner.py:21`` AutoTuner with
+``search_once``/``add_cfg``, candidate generation ``utils.py:160``, pruning
+rules ``prune.py``).
+
+Searches (dp, mp, pp, sharding-stage, micro-batch, recompute) over an
+N-chip budget: candidates are pruned by divisibility and a bf16 HBM
+estimate, then measured — on TPU a "trial" is just timing a jit-compiled
+step on the target mesh (no multi-process relaunch needed, the launcher
+hook of the reference collapses away). Best = lowest step time.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["AutoTuner", "default_candidates", "prune"]
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(tuner_cfg: Dict) -> List[Dict]:
+    """Reference ``utils.py:160``: the dp/mp/pp/sharding/mbs/recompute
+    grid for ``num_gpus`` (chips here)."""
+    n = int(tuner_cfg["num_gpus"])
+    batch = int(tuner_cfg.get("global_batch_size", 1))
+    cands = []
+    for dp, mp, pp in itertools.product(_divisors(n), repeat=3):
+        if dp * mp * pp != n:
+            continue
+        for stage in tuner_cfg.get("sharding_stage", [0]):
+            for mbs in _divisors(max(batch // dp, 1)):
+                for rc in tuner_cfg.get("use_recompute", [False]):
+                    cands.append({
+                        "dp_degree": dp, "mp_degree": mp,
+                        "pp_degree": pp, "sharding_stage": stage,
+                        "micro_batch_size": mbs, "use_recompute": rc,
+                    })
+    return cands
+
+
+def prune(tuner_cfg: Dict, cur_cfg: Dict) -> Optional[str]:
+    """Divisibility + memory pruning (reference ``prune.py`` rules
+    collapsed). Returns the prune reason, or None to keep."""
+    n = int(tuner_cfg["num_gpus"])
+    dp, mp, pp = (cur_cfg["dp_degree"], cur_cfg["mp_degree"],
+                  cur_cfg["pp_degree"])
+    if dp * mp * pp != n:
+        return "num_gpus"
+    hidden = tuner_cfg.get("hidden_size")
+    if hidden and hidden % mp:
+        return "mp"  # prune_by_mp: heads/hidden must divide
+    heads = tuner_cfg.get("num_attention_heads")
+    if heads and heads % mp:
+        return "mp"
+    layers = tuner_cfg.get("num_layers")
+    if layers and layers % pp:
+        return "pp"  # prune_by_pp
+    batch = tuner_cfg.get("global_batch_size")
+    if batch:
+        local = batch // dp
+        if batch % dp or local % cur_cfg["micro_batch_size"]:
+            return "mbs"  # prune_by_mbs
+    limit = tuner_cfg.get("max_mem_usage")  # bytes per chip
+    if limit and hidden and layers:
+        vocab = tuner_cfg.get("vocab_size", 0)
+        params = (12 * layers * hidden * hidden + vocab * hidden)
+        # model params split over mp*pp; optimizer states additionally
+        # split over dp when sharding (ZeRO) is on
+        shard = dp if cur_cfg["sharding_stage"] else 1
+        # bf16 weights + fp32 master+moments on the optimizer shard
+        per_chip = params * (2 + 12 / max(shard, 1)) / (mp * pp)
+        if per_chip > limit:
+            return "mem_estimation"  # prune_by_memory_estimation
+    return None
+
+
+class AutoTuner:
+    """Reference ``tuner.py:21``: iterate candidate configs, record
+    metrics, report the best. ``tune(run_fn)`` drives the whole loop;
+    ``search_once``/``add_cfg`` expose the reference's incremental API.
+    """
+
+    def __init__(self, tuner_cfg: Dict):
+        self.tuner_cfg = dict(tuner_cfg)
+        self.metric = tuner_cfg.get("metric_cfg", {}).get(
+            "name", "step_time")
+        self.history: List[Dict] = []
+        self.pruned: List[Dict] = []
+        self._queue = []
+        for cfg in default_candidates(self.tuner_cfg):
+            reason = prune(self.tuner_cfg, cfg)
+            if reason is None:
+                self._queue.append(cfg)
+            else:
+                self.pruned.append({**cfg, "pruned_by": reason})
+        self._cur = 0
+
+    @property
+    def search_space_size(self):
+        return len(self._queue)
+
+    def search_once(self) -> Optional[Dict]:
+        """Next un-measured candidate, or None when exhausted."""
+        if self._cur >= len(self._queue):
+            return None
+        cfg = self._queue[self._cur]
+        self._cur += 1
+        return dict(cfg)
+
+    def add_cfg(self, cfg: Dict):
+        """Record a measured config (must carry the metric key or
+        ``error``)."""
+        self.history.append(dict(cfg))
+
+    def best_cfg(self) -> Optional[Dict]:
+        ok = [h for h in self.history
+              if h.get(self.metric) is not None and "error" not in h]
+        return min(ok, key=lambda h: h[self.metric]) if ok else None
+
+    def tune(self, run_fn: Callable[[Dict], float],
+             warmup: int = 1, iters: int = 3) -> Optional[Dict]:
+        """Measure every candidate with ``run_fn(cfg) -> step_fn`` (or a
+        directly-measured float). Failed trials are recorded, not fatal
+        (the reference marks OOM/error runs and continues)."""
+        while (cfg := self.search_once()) is not None:
+            try:
+                out = run_fn(cfg)
+                if callable(out):
+                    for _ in range(warmup):
+                        out()
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        out()
+                    cfg[self.metric] = (time.perf_counter() - t0) / iters
+                else:
+                    cfg[self.metric] = float(out)
+            except Exception as e:  # config infeasible — keep searching
+                cfg["error"] = f"{type(e).__name__}: {e}"
+            self.add_cfg(cfg)
+        return self.best_cfg()

@@ -8,7 +8,8 @@ from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 from .fleet import (  # noqa: F401
     init, DistributedStrategy, distributed_model, distributed_optimizer,
     get_hybrid_communicate_group, set_hybrid_communicate_group,
-    worker_index, worker_num,
+    worker_index, worker_num, is_server, is_worker, server_num,
+    server_endpoints, run_server, init_worker, barrier_worker, stop_worker,
 )
 from . import layers  # noqa: F401
 from .layers.mpu import (  # noqa: F401

@@ -48,12 +48,58 @@ class DistributedStrategy:
         self.find_unused_parameters = False
 
 
+class _PsRole:
+    """PS-mode role state from the reference env contract
+    (``fleet/base/role_maker.py:854-909``): ``TRAINING_ROLE`` =
+    PSERVER | TRAINER, ``PADDLE_PSERVERS_IP_PORT_LIST``,
+    ``PADDLE_TRAINERS_NUM``, ``PADDLE_TRAINER_ID``/``PADDLE_PORT``."""
+
+    def __init__(self):
+        import os
+        self.role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self.server_endpoints = [e for e in eps.split(",") if e]
+        self.n_workers = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        self.worker_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self.port = os.environ.get("PADDLE_PORT")
+        self.pod_ip = os.environ.get("POD_IP")
+        sid = os.environ.get("PADDLE_PSERVER_ID")
+        self.server_id = None if sid is None else int(sid)
+        self.server = None
+        self.client = None
+
+    def my_server_endpoint(self):
+        """This pserver's own endpoint (reference role_maker derives it
+        from POD_IP + PADDLE_PORT; PADDLE_PSERVER_ID also works here)."""
+        if self.server_id is not None:
+            return self.server_endpoints[self.server_id]
+        if self.pod_ip and self.port:
+            want = f"{self.pod_ip}:{self.port}"
+            if want in self.server_endpoints:
+                return want
+        if self.port:
+            return f"0.0.0.0:{self.port}"
+        if len(self.server_endpoints) == 1:
+            return self.server_endpoints[0]
+        raise RuntimeError(
+            "cannot identify this pserver among "
+            f"{self.server_endpoints}: set PADDLE_PSERVER_ID or "
+            "POD_IP + PADDLE_PORT")
+
+
+_ps_role: Optional[_PsRole] = None
+
+
 def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
-    """Reference ``fleet.py:167`` fleet.init."""
-    global _hcg, _strategy
+    """Reference ``fleet.py:167`` fleet.init. ``is_collective=False``
+    enters PS mode and reads the role env contract."""
+    global _hcg, _strategy, _ps_role
     strategy = strategy or DistributedStrategy()
-    cfg = strategy.hybrid_configs
     _strategy = strategy
+    if not is_collective:
+        _ps_role = _PsRole()
+        return _ps_role
+    cfg = strategy.hybrid_configs
     _hcg = HybridCommunicateGroup(
         dp_degree=cfg.get("dp_degree", 1),
         mp_degree=cfg.get("mp_degree", 1),
@@ -75,11 +121,71 @@ def set_hybrid_communicate_group(hcg):
 
 
 def worker_index():
-    return 0
+    return _ps_role.worker_id if _ps_role is not None else 0
 
 
 def worker_num():
-    return len(jax.devices())
+    return (_ps_role.n_workers if _ps_role is not None
+            else len(jax.devices()))
+
+
+# -- PS-mode role flow (reference fleet.is_server/run_server/init_worker) --
+
+def _require_ps():
+    if _ps_role is None:
+        raise RuntimeError("PS mode: call fleet.init(is_collective=False) "
+                           "with the TRAINING_ROLE env contract first")
+    return _ps_role
+
+
+def is_server():
+    return _require_ps().role == "PSERVER"
+
+
+def is_worker():
+    return _require_ps().role == "TRAINER"
+
+
+def server_num():
+    return len(_require_ps().server_endpoints)
+
+
+def server_endpoints():
+    return list(_require_ps().server_endpoints)
+
+
+def run_server(sync=False):
+    """Host this node's PS shard; blocks until a worker sends stop
+    (reference fleet.run_server)."""
+    role = _require_ps()
+    from ..ps import PsServer
+    role.server = PsServer(role.my_server_endpoint(),
+                           n_workers=role.n_workers, sync=sync)
+    role.server.run()
+
+
+def init_worker():
+    """Connect this trainer to every PS node (reference
+    fleet.init_worker)."""
+    role = _require_ps()
+    from ..ps import PsClient
+    role.client = PsClient(role.server_endpoints)
+    return role.client
+
+
+def barrier_worker():
+    role = _require_ps()
+    if role.client is not None:
+        role.client.barrier("worker_barrier", role.n_workers)
+
+
+def stop_worker():
+    """Last worker out stops the servers (reference fleet.stop_worker)."""
+    role = _require_ps()
+    if role.client is not None:
+        role.client.stop_servers()
+        role.client.close()
+        role.client = None
 
 
 class HybridParallelModel(Layer):

@@ -1,0 +1,29 @@
+"""Parameter-server mode (SURVEY D9/D24/C26; reference
+``paddle/fluid/distributed/ps/`` brpc PS + ``python/paddle/distributed/ps/``
++ fleet PS role flow ``fleet/base/role_maker.py:854-909``).
+
+The reference's PS is a brpc service hosting dense and sparse tables with
+server-side optimizers ("accessors"), pulled/pushed by CPU trainers — the
+sparse-embedding path is the reason PS exists (tables too big for any one
+worker). This TPU-native build keeps that capability with a threaded TCP
+server per PS node (same framed-pickle wire as ``distributed.store``),
+sparse rows sharded ``id % n_servers`` across server nodes:
+
+- dense tables:   whole-table pull / grad push, server-side SGD/Adam/sum;
+- sparse tables:  row pull by id (lazy-init), row-grad push, per-row
+                  Adam/SGD state on the server;
+- sync mode:      the server folds ``n_workers`` pushes into one update
+                  and bumps the table version; workers pull-by-version
+                  (the reference's sync a_sync=False semantics);
+- async mode:     every push applies immediately (a_sync=True, default).
+
+Worker-side surface: ``SparseEmbedding`` (the distributed lookup-table
+layer), ``PSOptimizer`` (push grads / pull fresh params around an eager
+step), and the fleet role flow (``fleet.init(is_collective=False)``,
+``is_server/run_server/init_worker/stop_worker``).
+"""
+from .service import PsClient, PsServer
+from .layers import SparseEmbedding
+from .optimizer import PSOptimizer
+
+__all__ = ["PsServer", "PsClient", "SparseEmbedding", "PSOptimizer"]

@@ -1,0 +1,58 @@
+"""Worker-side PS layers: the distributed lookup table (reference
+``paddle.static.nn.sparse_embedding`` / ``ps/table/memory_sparse_table`` —
+embeddings too large for any single worker)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.dispatch import apply, unwrap
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+
+
+class SparseEmbedding(Layer):
+    """Embedding whose rows live on the parameter servers.
+
+    Forward pulls the batch's unique rows into a local leaf tensor and
+    gathers from it, so autograd produces a dense grad for exactly those
+    rows; ``PSOptimizer.step`` pushes the row grads back and the server
+    applies its accessor rule. Eager-mode by design — the pull is a host
+    round-trip, the PS workflow of the reference's CPU trainers (SURVEY
+    C26); keep TPU-resident embeddings on the GSPMD path instead.
+    """
+
+    def __init__(self, client, name, size, rule="adam", lr=0.01, seed=0):
+        super().__init__()
+        self.client = client
+        self.table = name
+        self.num_embeddings, self.embedding_dim = size
+        client.create_sparse_table(name, self.embedding_dim, rule=rule,
+                                   lr=lr, seed=seed)
+        self._pending = []  # (unique ids, rows leaf) awaiting grad push
+
+    def forward(self, ids):
+        from ...core import state
+        idv = np.asarray(unwrap(ids)).reshape(-1)
+        uniq, inv = np.unique(idv, return_inverse=True)
+        train = state.is_grad_enabled() and self.training
+        rows = Tensor(self.client.pull_sparse(self.table, uniq),
+                      stop_gradient=not train)
+        if train:  # eval/no-grad pulls need no push-back bookkeeping
+            self._pending.append((uniq, rows))
+
+        import jax.numpy as jnp
+
+        def gather(rv):
+            out = jnp.take(rv, jnp.asarray(inv), axis=0)
+            return out.reshape(tuple(np.shape(unwrap(ids)))
+                               + (self.embedding_dim,))
+
+        return apply("ps_sparse_embedding", gather, rows)
+
+    def push_gradients(self):
+        """Push accumulated row grads (called by PSOptimizer.step)."""
+        for uniq, rows in self._pending:
+            if rows.grad is not None:
+                self.client.push_sparse(self.table, uniq,
+                                        np.asarray(rows.grad._read()))
+        self._pending.clear()

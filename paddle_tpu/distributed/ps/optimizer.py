@@ -1,0 +1,56 @@
+"""PS-mode optimizer: push grads, pull fresh params (reference
+``fleet/meta_optimizers/ps_optimizer.py`` + the async communicator
+``ps/service/communicator/`` collapsed into explicit push/pull)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from .layers import SparseEmbedding
+
+
+class PSOptimizer:
+    """Server-side optimization for dense params + sparse tables.
+
+    ``step()`` pushes every parameter's grad to its dense table and every
+    ``SparseEmbedding``'s row grads to its sparse table, then pulls the
+    updated dense values back into the local tensors. In sync mode the
+    pull waits for the post-update table version, giving the reference's
+    synchronous semantics; async mode (a_sync) pulls whatever is newest.
+    """
+
+    def __init__(self, client, parameters=None, layers=None, rule="sgd",
+                 lr=0.01, sync=False, prefix="param"):
+        self.client = client
+        self.sync = sync
+        self._params = []
+        self._embeddings = []
+        params = list(parameters or [])
+        if layers is not None:
+            for sub in layers.sublayers(include_self=True):
+                if isinstance(sub, SparseEmbedding):
+                    self._embeddings.append(sub)
+            params = params or list(layers.parameters())
+        for i, p in enumerate(params):
+            name = f"{prefix}/{i}"
+            client.create_dense_table(name, tuple(p.shape), rule=rule,
+                                      lr=lr)
+            client.init_dense(name, np.asarray(p._read()))
+            self._params.append((name, p))
+
+    def step(self):
+        versions = {}  # push returns the version CONTAINING this update
+        for name, p in self._params:
+            if p.grad is not None:
+                versions[name] = self.client.push_dense(
+                    name, np.asarray(p.grad._read()))
+        for e in self._embeddings:
+            e.push_gradients()
+        for name, p in self._params:
+            want = versions.get(name, 0) if self.sync else 0
+            value, _ = self.client.pull_dense(name, min_version=want)
+            p._write(value.reshape(np.asarray(p._read()).shape))
+
+    def clear_grad(self):
+        for _, p in self._params:
+            p.clear_gradient()

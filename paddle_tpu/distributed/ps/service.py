@@ -1,0 +1,329 @@
+"""PS server/client transport + tables (reference
+``ps/service/brpc_ps_server.h`` / ``brpc_ps_client.h``, tables
+``ps/table/`` memory_sparse_table / memory_dense_table).
+"""
+from __future__ import annotations
+
+import socket
+import threading
+
+import numpy as np
+
+from ..store import _recv_frame, _send_frame
+
+
+class _Accessor:
+    """Server-side optimizer state for one table (the reference's
+    sparse/dense 'accessor' concept, ``ps/table/sparse_sgd_rule.h``)."""
+
+    def __init__(self, rule="sgd", lr=0.01, beta1=0.9, beta2=0.999,
+                 eps=1e-8):
+        self.rule, self.lr = rule, lr
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+
+    def init_state(self, shape):
+        if self.rule == "adam":
+            return {"m": np.zeros(shape, np.float32),
+                    "v": np.zeros(shape, np.float32), "t": 0}
+        return {}
+
+    def apply(self, value, grad, state):
+        if self.rule == "sum":
+            return value + grad
+        if self.rule == "adam":
+            state["t"] += 1
+            t = state["t"]
+            state["m"] = self.beta1 * state["m"] + (1 - self.beta1) * grad
+            state["v"] = (self.beta2 * state["v"]
+                          + (1 - self.beta2) * grad * grad)
+            mhat = state["m"] / (1 - self.beta1 ** t)
+            vhat = state["v"] / (1 - self.beta2 ** t)
+            return value - self.lr * mhat / (np.sqrt(vhat) + self.eps)
+        return value - self.lr * grad  # sgd
+
+
+class _DenseTable:
+    def __init__(self, shape, accessor, n_workers, sync):
+        self.value = np.zeros(shape, np.float32)
+        self.accessor = _Accessor(**accessor)
+        self.state = self.accessor.init_state(shape)
+        self.n_workers, self.sync = n_workers, sync
+        self.version = 0
+        self._pending = None
+        self._n_pending = 0
+        self.cv = threading.Condition()
+
+    def push(self, grad):
+        """Returns the version that will contain this push — callers pull
+        with min_version=<return> to observe their own update (sync mode:
+        the step completes when the n-th worker pushes)."""
+        with self.cv:
+            if not self.sync:
+                self.value = self.accessor.apply(self.value, grad,
+                                                 self.state)
+                self.version += 1
+                target = self.version
+            else:
+                self._pending = grad if self._pending is None \
+                    else self._pending + grad
+                self._n_pending += 1
+                target = self.version + 1
+                if self._n_pending >= self.n_workers:
+                    self.value = self.accessor.apply(
+                        self.value, self._pending / self.n_workers,
+                        self.state)
+                    self._pending, self._n_pending = None, 0
+                    self.version += 1
+            self.cv.notify_all()
+            return target
+
+    def pull(self, min_version=0, timeout=60):
+        with self.cv:
+            if not self.cv.wait_for(lambda: self.version >= min_version,
+                                    timeout=timeout):
+                raise TimeoutError(
+                    f"dense pull: version {min_version} not reached")
+            return self.value.copy(), self.version
+
+
+class _SparseTable:
+    def __init__(self, dim, accessor, initializer_scale=0.01, seed=0):
+        self.dim = dim
+        self.accessor = _Accessor(**accessor)
+        self.rows = {}
+        self.state = {}
+        self._rng = np.random.default_rng(seed)
+        self.lock = threading.Lock()
+
+    def _row(self, i):
+        i = int(i)
+        if i not in self.rows:
+            self.rows[i] = self._rng.normal(
+                0, 0.01, self.dim).astype(np.float32)
+            self.state[i] = self.accessor.init_state((self.dim,))
+        return self.rows[i]
+
+    def pull(self, ids):
+        with self.lock:
+            return np.stack([self._row(i) for i in ids])
+
+    def push(self, ids, grads):
+        with self.lock:
+            for i, g in zip(ids, grads):
+                i = int(i)
+                self._row(i)
+                self.rows[i] = self.accessor.apply(self.rows[i], g,
+                                                   self.state[i])
+
+
+class PsServer:
+    """One PS node (reference ``brpc_ps_server.h``): hosts the shard of
+    every table that maps to this server index."""
+
+    def __init__(self, endpoint, n_workers=1, sync=False):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(128)
+        self.n_workers, self.sync = n_workers, sync
+        self._dense = {}
+        self._sparse = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._barrier_count = {}
+        self._barrier_cv = threading.Condition()
+        self._thread = None
+
+    @property
+    def port(self):
+        return self._sock.getsockname()[1]
+
+    # -- lifecycle ----------------------------------------------------
+    def run(self):
+        """Blocking accept loop (reference fleet.run_server). Polls the
+        stop flag: close() alone does not wake a blocked accept()."""
+        self._sock.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def start(self):
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- request handling ---------------------------------------------
+    def _serve(self, conn):
+        try:
+            while True:
+                req = _recv_frame(conn)
+                try:
+                    reply = (True, self._handle(*req))
+                except Exception as e:  # surface, don't kill the socket
+                    reply = (False, f"{type(e).__name__}: {e}")
+                _send_frame(conn, reply)
+        except (ConnectionError, EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _handle(self, op, name, *args):
+        if op == "create_dense":
+            shape, accessor = args
+            with self._lock:
+                if name not in self._dense:
+                    self._dense[name] = _DenseTable(
+                        shape, accessor, self.n_workers, self.sync)
+            return True
+        if op == "init_dense":
+            (value,) = args
+            self._dense[name].value = np.array(value, np.float32)
+            return True
+        if op == "create_sparse":
+            dim, accessor, seed = args
+            with self._lock:
+                if name not in self._sparse:
+                    self._sparse[name] = _SparseTable(dim, accessor,
+                                                      seed=seed)
+            return True
+        if op == "pull_dense":
+            (min_version,) = args
+            return self._dense[name].pull(min_version)
+        if op == "push_dense":
+            (grad,) = args
+            return self._dense[name].push(np.asarray(grad))
+        if op == "pull_sparse":
+            (ids,) = args
+            return self._sparse[name].pull(ids)
+        if op == "push_sparse":
+            ids, grads = args
+            self._sparse[name].push(ids, np.asarray(grads))
+            return True
+        if op == "barrier":
+            (n,) = args
+            with self._barrier_cv:
+                count = self._barrier_count.get(name, 0) + 1
+                self._barrier_count[name] = count
+                gen = (count - 1) // n  # generation: barriers are reusable
+                self._barrier_cv.notify_all()
+                ok = self._barrier_cv.wait_for(
+                    lambda: self._barrier_count[name] >= (gen + 1) * n,
+                    timeout=120)
+            if not ok:
+                raise TimeoutError(
+                    f"ps barrier {name!r}: peers missing after 120s")
+            return True
+        if op == "stop":
+            self.stop()
+            return True
+        raise ValueError(f"unknown ps op {op}")
+
+
+class PsClient:
+    """Worker-side connection to every PS node (reference
+    ``brpc_ps_client.h``). Sparse ids shard ``id % n_servers``; dense
+    tables live on ``hash(name) % n_servers``."""
+
+    def __init__(self, endpoints):
+        self.endpoints = list(endpoints)
+        self._conns = []
+        for ep in self.endpoints:
+            host, port = ep.rsplit(":", 1)
+            self._conns.append(
+                socket.create_connection((host, int(port)), timeout=60))
+        self._locks = [threading.Lock() for _ in self._conns]
+
+    def _call(self, server, *req):
+        with self._locks[server]:
+            _send_frame(self._conns[server], req)
+            ok, value = _recv_frame(self._conns[server])
+        if not ok:
+            raise RuntimeError(
+                f"ps server {self.endpoints[server]}: {value}")
+        return value
+
+    def _dense_home(self, name):
+        return sum(name.encode()) % len(self._conns)
+
+    # -- dense ---------------------------------------------------------
+    def create_dense_table(self, name, shape, rule="sgd", lr=0.01, **kw):
+        self._call(self._dense_home(name), "create_dense", name,
+                   tuple(shape), dict(rule=rule, lr=lr, **kw))
+
+    def init_dense(self, name, value):
+        self._call(self._dense_home(name), "init_dense", name,
+                   np.asarray(value, np.float32))
+
+    def pull_dense(self, name, min_version=0):
+        value, version = self._call(self._dense_home(name), "pull_dense",
+                                    name, min_version)
+        return value, version
+
+    def push_dense(self, name, grad):
+        return self._call(self._dense_home(name), "push_dense", name,
+                          np.asarray(grad, np.float32))
+
+    # -- sparse --------------------------------------------------------
+    def create_sparse_table(self, name, dim, rule="sgd", lr=0.01, seed=0,
+                            **kw):
+        for s in range(len(self._conns)):
+            self._call(s, "create_sparse", name, dim,
+                       dict(rule=rule, lr=lr, **kw), seed + s)
+
+    def pull_sparse(self, name, ids):
+        ids = np.asarray(ids).reshape(-1)
+        n = len(self._conns)
+        out = np.empty((len(ids), 0), np.float32) if len(ids) == 0 else None
+        parts, idxs = [], []
+        for s in range(n):
+            mask = (ids % n) == s
+            if mask.any():
+                parts.append(self._call(s, "pull_sparse", name,
+                                        ids[mask].tolist()))
+                idxs.append(np.flatnonzero(mask))
+        if out is not None:
+            return out
+        dim = parts[0].shape[1]
+        rows = np.empty((len(ids), dim), np.float32)
+        for part, idx in zip(parts, idxs):
+            rows[idx] = part
+        return rows
+
+    def push_sparse(self, name, ids, grads):
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, np.float32)
+        n = len(self._conns)
+        for s in range(n):
+            mask = (ids % n) == s
+            if mask.any():
+                self._call(s, "push_sparse", name, ids[mask].tolist(),
+                           grads[mask])
+
+    # -- control -------------------------------------------------------
+    def barrier(self, name, n_workers):
+        self._call(0, "barrier", name, n_workers)
+
+    def stop_servers(self):
+        for s in range(len(self._conns)):
+            try:
+                self._call(s, "stop", None)
+            except (ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for c in self._conns:
+            c.close()

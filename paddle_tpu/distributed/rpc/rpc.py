@@ -1,0 +1,191 @@
+"""Tensor/function RPC (reference ``python/paddle/distributed/rpc/rpc.py``:
+73 init_rpc, :143 rpc_sync, :183 rpc_async; C++ agent ``rpc_agent.h`` —
+SURVEY D10).
+
+Each worker runs a threaded TCP server executing pickled
+``(fn, args, kwargs)`` requests; worker discovery and barriers go through
+the ``TCPStore`` hosted by rank 0 at ``master_endpoint``. Python-level —
+the payloads here are control-plane objects and host arrays; bulk tensor
+traffic belongs on the ICI collectives, not RPC (same division as the
+reference, whose RPC is explicitly a 'minimal' agent).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import threading
+import time
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from ..store import TCPStore, _recv_frame, _send_frame
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_DEFAULT_RPC_TIMEOUT = 30.0
+
+_state = None  # (store, server_sock, infos: {name: WorkerInfo}, me)
+
+
+class _RpcServer:
+    def __init__(self, host):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(128)
+        self._pool = ThreadPoolExecutor(max_workers=8)
+        self._stop = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    @property
+    def port(self):
+        return self._sock.getsockname()[1]
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            self._pool.submit(self._serve, conn)
+
+    def _serve(self, conn):
+        try:
+            fn, args, kwargs = _recv_frame(conn)
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # ship the failure to the caller
+                result = (False, e)
+            _send_frame(conn, result)
+        except (ConnectionError, EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._pool.shutdown(wait=False)
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Reference ``rpc.py:73``: start this worker's agent and exchange
+    ``WorkerInfo`` with every peer through the master store."""
+    global _state
+    if _state is not None:
+        raise RuntimeError("init_rpc already called")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) \
+        if rank is None else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT")
+    if master_endpoint is None:
+        if world_size > 1:
+            raise ValueError("init_rpc: master_endpoint (or "
+                             "PADDLE_MASTER_ENDPOINT) is required when "
+                             "world_size > 1")
+        master_endpoint = "127.0.0.1:0"
+    host, port = master_endpoint.rsplit(":", 1)
+
+    server = _RpcServer("0.0.0.0")
+    store = TCPStore(host, int(port), world_size=world_size,
+                     is_master=(rank == 0))
+    ip = socket.gethostbyname(socket.gethostname()) \
+        if world_size > 1 else "127.0.0.1"
+    me = WorkerInfo(name, rank, ip, server.port)
+    store.set(f"__rpc/worker/{rank}", pickle.dumps(me))
+    infos = {}
+    for r in range(world_size):
+        info = pickle.loads(store.get(f"__rpc/worker/{r}"))
+        if info.name in infos:
+            raise ValueError(f"duplicate rpc worker name {info.name!r}")
+        infos[info.name] = info
+    # _state must be live BEFORE the barrier: a peer may fire an rpc the
+    # instant its own barrier releases, racing this thread's assignment
+    _state = (store, server, infos, me)
+    store.barrier("rpc_init", world_size)
+    return me
+
+
+def _require_state():
+    if _state is None:
+        raise RuntimeError("call paddle.distributed.rpc.init_rpc first")
+    return _state
+
+
+def get_worker_info(name):
+    return _require_state()[2][name]
+
+
+def get_all_worker_infos():
+    return list(_require_state()[2].values())
+
+
+def get_current_worker_info():
+    return _require_state()[3]
+
+
+def _invoke(to, fn, args, kwargs, timeout):
+    info = get_worker_info(to)
+    conn = socket.create_connection((info.ip, info.port), timeout=timeout)
+    if timeout and timeout > 0:
+        conn.settimeout(timeout)
+    try:
+        _send_frame(conn, (fn, tuple(args or ()), dict(kwargs or {})))
+        ok, value = _recv_frame(conn)
+    finally:
+        conn.close()
+    if not ok:
+        raise value
+    return value
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Blocking remote call: run ``fn(*args, **kwargs)`` on worker ``to``
+    and return its result (reference ``rpc.py:143``)."""
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Non-blocking remote call returning a Future with ``wait()``
+    (reference ``rpc.py:183``)."""
+    fut = Future()
+
+    def run():
+        try:
+            fut.set_result(_invoke(to, fn, args, kwargs, timeout))
+        except Exception as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=run, daemon=True).start()
+    fut.wait = lambda t=None: fut.result(t)  # reference Future API
+    return fut
+
+
+def shutdown(graceful=True):
+    """Reference ``rpc.py`` shutdown: barrier (graceful) then stop. The
+    master's store must outlive every peer's final store op, so rank 0
+    waits for all closed-signals before tearing the store down."""
+    global _state
+    if _state is None:
+        return
+    store, server, infos, me = _state
+    n = len(infos)
+    if graceful and n > 1:
+        store.barrier("rpc_shutdown", n)
+    if n > 1:
+        if me.rank == 0:
+            deadline = time.time() + 30
+            while (store.add("__rpc/closed", 0) < n - 1
+                   and time.time() < deadline):
+                time.sleep(0.01)
+        else:
+            store.add("__rpc/closed", 1)
+    server.stop()
+    store.close()
+    _state = None

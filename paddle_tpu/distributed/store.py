@@ -1,0 +1,178 @@
+"""TCP key-value rendezvous store (reference ``TCPStore``,
+``paddle/phi/core/distributed/store/tcp_store.h:121`` — SURVEY D3).
+
+One process (``is_master=True``, conventionally rank 0) hosts the table;
+every process (master included) connects as a client. Used by
+``paddle.distributed.rpc`` for worker-info exchange and barriers; the
+collective path does NOT need it (the JAX coordination service owns that
+bootstrap), matching SURVEY §7's "TCPStore-compatible bootstrap" row.
+
+Wire protocol: length-prefixed pickle frames ``(op, key, value)`` →
+``(ok, value)``.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+
+
+def _send_frame(sock, obj):
+    payload = pickle.dumps(obj)
+    sock.sendall(struct.pack("!I", len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("store connection closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock):
+    (n,) = struct.unpack("!I", _recv_exact(sock, 4))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class _Server:
+    def __init__(self, host, port):
+        self._data = {}
+        self._cv = threading.Condition()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self._stop = False
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self):
+        return self._sock.getsockname()[1]
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                op, key, value = _recv_frame(conn)
+                if op == "set":
+                    with self._cv:
+                        self._data[key] = value
+                        self._cv.notify_all()
+                    _send_frame(conn, (True, None))
+                elif op == "get":
+                    with self._cv:
+                        ok = self._cv.wait_for(
+                            lambda: key in self._data, timeout=value)
+                        _send_frame(conn, (ok, self._data.get(key)))
+                elif op == "add":
+                    with self._cv:
+                        cur = int(self._data.get(key, 0)) + int(value)
+                        self._data[key] = cur
+                        self._cv.notify_all()
+                    _send_frame(conn, (True, cur))
+                elif op == "delete":
+                    with self._cv:
+                        existed = self._data.pop(key, None) is not None
+                        self._cv.notify_all()
+                    _send_frame(conn, (True, existed))
+                elif op == "close":
+                    _send_frame(conn, (True, None))
+                    return
+                else:
+                    _send_frame(conn, (False, f"bad op {op}"))
+        except (ConnectionError, EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TCPStore:
+    """Client (+ optionally the host) of the rendezvous table."""
+
+    def __init__(self, host, port, world_size=1, is_master=False,
+                 timeout=300):
+        self._server = _Server(host, port) if is_master else None
+        self._addr = (host, self._server.port if is_master else port)
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        deadline = time.time() + timeout
+        while True:
+            try:
+                self._sock = socket.create_connection(self._addr, timeout=5)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"TCPStore: no master at {self._addr} "
+                        f"after {timeout}s")
+                time.sleep(0.05)
+
+    @property
+    def port(self):
+        return self._addr[1]
+
+    def _call(self, op, key, value=None):
+        with self._lock:
+            _send_frame(self._sock, (op, key, value))
+            return _recv_frame(self._sock)
+
+    def set(self, key, value):
+        self._call("set", key, value)
+
+    def get(self, key, timeout=None):
+        ok, value = self._call("get", key,
+                               self._timeout if timeout is None else timeout)
+        if not ok:
+            raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+        return value
+
+    def add(self, key, amount=1):
+        return self._call("add", key, amount)[1]
+
+    def delete_key(self, key):
+        return self._call("delete", key)[1]
+
+    def wait(self, keys, timeout=None):
+        for k in keys:
+            self.get(k, timeout)
+
+    def barrier(self, name, world_size, timeout=None):
+        """All ``world_size`` callers block until everyone arrived.
+        Reusable: arrival counts map to generations, so calling the same
+        barrier name once per iteration keeps synchronizing."""
+        n = self.add(f"__barrier/{name}", 1)
+        gen = (n - 1) // world_size
+        if n >= (gen + 1) * world_size:
+            self.set(f"__barrier/{name}/done/{gen}", b"1")
+        self.get(f"__barrier/{name}/done/{gen}", timeout)
+
+    def close(self):
+        try:
+            self._call("close", None)
+        except (ConnectionError, OSError):
+            pass
+        self._sock.close()
+        if self._server is not None:
+            self._server.stop()
