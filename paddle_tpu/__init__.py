@@ -61,6 +61,7 @@ from . import sysconfig  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
 from . import onnx  # noqa: F401
+from . import cost_model  # noqa: F401
 from .hapi import hub  # noqa: F401
 from . import tensor  # noqa: F401  (compat: paddle.tensor op namespace)
 from . import base  # noqa: F401

@@ -235,16 +235,22 @@ class PsServer:
 
 class PsClient:
     """Worker-side connection to every PS node (reference
-    ``brpc_ps_client.h``). Sparse ids shard ``id % n_servers``; dense
-    tables live on ``hash(name) % n_servers``."""
+    ``brpc_ps_client.h``). Sparse ids shard ``id % n_servers``; a dense
+    table lives on ``sum(name_bytes) % n_servers`` (stable across
+    processes, unlike Python's salted hash)."""
 
     def __init__(self, endpoints):
         self.endpoints = list(endpoints)
         self._conns = []
+        self._sparse_dims = {}
         for ep in self.endpoints:
             host, port = ep.rsplit(":", 1)
-            self._conns.append(
-                socket.create_connection((host, int(port)), timeout=60))
+            conn = socket.create_connection((host, int(port)), timeout=60)
+            # ops block without a client deadline: waits (barrier, sync
+            # pull) are bounded server-side; a client recv timeout would
+            # leave the late reply in the stream and desync the framing
+            conn.settimeout(None)
+            self._conns.append(conn)
         self._locks = [threading.Lock() for _ in self._conns]
 
     def _call(self, server, *req):
@@ -280,6 +286,7 @@ class PsClient:
     # -- sparse --------------------------------------------------------
     def create_sparse_table(self, name, dim, rule="sgd", lr=0.01, seed=0,
                             **kw):
+        self._sparse_dims[name] = dim
         for s in range(len(self._conns)):
             self._call(s, "create_sparse", name, dim,
                        dict(rule=rule, lr=lr, **kw), seed + s)
@@ -287,7 +294,9 @@ class PsClient:
     def pull_sparse(self, name, ids):
         ids = np.asarray(ids).reshape(-1)
         n = len(self._conns)
-        out = np.empty((len(ids), 0), np.float32) if len(ids) == 0 else None
+        if len(ids) == 0:
+            return np.empty((0, self._sparse_dims.get(name, 0)),
+                            np.float32)
         parts, idxs = [], []
         for s in range(n):
             mask = (ids % n) == s
@@ -295,8 +304,6 @@ class PsClient:
                 parts.append(self._call(s, "pull_sparse", name,
                                         ids[mask].tolist()))
                 idxs.append(np.flatnonzero(mask))
-        if out is not None:
-            return out
         dim = parts[0].shape[1]
         rows = np.empty((len(ids), dim), np.float32)
         for part, idx in zip(parts, idxs):

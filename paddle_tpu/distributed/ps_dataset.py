@@ -1,0 +1,97 @@
+"""PS-mode datasets (reference ``python/paddle/distributed/fleet/dataset/``
+InMemoryDataset / QueueDataset + the C++ DataFeed of SURVEY C26).
+
+The reference streams slot-format text files through a C++ pipeline into
+PS trainers. Here the same surface wraps the framework's IO stack: a
+``parse_fn`` (the data_generator analog) maps each text line to a sample;
+``InMemoryDataset`` materializes + shuffles, ``QueueDataset`` streams
+through the thread-backed reader.
+"""
+from __future__ import annotations
+
+import random
+
+__all__ = ["InMemoryDataset", "QueueDataset"]
+
+
+class _DatasetBase:
+    def __init__(self):
+        self._filelist = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._parse_fn = None
+
+    def init(self, batch_size=1, thread_num=1, parse_fn=None, use_var=None,
+             pipe_command=None, **kwargs):
+        """Reference ``dataset.init``: configure batching/threads and the
+        line parser (``parse_fn(line) -> sample``; the data_generator)."""
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        self._parse_fn = parse_fn or (lambda line: line)
+        return self
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def _lines(self):
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    yield line.rstrip("\n")
+
+    def _batches(self, samples):
+        batch = []
+        for s in samples:
+            batch.append(s)
+            if len(batch) == self._batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+
+class InMemoryDataset(_DatasetBase):
+    """Reference InMemoryDataset: load, shuffle in memory, iterate."""
+
+    def __init__(self):
+        super().__init__()
+        self._data = None
+
+    def load_into_memory(self):
+        self._data = [self._parse_fn(ln) for ln in self._lines()]
+
+    def local_shuffle(self, seed=None):
+        if self._data is None:
+            raise RuntimeError("call load_into_memory first")
+        random.Random(seed).shuffle(self._data)
+
+    def global_shuffle(self, fleet=None, thread_num=None, seed=None):
+        # single-controller: every worker sees the same store-backed list;
+        # a seeded shuffle is globally consistent
+        self.local_shuffle(seed if seed is not None else 0)
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._data or [])
+
+    def release_memory(self):
+        self._data = None
+
+    def __iter__(self):
+        if self._data is None:
+            raise RuntimeError("call load_into_memory first")
+        return self._batches(iter(self._data))
+
+
+class QueueDataset(_DatasetBase):
+    """Reference QueueDataset: stream files through a bounded queue
+    (thread-backed, like paddle_tpu.io's loader) without materializing."""
+
+    def __iter__(self):
+        from .. import reader as reader_mod
+
+        def creator():
+            for ln in self._lines():
+                yield self._parse_fn(ln)
+
+        buffered = reader_mod.buffered(creator, max(self._thread_num, 1) * 64)
+        return self._batches(buffered())

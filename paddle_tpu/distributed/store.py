@@ -121,6 +121,11 @@ class TCPStore:
         while True:
             try:
                 self._sock = socket.create_connection(self._addr, timeout=5)
+                # connect probe used 5s; ops must block indefinitely — the
+                # wait budget is enforced SERVER-side (a client-side recv
+                # timeout would desync the framed protocol: the late reply
+                # would be read as the next call's response)
+                self._sock.settimeout(None)
                 break
             except OSError:
                 if time.time() > deadline:

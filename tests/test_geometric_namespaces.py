@@ -178,3 +178,37 @@ def test_dataset_common_gating(tmp_path):
         paddle.dataset.common.download("http://x/y.gz", "nope", "0" * 32)
     with pytest.raises((FileNotFoundError, RuntimeError)):
         next(paddle.dataset.mnist.train()())
+
+
+def test_cost_model():
+    import paddle_tpu.cost_model as cm
+    m = cm.CostModel()
+    cost = m.profile_measure(lambda a, b: a @ b,
+                             (np.ones((64, 64), "float32"),
+                              np.ones((64, 64), "float32")))
+    assert cost["flops"] > 0 and cost["measured_seconds"] > 0
+    t = m.get_static_op_time("tanh")
+    assert t["time"] > 0 and m.static_cost_data()
+
+
+def test_ps_datasets(tmp_path):
+    import paddle_tpu.distributed as dist
+    f1 = tmp_path / "a.txt"
+    f1.write_text("\n".join(f"{i} {i*2}" for i in range(10)) + "\n")
+    parse = lambda ln: tuple(int(v) for v in ln.split())
+
+    ds = dist.InMemoryDataset()
+    ds.init(batch_size=4, parse_fn=parse)
+    ds.set_filelist([str(f1)])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 10
+    ds.local_shuffle(seed=0)
+    batches = list(ds)
+    assert len(batches) == 3 and sorted(
+        s for b in batches for s in b) == [(i, 2 * i) for i in range(10)]
+    ds.release_memory()
+
+    qs = dist.QueueDataset()
+    qs.init(batch_size=5, parse_fn=parse)
+    qs.set_filelist([str(f1)])
+    assert sum(len(b) for b in qs) == 10

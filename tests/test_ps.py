@@ -12,6 +12,8 @@ import threading
 import numpy as np
 import pytest
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 import paddle_tpu as paddle
 from paddle_tpu.distributed.ps import (PsClient, PsServer, PSOptimizer,
                                        SparseEmbedding)
@@ -160,7 +162,7 @@ def test_fleet_ps_role_flow(tmp_path):
     script = tmp_path / "ps_node.py"
     script.write_text(textwrap.dedent(ROLE_SCRIPT))
     port = _free_port()
-    base = {**os.environ, "PYTHONPATH": "/root/repo",
+    base = {**os.environ, "PYTHONPATH": _REPO_ROOT,
             "PADDLE_PSERVERS_IP_PORT_LIST": f"127.0.0.1:{port}",
             "PADDLE_TRAINERS_NUM": "1"}
     server = worker = None
@@ -177,8 +179,8 @@ def test_fleet_ps_role_flow(tmp_path):
                  "PADDLE_TRAINER_ID": "0"},
             cwd=str(tmp_path), stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
-        wout, _ = worker.communicate(timeout=120)
-        sout, _ = server.communicate(timeout=60)
+        wout, _ = worker.communicate(timeout=300)
+        sout, _ = server.communicate(timeout=180)
         assert worker.returncode == 0, wout
         assert "PS_ROLE_OK" in wout
         assert server.returncode == 0, sout

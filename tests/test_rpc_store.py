@@ -9,6 +9,8 @@ import threading
 
 import pytest
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 from paddle_tpu.distributed.store import TCPStore
 
 
@@ -110,7 +112,7 @@ def test_rpc_three_workers(tmp_path):
     procs = []
     try:
         for rank in range(3):
-            env = {**os.environ, "PYTHONPATH": "/root/repo",
+            env = {**os.environ, "PYTHONPATH": _REPO_ROOT,
                    "PADDLE_TRAINER_ID": str(rank),
                    "MASTER": f"127.0.0.1:{port}"}
             procs.append(subprocess.Popen(
