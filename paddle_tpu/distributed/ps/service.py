@@ -206,6 +206,8 @@ class PsServer:
         if op == "push_dense":
             (grad,) = args
             return self._dense[name].push(np.asarray(grad))
+        if op == "sparse_dim":
+            return self._sparse[name].dim
         if op == "pull_sparse":
             (ids,) = args
             return self._sparse[name].pull(ids)
@@ -295,8 +297,9 @@ class PsClient:
         ids = np.asarray(ids).reshape(-1)
         n = len(self._conns)
         if len(ids) == 0:
-            return np.empty((0, self._sparse_dims.get(name, 0)),
-                            np.float32)
+            if name not in self._sparse_dims:  # attach-only client
+                self._sparse_dims[name] = self._call(0, "sparse_dim", name)
+            return np.empty((0, self._sparse_dims[name]), np.float32)
         parts, idxs = [], []
         for s in range(n):
             mask = (ids % n) == s

@@ -19,7 +19,7 @@ class _DatasetBase:
         self._filelist = []
         self._batch_size = 1
         self._thread_num = 1
-        self._parse_fn = None
+        self._parse_fn = lambda line: line
 
     def init(self, batch_size=1, thread_num=1, parse_fn=None, use_var=None,
              pipe_command=None, **kwargs):

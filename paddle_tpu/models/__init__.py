@@ -7,3 +7,7 @@ canonical model family to exercise.
 """
 from . import gpt  # noqa: F401
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401
+from . import bert  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForPretraining,
+    BertForSequenceClassification)
