@@ -241,13 +241,26 @@ class PsClient:
     table lives on ``sum(name_bytes) % n_servers`` (stable across
     processes, unlike Python's salted hash)."""
 
-    def __init__(self, endpoints):
+    def __init__(self, endpoints, connect_timeout=300):
+        import time
+
         self.endpoints = list(endpoints)
         self._conns = []
         self._sparse_dims = {}
+        deadline = time.time() + connect_timeout
         for ep in self.endpoints:
             host, port = ep.rsplit(":", 1)
-            conn = socket.create_connection((host, int(port)), timeout=60)
+            while True:  # retry: workers may come up before their servers
+                try:
+                    conn = socket.create_connection((host, int(port)),
+                                                    timeout=5)
+                    break
+                except OSError:
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f"PsClient: no server at {ep} after "
+                            f"{connect_timeout}s")
+                    time.sleep(0.1)
             # ops block without a client deadline: waits (barrier, sync
             # pull) are bounded server-side; a client recv timeout would
             # leave the late reply in the stream and desync the framing

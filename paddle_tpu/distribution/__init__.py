@@ -9,13 +9,13 @@ differentiable and jit-fusible); sampling draws from the framework PRNG
 """
 from .distributions import (  # noqa: F401
     Distribution, Normal, Uniform, Bernoulli, Categorical, Beta,
-    Dirichlet, Gamma, Exponential, Laplace, LogNormal, Gumbel, Cauchy,
+    Dirichlet, Gamma, Binomial, Exponential, Laplace, LogNormal, Gumbel, Cauchy,
     Geometric, Poisson, Multinomial, kl_divergence, register_kl,
 )
 
 __all__ = [
     "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
-    "Beta", "Dirichlet", "Gamma", "Exponential", "Laplace", "LogNormal",
+    "Beta", "Dirichlet", "Gamma", "Binomial", "Exponential", "Laplace", "LogNormal",
     "Gumbel", "Cauchy", "Geometric", "Poisson", "Multinomial",
     "kl_divergence", "register_kl",
 ]

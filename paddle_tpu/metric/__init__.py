@@ -194,3 +194,19 @@ class Auc(Metric):
 
 
 __all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Reference ``paddle.metric.accuracy`` functional: top-k accuracy of
+    ``input`` [N, C] probabilities/logits against ``label`` [N] or [N, 1]."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    def impl(x, y):
+        topk = jnp.argsort(-x, axis=-1)[:, :k]
+        yy = y.reshape(-1, 1)
+        hit = jnp.any(topk == yy, axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply("accuracy", impl, input, label)

@@ -10,6 +10,7 @@ from .conv import (  # noqa: F401
     conv3d_transpose,
 )
 from .pooling import (  # noqa: F401
+    max_unpool2d,
     max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
     adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
@@ -23,7 +24,7 @@ from .loss import (  # noqa: F401
     smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
     kl_div, margin_ranking_loss, hinge_embedding_loss, cosine_embedding_loss,
     triplet_margin_loss, square_error_cost, sigmoid_focal_loss, log_loss,
-    ctc_loss,
+    ctc_loss, huber_loss, hsigmoid_loss, rnnt_loss,
 )
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, flash_attn_qkvpacked,

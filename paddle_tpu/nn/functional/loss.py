@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 
 from ...core.dispatch import apply
@@ -341,3 +342,145 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return _reduce(loss, reduction)
 
     return apply("ctc_loss", impl, *args)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    """Reference ``huber_loss`` op: 0.5 r^2 inside |r| <= delta, linear
+    outside (the unscaled Huber — ``smooth_l1_loss`` is paddle's
+    delta-scaled variant)."""
+    def impl(a, b):
+        r = jnp.abs(a - b)
+        loss = jnp.where(r <= delta, 0.5 * r * r,
+                         delta * (r - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply("huber_loss", impl, input, label)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Reference ``hsigmoid_loss``: hierarchical sigmoid over a binary
+    tree. Default tree = complete binary heap (leaf of class c at heap
+    slot c + num_classes - 1, internal nodes 0..num_classes-2), matching
+    the reference's built-in coding; custom trees come via
+    ``path_table``/``path_code`` [N, L] (padded with -1)."""
+    import numpy as np
+
+    from ...core.dispatch import unwrap
+
+    if path_table is None:
+        n = int(num_classes)
+        depth = max(int(np.ceil(np.log2(max(n, 2)))), 1)
+        labels_np = np.asarray(unwrap(label)).reshape(-1)
+        tables, codes = [], []
+        for c in labels_np:
+            node = int(c) + n - 1  # heap leaf slot
+            path, code = [], []
+            while node > 0:
+                parent = (node - 1) // 2
+                path.append(parent)
+                code.append(node == 2 * parent + 2)  # right child?
+                node = parent
+            path = path[::-1][:depth]
+            code = code[::-1][:depth]
+            pad = depth - len(path)
+            tables.append(path + [-1] * pad)
+            codes.append([float(v) for v in code] + [0.0] * pad)
+        path_table = np.asarray(tables, np.int32)
+        path_code = np.asarray(codes, np.float32)
+    else:
+        path_table = np.asarray(unwrap(path_table), np.int32)
+        path_code = np.asarray(unwrap(path_code), np.float32)
+
+    def impl(x, w, *maybe_bias):
+        pt = jnp.asarray(path_table)
+        pc = jnp.asarray(path_code)
+        valid = (pt >= 0).astype(x.dtype)
+        idx = jnp.maximum(pt, 0)
+        wn = jnp.take(w, idx, axis=0)             # [N, L, D]
+        logits = jnp.einsum("nd,nld->nl", x, wn)
+        if maybe_bias:
+            logits = logits + jnp.take(maybe_bias[0].reshape(-1), idx)
+        # sigmoid CE with target = code (1 right, 0 left)
+        ce = jnp.maximum(logits, 0) - logits * pc + jnp.log1p(
+            jnp.exp(-jnp.abs(logits)))
+        return jnp.mean(jnp.sum(ce * valid, axis=1))
+
+    args = (input, weight) + ((bias,) if bias is not None else ())
+    return apply("hsigmoid_loss", impl, *args)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """Reference ``warprnnt`` op (``rnnt_loss``): RNN-Transducer negative
+    log-likelihood over logits [B, T, U+1, V] and labels [B, U] —
+    log-domain forward DP as a scan over time (the TPU-shaped replacement
+    for the warp-rnnt CUDA kernel)."""
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss: FastEmit regularization is not implemented; "
+            "pass fastemit_lambda=0")
+
+    def impl(logits, labels, in_len, lab_len):
+        B, T, U1, V = logits.shape
+        U = U1 - 1
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        lp_blank = lp[..., blank]                      # [B, T, U+1]
+        lab = labels.astype(jnp.int32)                 # [B, U]
+        # emit log-prob at (t, u): P(label_u | t, u), u < U
+        lp_emit = jnp.take_along_axis(
+            lp[:, :, :U, :], lab[:, None, :, None], axis=-1)[..., 0]
+        NEG = jnp.float32(-1e30)
+
+        def emit_at(t, u_minus_1):
+            # lp_emit[:, t, max(u-1, 0)] without dynamic gather per batch
+            return jnp.take_along_axis(
+                lp_emit[:, t, :],
+                jnp.broadcast_to(jnp.maximum(u_minus_1, 0), (B, 1)),
+                axis=1)[:, 0]
+
+        def row(from_blank, t):
+            """alpha row at time t given the blank-moves column
+            from_blank[u]; vertical emit recurrence is sequential in u."""
+            def scan_u(carry, u):
+                a = jnp.where(u == 0, from_blank[:, 0],
+                              jnp.logaddexp(from_blank[:, u],
+                                            carry + emit_at(t, u - 1)))
+                return a, a
+
+            _, cols = lax.scan(scan_u, jnp.full((B,), NEG),
+                               jnp.arange(U1))
+            return jnp.swapaxes(cols, 0, 1)
+
+        # t = 0: no blank moves; alpha[0,0] = 0, alpha[0,u] pure emits
+        def scan_u0(carry, u):
+            a = jnp.where(u == 0, 0.0, carry + emit_at(0, u - 1))
+            return a, a
+
+        _, cols0 = lax.scan(scan_u0, jnp.zeros((B,)), jnp.arange(U1))
+        alpha0 = jnp.swapaxes(cols0, 0, 1)
+
+        def full_step(alpha, t):
+            new = row(alpha + lp_blank[:, t - 1, :], t)
+            return new, new
+
+        _, rows = lax.scan(full_step, alpha0, jnp.arange(1, T))
+        alphas = jnp.concatenate([alpha0[None], rows], axis=0)  # [T,B,U1]
+        t_last = (in_len.astype(jnp.int32) - 1)
+        last = jnp.take_along_axis(
+            alphas, t_last[None, :, None].repeat(U1, 2), axis=0)[0]
+        a_end = jnp.take_along_axis(
+            last, lab_len.astype(jnp.int32)[:, None], axis=1)[:, 0]
+        blank_end = jnp.take_along_axis(
+            jnp.take_along_axis(
+                lp_blank, t_last[:, None, None].repeat(U1, 2),
+                axis=1)[:, 0, :],
+            lab_len.astype(jnp.int32)[:, None], axis=1)[:, 0]
+        loss = -(a_end + blank_end)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        return _reduce(loss, reduction)
+
+    return apply("rnnt_loss", impl, input, label, input_lengths,
+                 label_lengths)

@@ -285,3 +285,38 @@ def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     if return_mask:
         raise NotImplementedError("return_mask on TPU backend")
     return out
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Reference ``unpool`` op: scatter pooled values back to the flat
+    per-plane positions recorded by ``max_pool2d(..., return_mask=True)``."""
+    import jax.numpy as jnp
+
+    from ...core.dispatch import apply
+
+    if data_format != "NCHW":
+        raise ValueError("max_unpool2d supports NCHW")
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride, stride) if isinstance(stride, int) else tuple(stride))
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+
+    def impl(v, idx):
+        n, c, h, w = v.shape
+        if output_size is not None:
+            oh, ow = output_size[-2], output_size[-1]
+        else:
+            oh = (h - 1) * st[0] - 2 * pd[0] + ks[0]
+            ow = (w - 1) * st[1] - 2 * pd[1] + ks[1]
+        flat = jnp.zeros((n, c, oh * ow), v.dtype)
+        upd = jnp.reshape(v, (n, c, -1))
+        ii = idx.reshape(n, c, -1).astype(jnp.int32)
+        # scatter values to their recorded positions
+        bn = jnp.arange(n)[:, None, None]
+        cn = jnp.arange(c)[None, :, None]
+        flat = flat.at[bn, cn, ii].set(upd)
+        return flat.reshape(n, c, oh, ow)
+
+    return apply("max_unpool2d", impl, x, indices)

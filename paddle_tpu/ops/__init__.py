@@ -25,9 +25,10 @@ from .linalg import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .array import *  # noqa: F401,F403
 from .extra import *  # noqa: F401,F403
+from .special import *  # noqa: F401,F403
 
 from . import creation, math, manipulation, logic, linalg, random  # noqa: F401
-from . import array, extra  # noqa: F401
+from . import array, extra, special  # noqa: F401
 
 
 # ---- indexing ------------------------------------------------------------

@@ -1,0 +1,227 @@
+"""Special functions + op-surface completion batch 2 (reference
+``ops.yaml`` rows absent from the first sweeps: gammaln/gammaincc/
+polygamma, nanmedian, standard_gamma/binomial sampling, add_n, eigvals,
+lu_unpack, clip_by_norm, gather_tree, viterbi_decode, top_p_sampling)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy import special as jsp
+
+from ..core import state
+from ..core.dispatch import primitive, unwrap
+from ..core.tensor import Tensor
+
+
+@primitive
+def gammaln(x):
+    """Reference ``gammaln``: log |Gamma(x)|."""
+    return jsp.gammaln(x)
+
+
+@primitive
+def gammainc(x, y):
+    """Reference ``gammainc``: lower regularized incomplete gamma P(x, y)."""
+    return jsp.gammainc(x, y)
+
+
+@primitive
+def gammaincc(x, y):
+    """Reference ``gammaincc``: upper regularized incomplete gamma Q(x, y)."""
+    return jsp.gammaincc(x, y)
+
+
+@primitive
+def polygamma(x, n=1):
+    """Reference ``polygamma``: n-th derivative of digamma."""
+    return jsp.polygamma(n, x)
+
+
+@primitive
+def nanmedian(x, axis=None, keepdim=False):
+    """Reference ``nanmedian``: median ignoring NaNs."""
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def add_n(inputs, name=None):
+    """Reference ``add_n``: elementwise sum of a tensor list."""
+    if isinstance(inputs, Tensor):
+        return inputs
+
+    @primitive(name="add_n")
+    def _add_n(xs):
+        out = xs[0]
+        for v in xs[1:]:
+            out = out + v
+        return out
+
+    return _add_n(list(inputs))
+
+
+@primitive
+def clip_by_norm(x, max_norm):
+    """Reference ``clip_by_norm``: scale x so its L2 norm <= max_norm."""
+    norm = jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2))
+    scale = jnp.minimum(max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return (x * scale.astype(x.dtype))
+
+
+def standard_gamma(x, name=None):
+    """Reference ``standard_gamma``: sample Gamma(alpha=x, scale=1)."""
+    @primitive(name="standard_gamma")
+    def _sg(alpha, key):
+        return jax.random.gamma(jax.random.wrap_key_data(key), alpha)
+
+    return _sg(x, jax.random.key_data(state.default_rng.next_key()))
+
+
+def binomial(count, prob, name=None):
+    """Reference ``binomial``: sample Binomial(count, prob) elementwise."""
+    @primitive(name="binomial")
+    def _bn(n, p, key):
+        return jax.random.binomial(
+            jax.random.wrap_key_data(key), n.astype(jnp.float32),
+            p).astype(jnp.int32)
+
+    return _bn(count, prob,
+               jax.random.key_data(state.default_rng.next_key()))
+
+
+# --- linalg completions ---------------------------------------------------
+
+def eigvals(x, name=None):
+    """Reference ``eigvals``: eigenvalues of a general square matrix.
+    Host-side numpy (general complex eig has no TPU lowering — the
+    reference's kernel is CPU-only too), so eager-mode only."""
+    import numpy as np
+
+    a = np.asarray(unwrap(x))
+    out_dtype = (np.complex64 if a.dtype in (np.float32, np.complex64)
+                 else np.complex128)
+    return Tensor(np.linalg.eigvals(a).astype(out_dtype))
+
+
+@primitive
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
+    """Reference ``lu_unpack``: split packed LU into (P, L, U)."""
+    m, n = lu_data.shape[-2], lu_data.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(lu_data[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_data.dtype)
+    U = jnp.triu(lu_data[..., :k, :])
+    # pivots (1-based sequential swaps) -> permutation matrix
+    def perm_from_pivots(piv):
+        def body(i, p):
+            j = piv[i] - 1
+            pi, pj = p[i], p[j]
+            p = p.at[i].set(pj).at[j].set(pi)
+            return p
+        p = lax.fori_loop(0, piv.shape[0], body, jnp.arange(m))
+        return jnp.eye(m, dtype=lu_data.dtype)[p]
+
+    piv = lu_pivots.astype(jnp.int32)
+    if piv.ndim == 1:
+        P = perm_from_pivots(piv)
+    else:
+        P = jax.vmap(perm_from_pivots)(piv.reshape(-1, piv.shape[-1]))
+        P = P.reshape(lu_data.shape[:-2] + (m, m))
+    return P, L, U
+
+
+# --- sequence/beam ops ----------------------------------------------------
+
+@primitive
+def gather_tree(ids, parents):
+    """Reference ``gather_tree``: backtrace beam-search parent pointers.
+    ids/parents: [seq_len, batch, beam] -> full sequences."""
+    T = ids.shape[0]
+
+    def step(carry, t):
+        beams = carry  # [batch, beam] current beam indices
+        tok = jnp.take_along_axis(ids[t], beams, axis=-1)
+        nxt = jnp.take_along_axis(parents[t], beams, axis=-1)
+        return nxt, tok
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2]), ids.shape[1:])
+    _, toks = lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return toks[::-1]
+
+
+@primitive
+def viterbi_decode(potentials, transition, lengths=None,
+                   include_bos_eos_tag=True):
+    """Reference ``viterbi_decode``: max-sum decoding over a linear-chain
+    CRF. potentials [B, T, C], transition [C, C] (+2 BOS/EOS rows when
+    ``include_bos_eos_tag``). ``lengths`` masks padded timesteps (path
+    positions past a sequence's length repeat its final tag). Returns
+    (scores [B], paths [B, T])."""
+    B, T, C = potentials.shape
+    if include_bos_eos_tag:
+        # transition is [C+2, C+2]: last two rows/cols are BOS, EOS
+        trans = transition[:C, :C]
+        bos = transition[C, :C]
+        eos = transition[:C, C + 1]
+    else:
+        trans = transition
+        bos = jnp.zeros((C,), potentials.dtype)
+        eos = jnp.zeros((C,), potentials.dtype)
+
+    alpha0 = potentials[:, 0] + bos  # [B, C]
+    lens = (None if lengths is None
+            else unwrap(lengths).astype(jnp.int32))
+
+    def step(carry, inp):
+        alpha = carry
+        emit, t = inp
+        scores = alpha[:, :, None] + trans[None]  # [B, C_prev, C]
+        best_prev = jnp.argmax(scores, axis=1)    # [B, C]
+        new = jnp.max(scores, axis=1) + emit
+        if lens is not None:
+            live = (t < lens)[:, None]            # padded steps freeze
+            new = jnp.where(live, new, alpha)
+            best_prev = jnp.where(live, best_prev,
+                                  jnp.arange(C)[None, :])
+        return new, best_prev
+
+    alpha, back = lax.scan(
+        step, alpha0,
+        (jnp.swapaxes(potentials[:, 1:], 0, 1),
+         jnp.arange(1, T)))
+    alpha = alpha + eos
+    last = jnp.argmax(alpha, axis=-1)             # [B]
+    score = jnp.max(alpha, axis=-1)
+
+    def backstep(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, prev  # ys[t] = tag_t, carry walks backwards
+
+    _, path = lax.scan(backstep, last, back, reverse=True)
+    path = jnp.concatenate([jnp.swapaxes(path, 0, 1), last[:, None]],
+                           axis=1)
+    return score, path
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Reference ``top_p_sampling``: nucleus sampling over logits
+    [B, V]; keeps the smallest prefix of the sorted distribution with
+    cumulative probability >= p, samples within it. Returns
+    (scores, token ids)."""
+    import jax.random as jr
+
+    key_data = jax.random.key_data(state.default_rng.next_key())
+
+    @primitive(name="top_p_sampling")
+    def _tps(logits, p, key):
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        order = jnp.argsort(-probs, axis=-1)
+        sp = jnp.take_along_axis(probs, order, axis=-1)
+        cum = jnp.cumsum(sp, axis=-1)
+        keep = (cum - sp) < p.reshape(-1, 1)  # first bucket always kept
+        masked = jnp.where(keep, sp, 0.0)
+        masked = masked / jnp.sum(masked, axis=-1, keepdims=True)
+        idx = jr.categorical(jr.wrap_key_data(key), jnp.log(masked + 1e-30))
+        token = jnp.take_along_axis(order, idx[:, None], axis=-1)
+        score = jnp.take_along_axis(probs, token, axis=-1)
+        return score, token
+
+    return _tps(x, ps, key_data)

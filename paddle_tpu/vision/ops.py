@@ -190,4 +190,324 @@ def box_coder(prior_box, prior_box_var, target_box,
                      axis=-1)
 
 
-__all__ = ["nms", "roi_align", "roi_pool", "box_coder"]
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Reference ``yolo_box``: decode YOLOv3 head output [N, C, H, W]
+    into (boxes [N, H*W*A, 4], scores [N, H*W*A, class_num])."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply, unwrap
+    import numpy as np
+
+    anchors = np.asarray(unwrap(anchors)).reshape(-1, 2)
+    A = len(anchors)
+
+    def impl(xv, img):
+        n, c, h, w = xv.shape
+        if iou_aware:
+            # layout [N, A*(6+cls), H, W]: first A channels predict IoU
+            ioup = jax.nn.sigmoid(xv[:, :A].reshape(n, A, h, w))
+            xv = xv[:, A:]
+        pred = xv.reshape(n, A, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        sig = jax.nn.sigmoid
+        bx = (sig(pred[:, :, 0]) * scale_x_y
+              - 0.5 * (scale_x_y - 1.0) + gx) / w
+        by = (sig(pred[:, :, 1]) * scale_x_y
+              - 0.5 * (scale_x_y - 1.0) + gy) / h
+        aw = jnp.asarray(anchors[:, 0], jnp.float32)[None, :, None, None]
+        ah = jnp.asarray(anchors[:, 1], jnp.float32)[None, :, None, None]
+        bw = jnp.exp(pred[:, :, 2]) * aw / (w * downsample_ratio)
+        bh = jnp.exp(pred[:, :, 3]) * ah / (h * downsample_ratio)
+        conf = sig(pred[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1 - iou_aware_factor) * \
+                ioup ** iou_aware_factor
+        probs = sig(pred[:, :, 5:]) * conf[:, :, None]
+        # below-threshold predictions are zeroed (reference semantics)
+        keep = (conf >= conf_thresh).astype(jnp.float32)
+        imh = img[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = img[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]
+        scores = probs * keep[:, :, None]
+        boxes = boxes.reshape(n, -1, 4)           # [n, A, h, w, 4]
+        scores = scores.transpose(0, 1, 3, 4, 2).reshape(
+            n, -1, class_num)
+        return boxes, scores
+
+    import jax
+    return apply("yolo_box", impl, x, img_size)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """Reference ``prior_box``: SSD anchor generation over the feature
+    map grid. Host-side (static given shapes; anchors are data-prep)."""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+    from ..core.tensor import Tensor
+
+    fh, fw = unwrap(input).shape[2:4]
+    ih, iw = unwrap(image).shape[2:4]
+    sh = steps[1] or ih / fh
+    sw = steps[0] or iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes, vars_ = [], []
+    for y in range(fh):
+        for x in range(fw):
+            cx = (x + offset) * sw
+            cy = (y + offset) * sh
+            cell = []
+            for i, ms in enumerate(min_sizes):
+                ms = float(ms)
+                for ar in ars:
+                    w = ms * np.sqrt(ar) / 2
+                    h = ms / np.sqrt(ar) / 2
+                    cell.append([(cx - w) / iw, (cy - h) / ih,
+                                 (cx + w) / iw, (cy + h) / ih])
+                if max_sizes:
+                    bs = np.sqrt(ms * float(max_sizes[i])) / 2
+                    cell.append([(cx - bs) / iw, (cy - bs) / ih,
+                                 (cx + bs) / iw, (cy + bs) / ih])
+            boxes.extend(cell)
+            vars_.extend([list(variance)] * len(cell))
+    out = np.asarray(boxes, np.float32).reshape(fh, fw, -1, 4)
+    var = np.asarray(vars_, np.float32).reshape(fh, fw, -1, 4)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    return Tensor(out), Tensor(var)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Reference ``matrix_nms`` (SOLOv2): parallel soft-NMS — every box's
+    score decays by its worst overlap with a higher-scored same-class box.
+    Host numpy (data-dependent output size, like ``nms``)."""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+    from ..core.tensor import Tensor
+
+    b = np.asarray(unwrap(bboxes))      # [N, M, 4]
+    s = np.asarray(unwrap(scores))      # [N, C, M]
+    outs, idxs, nums = [], [], []
+    for n in range(b.shape[0]):
+        dets = []
+        det_idx = []
+        for c in range(s.shape[1]):
+            if c == background_label:
+                continue
+            sc = s[n, c]
+            keep = np.flatnonzero(sc > score_threshold)
+            if keep.size == 0:
+                continue
+            keep = keep[np.argsort(-sc[keep])][:nms_top_k]
+            bb, sS = b[n, keep], sc[keep]
+            x1, y1, x2, y2 = bb.T
+            off = 0.0 if normalized else 1.0
+            area = (x2 - x1 + off) * (y2 - y1 + off)
+            ix1 = np.maximum(x1[:, None], x1[None])
+            iy1 = np.maximum(y1[:, None], y1[None])
+            ix2 = np.minimum(x2[:, None], x2[None])
+            iy2 = np.minimum(y2[:, None], y2[None])
+            inter = (np.clip(ix2 - ix1 + off, 0, None)
+                     * np.clip(iy2 - iy1 + off, 0, None))
+            iou = inter / (area[:, None] + area[None] - inter)
+            iou = np.triu(iou, 1)                 # [i, j]: i suppresses j
+            # compensation: how much suppressor i was itself suppressed
+            iou_cmax = iou.max(axis=0)[:, None]   # per ROW i
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - iou_cmax ** 2)
+                               / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1 - iou)
+                         / (1 - iou_cmax + 1e-10)).min(axis=0)
+            dec = sS * decay
+            ok = dec >= post_threshold
+            for j in np.flatnonzero(ok):
+                dets.append([c, dec[j], *bb[j]])
+                det_idx.append(keep[j])
+        if dets:
+            dets = np.asarray(dets, np.float32)
+            order = np.argsort(-dets[:, 1])[:keep_top_k]
+            dets = dets[order]
+            det_idx = np.asarray(det_idx)[order]
+        else:
+            dets = np.zeros((0, 6), np.float32)
+            det_idx = np.zeros((0,), np.int64)
+        outs.append(dets)
+        idxs.append(det_idx + n * b.shape[1])
+        nums.append(len(dets))
+    out = Tensor(np.concatenate(outs) if outs
+                 else np.zeros((0, 6), np.float32))
+    res = [out]
+    if return_index:
+        res.append(Tensor(np.concatenate(idxs).astype(np.int64)))
+    if return_rois_num:
+        res.append(Tensor(np.asarray(nums, np.int32)))
+    return tuple(res) if len(res) > 1 else out
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Reference ``psroi_pool``: position-sensitive RoI average pooling —
+    output channel (c, i, j) pools input channel c*k*k + i*k + j over
+    bin (i, j) of the RoI."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..core.dispatch import apply, unwrap
+
+    k = output_size if isinstance(output_size, int) else output_size[0]
+    nboxes = np.asarray(unwrap(boxes_num))
+    batch_of_box = np.repeat(np.arange(len(nboxes)), nboxes)
+
+    def impl(xv, bx):
+        n, c, h, w = xv.shape
+        oc = c // (k * k)
+        outs = []
+        for bi in range(bx.shape[0]):
+            img = xv[batch_of_box[bi]]
+            x1, y1, x2, y2 = [bx[bi, i] * spatial_scale for i in range(4)]
+            bh = jnp.maximum(y2 - y1, 0.1) / k
+            bw = jnp.maximum(x2 - x1, 0.1) / k
+            bins = []
+            ys = jnp.arange(h, dtype=jnp.float32)
+            xs = jnp.arange(w, dtype=jnp.float32)
+            for i in range(k):
+                for j in range(k):
+                    my = ((ys >= jnp.floor(y1 + i * bh))
+                          & (ys < jnp.ceil(y1 + (i + 1) * bh)))
+                    mx = ((xs >= jnp.floor(x1 + j * bw))
+                          & (xs < jnp.ceil(x1 + (j + 1) * bw)))
+                    m = (my[:, None] & mx[None, :]).astype(xv.dtype)
+                    cnt = jnp.maximum(m.sum(), 1.0)
+                    ch = img[(jnp.arange(oc) * k * k + i * k + j)]
+                    bins.append((ch * m[None]).sum((1, 2)) / cnt)
+            outs.append(jnp.stack(bins, 1).reshape(oc, k, k))
+        return jnp.stack(outs)
+
+    return apply("psroi_pool", impl, x, boxes)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Reference ``deformable_conv`` (v1/v2): sample the input at
+    offset-shifted taps (bilinear), then convolve. Implemented as
+    gather + einsum — the MXU-friendly formulation (im2col with learned
+    coordinates)."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int)         else tuple(dilation)
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError("deform_conv2d: groups == 1 only")
+
+    def impl(xv, off, w, *rest):
+        n, c, h, wd = xv.shape
+        co, ci, kh, kw = w.shape
+        oh = (h + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        ow = (wd + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        base_y = (jnp.arange(oh) * st[0] - pd[0])[:, None, None]
+        base_x = (jnp.arange(ow) * st[1] - pd[1])[None, :, None]
+        ky = (jnp.arange(kh) * dl[0])[None, None, :, None]
+        kx = (jnp.arange(kw) * dl[1])[None, None, None, :]
+        off = off.reshape(n, kh, kw, 2, oh, ow)
+        oy = off[:, :, :, 0].transpose(0, 3, 4, 1, 2)  # [n,oh,ow,kh,kw]
+        ox = off[:, :, :, 1].transpose(0, 3, 4, 1, 2)
+        py = base_y[None, :, :, :, None] + ky[None] + oy
+        px = base_x[None, :, :, None, :] + kx[None] + ox
+
+        y0 = jnp.floor(py); x0 = jnp.floor(px)
+        wy = py - y0; wx = px - x0
+
+        def sample(yy, xx):
+            yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, wd - 1).astype(jnp.int32)
+            valid = ((yy >= 0) & (yy <= h - 1)
+                     & (xx >= 0) & (xx <= wd - 1))
+            flat = xv.reshape(n, c, -1)
+            idx = (yi * wd + xi).reshape(n, 1, -1)
+            g = jnp.take_along_axis(
+                flat, jnp.broadcast_to(idx, (n, c, idx.shape[-1])),
+                axis=2)
+            g = g.reshape((n, c) + yy.shape[1:])
+            return g * valid[:, None].astype(xv.dtype)
+
+        v = (sample(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
+             + sample(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
+             + sample(y0 + 1, x0) * (wy * (1 - wx))[:, None]
+             + sample(y0 + 1, x0 + 1) * (wy * wx)[:, None])
+        if mask is not None:
+            mk = rest[-1].reshape(n, kh, kw, oh, ow)                 .transpose(0, 3, 4, 1, 2)
+            v = v * mk[:, None]
+        out = jnp.einsum("nchwij,ocij->nohw", v, w)
+        if bias is not None:
+            out = out + rest[0].reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if bias is not None:
+        args.append(bias)
+    if mask is not None:
+        args.append(mask)
+    return apply("deform_conv2d", impl, *args)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Reference ``distribute_fpn_proposals``: route each RoI to an FPN
+    level by its scale. Host numpy (data-dependent splits)."""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+    from ..core.tensor import Tensor
+
+    rois = np.asarray(unwrap(fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.clip((rois[:, 2] - rois[:, 0] + off)
+                            * (rois[:, 3] - rois[:, 1] + off), 0, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, index = [], []
+    for l in range(min_level, max_level + 1):
+        sel = np.flatnonzero(lvl == l)
+        outs.append(Tensor(rois[sel]))
+        index.append(sel)
+    restore = np.argsort(np.concatenate(index)) if index else np.array([])
+    res_num = [Tensor(np.asarray([len(i)], np.int32)) for i in index]
+    out = (outs, Tensor(restore.astype(np.int64)))
+    if rois_num is not None:
+        return outs, Tensor(restore.astype(np.int64)), res_num
+    return out
+
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
+           "prior_box", "matrix_nms", "psroi_pool", "deform_conv2d",
+           "distribute_fpn_proposals"]

@@ -1,0 +1,258 @@
+"""Op-surface completion batch 2 (reference ops.yaml rows): special
+functions, sampling, linalg completions, sequence/beam ops, losses
+(huber/hsigmoid/rnnt), max_unpool2d, metric.accuracy, detection ops."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def test_special_functions():
+    assert abs(float(paddle.gammaln(paddle.to_tensor([5.0])).numpy()[0])
+               - np.log(24)) < 1e-4
+    assert abs(float(paddle.gammaincc(paddle.to_tensor([2.0]),
+                                      paddle.to_tensor([0.5])).numpy()[0])
+               - 0.9098) < 1e-3
+    paddle.polygamma(paddle.to_tensor([2.0]), n=1)
+    assert float(paddle.nanmedian(
+        paddle.to_tensor([1.0, float("nan"), 3.0])).numpy()) == 2.0
+
+
+def test_add_n_clip_by_norm():
+    assert paddle.add_n(
+        [paddle.ones([2])] * 3).numpy().tolist() == [3.0, 3.0]
+    v = paddle.clip_by_norm(paddle.to_tensor([3.0, 4.0]), 1.0)
+    np.testing.assert_allclose(np.linalg.norm(v.numpy()), 1.0, rtol=1e-5)
+
+
+def test_sampling_ops():
+    paddle.seed(0)
+    g = paddle.standard_gamma(paddle.full([2000], 2.0))
+    assert abs(float(g.numpy().mean()) - 2.0) < 0.15
+    b = paddle.binomial(paddle.full([2000], 10.0), paddle.full([2000], 0.3))
+    assert abs(float(b.numpy().mean()) - 3.0) < 0.25
+    d = paddle.distribution.Binomial(paddle.to_tensor(10.0),
+                                     paddle.to_tensor(0.3))
+    from scipy.stats import binom
+    np.testing.assert_allclose(float(d.log_prob(paddle.to_tensor(3.0))),
+                               binom.logpmf(3, 10, 0.3), rtol=1e-5)
+
+
+def test_linalg_completions():
+    ev = paddle.eigvals(paddle.to_tensor(
+        np.diag([1.0, 2.0]).astype("float32")))
+    assert sorted(np.real(ev.numpy()).tolist()) == [1.0, 2.0]
+    import scipy.linalg as sl
+    A = np.random.default_rng(0).normal(size=(4, 4)).astype("float32")
+    lu, piv = sl.lu_factor(A)
+    P, L, U = paddle.lu_unpack(paddle.to_tensor(lu),
+                               paddle.to_tensor((piv + 1).astype("int32")))
+    np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), A,
+                               atol=1e-5)
+
+
+def test_gather_tree():
+    # reference docstring example
+    ids = paddle.to_tensor(np.array(
+        [[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]], "int32"))
+    par = paddle.to_tensor(np.array(
+        [[[0, 0], [1, 1]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]], "int32"))
+    out = paddle.gather_tree(ids, par).numpy()
+    np.testing.assert_array_equal(
+        out, [[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]])
+
+
+def test_viterbi_decode_matches_brute_force():
+    rng = np.random.default_rng(0)
+    pot = rng.normal(size=(2, 5, 3)).astype("float32")
+    trans = rng.normal(size=(3, 3)).astype("float32")
+    score, path = paddle.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        include_bos_eos_tag=False)
+    import itertools
+    for b in range(2):
+        best = max(itertools.product(range(3), repeat=5),
+                   key=lambda t: pot[b][range(5), list(t)].sum()
+                   + sum(trans[t[i], t[i + 1]] for i in range(4)))
+        assert tuple(path.numpy()[b]) == best
+
+
+def test_top_p_sampling():
+    paddle.seed(1)
+    logits = paddle.to_tensor(np.array([[10., 0., 0., 0.]], "float32"))
+    s, tok = paddle.top_p_sampling(logits, paddle.to_tensor([0.5]))
+    assert tok.numpy().tolist() == [[0]]
+
+
+def test_huber_loss():
+    out = F.huber_loss(paddle.to_tensor([0.5, 2.0]),
+                       paddle.to_tensor([0.0, 0.0]),
+                       delta=1.0, reduction="none").numpy()
+    np.testing.assert_allclose(out, [0.125, 1.5])
+
+
+def test_rnnt_loss_matches_brute_force():
+    logits = np.random.default_rng(0).normal(
+        size=(1, 2, 2, 2)).astype("float32")
+    lp = np.log(np.exp(logits) / np.exp(logits).sum(-1, keepdims=True))
+    # T=2, U=1, blank=0: two alignments (emit at t0 / emit at t1)
+    a1 = lp[0, 0, 0, 1] + lp[0, 0, 1, 0] + lp[0, 1, 1, 0]
+    a2 = lp[0, 0, 0, 0] + lp[0, 1, 0, 1] + lp[0, 1, 1, 0]
+    got = float(F.rnnt_loss(
+        paddle.to_tensor(logits),
+        paddle.to_tensor(np.array([[1]], "int32")),
+        paddle.to_tensor(np.array([2], "int32")),
+        paddle.to_tensor(np.array([1], "int32"))).numpy())
+    np.testing.assert_allclose(got, -np.logaddexp(a1, a2), rtol=1e-5)
+
+
+def test_hsigmoid_trains():
+    paddle.seed(0)
+    x = paddle.to_tensor(np.random.default_rng(1).normal(
+        size=(8, 6)).astype("float32"))
+    lbl = paddle.to_tensor(np.arange(8, dtype="int32") % 4)
+    w = paddle.to_tensor(np.random.default_rng(2).normal(
+        size=(3, 6)).astype("float32") * 0.1)
+    w.stop_gradient = False
+    opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[w])
+    losses = []
+    for _ in range(20):
+        loss = F.hsigmoid_loss(x, lbl, 4, w)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_max_unpool2d_roundtrip():
+    x = paddle.to_tensor(np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    pooled, mask = F.max_pool2d(x, 2, 2, return_mask=True)
+    un = F.max_unpool2d(pooled, mask, 2, 2)
+    expect = np.zeros((1, 1, 4, 4), "float32")
+    for v in [5, 7, 13, 15]:
+        expect.reshape(-1)[v] = v
+    np.testing.assert_allclose(un.numpy(), expect)
+
+
+def test_metric_accuracy():
+    acc = paddle.metric.accuracy(
+        paddle.to_tensor([[0.1, 0.9], [0.8, 0.2]]),
+        paddle.to_tensor([1, 1]))
+    assert float(acc.numpy()) == 0.5
+    acc2 = paddle.metric.accuracy(
+        paddle.to_tensor([[0.1, 0.9], [0.8, 0.2]]),
+        paddle.to_tensor([1, 1]), k=2)
+    assert float(acc2.numpy()) == 1.0
+
+
+# --- detection ops --------------------------------------------------------
+
+def test_prior_box():
+    boxes, var = paddle.vision.ops.prior_box(
+        paddle.zeros([1, 8, 4, 4]), paddle.zeros([1, 3, 32, 32]),
+        min_sizes=[8.0], aspect_ratios=[1.0, 2.0], flip=True)
+    assert tuple(boxes.shape) == (4, 4, 3, 4)
+    assert tuple(var.shape) == (4, 4, 3, 4)
+
+
+def test_yolo_box():
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(1, 3 * 7, 2, 2)).astype("float32"))
+    img = paddle.to_tensor(np.array([[64, 64]], "int32"))
+    b, s = paddle.vision.ops.yolo_box(
+        x, img, anchors=[10, 13, 16, 30, 33, 23], class_num=2,
+        conf_thresh=0.5, downsample_ratio=32)
+    assert tuple(b.shape) == (1, 12, 4) and tuple(s.shape) == (1, 12, 2)
+    # boxes stay inside the clipped image
+    assert float(b.numpy().max()) <= 63.0 and float(b.numpy().min()) >= 0.0
+
+
+def test_matrix_nms_decays_duplicates():
+    bb = paddle.to_tensor(np.array(
+        [[[0, 0, 10, 10], [0, 1, 10, 11], [20, 20, 30, 30]]], "float32"))
+    sc = paddle.to_tensor(np.array(
+        [[[0.0, 0.0, 0.0], [0.9, 0.8, 0.7]]], "float32"))
+    out, idx, num = paddle.vision.ops.matrix_nms(
+        bb, sc, score_threshold=0.1, post_threshold=0.0,
+        background_label=0, return_index=True)
+    assert out.shape[1] == 6 and int(num.numpy()[0]) == out.shape[0]
+    got = {tuple(r[2:].astype(int)): r[1] for r in out.numpy()}
+    # top box and the disjoint box keep their scores
+    np.testing.assert_allclose(got[(0, 0, 10, 10)], 0.9, rtol=1e-6)
+    np.testing.assert_allclose(got[(20, 20, 30, 30)], 0.7, rtol=1e-6)
+    # near-duplicate decays by (1 - iou): iou ~ 0.8182 -> 0.8 * 0.1818
+    iou = (10 * 9) / (2 * 100 - 10 * 9)
+    np.testing.assert_allclose(got[(0, 1, 10, 11)], 0.8 * (1 - iou),
+                               rtol=1e-4)
+
+
+def test_yolo_box_coordinates_consistent():
+    """Box coords must come from the same grid cell (layout regression:
+    coords axis is already last — no transpose)."""
+    x = np.zeros((1, 1 * 7, 2, 2), "float32")
+    x[:, 4] = 10.0  # conf ~ 1 everywhere
+    b, s = paddle.vision.ops.yolo_box(
+        paddle.to_tensor(x), paddle.to_tensor(np.array([[64, 64]], "int32")),
+        anchors=[16, 16], class_num=2, conf_thresh=0.1,
+        downsample_ratio=32, clip_bbox=False)
+    bn = b.numpy().reshape(2, 2, 4)  # grid [h, w, 4]
+    # with zero tx/ty, centers sit at (col+0.5)/2, (row+0.5)/2 of the image
+    for r in range(2):
+        for c in range(2):
+            cx = (bn[r, c, 0] + bn[r, c, 2]) / 2
+            cy = (bn[r, c, 1] + bn[r, c, 3]) / 2
+            np.testing.assert_allclose(cx, (c + 0.5) / 2 * 64, rtol=1e-4)
+            np.testing.assert_allclose(cy, (r + 0.5) / 2 * 64, rtol=1e-4)
+
+
+def test_viterbi_lengths_masking():
+    rng = np.random.default_rng(1)
+    pot = rng.normal(size=(2, 4, 3)).astype("float32")
+    trans = rng.normal(size=(3, 3)).astype("float32")
+    # batch entry 1 has length 2: its score must equal decoding just 2 steps
+    score, path = paddle.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        lengths=paddle.to_tensor(np.array([4, 2], "int32")),
+        include_bos_eos_tag=False)
+    s_short, p_short = paddle.viterbi_decode(
+        paddle.to_tensor(pot[1:, :2]), paddle.to_tensor(trans),
+        include_bos_eos_tag=False)
+    np.testing.assert_allclose(float(score.numpy()[1]),
+                               float(s_short.numpy()[0]), rtol=1e-5)
+    np.testing.assert_array_equal(path.numpy()[1, :2], p_short.numpy()[0])
+
+
+def test_psroi_pool_constant():
+    out = paddle.vision.ops.psroi_pool(
+        paddle.to_tensor(np.ones((1, 8, 8, 8), "float32")),
+        paddle.to_tensor(np.array([[0, 0, 8, 8]], "float32")),
+        paddle.to_tensor(np.array([1], "int32")), 2)
+    assert tuple(out.shape) == (1, 2, 2, 2)
+    np.testing.assert_allclose(out.numpy(), 1.0)
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    x = paddle.to_tensor(np.random.default_rng(1).normal(
+        size=(1, 2, 6, 6)).astype("float32"))
+    w = paddle.to_tensor(np.random.default_rng(2).normal(
+        size=(3, 2, 3, 3)).astype("float32"))
+    off = paddle.zeros([1, 2 * 3 * 3, 4, 4])
+    out = paddle.vision.ops.deform_conv2d(x, off, w)
+    ref = F.conv2d(x, w)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=2e-4)
+    # grads flow through the bilinear sampling
+    x.stop_gradient = False
+    paddle.vision.ops.deform_conv2d(x, off, w).sum().backward()
+    assert x.grad is not None
+
+
+def test_distribute_fpn_proposals():
+    rois = paddle.to_tensor(np.array(
+        [[0, 0, 16, 16], [0, 0, 200, 200]], "float32"))
+    outs, restore = paddle.vision.ops.distribute_fpn_proposals(
+        rois, 2, 5, 4, 224)
+    assert len(outs) == 4
+    assert sum(o.shape[0] for o in outs) == 2
+    assert sorted(restore.numpy().tolist()) == [0, 1]
