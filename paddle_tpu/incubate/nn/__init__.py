@@ -1,4 +1,8 @@
 """``paddle.incubate.nn`` parity (reference ``python/paddle/incubate/nn``)."""
 from . import functional  # noqa: F401
+from .layer import (  # noqa: F401
+    FusedDropoutAdd, FusedFeedForward, FusedLinear,
+    FusedMultiHeadAttention)
 
-__all__ = ["functional"]
+__all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedLinear", "FusedDropoutAdd"]

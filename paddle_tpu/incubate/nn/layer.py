@@ -1,0 +1,131 @@
+"""Fused transformer layers (reference ``python/paddle/incubate/nn/layer/
+fused_transformer.py``: FusedMultiHeadAttention :278, FusedFeedForward
+:564; ``fused_dropout_add.py``, ``fused_linear.py``).
+
+TPU-native: "fused" means routed through the Pallas/fused-functional tier
+(flash attention, fused norms) and left to XLA to fuse the rest — the
+layer classes keep the reference's signatures so incubate call sites work.
+"""
+from __future__ import annotations
+
+from ...nn import functional as F
+from ...nn.layer import Layer
+from ...nn.layers import Dropout, LayerNorm, Linear
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "FusedLinear",
+           "FusedDropoutAdd"]
+
+
+class FusedLinear(Layer):
+    """Reference ``fused_linear.py`` FusedLinear (gemm+bias in one op —
+    XLA fuses these natively)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        if transpose_weight:
+            raise NotImplementedError(
+                "FusedLinear(transpose_weight=True) stores [out, in] "
+                "weights; use the default layout on this backend")
+        self.linear = Linear(in_features, out_features,
+                             weight_attr=weight_attr, bias_attr=bias_attr)
+
+    def forward(self, x):
+        return self.linear(x)
+
+
+class FusedDropoutAdd(Layer):
+    """Reference ``fused_dropout_add.py``: dropout(x) + y in one pass."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.drop = Dropout(p, mode=mode)
+
+    def forward(self, x, y):
+        return self.drop(x) + y
+
+
+class FusedMultiHeadAttention(Layer):
+    """Reference ``fused_transformer.py:278``: pre/post-LN multi-head
+    self-attention block with fused qkv, flash-attention core, residual."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, transpose_qkv_wb=False, name=None):
+        super().__init__()
+        if need_weights:
+            raise NotImplementedError("need_weights=True is unsupported "
+                                      "(flash attention never forms the "
+                                      "probability matrix)")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.qkv = Linear(embed_dim, 3 * embed_dim,
+                          weight_attr=qkv_weight_attr,
+                          bias_attr=qkv_bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim,
+                               weight_attr=linear_weight_attr,
+                               bias_attr=linear_bias_attr)
+        self.ln = LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.drop = Dropout(dropout_rate)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        from ... import ops
+        residual = query
+        x = self.ln(query) if self.normalize_before else query
+        b, s, _ = x.shape
+        qkv = ops.reshape(self.qkv(x),
+                          [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = ops.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate if self.training else 0.0)
+        out = self.out_proj(ops.reshape(out, [b, s, self.embed_dim]))
+        out = residual + self.drop(out)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """Reference ``fused_transformer.py:564``: pre/post-LN FFN block with
+    residual (linear→act→dropout→linear→dropout + add)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.fc1 = Linear(d_model, dim_feedforward,
+                          weight_attr=linear1_weight_attr,
+                          bias_attr=linear1_bias_attr)
+        self.fc2 = Linear(dim_feedforward, d_model,
+                          weight_attr=linear2_weight_attr,
+                          bias_attr=linear2_bias_attr)
+        self.ln = LayerNorm(d_model, epsilon=epsilon)
+        self.act = getattr(F, activation)
+        self.drop_act = Dropout(act_dropout_rate if act_dropout_rate
+                                is not None else dropout_rate)
+        self.drop_out = Dropout(dropout_rate)
+
+    def forward(self, src, cache=None):
+        residual = src
+        x = self.ln(src) if self.normalize_before else src
+        x = self.fc2(self.drop_act(self.act(self.fc1(x))))
+        out = residual + self.drop_out(x)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out

@@ -361,42 +361,41 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
                   path_table=None, path_code=None, is_sparse=False,
                   name=None):
     """Reference ``hsigmoid_loss``: hierarchical sigmoid over a binary
-    tree. Default tree = complete binary heap (leaf of class c at heap
-    slot c + num_classes - 1, internal nodes 0..num_classes-2), matching
-    the reference's built-in coding; custom trees come via
-    ``path_table``/``path_code`` [N, L] (padded with -1)."""
+    tree; returns the per-sample loss [N, 1] (reference output shape).
+    Default tree = complete binary heap (leaf of class c at heap slot
+    c + num_classes - 1, internal nodes 0..num_classes-2), computed with
+    traceable bit arithmetic so the loss works under jit; custom trees
+    come via ``path_table``/``path_code`` [N, L] (padded with -1)."""
     import numpy as np
 
     from ...core.dispatch import unwrap
 
-    if path_table is None:
-        n = int(num_classes)
-        depth = max(int(np.ceil(np.log2(max(n, 2)))), 1)
-        labels_np = np.asarray(unwrap(label)).reshape(-1)
-        tables, codes = [], []
-        for c in labels_np:
-            node = int(c) + n - 1  # heap leaf slot
-            path, code = [], []
-            while node > 0:
-                parent = (node - 1) // 2
-                path.append(parent)
-                code.append(node == 2 * parent + 2)  # right child?
-                node = parent
-            path = path[::-1][:depth]
-            code = code[::-1][:depth]
-            pad = depth - len(path)
-            tables.append(path + [-1] * pad)
-            codes.append([float(v) for v in code] + [0.0] * pad)
-        path_table = np.asarray(tables, np.int32)
-        path_code = np.asarray(codes, np.float32)
-    else:
+    n = int(num_classes)
+    depth = max(int(np.ceil(np.log2(max(n, 2)))), 1)
+    use_default_tree = path_table is None
+    if not use_default_tree:
         path_table = np.asarray(unwrap(path_table), np.int32)
         path_code = np.asarray(unwrap(path_code), np.float32)
 
-    def impl(x, w, *maybe_bias):
-        pt = jnp.asarray(path_table)
-        pc = jnp.asarray(path_code)
-        valid = (pt >= 0).astype(x.dtype)
+    def impl(x, lab, w, *maybe_bias):
+        if use_default_tree:
+            # walk the heap from each label's leaf up — fixed `depth`
+            # unrolled steps, pure jnp (jit-traceable)
+            node = lab.reshape(-1).astype(jnp.int32) + n - 1
+            steps = []
+            for _ in range(depth):
+                parent = (node - 1) // 2
+                steps.append((parent, (node == 2 * parent + 2), node > 0))
+                node = parent
+            pt = jnp.stack([s[0] for s in steps[::-1]], axis=1)
+            pc = jnp.stack([s[1] for s in steps[::-1]],
+                           axis=1).astype(x.dtype)
+            vmask = jnp.stack([s[2] for s in steps[::-1]],
+                              axis=1).astype(x.dtype)
+        else:
+            pt = jnp.asarray(path_table)
+            pc = jnp.asarray(path_code)
+            vmask = (pt >= 0).astype(x.dtype)
         idx = jnp.maximum(pt, 0)
         wn = jnp.take(w, idx, axis=0)             # [N, L, D]
         logits = jnp.einsum("nd,nld->nl", x, wn)
@@ -405,9 +404,9 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         # sigmoid CE with target = code (1 right, 0 left)
         ce = jnp.maximum(logits, 0) - logits * pc + jnp.log1p(
             jnp.exp(-jnp.abs(logits)))
-        return jnp.mean(jnp.sum(ce * valid, axis=1))
+        return jnp.sum(ce * vmask, axis=1, keepdims=True)  # [N, 1]
 
-    args = (input, weight) + ((bias,) if bias is not None else ())
+    args = (input, label, weight) + ((bias,) if bias is not None else ())
     return apply("hsigmoid_loss", impl, *args)
 
 
@@ -478,8 +477,6 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
                 axis=1)[:, 0, :],
             lab_len.astype(jnp.int32)[:, None], axis=1)[:, 0]
         loss = -(a_end + blank_end)
-        if reduction == "mean":
-            return jnp.mean(loss)
         return _reduce(loss, reduction)
 
     return apply("rnnt_loss", impl, input, label, input_lengths,

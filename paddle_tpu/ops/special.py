@@ -150,25 +150,26 @@ def gather_tree(ids, parents):
 @primitive
 def viterbi_decode(potentials, transition, lengths=None,
                    include_bos_eos_tag=True):
-    """Reference ``viterbi_decode``: max-sum decoding over a linear-chain
-    CRF. potentials [B, T, C], transition [C, C] (+2 BOS/EOS rows when
-    ``include_bos_eos_tag``). ``lengths`` masks padded timesteps (path
-    positions past a sequence's length repeat its final tag). Returns
-    (scores [B], paths [B, T])."""
+    """Reference ``viterbi_decode`` (``text/viterbi_decode.py:26``,
+    kernel ``phi/kernels/cpu/viterbi_decode_kernel.cc``): max-sum decode
+    over a linear-chain CRF. potentials [B, T, C], transition [C, C];
+    with ``include_bos_eos_tag`` the LAST row is the start tag and the
+    SECOND-TO-LAST column the stop tag (the reference convention).
+    ``lengths`` masks padded timesteps (path positions past a sequence's
+    length repeat its final tag). Returns (scores [B], int64 paths
+    [B, T])."""
     B, T, C = potentials.shape
+    trans = transition
     if include_bos_eos_tag:
-        # transition is [C+2, C+2]: last two rows/cols are BOS, EOS
-        trans = transition[:C, :C]
-        bos = transition[C, :C]
-        eos = transition[:C, C + 1]
+        bos = transition[C - 1, :]   # start-tag row
+        eos = transition[:, C - 2]   # stop-tag column
     else:
-        trans = transition
         bos = jnp.zeros((C,), potentials.dtype)
         eos = jnp.zeros((C,), potentials.dtype)
 
     alpha0 = potentials[:, 0] + bos  # [B, C]
     lens = (None if lengths is None
-            else unwrap(lengths).astype(jnp.int32))
+            else lengths.astype(jnp.int32))
 
     def step(carry, inp):
         alpha = carry
@@ -198,17 +199,22 @@ def viterbi_decode(potentials, transition, lengths=None,
     _, path = lax.scan(backstep, last, back, reverse=True)
     path = jnp.concatenate([jnp.swapaxes(path, 0, 1), last[:, None]],
                            axis=1)
-    return score, path
+    return score, path.astype(jnp.int64)
 
 
 def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
     """Reference ``top_p_sampling``: nucleus sampling over logits
     [B, V]; keeps the smallest prefix of the sorted distribution with
-    cumulative probability >= p, samples within it. Returns
-    (scores, token ids)."""
+    cumulative probability >= p (candidates below ``threshold`` are also
+    dropped), samples within it. ``seed`` makes the draw reproducible;
+    otherwise the framework RNG stream (``paddle.seed``) is used.
+    Returns (scores, token ids)."""
     import jax.random as jr
 
-    key_data = jax.random.key_data(state.default_rng.next_key())
+    if seed is not None and seed >= 0:
+        key_data = jax.random.key_data(jax.random.PRNGKey(seed))
+    else:
+        key_data = jax.random.key_data(state.default_rng.next_key())
 
     @primitive(name="top_p_sampling")
     def _tps(logits, p, key):
@@ -217,6 +223,9 @@ def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
         sp = jnp.take_along_axis(probs, order, axis=-1)
         cum = jnp.cumsum(sp, axis=-1)
         keep = (cum - sp) < p.reshape(-1, 1)  # first bucket always kept
+        if threshold is not None:
+            keep = keep & (sp >= threshold)
+            keep = keep.at[:, 0].set(True)    # never drop every token
         masked = jnp.where(keep, sp, 0.0)
         masked = masked / jnp.sum(masked, axis=-1, keepdims=True)
         idx = jr.categorical(jr.wrap_key_data(key), jnp.log(masked + 1e-30))

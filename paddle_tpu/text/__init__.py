@@ -13,67 +13,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.dispatch import primitive
 from ..io import Dataset
 
 
-@primitive("gather_tree")
-def gather_tree(ids, parents):
-    """Beam-search trace-back (reference ``nn/decode gather_tree``):
-    ids/parents: [max_time, batch, beam] -> full sequences by walking
-    parent pointers from the last step."""
-    t, b, k = ids.shape
-
-    def step(carry, inp):
-        beams = carry                      # [batch, beam] current beam idx
-        id_t, par_t = inp                  # each [batch, beam]
-        out = jnp.take_along_axis(id_t, beams, axis=-1)
-        nxt = jnp.take_along_axis(par_t, beams, axis=-1)
-        return nxt, out
-
-    last = jnp.broadcast_to(jnp.arange(k, dtype=ids.dtype), (b, k))
-    _, outs = jax.lax.scan(step, last, (ids[::-1], parents[::-1]))
-    return outs[::-1]
-
-
-@primitive("viterbi_decode")
-def viterbi_decode(potentials, transition_params, lengths=None,
-                   include_bos_eos_tag=True):
-    """CRF viterbi decode (reference ``text/viterbi_decode.py``):
-    potentials [B, T, N] emissions, transition [N(+2), N(+2)] -> (scores,
-    paths [B, T]). With include_bos_eos_tag, the last two transition rows/
-    cols are BOS/EOS (reference convention)."""
-    b, t, n = potentials.shape
-    if include_bos_eos_tag:
-        trans = transition_params[:n, :n]
-        bos = transition_params[n, :n] if transition_params.shape[0] > n \
-            else jnp.zeros((n,))
-        eos = transition_params[:n, n + 1] \
-            if transition_params.shape[1] > n + 1 else jnp.zeros((n,))
-    else:
-        trans, bos, eos = transition_params, 0.0, 0.0
-
-    alpha0 = potentials[:, 0] + bos        # [B, N]
-
-    def step(alpha, emit):
-        scores = alpha[:, :, None] + trans[None]      # [B, N, N]
-        best = jnp.max(scores, axis=1) + emit
-        back = jnp.argmax(scores, axis=1)
-        return best, back
-
-    alpha, backs = jax.lax.scan(step, alpha0,
-                                jnp.swapaxes(potentials[:, 1:], 0, 1))
-    alpha = alpha + eos
-    last = jnp.argmax(alpha, axis=-1)                 # [B]
-    score = jnp.max(alpha, axis=-1)
-
-    def walk(state, back_t):
-        prev = jnp.take_along_axis(back_t, state[:, None], -1)[:, 0]
-        return prev, prev
-
-    _, path_rev = jax.lax.scan(walk, last, backs[::-1])
-    paths = jnp.concatenate([path_rev[::-1], last[None]], axis=0)
-    return score, jnp.swapaxes(paths, 0, 1).astype(jnp.int64)
+# decode ops: single implementations live in ops/special.py (reference
+# text/viterbi_decode.py convention: last transition row = start tag,
+# second-to-last column = stop tag)
+from ..ops.special import gather_tree, viterbi_decode  # noqa: F401
 
 
 class _SyntheticTextDataset(Dataset):

@@ -373,7 +373,9 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                name=None):
     """Reference ``psroi_pool``: position-sensitive RoI average pooling —
     output channel (c, i, j) pools input channel c*k*k + i*k + j over
-    bin (i, j) of the RoI."""
+    bin (i, j) of the RoI. Vectorized over boxes (vmap) with masked bin
+    averages; trace size is constant in the number of boxes."""
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
@@ -381,32 +383,36 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
     k = output_size if isinstance(output_size, int) else output_size[0]
     nboxes = np.asarray(unwrap(boxes_num))
-    batch_of_box = np.repeat(np.arange(len(nboxes)), nboxes)
+    batch_of_box = jnp.asarray(
+        np.repeat(np.arange(len(nboxes)), nboxes).astype(np.int32))
 
     def impl(xv, bx):
         n, c, h, w = xv.shape
         oc = c // (k * k)
-        outs = []
-        for bi in range(bx.shape[0]):
-            img = xv[batch_of_box[bi]]
-            x1, y1, x2, y2 = [bx[bi, i] * spatial_scale for i in range(4)]
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        gi = jnp.arange(k, dtype=jnp.float32)
+
+        def one_box(box, img_idx):
+            img = xv[img_idx]                       # [c, h, w]
+            x1, y1, x2, y2 = (box[0] * spatial_scale,
+                              box[1] * spatial_scale,
+                              box[2] * spatial_scale,
+                              box[3] * spatial_scale)
             bh = jnp.maximum(y2 - y1, 0.1) / k
             bw = jnp.maximum(x2 - x1, 0.1) / k
-            bins = []
-            ys = jnp.arange(h, dtype=jnp.float32)
-            xs = jnp.arange(w, dtype=jnp.float32)
-            for i in range(k):
-                for j in range(k):
-                    my = ((ys >= jnp.floor(y1 + i * bh))
-                          & (ys < jnp.ceil(y1 + (i + 1) * bh)))
-                    mx = ((xs >= jnp.floor(x1 + j * bw))
-                          & (xs < jnp.ceil(x1 + (j + 1) * bw)))
-                    m = (my[:, None] & mx[None, :]).astype(xv.dtype)
-                    cnt = jnp.maximum(m.sum(), 1.0)
-                    ch = img[(jnp.arange(oc) * k * k + i * k + j)]
-                    bins.append((ch * m[None]).sum((1, 2)) / cnt)
-            outs.append(jnp.stack(bins, 1).reshape(oc, k, k))
-        return jnp.stack(outs)
+            # [k, h] / [k, w] bin-membership masks
+            my = ((ys[None] >= jnp.floor(y1 + gi[:, None] * bh))
+                  & (ys[None] < jnp.ceil(y1 + (gi[:, None] + 1) * bh)))
+            mx = ((xs[None] >= jnp.floor(x1 + gi[:, None] * bw))
+                  & (xs[None] < jnp.ceil(x1 + (gi[:, None] + 1) * bw)))
+            m = (my[:, None, :, None] & mx[None, :, None, :]) \
+                .astype(xv.dtype)                   # [k, k, h, w]
+            cnt = jnp.maximum(m.sum((-2, -1)), 1.0)  # [k, k]
+            chans = img.reshape(oc, k, k, h, w)      # channel (c, i, j)
+            return jnp.einsum("oijhw,ijhw->oij", chans, m) / cnt
+
+        return jax.vmap(one_box)(bx, batch_of_box)
 
     return apply("psroi_pool", impl, x, boxes)
 

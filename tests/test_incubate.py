@@ -183,3 +183,38 @@ def test_fused_dropout_add_eval():
     y = _t((4, 8), 17)
     out = FI.fused_dropout_add(x, y, p=0.5, training=False)
     np.testing.assert_allclose(out.numpy(), x.numpy() + y.numpy(), atol=1e-6)
+
+
+def test_fused_transformer_layers():
+    """incubate.nn layer classes (reference fused_transformer.py:278,564):
+    attention+FFN block trains under jit; dropout-add identity at p=0."""
+    import numpy as np
+
+    from paddle_tpu.incubate.nn import (FusedDropoutAdd, FusedFeedForward,
+                                        FusedLinear, FusedMultiHeadAttention)
+
+    paddle.seed(0)
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(2, 8, 16)).astype("float32"))
+    attn = FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                   attn_dropout_rate=0.0)
+    ffn = FusedFeedForward(16, 32, dropout_rate=0.0, activation="gelu",
+                           normalize_before=True)
+    opt = paddle.optimizer.Adam(
+        learning_rate=1e-2,
+        parameters=list(attn.parameters()) + list(ffn.parameters()))
+    tgt = paddle.zeros([2, 8, 16])
+
+    @paddle.jit.to_static
+    def step(x):
+        loss = ((ffn(attn(x)) - tgt) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses = [float(step(x)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    assert tuple(FusedLinear(16, 8)(x).shape) == (2, 8, 8)
+    fd = FusedDropoutAdd(p=0.0)
+    np.testing.assert_allclose(fd(x, x).numpy(), 2 * x.numpy(), rtol=1e-6)

@@ -118,7 +118,9 @@ def test_hsigmoid_trains():
     opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[w])
     losses = []
     for _ in range(20):
-        loss = F.hsigmoid_loss(x, lbl, 4, w)
+        per_sample = F.hsigmoid_loss(x, lbl, 4, w)
+        assert tuple(per_sample.shape) == (8, 1)  # reference output shape
+        loss = per_sample.mean()
         loss.backward()
         opt.step()
         opt.clear_grad()

@@ -37,15 +37,17 @@ def _brute_viterbi(emis, trans, bos, eos):
 
 
 def test_viterbi_decode_matches_bruteforce():
-    n, t = 3, 4
+    # reference convention: transition [n, n]; LAST row = start tag,
+    # SECOND-TO-LAST column = stop tag (text/viterbi_decode.py:37)
+    n, t = 4, 4
     emis = R.normal(size=(2, t, n)).astype("float32")
-    full = R.normal(size=(n + 2, n + 2)).astype("float32")
+    full = R.normal(size=(n, n)).astype("float32")
     scores, paths = paddle.text.viterbi_decode(
         paddle.to_tensor(emis), paddle.to_tensor(full))
-    bos = full[n, :n]
-    eos = full[:n, n + 1]
+    bos = full[n - 1, :]
+    eos = full[:, n - 2]
     for b in range(2):
-        ws, wp = _brute_viterbi(emis[b], full[:n, :n], bos, eos)
+        ws, wp = _brute_viterbi(emis[b], full, bos, eos)
         np.testing.assert_allclose(float(np.asarray(scores._read())[b]),
                                    ws, atol=1e-4)
         assert list(np.asarray(paths._read())[b]) == wp
