@@ -69,6 +69,12 @@ def main():
     model.train()
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters())
+    if on_tpu:
+        # O2 (bf16 params + fp32 master weights) measured ~3% over O1:
+        # per-op input casts disappear from the compiled step
+        model, opt = amp.decorate(models=model, optimizers=opt,
+                                  level="O2", dtype="bfloat16",
+                                  master_weight=True)
 
     if on_tpu:
         # tune the flash-attention block sizes for this model's shapes
@@ -83,9 +89,11 @@ def main():
                           jnp.bfloat16)
         fa.flash_attention(probe, probe, probe, causal=True)
 
+    level = "O2" if on_tpu else "O1"
+
     @paddle.jit.to_static
     def train_step(ids, labels):
-        with amp.auto_cast(level="O1", dtype="bfloat16"):
+        with amp.auto_cast(level=level, dtype="bfloat16"):
             loss = model(ids, labels)
         loss.backward()
         opt.step()
@@ -128,7 +136,7 @@ def main():
             "model_tflops_per_sec": round(achieved / 1e12, 2),
             "mfu": round(mfu, 4),
             "final_loss": round(final_loss, 4),
-            "amp": "O1-bf16", "recompute": True,
+            "amp": "O2-bf16-master" if on_tpu else "O1-bf16", "recompute": True,
         },
     }))
 
