@@ -357,7 +357,8 @@ class PipelinedBlocks(Layer):
 
         return apply("pipelined_blocks_vpp", impl, x, *leaf_tensors)
 
-    def train_batch(self, x, target, loss_fn, batch_axes=None):
+    def train_batch(self, x, target, loss_fn, batch_axes=None,
+                    post_params=None):
         """Fused 1F1B train step (reference ``pipeline_parallel.py:663``
         ``train_batch`` / ``forward_backward_pipeline``): ONE SPMD program
         runs forward and backward micro-steps interleaved, holding at most
@@ -365,11 +366,15 @@ class PipelinedBlocks(Layer):
         — vs O(M) for the scan-transpose GPipe path), recomputing each
         chunk's vjp from the saved chunk input (recompute policy).
 
-        ``loss_fn(y, target_mb) -> scalar mean loss`` runs on the last
-        stage (closed-over tensors are constants — keep the head inside
-        the blocks or tie it to ``x``'s producer). Returns the scalar mean
-        loss; ``loss.backward()`` flows grads into the stacked leaves and
-        ``x`` through the recorded vjp, so optimizers work unchanged.
+        ``loss_fn(y, target_mb)`` (or ``loss_fn(y, target_mb,
+        post_vals)`` with ``post_params``) -> scalar mean loss, run on the
+        last stage. ``post_params`` lets a trailing trainable epilogue
+        (final norm, tied LM head) live inside the schedule: their raw
+        values are passed to ``loss_fn`` and their grads flow back like
+        the stacked leaves'. Returns the scalar mean loss;
+        ``loss.backward()`` flows grads into the stacked leaves, ``x``,
+        and the post params through the recorded vjp, so optimizers work
+        unchanged.
 
         Schedule: tick ``t`` runs forward of microbatch ``t - i`` and
         backward of microbatch ``t - (2pp - 1 - i)`` on stage ``i``;
@@ -399,8 +404,12 @@ class PipelinedBlocks(Layer):
         dp_n = int(np.prod([sizes[a] for a in batch_tuple])) \
             if batch_tuple else 1
         leaf_tensors = [self.stacked_parameter(n) for n in names]
+        post_params = list(post_params or [])
+        n_leaves = len(leaf_tensors)
 
-        def impl(xv, tgt, *leaves):
+        def impl(xv, tgt, *leaves_and_post):
+            leaves = leaves_and_post[:n_leaves]
+            post_vals_in = leaves_and_post[n_leaves:]
             b = xv.shape[0]
             if b % M:
                 raise ValueError(f"batch {b} not divisible by "
@@ -409,7 +418,9 @@ class PipelinedBlocks(Layer):
             tm = tgt.reshape((M, b // M) + tgt.shape[1:])
             seed = 1.0 / (M * dp_n)
 
-            def run(xmv, tmv, *lvs_in):
+            def run(xmv, tmv, *lvs_and_post):
+                lvs_in = lvs_and_post[:n_leaves]
+                post_in = lvs_and_post[n_leaves:]
                 def block_apply(h, layer_leaves):
                     vals = dict(zip(names, layer_leaves))
                     return functional_call(template, vals, h), None
@@ -418,7 +429,9 @@ class PipelinedBlocks(Layer):
                     y, _ = lax.scan(block_apply, h, lvs)
                     return y
 
-                def local(xloc, tloc, *lvs):
+                def local(xloc, tloc, *lvs_all):
+                    lvs = lvs_all[:n_leaves]
+                    post = lvs_all[n_leaves:]
                     i = lax.axis_index(ax)
                     is_last = i == pp - 1
                     mb_shape = xloc.shape[1:]
@@ -426,18 +439,20 @@ class PipelinedBlocks(Layer):
                     fwd_ring = [(r, (r + 1) % pp) for r in range(pp)]
                     bwd_ring = [(r, (r - 1) % pp) for r in range(pp)]
 
-                    def objective(h, lvs, t_mb, g):
-                        """where(is_last, seed*loss, <y, g>): its (h, lvs)
-                        vjp is the loss-vjp on the last stage and the
-                        cotangent-g chunk vjp elsewhere."""
+                    def objective(h, lvs, pv, t_mb, g):
+                        """where(is_last, seed*loss, <y, g>): its
+                        (h, lvs, pv) vjp is the loss-vjp on the last
+                        stage and the cotangent-g chunk vjp elsewhere."""
                         y = chunk_fwd(h, lvs)
-                        loss = loss_fn(y, t_mb)
+                        loss = (loss_fn(y, t_mb, pv) if pv
+                                else loss_fn(y, t_mb))
                         obj = jnp.where(is_last, loss * seed,
                                         jnp.vdot(y, g))
                         return obj, loss
 
                     def tick(carry, t):
-                        h_fwd, g_bwd, ring, dacc, loss_acc, dx = carry
+                        (h_fwd, g_bwd, ring, dacc, dpacc, loss_acc,
+                         dx) = carry
                         # ---- forward micro-step: mb u = t - i ----
                         u = t - i
                         uc = jnp.clip(u, 0, M - 1)
@@ -455,14 +470,23 @@ class PipelinedBlocks(Layer):
                         h_saved = lax.dynamic_index_in_dim(
                             ring, slot, 0, keepdims=False)
                         obj, vjp, loss = jax.vjp(
-                            lambda hh, ll: objective(hh, ll, tloc[mc],
-                                                     g_bwd),
-                            h_saved, lvs, has_aux=True)
-                        dh, dlvs = vjp(_pvary(jnp.ones((), obj.dtype),
-                                              vary_axes))
+                            lambda hh, ll, pv: objective(
+                                hh, ll, pv, tloc[mc], g_bwd),
+                            h_saved, lvs, tuple(post), has_aux=True)
+                        dh, dlvs, dpost = vjp(
+                            _pvary(jnp.ones((), obj.dtype), vary_axes))
                         dacc = tuple(
                             da + jnp.where(bvalid, dl, 0)
                             for da, dl in zip(dacc, dlvs))
+                        # dpost is auto-psummed over pp+dp (invarying
+                        # inputs); mid stages contribute exact zeros, so
+                        # gate by the LAST stage's mb validity at this
+                        # tick (same value on every device)
+                        m_last = t - pp
+                        glast = (m_last >= 0) & (m_last < M)
+                        dpacc = tuple(
+                            da + jnp.where(glast, dp_, 0)
+                            for da, dp_ in zip(dpacc, dpost))
                         loss_acc = loss_acc + jnp.where(
                             bvalid & is_last, loss, 0.0)
                         curx = lax.dynamic_index_in_dim(dx, mc, 0,
@@ -473,14 +497,15 @@ class PipelinedBlocks(Layer):
                         g_next = lax.ppermute(
                             jnp.where(bvalid, dh, jnp.zeros_like(dh)),
                             ax, bwd_ring)
-                        return (h_next, g_next, ring, dacc, loss_acc,
-                                dx), None
+                        return (h_next, g_next, ring, dacc, dpacc,
+                                loss_acc, dx), None
 
                     # dacc inherits pp-varying from the leaves and stays
                     # dp-INvarying: the vjp transpose auto-psums leaf
                     # cotangents over dp (invarying input x varying seed),
                     # so dl already carries the cross-dp sum
                     dacc0 = tuple(jnp.zeros_like(lv) for lv in lvs)
+                    dpacc0 = tuple(jnp.zeros_like(pv) for pv in post)
                     h0, g0, ring0, loss0, dx0 = _pvary((
                         jnp.zeros(mb_shape, xloc.dtype),
                         jnp.zeros(mb_shape, xloc.dtype),
@@ -488,46 +513,52 @@ class PipelinedBlocks(Layer):
                         jnp.zeros((), xloc.dtype),
                         jnp.zeros((M,) + mb_shape, xloc.dtype),
                     ), vary_axes)
-                    carry0 = (h0, g0, ring0, dacc0, loss0, dx0)
+                    carry0 = (h0, g0, ring0, dacc0, dpacc0, loss0, dx0)
                     carry, _ = lax.scan(tick, carry0,
                                         jnp.arange(M + 2 * pp - 1))
-                    _, _, _, dacc, loss_acc, dx = carry
+                    _, _, _, dacc, dpacc, loss_acc, dx = carry
                     # loss lives on the last stage; grads of x on stage 0
                     loss_out = lax.psum(
                         jnp.where(is_last, loss_acc, 0.0), ax)
                     dx = lax.psum(jnp.where(i == 0, dx, 0.0), ax)
                     if batch_tuple:
                         loss_out = lax.psum(loss_out, batch_tuple)
-                    return (loss_out, dx) + tuple(dacc)
+                    return (loss_out, dx) + tuple(dacc) + tuple(dpacc)
 
                 xspec = P(None, batch_axes,
                           *([None] * (xm.ndim - 2)))
                 tspec = P(None, batch_axes,
                           *([None] * (tm.ndim - 2)))
                 lspec = tuple(P(ax) for _ in lvs_in)
+                pspec = tuple(P() for _ in post_in)
                 outs = jax.shard_map(
                     local, mesh=jmesh,
-                    in_specs=(xspec, tspec) + lspec,
-                    out_specs=(P(), xspec) + lspec)(xmv, tmv, *lvs_in)
-                loss, dx, dls = outs[0], outs[1], outs[2:]
-                return loss / (M * dp_n), dx, dls
+                    in_specs=(xspec, tspec) + lspec + pspec,
+                    out_specs=(P(), xspec) + lspec + pspec)(
+                        xmv, tmv, *lvs_in, *post_in)
+                loss, dx = outs[0], outs[1]
+                dls = outs[2:2 + n_leaves]
+                dps = outs[2 + n_leaves:]
+                return loss / (M * dp_n), dx, dls, dps
 
             @jax.custom_vjp
-            def op(xmv, *lvs_in):
-                return run(xmv, tm, *lvs_in)[0]
+            def op(xmv, *rest):
+                return run(xmv, tm, *rest)[0]
 
-            def op_fwd(xmv, *lvs_in):
-                loss, dx, dls = run(xmv, tm, *lvs_in)
-                return loss, (dx, dls)
+            def op_fwd(xmv, *rest):
+                loss, dx, dls, dps = run(xmv, tm, *rest)
+                return loss, (dx, dls, dps)
 
             def op_bwd(res, g):
-                dx, dls = res  # dx already has xm's [M, b/M, ...] shape
-                return (g * dx,) + tuple(g * dl for dl in dls)
+                dx, dls, dps = res  # dx already has xm's shape
+                return ((g * dx,) + tuple(g * dl for dl in dls)
+                        + tuple(g * dp_ for dp_ in dps))
 
             op.defvjp(op_fwd, op_bwd)
-            return op(xm, *leaves)
+            return op(xm, *leaves, *post_vals_in)
 
-        return apply("pipeline_1f1b", impl, x, target, *leaf_tensors)
+        return apply("pipeline_1f1b", impl, x, target, *leaf_tensors,
+                     *post_params)
 
 
 def _as_param(t: Tensor):
@@ -583,7 +614,8 @@ class PipelineLayer(Layer):
     def forward(self, x, batch_axes=None):
         return self.blocks(x, batch_axes=batch_axes)
 
-    def train_batch(self, x, target, loss_fn, batch_axes=None):
+    def train_batch(self, x, target, loss_fn, batch_axes=None,
+                    post_params=None):
         """Fused 1F1B step (see ``PipelinedBlocks.train_batch``)."""
         return self.blocks.train_batch(x, target, loss_fn,
                                        batch_axes=batch_axes)

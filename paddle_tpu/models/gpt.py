@@ -231,7 +231,7 @@ class GPTForCausalLMPipe(Layer):
     shardings (dp over batch)."""
 
     def __init__(self, cfg: GPTConfig, mesh, pp_axis: str = "pp",
-                 dp_axis=None, num_microbatches: int = 1):
+                 dp_axis=None, num_microbatches: int = 1, interleave=1):
         super().__init__()
         if cfg.dropout:
             raise NotImplementedError(
@@ -251,7 +251,8 @@ class GPTForCausalLMPipe(Layer):
         self.blocks = PipelinedBlocks(lambda: GPTBlock(blk_cfg),
                                       cfg.num_layers, mesh=mesh,
                                       pp_axis=pp_axis,
-                                      num_microbatches=num_microbatches)
+                                      num_microbatches=num_microbatches,
+                                      interleave=interleave)
         self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
 
     def logits(self, input_ids) -> Tensor:
@@ -270,6 +271,39 @@ class GPTForCausalLMPipe(Layer):
         return F.cross_entropy(
             ops_reshape(logits, [-1, self.cfg.vocab_size]),
             ops_reshape(labels, [-1]))
+
+    def train_batch(self, input_ids, labels):
+        """Fused 1F1B step (reference ``pipeline_parallel.py:663``):
+        the epilogue (final norm + tied LM head + CE) runs INSIDE the
+        schedule on the last stage via ``post_params``, so ln_f and the
+        tied embedding get their head-path grads; the embedding path's
+        grads arrive through ``x``'s cotangent. ``loss.backward()``
+        then ``optimizer.step()`` as usual."""
+        import jax
+        import jax.numpy as jnp
+
+        from .. import ops
+        from ..distributed.fleet.pipeline import functional_call
+
+        def loss_fn(y, tgt, post_vals):
+            w_ln, b_ln, wte = post_vals
+            # run the real ln_f purely on the traced values (no drift
+            # from a hand-rolled copy of LayerNorm's math)
+            h = functional_call(self.ln_f,
+                                {"weight": w_ln, "bias": b_ln}, y)
+            logits = jnp.einsum("bsh,vh->bsv", h, wte)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            ll = jnp.take_along_axis(logp, tgt[..., None].astype(
+                jnp.int32), axis=-1)
+            return -jnp.mean(ll)
+
+        s = input_ids.shape[1]
+        pos = ops.arange(0, s, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(pos)
+        return self.blocks.train_batch(
+            x, labels, loss_fn, batch_axes=self.dp_axis,
+            post_params=[self.ln_f.weight, self.ln_f.bias,
+                         self.wte.weight])
 
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())
