@@ -618,4 +618,5 @@ class PipelineLayer(Layer):
                     post_params=None):
         """Fused 1F1B step (see ``PipelinedBlocks.train_batch``)."""
         return self.blocks.train_batch(x, target, loss_fn,
-                                       batch_axes=batch_axes)
+                                       batch_axes=batch_axes,
+                                       post_params=post_params)

@@ -11,3 +11,5 @@ from . import bert  # noqa: F401
 from .bert import (  # noqa: F401
     BertConfig, BertModel, BertForPretraining,
     BertForSequenceClassification)
+from . import llama  # noqa: F401
+from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM  # noqa: F401
