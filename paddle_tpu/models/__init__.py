@@ -13,3 +13,5 @@ from .bert import (  # noqa: F401
     BertForSequenceClassification)
 from . import llama  # noqa: F401
 from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM  # noqa: F401
+from . import generation  # noqa: F401
+from .generation import generate  # noqa: F401

@@ -1,0 +1,228 @@
+"""Autoregressive generation with KV caches.
+
+Capability analog of the reference ecosystem's ``model.generate`` (greedy /
+temperature / nucleus sampling; the reference keeps generation in PaddleNLP
+but ships the primitives in-tree: ``top_p_sampling``, block/paged KV
+attention kernels — SURVEY C12). TPU-shaped: the decode step is ONE jitted
+program with static shapes — caches are preallocated [B, max_len, Hkv, D]
+and updated in place with ``dynamic_update_slice`` at the traced position;
+attention masks positions beyond the current length. The per-token Python
+loop re-invokes the same compiled step (functional cache threading — no
+retrace after the first token).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+
+
+@primitive
+def cache_attention(q, k_new, v_new, k_cache, v_cache, pos,
+                    scale=None):
+    """One decode step of attention against a preallocated KV cache.
+
+    q/k_new/v_new: [B, 1, H(q|kv), D]; caches [B, L, Hkv, D]; pos [1]
+    (traced). Returns (out [B, 1, Hq, D], k_cache', v_cache'). GQA: kv
+    heads repeat to match q heads. Positions > pos are masked out.
+    """
+    p = pos.reshape(())
+    kc = lax.dynamic_update_slice_in_dim(k_cache, k_new.astype(
+        k_cache.dtype), p, axis=1)
+    vc = lax.dynamic_update_slice_in_dim(v_cache, v_new.astype(
+        v_cache.dtype), p, axis=1)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    hq, hk = q.shape[2], kc.shape[2]
+    kt, vt = kc, vc
+    if hk != hq:
+        kt = jnp.repeat(kt, hq // hk, axis=2)
+        vt = jnp.repeat(vt, hq // hk, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kt,
+                        preferred_element_type=jnp.float32) * s
+    valid = (jnp.arange(kc.shape[1]) <= p)[None, None, None, :]
+    logits = jnp.where(valid, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vt)
+    return out, kc, vc
+
+
+@primitive
+def rope_at(x, pos, theta=10000.0):
+    """Half-rotation rope for ONE position (decode): x [B, 1, H, D],
+    pos [1] traced. Convention comes from llama.rope_angles (single
+    home — training and decode paths cannot drift)."""
+    from .llama import rope_angles
+    cos, sin = rope_angles(pos.reshape(()), x.shape[-1], theta)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return x * cos + rotated * sin
+
+
+def _empty_caches(model, batch, max_len):
+    cfg = model.cfg
+    n_kv = getattr(cfg, "num_kv_heads", cfg.num_heads)
+    caches = []
+    for _ in range(cfg.num_layers):
+        kc = Tensor(jnp.zeros((batch, max_len, n_kv, cfg.head_dim),
+                              jnp.float32))
+        vc = Tensor(jnp.zeros((batch, max_len, n_kv, cfg.head_dim),
+                              jnp.float32))
+        caches.extend([kc, vc])
+    return caches
+
+
+def _gpt_decode(model, ids_t, pos, caches):
+    """One-token logits for GPTForCausalLM given flat [k0,v0,k1,v1,...]
+    caches; returns (logits [B, V], new caches)."""
+    from .. import ops
+    gpt = model.gpt
+    x = gpt.wte(ids_t) + gpt.wpe(ops.reshape(pos, [1]))
+    new = []
+    for li, blk in enumerate(gpt.blocks):
+        kc, vc = caches[2 * li], caches[2 * li + 1]
+        h = blk.ln1(x)
+        b, s, hidden = h.shape
+        qkv = ops.reshape(blk.attn.qkv(h),
+                          [b, 1, 3, blk.attn.num_heads,
+                           blk.attn.head_dim])
+        q, k, v = ops.unbind(qkv, axis=2)
+        att, kc, vc = cache_attention(q, k, v, kc, vc, pos)
+        x = x + blk.attn.proj(ops.reshape(att, [b, 1, hidden]))
+        x = x + blk.mlp(blk.ln2(x))
+        new.extend([kc, vc])
+    h = gpt.ln_f(x)
+    if model.lm_head is not None:
+        logits = model.lm_head(h)
+    else:
+        logits = ops.matmul(h, gpt.wte.weight, transpose_y=True)
+    return ops.reshape(logits, [logits.shape[0], -1]), new
+
+
+def _llama_decode(model, ids_t, pos, caches):
+    from .. import ops
+    lm = model.llama
+    x = lm.embed_tokens(ids_t)
+    new = []
+    for li, layer in enumerate(lm.layers):
+        kc, vc = caches[2 * li], caches[2 * li + 1]
+        att_in = layer.input_norm(x)
+        a = layer.attn
+        b = att_in.shape[0]
+        q = ops.reshape(a.q_proj(att_in), [b, 1, a.num_heads, a.head_dim])
+        k = ops.reshape(a.k_proj(att_in),
+                        [b, 1, a.num_kv_heads, a.head_dim])
+        v = ops.reshape(a.v_proj(att_in),
+                        [b, 1, a.num_kv_heads, a.head_dim])
+        q = rope_at(q, pos, theta=a.rope_theta)
+        k = rope_at(k, pos, theta=a.rope_theta)
+        att, kc, vc = cache_attention(q, k, v, kc, vc, pos)
+        x = x + a.o_proj(ops.reshape(att, [b, 1, -1]))
+        x = x + layer.mlp(layer.post_norm(x))
+        new.extend([kc, vc])
+    h = lm.norm(x)
+    if model.lm_head is not None:
+        logits = model.lm_head(h)
+    else:
+        logits = ops.matmul(h, lm.embed_tokens.weight, transpose_y=True)
+    return ops.reshape(logits, [logits.shape[0], -1]), new
+
+
+def _decode_fn(model):
+    from .gpt import GPTForCausalLM
+    from .llama import LlamaForCausalLM
+    if isinstance(model, GPTForCausalLM):
+        return _gpt_decode
+    if isinstance(model, LlamaForCausalLM):
+        return _llama_decode
+    raise TypeError(f"generate: unsupported model {type(model).__name__}")
+
+
+def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
+             top_p=None, eos_token_id=None, seed=None, use_jit=True):
+    """Greedy / temperature / nucleus decoding with a KV cache.
+
+    ``input_ids`` [B, S] prompt; returns [B, S + max_new_tokens] int32
+    (rows stop changing after ``eos_token_id``). One compiled decode step
+    serves both prefill and generation (same static shapes).
+    """
+    from .. import jit as jit_mod
+    from ..ops.special import top_p_sampling
+
+    decode = _decode_fn(model)
+    ids = np.asarray(input_ids.numpy()
+                     if isinstance(input_ids, Tensor) else input_ids)
+    batch, prompt_len = ids.shape
+    max_len = prompt_len + max_new_tokens
+    cfg = model.cfg
+    if max_len > cfg.max_seq_len and hasattr(model, "gpt"):
+        raise ValueError(f"max_len {max_len} exceeds max_seq_len "
+                         f"{cfg.max_seq_len}")
+    caches = _empty_caches(model, batch, max_len)
+    was_training = model.training
+    model.eval()
+
+    # compiled decode step cached per (batch, max_len) ON the model:
+    # repeat generate() calls reuse the program instead of re-tracing
+    cache_key = (batch, max_len)
+    step_cache = model.__dict__.setdefault("_decode_step_cache", {})
+    step_fn = step_cache.get(cache_key)
+    if step_fn is None:
+
+        def step(tok, pos, *cs):
+            import paddle_tpu as pp
+            with pp.no_grad():
+                logits, new = decode(model, tok, pos, list(cs))
+            return (logits,) + tuple(new)
+
+        step_fn = jit_mod.to_static(step) if use_jit else step
+        if use_jit:
+            step_cache[cache_key] = step_fn
+
+    out = np.concatenate(
+        [ids, np.zeros((batch, max_new_tokens), ids.dtype)], axis=1)
+    finished = np.zeros(batch, bool)
+    for t in range(max_len - 1):  # the last token needs no forward
+        tok = Tensor(jnp.asarray(out[:, t:t + 1].astype(np.int32)))
+        pos = Tensor(jnp.asarray([t], jnp.int32))
+        res = step_fn(tok, pos, *caches)
+        logits, caches = res[0], list(res[1:])
+        if t < prompt_len - 1:
+            continue  # prefill: ignore logits, just fill the cache
+        lg = logits.numpy().astype(np.float32)
+        if temperature != 1.0:
+            lg = lg / max(temperature, 1e-6)
+        if top_p is not None:
+            # per-step key: seed+t keeps a seeded STREAM, not one quantile
+            _, nxt = top_p_sampling(
+                Tensor(jnp.asarray(lg)),
+                Tensor(jnp.full((batch,), float(top_p))),
+                seed=None if seed is None else seed + t)
+            nxt = nxt.numpy().reshape(-1)
+        elif temperature != 1.0:
+            # temperature-only: categorical over the softened logits
+            # (argmax would be scale-invariant, i.e. silently greedy)
+            rng_t = np.random.default_rng(
+                None if seed is None else seed + t)
+            p = np.exp(lg - lg.max(-1, keepdims=True))
+            p = p / p.sum(-1, keepdims=True)
+            nxt = np.array([rng_t.choice(p.shape[-1], p=row)
+                            for row in p])
+        else:
+            nxt = lg.argmax(-1)
+        if eos_token_id is not None:
+            nxt = np.where(finished, eos_token_id, nxt)
+            finished |= (nxt == eos_token_id)
+        out[:, t + 1] = nxt.astype(out.dtype)
+        if eos_token_id is not None and finished.all():
+            out = out[:, :t + 2]
+            break
+    if was_training:
+        model.train()
+    return Tensor(jnp.asarray(out.astype(np.int32)))

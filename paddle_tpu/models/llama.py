@@ -56,6 +56,18 @@ def _init(std=0.02):
     return I.Normal(mean=0.0, std=std)
 
 
+def rope_angles(positions, d, theta):
+    """Half-rotation rope tables: (cos, sin) [..., d] for ``positions``
+    (numpy or traced jnp values). SINGLE home of the LLaMA rope
+    convention — the training path (_rope_tables) and the KV-cache decode
+    path (generation.rope_at) both read it."""
+    import jax.numpy as jnp
+    inv = 1.0 / theta ** (jnp.arange(0, d // 2) * 2.0 / d)
+    ang = jnp.asarray(positions)[..., None].astype(jnp.float32) * inv
+    ang = jnp.concatenate([ang, ang], axis=-1)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
 class LlamaAttention(Layer):
     """Rope + grouped-query flash attention. KV projections emit
     ``num_kv_heads`` heads; the Pallas kernel maps q-head -> kv-head
@@ -79,14 +91,11 @@ class LlamaAttention(Layer):
                                  2 * cfg.num_layers)))
 
     def _rope_tables(self, s):
-        """cos/sin [s, head_dim] for this config's rope_theta (half
-        tiling — the LLaMA/HF half-rotation convention)."""
+        """cos/sin [s, head_dim] for this config's rope_theta."""
         import numpy as np
-        d = self.head_dim
-        inv = 1.0 / self.rope_theta ** (np.arange(0, d // 2) * 2.0 / d)
-        ang = np.arange(s)[:, None] * inv[None, :]
-        ang = np.concatenate([ang, ang], axis=-1).astype(np.float32)
-        return Tensor(np.cos(ang)), Tensor(np.sin(ang))
+        cos, sin = rope_angles(np.arange(s), self.head_dim,
+                               self.rope_theta)
+        return Tensor(cos), Tensor(sin)
 
     def forward(self, x):
         from .. import ops
