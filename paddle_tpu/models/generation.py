@@ -135,12 +135,14 @@ def _llama_decode(model, ids_t, pos, caches):
 
 
 def _decode_fn(model):
+    """(decode_fn, hard_position_limit): GPT's learned wpe table makes
+    max_seq_len a hard bound; LLaMA's rope extrapolates (soft)."""
     from .gpt import GPTForCausalLM
     from .llama import LlamaForCausalLM
     if isinstance(model, GPTForCausalLM):
-        return _gpt_decode
+        return _gpt_decode, True
     if isinstance(model, LlamaForCausalLM):
-        return _llama_decode
+        return _llama_decode, False
     raise TypeError(f"generate: unsupported model {type(model).__name__}")
 
 
@@ -155,18 +157,36 @@ def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
     from .. import jit as jit_mod
     from ..ops.special import top_p_sampling
 
-    decode = _decode_fn(model)
+    decode, hard_limit = _decode_fn(model)
     ids = np.asarray(input_ids.numpy()
                      if isinstance(input_ids, Tensor) else input_ids)
     batch, prompt_len = ids.shape
     max_len = prompt_len + max_new_tokens
     cfg = model.cfg
-    if max_len > cfg.max_seq_len and hasattr(model, "gpt"):
-        raise ValueError(f"max_len {max_len} exceeds max_seq_len "
-                         f"{cfg.max_seq_len}")
+    if max_len > cfg.max_seq_len:
+        if hard_limit:  # learned position table: out-of-range = garbage
+            raise ValueError(f"max_len {max_len} exceeds max_seq_len "
+                             f"{cfg.max_seq_len}")
+        import warnings
+        warnings.warn(f"generating past max_seq_len ({max_len} > "
+                      f"{cfg.max_seq_len}): rope extrapolation territory")
     caches = _empty_caches(model, batch, max_len)
     was_training = model.training
     model.eval()
+    try:
+        return _generate_loop(model, decode, ids, batch, prompt_len,
+                              max_len, max_new_tokens, temperature, top_p,
+                              eos_token_id, seed, use_jit, caches)
+    finally:
+        if was_training:
+            model.train()
+
+
+def _generate_loop(model, decode, ids, batch, prompt_len, max_len,
+                   max_new_tokens, temperature, top_p, eos_token_id,
+                   seed, use_jit, caches):
+    from .. import jit as jit_mod
+    from ..ops.special import top_p_sampling
 
     # compiled decode step cached per (batch, max_len) ON the model:
     # repeat generate() calls reuse the program instead of re-tracing
@@ -223,6 +243,4 @@ def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
         if eos_token_id is not None and finished.all():
             out = out[:, :t + 2]
             break
-    if was_training:
-        model.train()
     return Tensor(jnp.asarray(out.astype(np.int32)))

@@ -60,10 +60,23 @@ def rope_angles(positions, d, theta):
     """Half-rotation rope tables: (cos, sin) [..., d] for ``positions``
     (numpy or traced jnp values). SINGLE home of the LLaMA rope
     convention — the training path (_rope_tables) and the KV-cache decode
-    path (generation.rope_at) both read it."""
+    path (generation.rope_at) both read it.
+
+    Concrete positions compute in float64 (f32 loses ~1e-4 rad at
+    position 2048 — enough to drift checkpoints); traced positions (the
+    decode path) necessarily stay f32, still within the cache/full parity
+    tolerance."""
+    import jax
     import jax.numpy as jnp
+    import numpy as np
+    if not isinstance(positions, jax.core.Tracer):
+        inv = 1.0 / theta ** (np.arange(0, d // 2) * 2.0 / d)
+        ang = np.asarray(positions, np.float64)[..., None] * inv
+        ang = np.concatenate([ang, ang], axis=-1)
+        return (jnp.asarray(np.cos(ang), jnp.float32),
+                jnp.asarray(np.sin(ang), jnp.float32))
     inv = 1.0 / theta ** (jnp.arange(0, d // 2) * 2.0 / d)
-    ang = jnp.asarray(positions)[..., None].astype(jnp.float32) * inv
+    ang = positions[..., None].astype(jnp.float32) * inv
     ang = jnp.concatenate([ang, ang], axis=-1)
     return jnp.cos(ang), jnp.sin(ang)
 

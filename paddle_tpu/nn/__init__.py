@@ -5,6 +5,7 @@ Analog of ``python/paddle/nn/`` (reference). ``Layer`` is the module base;
 """
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
 from .layer import Layer, ParamAttr  # noqa: F401
 from .layers import (  # noqa: F401
     Identity, Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,

@@ -1,0 +1,158 @@
+"""Beam-search decoding (reference ``python/paddle/nn/decode.py``:
+``Decoder`` base, ``BeamSearchDecoder`` :153, ``dynamic_decode`` :994).
+
+Host-driven decode loop over a cell (the reference's dynamic decode is a
+while-loop too); the per-step math (cell forward, top-k over beam*vocab,
+state gather) runs as framework ops, and the final backtrace reuses
+``gather_tree``. Works with any ``RNNCellBase``-interface cell.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dispatch import unwrap
+from ..core.tensor import Tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Decode-loop contract consumed by ``dynamic_decode`` (capability
+    analog of the reference ``Decoder``; the state is carried as ONE
+    object here instead of the reference's (inputs, states, finished)
+    triple — simpler to thread through a host loop):
+
+    - ``initialize(inits) -> state``
+    - ``step(time, state) -> (tokens [B, beam], parents [B, beam],
+      new_state)`` where ``new_state['finished']`` is a bool [B, beam]
+    - ``finalize(token_steps, parent_steps, final_state) -> outputs``
+    """
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, state):
+        raise NotImplementedError
+
+    def finalize(self, token_steps, parent_steps, final_state):
+        raise NotImplementedError
+
+
+class BeamSearchDecoder(Decoder):
+    """Reference ``BeamSearchDecoder``: wraps a cell; each step scores
+    ``beam_size * vocab`` continuations per batch row, keeps the top
+    ``beam_size``, and gathers cell states by parent beam. Finished beams
+    are locked: they only ever continue with ``end_token`` at score 0."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] (repeat each row beam_size times);
+        the reference helper for attention memories."""
+        v = np.asarray(unwrap(x))
+        return Tensor(np.repeat(v, beam_size, axis=0))
+
+    # -- host-side beam bookkeeping (numpy) ---------------------------
+    def initialize(self, initial_cell_states):
+        states = initial_cell_states
+        flat = [np.asarray(unwrap(s)) for s in
+                (states if isinstance(states, (list, tuple)) else [states])]
+        batch = flat[0].shape[0]
+        k = self.beam_size
+        tiled = [Tensor(np.repeat(f, k, axis=0)) for f in flat]
+        tokens = np.full((batch, k), self.start_token, np.int64)
+        # only beam 0 is live initially (others would duplicate it)
+        log_probs = np.full((batch, k), -1e9, np.float32)
+        log_probs[:, 0] = 0.0
+        finished = np.zeros((batch, k), bool)
+        init = {"tokens": tokens, "log_probs": log_probs,
+                "finished": finished, "cell": tiled, "batch": batch}
+        return init
+
+    def _embed(self, tokens):
+        t = Tensor(tokens.reshape(-1).astype(np.int64))
+        if self.embedding_fn is not None:
+            return self.embedding_fn(t)
+        raise ValueError("BeamSearchDecoder needs embedding_fn to map "
+                         "token ids to cell inputs")
+
+    def step(self, time, state):
+        k = self.beam_size
+        batch = state["batch"]
+        inputs = self._embed(state["tokens"])           # [B*k, D]
+        cell_states = state["cell"]
+        out, new_states = self.cell(
+            inputs, cell_states if len(cell_states) > 1
+            else cell_states[0])
+        logits = self.output_fn(out) if self.output_fn else out
+        lg = np.asarray(unwrap(logits)).reshape(batch, k, -1)
+        logp = lg - lg.max(-1, keepdims=True)
+        logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+        vocab = logp.shape[-1]
+        # finished beams may only emit end_token at no cost
+        fin = state["finished"]
+        locked = np.full_like(logp, -1e9)
+        locked[:, :, self.end_token] = 0.0
+        logp = np.where(fin[:, :, None], locked, logp)
+        total = state["log_probs"][:, :, None] + logp    # [B, k, V]
+        flat = total.reshape(batch, -1)
+        top = np.argsort(-flat, axis=-1)[:, :k]          # [B, k]
+        parents = top // vocab
+        tokens = (top % vocab).astype(np.int64)
+        log_probs = np.take_along_axis(flat, top, axis=-1)
+        finished = np.take_along_axis(fin, parents, axis=-1) \
+            | (tokens == self.end_token)
+        # gather cell states by parent beam
+        new_flat = [np.asarray(unwrap(s)) for s in
+                    (new_states if isinstance(new_states, (list, tuple))
+                     else [new_states])]
+        idx = (np.arange(batch)[:, None] * k + parents).reshape(-1)
+        gathered = [Tensor(f[idx]) for f in new_flat]
+        new_state = {"tokens": tokens, "log_probs": log_probs,
+                     "finished": finished, "cell": gathered,
+                     "batch": batch}
+        return tokens, parents, new_state
+
+    def finalize(self, token_steps, parent_steps, final_state):
+        """Backtrace via gather_tree -> [T, B, beam] sequences."""
+        from ..ops.special import gather_tree
+        ids = Tensor(np.stack(token_steps).astype(np.int64))
+        parents = Tensor(np.stack(parent_steps).astype(np.int64))
+        return gather_tree(ids, parents)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=25,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Reference ``dynamic_decode``: run ``decoder.step`` until every
+    beam finished or ``max_step_num``. Returns (outputs [B, T, beam] or
+    [T, B, beam], final scores [B, beam]) (+ lengths)."""
+    state = decoder.initialize(inits)
+    token_steps, parent_steps = [], []
+    for t in range(max_step_num):
+        tokens, parents, state = decoder.step(t, state)
+        token_steps.append(tokens)
+        parent_steps.append(parents)
+        if state["finished"].all():
+            break
+    outputs = decoder.finalize(token_steps, parent_steps, state)
+    if not output_time_major:
+        from .. import ops
+        outputs = ops.transpose(outputs, [1, 0, 2])
+    scores = Tensor(state["log_probs"].astype(np.float32))
+    if return_length:
+        seqs = np.asarray(unwrap(outputs))
+        arr = (seqs if not output_time_major
+               else np.swapaxes(seqs, 0, 1))  # [B, T, beam]
+        lens = (arr != decoder.end_token).sum(axis=1) + \
+            (arr == decoder.end_token).any(axis=1)
+        return outputs, scores, Tensor(lens.astype(np.int64))
+    return outputs, scores

@@ -195,3 +195,59 @@ def lu(x, pivot=True):
 @primitive
 def householder_product(x, tau):
     return jax.lax.linalg.householder_product(x, tau)
+
+
+@primitive
+def cond(x, p=None):
+    """Reference ``linalg.cond``: condition number (default 2-norm)."""
+    return jnp.linalg.cond(x, p=p)
+
+
+@primitive
+def matrix_exp(x):
+    """Reference ``linalg.matrix_exp``."""
+    return jax.scipy.linalg.expm(x)
+
+
+@primitive
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    """Reference ``linalg.matrix_norm``: norms over the trailing matrix
+    dims ('fro', 'nuc', 1, -1, 2, -2, inf, -inf)."""
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis),
+                           keepdims=keepdim)
+
+
+@primitive
+def vector_norm(x, p=2.0, axis=None, keepdim=False):
+    """Reference ``linalg.vector_norm``: p-norm over ``axis`` (all dims
+    when None; keepdim then yields an all-ones shape of x's rank)."""
+    if axis is None:
+        out = jnp.linalg.norm(x.reshape(-1), ord=p, axis=0)
+        return out.reshape((1,) * x.ndim) if keepdim else out
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Reference ``linalg.pca_lowrank``: rank-q PCA factors (U, S, V).
+    Exact thin SVD of the (optionally centered) matrix — on TPU the full
+    matmul-based SVD is the efficient path; ``niter`` (the randomized
+    power-iteration count) is accepted for signature parity."""
+    from ..core.dispatch import apply
+
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(6, m, n) if q is None else q
+    if not 0 < k <= min(m, n):
+        raise ValueError(f"pca_lowrank: q={k} must be in (0, "
+                         f"min(m, n)={min(m, n)}]")
+
+    def impl(v):
+        vv = v - v.mean(axis=-2, keepdims=True) if center else v
+        u, s, vt = jnp.linalg.svd(vv, full_matrices=False)
+        return (u[..., :, :k], s[..., :k],
+                jnp.swapaxes(vt, -1, -2)[..., :, :k])
+
+    return apply("pca_lowrank", impl, x)
+
+
+# linalg namespace aliases (implementations in ops/special.py)
+from .special import eigvals, lu_unpack  # noqa: F401,E402

@@ -1,0 +1,101 @@
+"""BeamSearchDecoder + dynamic_decode (reference nn/decode.py:153,994).
+Checks: beam_size=1 == stepwise greedy; scores ordered; EOS lock."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _build(vocab=12, hidden=16, seed=0):
+    paddle.seed(seed)
+    cell = nn.GRUCell(hidden, hidden)
+    emb = nn.Embedding(vocab, hidden)
+    head = nn.Linear(hidden, vocab)
+    return cell, emb, head
+
+
+def _greedy(cell, emb, head, h0, start, steps):
+    """Reference decode: argmax per step through the same cell."""
+    h = paddle.to_tensor(h0)
+    tok = np.full((h0.shape[0],), start, np.int64)
+    outs = []
+    for _ in range(steps):
+        out, h = cell(emb(paddle.to_tensor(tok)), h)
+        tok = head(out).numpy().argmax(-1).astype(np.int64)
+        outs.append(tok)
+    return np.stack(outs, axis=1)  # [B, T]
+
+
+def test_beam1_matches_greedy():
+    cell, emb, head = _build()
+    h0 = np.random.default_rng(0).normal(size=(3, 16)).astype("float32")
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=0,
+                               beam_size=1, embedding_fn=emb,
+                               output_fn=head)
+    outs, scores = nn.dynamic_decode(dec, paddle.to_tensor(h0),
+                                     max_step_num=6)
+    ref = _greedy(cell, emb, head, h0, 1, 6)
+    got = outs.numpy()[:, :, 0]  # [B, T] best beam
+    # greedy may stop early on eos; compare up to first eos per row
+    for b in range(3):
+        row = ref[b]
+        stop = np.argmax(row == 0) + 1 if (row == 0).any() else len(row)
+        np.testing.assert_array_equal(got[b, :stop], row[:stop])
+
+
+def test_beam_scores_ordered_and_eos_lock():
+    cell, emb, head = _build(seed=3)
+    h0 = np.random.default_rng(1).normal(size=(2, 16)).astype("float32")
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=0,
+                               beam_size=4, embedding_fn=emb,
+                               output_fn=head)
+    outs, scores, lens = nn.dynamic_decode(
+        dec, paddle.to_tensor(h0), max_step_num=8, return_length=True)
+    s = scores.numpy()
+    assert (np.diff(s, axis=-1) <= 1e-5).all()   # best beam first
+    seq = outs.numpy()                           # [B, T, beam]
+    # after the first end_token, a beam emits only end_token
+    for b in range(seq.shape[0]):
+        for k in range(seq.shape[2]):
+            row = seq[b, :, k]
+            if (row == 0).any():
+                first = np.argmax(row == 0)
+                assert (row[first:] == 0).all()
+    assert lens.numpy().shape == (2, 4)
+
+
+def test_beam_finds_better_than_greedy():
+    """Crafted distribution where greedy is trapped: first step has a
+    slightly-better token leading to a low-prob continuation."""
+    import paddle_tpu.nn.functional as F
+
+    class TrapCell(nn.Layer):
+        """State = last token (one-hot); logits crafted so greedy picks
+        token 1 then gets stuck; beam finds 2 -> 3 with higher total."""
+
+        def forward(self, inputs, states):
+            # inputs: one-hot of last token [N, 4]
+            last = inputs.numpy().argmax(-1)
+            lg = np.full((len(last), 4), -10.0, np.float32)
+            for i, t in enumerate(last):
+                if t == 1:   # start: 1 slightly beats 2
+                    lg[i] = [-10, 0.0, -0.1, -10]
+                elif t == 0:
+                    lg[i] = [0, -10, -10, -10]
+                else:        # from 1: everything bad; from 2: 3 is great
+                    lg[i] = ([-1, -1, -1, -1] if t == 1
+                             else [-10, -10, -10, 5.0])
+            out = paddle.to_tensor(lg)
+            return out, states
+
+    emb = lambda toks: paddle.nn.functional.one_hot(
+        toks, num_classes=4).astype("float32")
+    cell = TrapCell()
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=0,
+                               beam_size=2, embedding_fn=emb,
+                               output_fn=None)
+    h0 = np.zeros((1, 4), "float32")
+    outs, scores = nn.dynamic_decode(dec, paddle.to_tensor(h0),
+                                     max_step_num=2)
+    best = outs.numpy()[0, :, 0]
+    assert best[0] == 2 and best[1] == 3, best  # beam escaped the trap
