@@ -1,0 +1,62 @@
+"""ctypes bindings for the native store server (``store.cc``).
+
+Compiled on first use via the shared ``utils.native_build`` helper (the
+same pattern as ``paddle_tpu/io/native``); ``start`` returns None when
+the toolchain is unavailable so the caller can fall back to the Python
+server.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+from ...utils.native_build import build_and_load
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libpaddle_tpu_store.so")
+_SRC = os.path.join(_HERE, "store.cc")
+_configured = False
+
+
+def _load():
+    global _configured
+    lib = build_and_load(_SRC, _SO)
+    if lib is not None and not _configured:
+        lib.pdtpu_store_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.pdtpu_store_start.restype = ctypes.c_int
+        lib.pdtpu_store_port.argtypes = [ctypes.c_int]
+        lib.pdtpu_store_port.restype = ctypes.c_int
+        lib.pdtpu_store_stop.argtypes = [ctypes.c_int]
+        lib.pdtpu_store_stop.restype = None
+        _configured = True
+    return lib
+
+
+class NativeStoreServer:
+    """A running C++ store server (from ``start``)."""
+
+    def __init__(self, handle, lib):
+        self._handle = handle
+        self._lib = lib
+
+    @property
+    def port(self):
+        return self._lib.pdtpu_store_port(self._handle)
+
+    def stop(self):
+        if self._handle is not None:
+            self._lib.pdtpu_store_stop(self._handle)
+            self._handle = None
+
+
+def start(port=0, host="127.0.0.1"):
+    """Start a native store server bound to ``host`` (loopback by
+    default — the store is unauthenticated); None if the lib can't
+    build/load."""
+    lib = _load()
+    if lib is None:
+        return None
+    handle = lib.pdtpu_store_start(host.encode(), int(port))
+    if handle < 1:
+        return None
+    return NativeStoreServer(handle, lib)

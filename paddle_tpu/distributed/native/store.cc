@@ -1,0 +1,277 @@
+// Native TCP key-value rendezvous store server (SURVEY D3 — the analog
+// of the reference's C++ TCPStore, paddle/phi/core/distributed/store/
+// tcp_store.h:121 + socket.cpp). Thread-per-connection; one mutex +
+// condition_variable guards the table so blocking GETs wake on SET/ADD.
+//
+// Wire protocol (lengths big-endian):
+//   request:  [1B op][4B klen][key][payload]
+//     op 1 SET:   payload = [4B vlen][value bytes]
+//     op 2 GET:   payload = [8B timeout_ms]   (blocks until key or timeout)
+//     op 3 ADD:   payload = [8B amount]       (int counter; returns value)
+//     op 4 DEL:   payload = none
+//     op 5 CLOSE: payload = none              (closes this connection)
+//   response: [1B ok][4B vlen][value]
+//     ADD -> value = [8B int]; DEL -> value = [1B existed]; GET -> bytes.
+//
+// C API (ctypes): pdtpu_store_start(host, port) -> handle (>0) or
+// -errno; pdtpu_store_port(h); pdtpu_store_stop(h).
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> data;
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+uint32_t rd32(const unsigned char* b) {
+  return (uint32_t(b[0]) << 24) | (uint32_t(b[1]) << 16) |
+         (uint32_t(b[2]) << 8) | uint32_t(b[3]);
+}
+
+int64_t rd64(const unsigned char* b) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = (v << 8) | b[i];
+  return static_cast<int64_t>(v);
+}
+
+void wr32(unsigned char* b, uint32_t v) {
+  b[0] = v >> 24;
+  b[1] = v >> 16;
+  b[2] = v >> 8;
+  b[3] = v;
+}
+
+void wr64(unsigned char* b, uint64_t v) {
+  for (int i = 7; i >= 0; --i) {
+    b[i] = v & 0xff;
+    v >>= 8;
+  }
+}
+
+bool reply(int fd, bool ok, const std::string& value) {
+  std::vector<unsigned char> out(5 + value.size());
+  out[0] = ok ? 1 : 0;
+  wr32(out.data() + 1, static_cast<uint32_t>(value.size()));
+  std::memcpy(out.data() + 5, value.data(), value.size());
+  return write_exact(fd, out.data(), out.size());
+}
+
+void serve(Store* st, int fd) {
+  for (;;) {
+    unsigned char hdr[5];
+    if (!read_exact(fd, hdr, 5)) break;
+    uint8_t op = hdr[0];
+    uint32_t klen = rd32(hdr + 1);
+    if (klen > (64u << 20)) break;  // sanity
+    std::string key(klen, '\0');
+    if (klen && !read_exact(fd, key.data(), klen)) break;
+
+    if (op == 1) {  // SET
+      unsigned char l4[4];
+      if (!read_exact(fd, l4, 4)) break;
+      uint32_t vlen = rd32(l4);
+      if (vlen > (256u << 20)) break;
+      std::string value(vlen, '\0');
+      if (vlen && !read_exact(fd, value.data(), vlen)) break;
+      {
+        std::lock_guard<std::mutex> g(st->mu);
+        st->data[key] = std::move(value);
+      }
+      st->cv.notify_all();
+      if (!reply(fd, true, "")) break;
+    } else if (op == 2) {  // GET (blocking)
+      unsigned char t8[8];
+      if (!read_exact(fd, t8, 8)) break;
+      int64_t timeout_ms = rd64(t8);
+      std::unique_lock<std::mutex> lk(st->mu);
+      bool ok = st->cv.wait_for(
+          lk, std::chrono::milliseconds(timeout_ms),
+          [&] { return st->stop.load() || st->data.count(key) > 0; });
+      ok = ok && st->data.count(key) > 0;
+      std::string value = ok ? st->data[key] : "";
+      lk.unlock();
+      if (!reply(fd, ok, value)) break;
+    } else if (op == 3) {  // ADD
+      unsigned char a8[8];
+      if (!read_exact(fd, a8, 8)) break;
+      int64_t amount = rd64(a8);
+      int64_t cur;
+      {
+        std::lock_guard<std::mutex> g(st->mu);
+        auto it = st->data.find(key);
+        int64_t prev = 0;
+        if (it != st->data.end() && it->second.size() == 8)
+          prev = rd64(reinterpret_cast<const unsigned char*>(
+              it->second.data()));
+        cur = prev + amount;
+        std::string enc(8, '\0');
+        wr64(reinterpret_cast<unsigned char*>(enc.data()),
+             static_cast<uint64_t>(cur));
+        st->data[key] = std::move(enc);
+      }
+      st->cv.notify_all();
+      std::string out(8, '\0');
+      wr64(reinterpret_cast<unsigned char*>(out.data()),
+           static_cast<uint64_t>(cur));
+      if (!reply(fd, true, out)) break;
+    } else if (op == 4) {  // DEL
+      bool existed;
+      {
+        std::lock_guard<std::mutex> g(st->mu);
+        existed = st->data.erase(key) > 0;
+      }
+      st->cv.notify_all();
+      if (!reply(fd, true, std::string(1, existed ? 1 : 0))) break;
+    } else if (op == 5) {  // CLOSE
+      reply(fd, true, "");
+      break;
+    } else {
+      reply(fd, false, "bad op");
+      break;
+    }
+  }
+  ::close(fd);
+}
+
+void accept_loop(Store* st) {
+  for (;;) {
+    int fd = ::accept(st->listen_fd, nullptr, nullptr);
+    if (st->stop.load()) {
+      if (fd >= 0) ::close(fd);
+      return;
+    }
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      return;  // listener closed
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(serve, st, fd).detach();
+  }
+}
+
+constexpr int kMaxStores = 64;
+Store* g_stores[kMaxStores] = {nullptr};
+std::mutex g_stores_mu;
+
+}  // namespace
+
+extern "C" {
+
+// Returns a handle >= 1, or -errno on failure. port 0 = ephemeral;
+// host: dotted quad (the caller's bind address — loopback by default,
+// NOT INADDR_ANY: the store is unauthenticated).
+int pdtpu_store_start(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -errno;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  if (host == nullptr || host[0] == '\0') {
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  } else if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -EINVAL;
+  }
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 128) < 0) {
+    int e = errno;
+    ::close(fd);
+    return -e;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+
+  auto* st = new Store();
+  st->listen_fd = fd;
+  st->port = ntohs(addr.sin_port);
+  st->accept_thread = std::thread(accept_loop, st);
+
+  std::lock_guard<std::mutex> g(g_stores_mu);
+  for (int i = 0; i < kMaxStores; ++i) {
+    if (g_stores[i] == nullptr) {
+      g_stores[i] = st;
+      return i + 1;
+    }
+  }
+  st->stop = true;
+  ::shutdown(fd, SHUT_RDWR);
+  ::close(fd);
+  st->accept_thread.join();
+  delete st;
+  return -EMFILE;
+}
+
+int pdtpu_store_port(int handle) {
+  std::lock_guard<std::mutex> g(g_stores_mu);
+  if (handle < 1 || handle > kMaxStores || !g_stores[handle - 1]) return -1;
+  return g_stores[handle - 1]->port;
+}
+
+void pdtpu_store_stop(int handle) {
+  Store* st = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_stores_mu);
+    if (handle < 1 || handle > kMaxStores) return;
+    st = g_stores[handle - 1];
+    g_stores[handle - 1] = nullptr;
+  }
+  if (!st) return;
+  st->stop = true;
+  st->cv.notify_all();
+  ::shutdown(st->listen_fd, SHUT_RDWR);
+  ::close(st->listen_fd);
+  if (st->accept_thread.joinable()) st->accept_thread.join();
+  // serve threads are detached and exit as clients disconnect; the Store
+  // object is intentionally leaked on stop to avoid racing them — stores
+  // are per-process singletons in practice (bounded by kMaxStores).
+}
+
+}  // extern "C"

@@ -2,22 +2,38 @@
 ``paddle/phi/core/distributed/store/tcp_store.h:121`` — SURVEY D3).
 
 One process (``is_master=True``, conventionally rank 0) hosts the table;
-every process (master included) connects as a client. Used by
-``paddle.distributed.rpc`` for worker-info exchange and barriers; the
-collective path does NOT need it (the JAX coordination service owns that
-bootstrap), matching SURVEY §7's "TCPStore-compatible bootstrap" row.
+every process (master included) connects as a client. The server is the
+NATIVE C++ one (``native/store.cc`` — matching the reference's C++
+TCPStore) when the toolchain can build it, with a Python fallback
+speaking the identical binary wire protocol, so clients never care which
+side serves them:
 
-Wire protocol: length-prefixed pickle frames ``(op, key, value)`` →
-``(ok, value)``.
+  request  [1B op][4B klen][key][payload]   (lengths big-endian)
+  response [1B ok][4B vlen][value]
+  ops: 1 SET([4B vlen][value]) / 2 GET([8B timeout_ms], blocking) /
+       3 ADD([8B amount] int counter) / 4 DEL / 5 CLOSE
+
+Used by ``paddle.distributed.rpc`` for worker-info exchange and barriers;
+the collective path does NOT need it (the JAX coordination service owns
+that bootstrap), matching SURVEY §7's "TCPStore-compatible bootstrap".
+
+``_send_frame``/``_recv_frame`` (length-prefixed pickle) remain here as
+shared helpers for the Python-to-Python protocols (rpc, ps) — the store
+itself no longer uses pickle so the C++ server can serve it.
 """
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import struct
 import threading
 import time
 
+_OP_SET, _OP_GET, _OP_ADD, _OP_DEL, _OP_CLOSE = 1, 2, 3, 4, 5
+
+
+# --- generic pickle framing (rpc/ps protocols, NOT the store's) ----------
 
 def _send_frame(sock, obj):
     payload = pickle.dumps(obj)
@@ -39,7 +55,19 @@ def _recv_frame(sock):
     return pickle.loads(_recv_exact(sock, n))
 
 
+# --- binary store protocol ------------------------------------------------
+
+def _store_request(sock, op, key, payload=b""):
+    k = key.encode() if isinstance(key, str) else bytes(key or b"")
+    sock.sendall(struct.pack("!BI", op, len(k)) + k + payload)
+    ok, vlen = struct.unpack("!BI", _recv_exact(sock, 5))
+    value = _recv_exact(sock, vlen) if vlen else b""
+    return bool(ok), value
+
+
 class _Server:
+    """Python fallback server — byte-identical protocol to store.cc."""
+
     def __init__(self, host, port):
         self._data = {}
         self._cv = threading.Condition()
@@ -48,9 +76,7 @@ class _Server:
         self._sock.bind((host, port))
         self._sock.listen(128)
         self._stop = False
-        self._thread = threading.Thread(target=self._accept_loop,
-                                        daemon=True)
-        self._thread.start()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
 
     @property
     def port(self):
@@ -68,34 +94,52 @@ class _Server:
     def _serve(self, conn):
         try:
             while True:
-                op, key, value = _recv_frame(conn)
-                if op == "set":
+                op, klen = struct.unpack("!BI", _recv_exact(conn, 5))
+                if klen > (64 << 20):  # same sanity cap as store.cc
+                    return
+                key = _recv_exact(conn, klen)  # bytes, like the C++ side
+                if op == _OP_SET:
+                    (vlen,) = struct.unpack("!I", _recv_exact(conn, 4))
+                    if vlen > (256 << 20):  # same cap as store.cc
+                        return
+                    value = _recv_exact(conn, vlen)
                     with self._cv:
                         self._data[key] = value
                         self._cv.notify_all()
-                    _send_frame(conn, (True, None))
-                elif op == "get":
+                    conn.sendall(struct.pack("!BI", 1, 0))
+                elif op == _OP_GET:
+                    (tmo,) = struct.unpack("!q", _recv_exact(conn, 8))
                     with self._cv:
                         ok = self._cv.wait_for(
-                            lambda: key in self._data, timeout=value)
-                        _send_frame(conn, (ok, self._data.get(key)))
-                elif op == "add":
+                            lambda: key in self._data,
+                            timeout=tmo / 1000.0)
+                        value = self._data.get(key, b"")
+                    conn.sendall(struct.pack("!BI", 1 if ok else 0,
+                                             len(value)) + value)
+                elif op == _OP_ADD:
+                    (amount,) = struct.unpack("!q", _recv_exact(conn, 8))
                     with self._cv:
-                        cur = int(self._data.get(key, 0)) + int(value)
-                        self._data[key] = cur
+                        prev = self._data.get(key, b"")
+                        cur = (struct.unpack("!q", prev)[0]
+                               if len(prev) == 8 else 0) + amount
+                        self._data[key] = struct.pack("!q", cur)
                         self._cv.notify_all()
-                    _send_frame(conn, (True, cur))
-                elif op == "delete":
+                    conn.sendall(struct.pack("!BI", 1, 8)
+                                 + struct.pack("!q", cur))
+                elif op == _OP_DEL:
                     with self._cv:
                         existed = self._data.pop(key, None) is not None
                         self._cv.notify_all()
-                    _send_frame(conn, (True, existed))
-                elif op == "close":
-                    _send_frame(conn, (True, None))
+                    conn.sendall(struct.pack("!BI", 1, 1)
+                                 + (b"\x01" if existed else b"\x00"))
+                elif op == _OP_CLOSE:
+                    conn.sendall(struct.pack("!BI", 1, 0))
                     return
                 else:
-                    _send_frame(conn, (False, f"bad op {op}"))
-        except (ConnectionError, EOFError, OSError):
+                    msg = b"bad op"
+                    conn.sendall(struct.pack("!BI", 0, len(msg)) + msg)
+                    return
+        except (ConnectionError, EOFError, OSError, struct.error):
             pass
         finally:
             conn.close()
@@ -108,12 +152,24 @@ class _Server:
             pass
 
 
+def _start_server(host, port):
+    """Native C++ server when it builds (PDTPU_NATIVE_STORE=0 forces the
+    Python fallback); both bind the caller's host (the store is
+    unauthenticated — callers choose the exposure)."""
+    if os.environ.get("PDTPU_NATIVE_STORE", "1") != "0":
+        from . import native
+        srv = native.start(port, host=host)
+        if srv is not None:
+            return srv
+    return _Server(host, port)
+
+
 class TCPStore:
     """Client (+ optionally the host) of the rendezvous table."""
 
     def __init__(self, host, port, world_size=1, is_master=False,
                  timeout=300):
-        self._server = _Server(host, port) if is_master else None
+        self._server = _start_server(host, port) if is_master else None
         self._addr = (host, self._server.port if is_master else port)
         self._timeout = timeout
         self._lock = threading.Lock()
@@ -138,26 +194,37 @@ class TCPStore:
     def port(self):
         return self._addr[1]
 
-    def _call(self, op, key, value=None):
+    @property
+    def is_native(self):
+        """True when this (master) store is served by the C++ server."""
+        from .native import NativeStoreServer
+        return isinstance(self._server, NativeStoreServer)
+
+    def _call(self, op, key, payload=b""):
         with self._lock:
-            _send_frame(self._sock, (op, key, value))
-            return _recv_frame(self._sock)
+            return _store_request(self._sock, op, key, payload)
 
     def set(self, key, value):
-        self._call("set", key, value)
+        if isinstance(value, str):
+            value = value.encode()
+        self._call(_OP_SET, key,
+                   struct.pack("!I", len(value)) + bytes(value))
 
     def get(self, key, timeout=None):
-        ok, value = self._call("get", key,
-                               self._timeout if timeout is None else timeout)
+        tmo = self._timeout if timeout is None else timeout
+        ok, value = self._call(_OP_GET, key,
+                               struct.pack("!q", int(tmo * 1000)))
         if not ok:
             raise TimeoutError(f"TCPStore.get({key!r}) timed out")
         return value
 
     def add(self, key, amount=1):
-        return self._call("add", key, amount)[1]
+        _, value = self._call(_OP_ADD, key, struct.pack("!q", amount))
+        return struct.unpack("!q", value)[0]
 
     def delete_key(self, key):
-        return self._call("delete", key)[1]
+        _, value = self._call(_OP_DEL, key)
+        return value == b"\x01"
 
     def wait(self, keys, timeout=None):
         for k in keys:
@@ -175,7 +242,7 @@ class TCPStore:
 
     def close(self):
         try:
-            self._call("close", None)
+            self._call(_OP_CLOSE, "")
         except (ConnectionError, OSError):
             pass
         self._sock.close()

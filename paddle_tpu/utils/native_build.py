@@ -1,0 +1,43 @@
+"""Shared compile-on-first-use loader for the native C++ runtime pieces
+(io loader, store server): mtime-based rebuild, double-checked caching,
+graceful None on a missing toolchain so callers can fall back to Python.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+def build_and_load(src: str, so: str, flags=("-O2",)):
+    """Compile ``src`` -> ``so`` (if stale) and dlopen it; None when the
+    toolchain is unavailable or the build fails. Results (including
+    failure) are cached per ``so`` path."""
+    if so in _cache:
+        lib = _cache[so]
+        return lib or None
+    with _lock:
+        if so in _cache:
+            lib = _cache[so]
+            return lib or None
+        try:
+            if (not os.path.exists(so)
+                    or os.path.getmtime(so) < os.path.getmtime(src)):
+                # build to a per-pid temp + atomic rename: concurrent
+                # processes (test subprocesses) must not read a half-
+                # written .so
+                tmp = f"{so}.{os.getpid()}.tmp"
+                subprocess.run(
+                    ["g++", *flags, "-shared", "-fPIC", "-std=c++17",
+                     "-pthread", src, "-o", tmp],
+                    check=True, capture_output=True)
+                os.replace(tmp, so)
+            lib = ctypes.CDLL(so)
+        except Exception:
+            lib = False
+        _cache[so] = lib
+        return lib or None

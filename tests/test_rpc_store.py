@@ -127,3 +127,52 @@ def test_rpc_three_workers(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+def test_native_store_backend():
+    """The C++ store server (native/store.cc) builds and serves the same
+    protocol; full op matrix + barrier against it."""
+    from paddle_tpu.distributed import native
+    if native._load() is None:
+        pytest.skip("no C++ toolchain for the native store")
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    assert master.is_native
+    client = TCPStore("127.0.0.1", master.port)
+    master.set("k", b"v1")
+    assert client.get("k") == b"v1"
+    client.set("k", b"v2")
+    assert master.get("k") == b"v2"
+    assert client.add("ctr", 5) == 5
+    assert master.add("ctr", -2) == 3
+    assert client.delete_key("k") is True
+    assert client.delete_key("k") is False
+    with pytest.raises(TimeoutError):
+        client.get("missing", timeout=0.2)
+    got = []
+    t = threading.Thread(
+        target=lambda: got.append(master.get("late", timeout=5)))
+    t.start()
+    client.set("late", b"now")
+    t.join(timeout=5)
+    assert got == [b"now"]
+    for it in range(2):  # reusable barrier on the native server
+        ts = threading.Thread(
+            target=lambda: master.barrier("nb", 2, timeout=10))
+        ts.start()
+        client.barrier("nb", 2, timeout=10)
+        ts.join(5)
+        assert not ts.is_alive()
+    client.close()
+    master.close()
+
+
+def test_python_fallback_store(monkeypatch):
+    monkeypatch.setenv("PDTPU_NATIVE_STORE", "0")
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    assert not master.is_native
+    client = TCPStore("127.0.0.1", master.port)
+    master.set("k", b"v")
+    assert client.get("k") == b"v"
+    assert client.add("c", 2) == 2
+    client.close()
+    master.close()
