@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 
 import numpy as np
@@ -22,13 +21,6 @@ _lib = None
 _lock = threading.Lock()
 
 
-def _build() -> str:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           _SRC, "-o", _SO]
-    subprocess.run(cmd, check=True, capture_output=True)
-    return _SO
-
-
 def load():
     """Load (building if needed) the native library; None if unavailable."""
     global _lib
@@ -37,12 +29,9 @@ def load():
     with _lock:
         if _lib is not None:
             return _lib
-        try:
-            if (not os.path.exists(_SO)
-                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-                _build()
-            lib = ctypes.CDLL(_SO)
-        except Exception:
+        from ...utils.native_build import build_and_load
+        lib = build_and_load(_SRC, _SO, flags=("-O3",))
+        if lib is None:
             return None
         lib.pdtpu_normalize_u8.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
