@@ -8,7 +8,7 @@ from . import initializer  # noqa: F401
 from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
 from .layer import Layer, ParamAttr  # noqa: F401
 from .layers import (  # noqa: F401
-    Identity, Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    Bilinear, Identity, Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
     Flatten, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
     PixelShuffle, PixelUnshuffle, Pad1D, Pad2D, Pad3D, ZeroPad2D,
     Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose,

@@ -25,6 +25,7 @@ from .loss import (  # noqa: F401
     kl_div, margin_ranking_loss, hinge_embedding_loss, cosine_embedding_loss,
     triplet_margin_loss, square_error_cost, sigmoid_focal_loss, log_loss,
     ctc_loss, huber_loss, hsigmoid_loss, rnnt_loss,
+    margin_cross_entropy, class_center_sample,
 )
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, flash_attn_qkvpacked,

@@ -310,3 +310,18 @@ def _normalize_impl(x, p=2, axis=1, epsilon=1e-12):
 
 def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
     return _normalize_impl(x, p=p, axis=axis, epsilon=epsilon)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Reference ``nn.functional.bilinear``: out[n, o] =
+    x1[n, i] W[o, i, j] x2[n, j] (+ bias)."""
+    from ...core.dispatch import apply
+
+    def impl(a, b, w, *rest):
+        out = jnp.einsum("ni,oij,nj->no", a, w, b)
+        if rest:
+            out = out + rest[0].reshape(1, -1)
+        return out
+
+    args = [x1, x2, weight] + ([bias] if bias is not None else [])
+    return apply("bilinear", impl, *args)

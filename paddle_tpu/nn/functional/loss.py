@@ -481,3 +481,67 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
 
     return apply("rnnt_loss", impl, input, label, input_lengths,
                  label_lengths)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean",
+                         name=None):
+    """Reference ``margin_cross_entropy`` (ArcFace/CosFace family):
+    target logit cos(theta) -> cos(m1*theta + m2) - m3, all scaled by
+    ``scale``, then softmax CE. Single-program form — under TP the vocab
+    dim shards via GSPMD instead of the reference's c_softmax collective
+    (``group`` accepted for signature parity)."""
+    def impl(lg, y):
+        yy = y.reshape(-1).astype(jnp.int32)
+        cos_t = jnp.take_along_axis(lg, yy[:, None], axis=1)[:, 0]
+        # stay strictly inside (-1, 1): arccos' derivative is -inf at
+        # the boundary and a perfectly-aligned feature would NaN the step
+        cos_t = jnp.clip(cos_t, -1.0 + 1e-6, 1.0 - 1e-6)
+        theta = jnp.arccos(cos_t)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = lg.at[jnp.arange(lg.shape[0]), yy].set(target) * scale
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        loss = -jnp.take_along_axis(logp, yy[:, None], axis=1)
+        sm = jnp.exp(logp)
+        if reduction == "mean":
+            loss_out = jnp.mean(loss)
+        elif reduction == "sum":
+            loss_out = jnp.sum(loss)
+        else:
+            loss_out = loss
+        return (loss_out, sm) if return_softmax else loss_out
+
+    return apply("margin_cross_entropy", impl, logits, label)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Reference ``class_center_sample``: keep the batch's positive
+    classes plus random negatives up to ``num_samples`` unique centers;
+    returns (remapped_label, sampled_class_indices). Host-side sampling
+    (data-dependent sizes), seeded by the framework RNG."""
+    import numpy as np
+
+    from ...core import state
+    from ...core.dispatch import unwrap
+    from ...core.tensor import Tensor
+
+    if num_samples > num_classes:
+        raise ValueError(f"class_center_sample: num_samples "
+                         f"{num_samples} > num_classes {num_classes}")
+    y = np.asarray(unwrap(label)).reshape(-1)
+    pos = np.unique(y)
+    import jax as _jax
+    key = np.asarray(_jax.random.key_data(state.default_rng.next_key()))
+    rng = np.random.default_rng(key.astype(np.uint32))
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+        extra = rng.choice(neg_pool, size=num_samples - len(pos),
+                           replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(remap[y].astype(np.int64)),
+            Tensor(sampled.astype(np.int64)))

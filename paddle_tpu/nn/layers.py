@@ -1111,3 +1111,21 @@ class LayerDict(Layer):
             else sublayers
         for k, v in items:
             self.add_sublayer(k, v)
+
+
+class Bilinear(Layer):
+    """Reference ``nn.Bilinear``: out = x1 W x2 + b with
+    W [out_features, in1_features, in2_features]."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.bias = self.create_parameter([out_features], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x1, x2):
+        from .functional import bilinear
+        return bilinear(x1, x2, self.weight, self.bias)

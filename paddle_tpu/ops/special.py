@@ -234,3 +234,26 @@ def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
         return score, token
 
     return _tps(x, ps, key_data)
+
+
+def frobenius_norm(x, axis=None, keepdim=False, name=None):
+    """Reference ``frobenius_norm``: sqrt(sum(x^2)) over ``axis``
+    (default: the trailing two dims, the reference kernel's contract).
+    Thin wrapper over linalg's ``_fro_norm`` primitive — one home for
+    the computation."""
+    from .linalg import _fro_norm
+    ax = tuple(axis) if axis is not None else (-2, -1)
+    return _fro_norm(x, axis=ax, keepdim=keepdim)
+
+
+@primitive
+def identity_loss(x, reduction="none"):
+    """Reference ``identity_loss`` op: pass-through loss marker with the
+    usual reductions (1=mean, 2=sum, 3/none=identity in the kernel;
+    string forms accepted here)."""
+    red = {1: "mean", 2: "sum", 3: "none"}.get(reduction, reduction)
+    if red == "mean":
+        return jnp.mean(x)
+    if red == "sum":
+        return jnp.sum(x)
+    return x

@@ -258,3 +258,59 @@ def test_distribute_fpn_proposals():
     assert len(outs) == 4
     assert sum(o.shape[0] for o in outs) == 2
     assert sorted(restore.numpy().tolist()) == [0, 1]
+
+
+def test_bilinear():
+    rng = np.random.default_rng(0)
+    x1 = paddle.to_tensor(rng.normal(size=(4, 3)).astype("float32"))
+    x2 = paddle.to_tensor(rng.normal(size=(4, 5)).astype("float32"))
+    bl = paddle.nn.Bilinear(3, 5, 2)
+    out = bl(x1, x2)
+    ref = np.einsum("ni,oij,nj->no", x1.numpy(), bl.weight.numpy(),
+                    x2.numpy()) + bl.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+
+def test_frobenius_norm_and_identity_loss():
+    rng = np.random.default_rng(1)
+    A = rng.normal(size=(2, 3, 4)).astype("float32")
+    np.testing.assert_allclose(
+        paddle.frobenius_norm(paddle.to_tensor(A)).numpy(),
+        np.sqrt((A ** 2).sum((-2, -1))), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(paddle.identity_loss(paddle.to_tensor([2.0, 4.0]),
+                                   "mean").numpy()), 3.0)
+
+
+def test_margin_cross_entropy():
+    rng = np.random.default_rng(2)
+    # logits are COSINES in this op's contract: keep them in [-1, 1]
+    # (values outside get clipped before arccos, diverging from plain CE)
+    lg = np.tanh(rng.normal(size=(6, 10))).astype("float32") * 0.9
+    y = rng.integers(0, 10, (6,)).astype("int32")
+    # zero margins at scale 1 == plain CE
+    loss = F.margin_cross_entropy(paddle.to_tensor(lg), paddle.to_tensor(y),
+                                  margin1=1.0, margin2=0.0, margin3=0.0,
+                                  scale=1.0)
+    ref = F.cross_entropy(paddle.to_tensor(lg), paddle.to_tensor(y))
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-4)
+    # the ArcFace margin shrinks the target logit -> larger loss
+    loss_m = F.margin_cross_entropy(paddle.to_tensor(lg),
+                                    paddle.to_tensor(y),
+                                    margin2=0.5, scale=1.0)
+    assert float(loss_m) > float(loss)
+    # softmax output shape
+    _, sm = F.margin_cross_entropy(paddle.to_tensor(lg),
+                                   paddle.to_tensor(y),
+                                   return_softmax=True)
+    assert tuple(sm.shape) == (6, 10)
+
+
+def test_class_center_sample():
+    paddle.seed(0)
+    lbl = paddle.to_tensor(np.array([3, 7, 3, 1], "int64"))
+    remap, sampled = F.class_center_sample(lbl, 100, 10)
+    s = sampled.numpy()
+    assert len(s) == 10 and {1, 3, 7} <= set(s.tolist())
+    # remapped labels point back at the original classes
+    assert (s[remap.numpy()] == np.array([3, 7, 3, 1])).all()
