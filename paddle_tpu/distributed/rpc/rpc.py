@@ -92,11 +92,17 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         master_endpoint = "127.0.0.1:0"
     host, port = master_endpoint.rsplit(":", 1)
 
-    server = _RpcServer("0.0.0.0")
+    # The agent executes arbitrary pickled calls from any connecting client
+    # and has no authentication (same trust model as store.py): never bind
+    # INADDR_ANY. Loopback-only for local jobs; otherwise bind this
+    # worker's resolved address so only the job network can reach it.
+    if world_size == 1 or host in ("127.0.0.1", "localhost"):
+        ip = "127.0.0.1"
+    else:
+        ip = socket.gethostbyname(socket.gethostname())
+    server = _RpcServer(ip)
     store = TCPStore(host, int(port), world_size=world_size,
                      is_master=(rank == 0))
-    ip = socket.gethostbyname(socket.gethostname()) \
-        if world_size > 1 else "127.0.0.1"
     me = WorkerInfo(name, rank, ip, server.port)
     store.set(f"__rpc/worker/{rank}", pickle.dumps(me))
     infos = {}

@@ -194,7 +194,9 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
              downsample_ratio, clip_bbox=True, scale_x_y=1.0,
              iou_aware=False, iou_aware_factor=0.5, name=None):
     """Reference ``yolo_box``: decode YOLOv3 head output [N, C, H, W]
-    into (boxes [N, H*W*A, 4], scores [N, H*W*A, class_num])."""
+    into (boxes [N, A*H*W, 4], scores [N, A*H*W, class_num]) —
+    anchor-major flattening, matching the reference kernel's
+    ``box_idx = i*box_num + j*stride + k*w + l``."""
     import jax.numpy as jnp
 
     from ..core.dispatch import apply, unwrap
@@ -338,8 +340,10 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             # compensation: how much suppressor i was itself suppressed
             iou_cmax = iou.max(axis=0)[:, None]   # per ROW i
             if use_gaussian:
-                decay = np.exp(-(iou ** 2 - iou_cmax ** 2)
-                               / gaussian_sigma).min(axis=0)
+                # reference matrix_nms_kernel.cc:70 multiplies by sigma:
+                # exp((max_iou^2 - iou^2) * sigma)
+                decay = np.exp((iou_cmax ** 2 - iou ** 2)
+                               * gaussian_sigma).min(axis=0)
             else:
                 decay = ((1 - iou)
                          / (1 - iou_cmax + 1e-10)).min(axis=0)

@@ -190,6 +190,26 @@ def test_matrix_nms_decays_duplicates():
                                rtol=1e-4)
 
 
+def test_matrix_nms_gaussian_decay():
+    """Gaussian mode multiplies by sigma (ref matrix_nms_kernel.cc:70:
+    exp((max_iou^2 - iou^2) * sigma)), it does NOT divide."""
+    bb = paddle.to_tensor(np.array(
+        [[[0, 0, 10, 10], [0, 1, 10, 11], [20, 20, 30, 30]]], "float32"))
+    sc = paddle.to_tensor(np.array(
+        [[[0.0, 0.0, 0.0], [0.9, 0.8, 0.7]]], "float32"))
+    sigma = 2.0
+    out, idx, num = paddle.vision.ops.matrix_nms(
+        bb, sc, score_threshold=0.1, post_threshold=0.0,
+        background_label=0, use_gaussian=True, gaussian_sigma=sigma,
+        return_index=True)
+    got = {tuple(r[2:].astype(int)): r[1] for r in out.numpy()}
+    np.testing.assert_allclose(got[(0, 0, 10, 10)], 0.9, rtol=1e-6)
+    np.testing.assert_allclose(got[(20, 20, 30, 30)], 0.7, rtol=1e-6)
+    iou = (10 * 9) / (2 * 100 - 10 * 9)
+    np.testing.assert_allclose(
+        got[(0, 1, 10, 11)], 0.8 * np.exp(-(iou ** 2) * sigma), rtol=1e-4)
+
+
 def test_yolo_box_coordinates_consistent():
     """Box coords must come from the same grid cell (layout regression:
     coords axis is already last — no transpose)."""
