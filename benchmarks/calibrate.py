@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""On-chip calibration: measured bf16 matmul TF/s at GPT-124M's actual GEMM
+shapes, attention fwd/bwd TF/s, and a matmul-only roofline for the bench
+config. Emits one JSON object (and writes it to argv[1] if given).
+
+Methodology — the axon tunnel adds milliseconds of fixed per-dispatch
+latency and ``block_until_ready`` does not actually wait (measured: it
+"times" an 8192^3 matmul at 57 PF/s), so naive per-call timing is garbage
+at these op sizes. Instead each op runs R times *inside one compiled
+program* (lax.scan over R distinct stacked inputs, accumulating into the
+output so nothing can be elided or hoisted), timed at two values of R with
+host-readback sync; the slope (t_R2 - t_R1) / (R2 - R1) is pure kernel
+time, with dispatch overhead and sync cost cancelled.
+
+The roofline is matmul+attention kernel time only (elementwise, softmax,
+optimizer, dispatch all ride free in its idealised world), so real step
+time must exceed it; the ratio is the schedulable headroom.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _sync(x):
+    """True device sync: host readback of a scalar (block_until_ready lies
+    over the tunnel — see module docstring)."""
+    return float(jnp.asarray(x).reshape(-1)[0].astype(jnp.float32))
+
+
+def _time_call(fn, *args, iters=4, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _scanned_matmul(m, k, n, reps, dtype=jnp.bfloat16, seed=0):
+    """One jit program running ``reps`` distinct [m,k]@[k,n] matmuls,
+    accumulating into the output (the add fuses into the dot epilogue)."""
+    rng = np.random.default_rng(seed)
+    A = jnp.asarray(rng.normal(size=(reps, m, k)) * 0.1, dtype)
+    b = jnp.asarray(rng.normal(size=(k, n)) * 0.1, dtype)
+
+    @jax.jit
+    def f(A, b):
+        def body(c, a):
+            return c + (a @ b), None
+        return jax.lax.scan(body, jnp.zeros((m, n), dtype), A)[0]
+
+    return f, (A, b)
+
+
+def measure_matmul(m, k, n, r1=8, r2=40):
+    """Kernel-only TF/s via the two-R slope."""
+    # cap stacked-input memory at ~2 GB
+    bytes_per = m * k * 2
+    max_reps = max(int(2e9 // bytes_per), 2)
+    r1, r2 = min(r1, max_reps // 2), min(r2, max_reps)
+    if r2 <= r1:
+        r1, r2 = 1, max(2, r2)
+    f1, a1 = _scanned_matmul(m, k, n, r1)
+    f2, a2 = _scanned_matmul(m, k, n, r2)
+    t1 = _time_call(f1, *a1)
+    t2 = _time_call(f2, *a2)
+    per_op = (t2 - t1) / (r2 - r1)
+    per_op = max(per_op, 1e-9)
+    return 2.0 * m * k * n / per_op / 1e12, per_op
+
+
+def _scanned_attention(batch, heads, seq, head_dim, reps, causal, bwd):
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    rng = np.random.default_rng(0)
+    shp = (reps, batch, seq, heads, head_dim)
+    Q = jnp.asarray(rng.normal(size=shp) * 0.1, jnp.bfloat16)
+    K = jnp.asarray(rng.normal(size=shp) * 0.1, jnp.bfloat16)
+    V = jnp.asarray(rng.normal(size=shp) * 0.1, jnp.bfloat16)
+
+    def one(q, k, v):
+        return fa.flash_attention(q, k, v, causal=causal)
+
+    if not bwd:
+        @jax.jit
+        def f(Q, K, V):
+            def body(c, qkv):
+                q, k, v = qkv
+                return c + one(q, k, v), None
+            z = jnp.zeros(shp[1:], jnp.bfloat16)
+            return jax.lax.scan(body, z, (Q, K, V))[0]
+    else:
+        grad = jax.grad(
+            lambda q, k, v: one(q, k, v).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2))
+
+        @jax.jit
+        def f(Q, K, V):
+            def body(c, qkv):
+                dq, dk, dv = grad(*qkv)
+                return c + dq.astype(jnp.bfloat16), None
+            z = jnp.zeros(shp[1:], jnp.bfloat16)
+            return jax.lax.scan(body, z, (Q, K, V))[0]
+
+    return f, (Q, K, V)
+
+
+def measure_attention(batch, heads, seq, head_dim, causal=True,
+                      r1=4, r2=16):
+    res = {}
+    for tag, bwd in (("fwd", False), ("bwd", True)):
+        f1, a1 = _scanned_attention(batch, heads, seq, head_dim, r1,
+                                    causal, bwd)
+        f2, a2 = _scanned_attention(batch, heads, seq, head_dim, r2,
+                                    causal, bwd)
+        t1 = _time_call(f1, *a1)
+        t2 = _time_call(f2, *a2)
+        per_op = max((t2 - t1) / (r2 - r1), 1e-9)
+        flops = 4.0 * batch * heads * seq * seq * head_dim
+        if causal:
+            flops *= 0.5
+        if bwd:
+            flops *= 2.5  # dQ,dK,dV + recompute
+        res[tag] = {"tflops": round(flops / per_op / 1e12, 2),
+                    "ms": round(per_op * 1e3, 3)}
+    return res
+
+
+def calibrate(batch=8, seq=1024, hidden=768, heads=12, layers=12,
+              vocab=50304, ffn_mult=4):
+    """Roofline for the bench GPT-124M config at (batch, seq)."""
+    tokens = batch * seq
+    head_dim = hidden // heads
+
+    gemms = {
+        # name: (m, k, n, count per step)
+        "qkv": (tokens, hidden, 3 * hidden, layers),
+        "attn_proj": (tokens, hidden, hidden, layers),
+        "ffn_up": (tokens, hidden, ffn_mult * hidden, layers),
+        "ffn_down": (tokens, ffn_mult * hidden, hidden, layers),
+        "lm_head": (tokens, hidden, vocab, 1),
+    }
+
+    out = {"device": str(jax.devices()[0].device_kind),
+           "batch": batch, "seq": seq,
+           "method": "scan-slope (see module docstring)", "gemms": {}}
+
+    for s in (8192,):
+        tf, dt = measure_matmul(s, s, s)
+        out["gemms"][f"square_{s}"] = {
+            "shape": [s, s, s], "tflops": round(tf, 2),
+            "ms": round(dt * 1e3, 3)}
+        _log(f"square_{s}: {tf:.1f} TF/s ({dt*1e3:.3f} ms)")
+
+    total_matmul_time = 0.0
+    total_matmul_flops = 0.0
+    for name, (m, k, n, cnt) in gemms.items():
+        tf, dt = measure_matmul(m, k, n)
+        tf_dx, dt_dx = measure_matmul(m, n, k)      # dX = dY @ W^T
+        tf_dw, dt_dw = measure_matmul(k, m, n)      # dW = X^T @ dY
+        out["gemms"][name] = {
+            "shape": [m, k, n], "count": cnt,
+            "fwd_tflops": round(tf, 2), "dx_tflops": round(tf_dx, 2),
+            "dw_tflops": round(tf_dw, 2),
+            "fwd_ms": round(dt * 1e3, 3)}
+        _log(f"{name}: fwd {tf:.1f} / dx {tf_dx:.1f} / dw {tf_dw:.1f} TF/s")
+        total_matmul_time += cnt * (dt + dt_dx + dt_dw)
+        total_matmul_flops += cnt * 3 * (2.0 * m * k * n)
+
+    att = measure_attention(batch, heads, seq, head_dim)
+    out["attention"] = dict(att, shape=[batch, heads, seq, head_dim],
+                            causal=True)
+    _log(f"attention: fwd {att['fwd']['tflops']} TF/s "
+         f"({att['fwd']['ms']} ms), bwd {att['bwd']['tflops']} TF/s "
+         f"({att['bwd']['ms']} ms)")
+    att_time = layers * (att["fwd"]["ms"] + att["bwd"]["ms"]) / 1e3
+
+    step_lb = total_matmul_time + att_time
+    out["roofline"] = {
+        "matmul_time_ms": round(total_matmul_time * 1e3, 2),
+        "attention_time_ms": round(att_time * 1e3, 2),
+        "step_time_lower_bound_ms": round(step_lb * 1e3, 2),
+        "blended_matmul_tflops": round(
+            total_matmul_flops / total_matmul_time / 1e12, 2),
+        "note": ("lower bound: GEMM+attention kernel time only, zero "
+                 "elementwise/softmax/optimizer/dispatch; real step time "
+                 "must exceed this"),
+    }
+    return out
+
+
+if __name__ == "__main__":
+    res = calibrate()
+    print(json.dumps(res, indent=2))
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "w") as f:
+            json.dump(res, f, indent=2)

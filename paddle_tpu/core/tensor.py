@@ -220,7 +220,18 @@ class Tensor:
             # accumulate IN PLACE (reference semantics: grads accumulate
             # into the same var). Keeping the grad object's identity stable
             # also lets the jit capture thread it as program state.
-            acc = self._grad._read() + g
+            try:
+                base = self._grad._read()
+            except Exception as e:
+                if type(e).__name__ == "GraphBreak":
+                    raise type(e)(
+                        "gradient existed before capture: cross-call grad "
+                        "accumulation cannot compile — clear_grad() before "
+                        "the captured call, or zero grads inside the "
+                        "captured function (clear_grad(set_to_zero=True))"
+                    ) from e
+                raise
+            acc = base + g
             if _tracker is not None:
                 _tracker.on_write(self._grad, acc)
             else:

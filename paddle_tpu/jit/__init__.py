@@ -244,11 +244,18 @@ class _Executable:
                        if i >= n_args and id(t) in written_ids)
         self._pure = pure  # re-used by jit.multi_step's scanned window
         self.compiled = jax.jit(pure, donate_argnums=donate)
-        # force tracing now so failures surface at capture time
+        # force tracing now so failures surface at capture time. The replay
+        # re-executes the function body, so host-side grad slots can be
+        # clobbered (clear_grad() + backward() replaces a concrete step-0
+        # grad with a tracer-backed Tensor): snapshot and restore them.
+        saved_grads = [(t, t._grad) for t in grad_owners]
         try:
             self.compiled.lower(*[t._data for t in ordered])
         finally:
             _scrub_leaked_tracers(d)
+            for t, g in saved_grads:
+                if t._grad is not g:
+                    t._grad = g
 
     def __call__(self, arg_tensors):
         for sync in self.discovery.host_syncs:
@@ -313,6 +320,22 @@ def _make_rebuilder(out):
     return lambda ts, _out=out: _out
 
 
+_fallback_retry_limit = 3
+
+
+def set_fallback_retry_limit(n: int) -> None:
+    """How many failed trace attempts before a cache key is pinned to eager
+    (the retry policy the reference's SOT gets from guard invalidation;
+    a transient failure — OOM, flaky host callback — no longer poisons the
+    key forever). Default 3."""
+    global _fallback_retry_limit
+    _fallback_retry_limit = max(1, int(n))
+
+
+def get_fallback_retry_limit() -> int:
+    return _fallback_retry_limit
+
+
 class StaticFunction:
     """Analog of ``SymbolicStaticFunction``
     (reference ``jit/dy2static/program_translator.py:708``)."""
@@ -322,6 +345,7 @@ class StaticFunction:
         self.fn = fn
         self._cache: dict[Any, _Executable] = {}
         self._fallback_keys: set = set()
+        self._fallback_counts: dict[Any, int] = {}
         self._full_graph = full_graph
         self.__name__ = getattr(fn, "__name__", "static_fn")
 
@@ -377,14 +401,24 @@ class StaticFunction:
                           len(ret_tensors))
         try:
             exe.build(arg_tensors, args, kwargs)
-        except Exception as e:  # trace failed -> permanent eager fallback
+        except Exception as e:  # trace failed -> eager, retry next call
             if self._full_graph:
                 raise
-            warnings.warn(
-                f"to_static: eager fallback for {self.__name__} "
-                f"({type(e).__name__}: {e})")
-            self._fallback_keys.add(key)
+            n = self._fallback_counts.get(key, 0) + 1
+            self._fallback_counts[key] = n
+            limit = _fallback_retry_limit
+            if n >= limit:
+                warnings.warn(
+                    f"to_static: pinning {self.__name__} to eager after "
+                    f"{n} failed traces ({type(e).__name__}: {e})")
+                self._fallback_keys.add(key)
+            else:
+                warnings.warn(
+                    f"to_static: eager fallback for {self.__name__}, "
+                    f"trace retry {n}/{limit} on next call "
+                    f"({type(e).__name__}: {e})")
             return out
+        self._fallback_counts.pop(key, None)
         self._cache[key] = exe
         return out  # discovery pass already produced step-0 results
 

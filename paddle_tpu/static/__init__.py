@@ -181,9 +181,11 @@ class CompiledProgram:
         self.program = program
 
 
+from . import nn  # noqa: E402,F401
+
 __all__ = [
     "InputSpec", "Program", "Executor", "data", "default_main_program",
     "default_startup_program", "save_inference_model",
     "load_inference_model", "scope_guard", "global_scope",
-    "CompiledProgram", "program_guard",
+    "CompiledProgram", "program_guard", "nn",
 ]

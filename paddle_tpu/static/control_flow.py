@@ -1,0 +1,484 @@
+"""Control-flow ops: ``cond`` / ``while_loop`` / ``switch_case`` / ``case``.
+
+Capability analog of the reference's control-flow layer
+(``python/paddle/static/nn/control_flow.py:1444`` cond, ``:687`` while_loop,
+``:1065`` switch_case, ``:942`` case), TPU-native in mechanism: instead of
+ConditionalBlock/While ops inside a ProgramDesc, these lower onto
+``lax.cond`` / ``lax.while_loop`` / ``lax.switch`` so a jit-captured train
+step keeps data-dependent branching *inside* the single compiled XLA
+program — the gap that previously forced a permanent eager fallback.
+
+Semantics by execution mode (mirrors the reference's dygraph/static split):
+
+- **Eager (dygraph)**: the predicate is concrete; exactly one branch runs,
+  with full per-op autograd. Identical to the reference's dygraph behavior.
+- **Under jit capture** (``paddle.jit.to_static`` discovery or replay): a
+  real ``lax.cond``/``switch``/``while`` is emitted through the op funnel.
+  Both/all branches are traced (the reference's static mode builds both
+  blocks too); closed-over tensors (weights etc.) are discovered by a probe
+  pass and hoisted into explicit operands so capture registers them as
+  program inputs and gradients flow through ``jax.vjp`` of the whole op.
+
+XLA constraints (documented divergences from the PIR executor):
+
+- Branches must return the same structure with matching shapes/dtypes
+  (static-shape compilation; the reference's runtime branch selection can
+  tolerate shape mismatch, XLA cannot).
+- Branch bodies must be functional under capture: in-place writes to
+  tensors that exist outside the branch raise (a traced branch cannot
+  mutate framework state; the same code still works eagerly). This includes
+  the global RNG — use dropout outside branches or pass explicit seeds.
+- ``while_loop`` under capture compiles to ``lax.while_loop`` only when no
+  operand needs gradients (XLA has no reverse-mode while). When gradients
+  are required the Python loop runs instead — unrolled into the capture,
+  which then degrades to the to_static eager fallback on replay, where the
+  loop differentiates normally through the tape.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import state
+from ..core import tensor as tensor_mod
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "switch_case", "case"]
+
+
+# --------------------------------------------------------------------------
+# tracker shims
+# --------------------------------------------------------------------------
+
+class _BranchTracker:
+    """Tracker installed while a branch body runs under capture.
+
+    - substitutes hoisted operand values (``subs``: id(Tensor) -> value),
+    - tracks branch-local tensors so their in-place writes stay local,
+    - records ordered reads of outer tensors when probing,
+    - forbids mutation of outer state (not representable in lax.cond).
+    """
+
+    def __init__(self, base, subs, record=False):
+        self.base = base
+        self.subs = subs
+        self.record = record
+        self.reads: list[Tensor] = []       # ordered, unique (probe mode)
+        self._read_ids: set[int] = set()
+        self.local: set[int] = set()
+        self.local_env: dict[int, Any] = {}
+
+    def on_create(self, t):
+        self.local.add(id(t))
+        if self.base is not None:
+            self.base.on_create(t)
+
+    def on_read(self, t):
+        tid = id(t)
+        if tid in self.subs:
+            return self.subs[tid]
+        if tid in self.local_env:
+            return self.local_env[tid]
+        if tid in self.local:
+            return t._data
+        if self.record and tid not in self._read_ids:
+            self._read_ids.add(tid)
+            self.reads.append(t)
+        if self.base is not None:
+            return self.base.on_read(t)
+        return t._data
+
+    def on_write(self, t, val):
+        tid = id(t)
+        if tid in self.local or tid in self.subs:
+            self.local_env[tid] = val
+            return
+        raise RuntimeError(
+            "control flow: in-place write to a tensor defined outside the "
+            "branch/body is not supported under jit capture (a traced "
+            "lax.cond/while branch cannot mutate framework state); return "
+            "the new value from the branch instead")
+
+    def on_grad_write(self, t):
+        raise RuntimeError(
+            "control flow: .backward() inside a branch/body is not "
+            "supported; call it on the result of cond/while_loop")
+
+    def add_host_sync(self, fn):
+        if self.base is not None:
+            self.base.add_host_sync(fn)
+
+
+def _run_branch(fn: Callable, subs, record=False):
+    """Run ``fn()`` under a _BranchTracker with grad recording off (the
+    outer op's jax.vjp owns differentiation) and flatten the result *inside*
+    the tracker context (branch-local in-place writes live in the tracker's
+    local_env, not in Tensor._data). Returns (leaves, tree, tracker)."""
+    tr = _BranchTracker(tensor_mod._tracker, subs, record=record)
+    old = tensor_mod.set_tracker(tr)
+    prev = state.set_grad_enabled(False)
+    try:
+        out = fn()
+        leaves, tree = _flatten_out(out)
+    finally:
+        state.set_grad_enabled(prev)
+        tensor_mod.set_tracker(old)
+    return leaves, tree, tr
+
+
+def _hoist(fns):
+    """Probe every branch once, collecting the ordered union of
+    outer-tensor reads (weights and other closures) to hoist as explicit
+    operands. Returns (trees, reads)."""
+    reads: list[Tensor] = []
+    read_ids: set[int] = set()
+    trees = []
+    for fn in fns:
+        _, tree, tr = _run_branch(fn, {}, record=True)
+        trees.append(tree)
+        for t in tr.reads:
+            if id(t) not in read_ids:
+                read_ids.add(id(t))
+                reads.append(t)
+    return trees, reads
+
+
+# --------------------------------------------------------------------------
+# output-structure handling
+# --------------------------------------------------------------------------
+
+def _flatten_out(out):
+    """nest of Tensors/values -> (flat jax values, treedef with holes).
+
+    Must run while the tracker that produced ``out`` is active: values are
+    taken through ``_read`` so substitutions and branch-local writes
+    resolve."""
+    leaves = []
+
+    def go(o):
+        if isinstance(o, Tensor):
+            leaves.append(o._read())
+            return ("T", len(leaves) - 1)
+        if isinstance(o, (list, tuple)):
+            return (type(o).__name__, [go(x) for x in o])
+        if isinstance(o, dict):
+            return ("dict", {k: go(o[k]) for k in sorted(o)})
+        return ("c", o)
+
+    tree = go(out)
+    return leaves, tree
+
+
+def _rebuild_out(tree, tensors):
+    kind = tree[0]
+    if kind == "T":
+        return tensors[tree[1]]
+    if kind == "list":
+        return [_rebuild_out(t, tensors) for t in tree[1]]
+    if kind == "tuple":
+        return tuple(_rebuild_out(t, tensors) for t in tree[1])
+    if kind == "dict":
+        return {k: _rebuild_out(v, tensors) for k, v in tree[1].items()}
+    return tree[1]
+
+
+def _struct_sig(tree):
+    kind = tree[0]
+    if kind == "T":
+        return "T"
+    if kind in ("list", "tuple"):
+        return (kind, tuple(_struct_sig(t) for t in tree[1]))
+    if kind == "dict":
+        return ("dict", tuple((k, _struct_sig(v))
+                              for k, v in sorted(tree[1].items())))
+    v = tree[1]
+    if isinstance(v, (np.ndarray, jax.Array)):  # value-compare raw arrays
+        a = np.asarray(v)
+        return ("arr", a.shape, str(a.dtype), a.tobytes())
+    try:
+        hash(v)
+        return ("c", v)
+    except TypeError:
+        return ("c", type(v).__name__, repr(v)[:200])
+
+
+def _check_same_structure(trees, what):
+    sigs = [_struct_sig(t) for t in trees]
+    if any(s != sigs[0] for s in sigs[1:]):
+        raise ValueError(
+            f"{what}: branches must return the same structure of tensors "
+            f"(got {sigs})")
+
+
+def _as_bool_scalar(v):
+    return jnp.reshape(jnp.asarray(v), ()).astype(bool)
+
+
+def _needs_grad(tensors):
+    return state.is_grad_enabled() and any(
+        isinstance(t, Tensor) and not t.stop_gradient for t in tensors)
+
+
+# --------------------------------------------------------------------------
+# cond
+# --------------------------------------------------------------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """``true_fn()`` if ``pred`` else ``false_fn()`` (reference
+    ``static/nn/control_flow.py:1444``). Works eagerly (runs one branch)
+    and under jit capture (emits ``lax.cond``)."""
+    true_fn = true_fn if true_fn is not None else (lambda: None)
+    false_fn = false_fn if false_fn is not None else (lambda: None)
+    if not callable(true_fn) or not callable(false_fn):
+        raise TypeError("cond: true_fn and false_fn must be callable")
+
+    if tensor_mod._tracker is None:
+        return true_fn() if bool(unwrap(pred)) else false_fn()
+
+    (tree_t, tree_f), reads = _hoist([true_fn, false_fn])
+    _check_same_structure([tree_t, tree_f], "cond")
+
+    pred_t = pred if isinstance(pred, Tensor) else Tensor(jnp.asarray(pred))
+    read_ids = [id(t) for t in reads]
+
+    def _cond_impl(pred_v, *op_vals):
+        def mk(fn):
+            def branch(vals):
+                leaves, _, _ = _run_branch(fn, dict(zip(read_ids, vals)))
+                return tuple(leaves)
+            return branch
+        return jax.lax.cond(_as_bool_scalar(pred_v), mk(true_fn),
+                            mk(false_fn), tuple(op_vals))
+
+    flat = apply("cond", _cond_impl, pred_t, *reads)
+    return _rebuild_out(tree_t, list(flat))
+
+
+# --------------------------------------------------------------------------
+# switch_case / case
+# --------------------------------------------------------------------------
+
+def _normalize_branch_fns(branch_fns, default):
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    elif isinstance(branch_fns, (list, tuple)):
+        if branch_fns and not isinstance(branch_fns[0], (list, tuple)):
+            pairs = list(enumerate(branch_fns))
+        else:
+            pairs = sorted((int(k), fn) for k, fn in branch_fns)
+    else:
+        raise TypeError("switch_case: branch_fns must be dict|list|tuple")
+    keys = [k for k, _ in pairs]
+    if len(set(keys)) != len(keys):
+        raise ValueError(f"switch_case: duplicate branch keys {keys}")
+    for _, fn in pairs:
+        if not callable(fn):
+            raise TypeError("switch_case: branch fns must be callable")
+    if default is None:
+        default = pairs[-1][1]  # reference: max index wins when no match
+    elif not callable(default):
+        raise TypeError("switch_case: default must be callable")
+    return pairs, default
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """C-style switch (reference ``static/nn/control_flow.py:1065``):
+    run ``branch_fns[branch_index]``, else ``default``."""
+    pairs, default = _normalize_branch_fns(branch_fns, default)
+
+    if tensor_mod._tracker is None:
+        idx = int(unwrap(branch_index))
+        for k, fn in pairs:
+            if k == idx:
+                return fn()
+        return default()
+
+    fns = [fn for _, fn in pairs] + [default]
+    keys = [k for k, _ in pairs]
+    trees, reads = _hoist(fns)
+    _check_same_structure(trees, "switch_case")
+
+    idx_t = (branch_index if isinstance(branch_index, Tensor)
+             else Tensor(jnp.asarray(branch_index)))
+    read_ids = [id(t) for t in reads]
+
+    def _switch_impl(idx_v, *op_vals):
+        iv = jnp.reshape(jnp.asarray(idx_v), ()).astype(jnp.int32)
+        sel = jnp.full((), len(keys), jnp.int32)  # default slot
+        for i, k in enumerate(keys):
+            sel = jnp.where(iv == k, jnp.int32(i), sel)
+
+        def mk(fn):
+            def branch(vals):
+                leaves, _, _ = _run_branch(fn, dict(zip(read_ids, vals)))
+                return tuple(leaves)
+            return branch
+
+        return jax.lax.switch(sel, [mk(f) for f in fns], tuple(op_vals))
+
+    flat = apply("switch_case", _switch_impl, idx_t, *reads)
+    return _rebuild_out(trees[0], list(flat))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """if/elif/else chain (reference ``static/nn/control_flow.py:942``):
+    first true pred wins; ``default`` (or the last fn) when none is."""
+    if not isinstance(pred_fn_pairs, (list, tuple)) or not pred_fn_pairs:
+        raise TypeError("case: pred_fn_pairs must be a non-empty list|tuple")
+    for p in pred_fn_pairs:
+        if not (isinstance(p, (list, tuple)) and len(p) == 2
+                and callable(p[1])):
+            raise TypeError("case: elements must be (pred, callable) pairs")
+    preds = [p for p, _ in pred_fn_pairs]
+    fns = [fn for _, fn in pred_fn_pairs]
+    if default is None:
+        default = fns[-1]
+
+    if tensor_mod._tracker is None:
+        for p, fn in zip(preds, fns):
+            if bool(unwrap(p)):
+                return fn()
+        return default()
+
+    all_fns = list(fns) + [default]
+    trees, reads = _hoist(all_fns)
+    _check_same_structure(trees, "case")
+
+    pred_ts = [p if isinstance(p, Tensor) else Tensor(jnp.asarray(p))
+               for p in preds]
+    read_ids = [id(t) for t in reads]
+    n = len(fns)
+
+    def _case_impl(*vals):
+        pred_vs, op_vals = vals[:n], vals[n:]
+        stacked = jnp.stack([_as_bool_scalar(p) for p in pred_vs]
+                            + [jnp.asarray(True)])
+        sel = jnp.argmax(stacked).astype(jnp.int32)  # first True wins
+
+        def mk(fn):
+            def branch(ops):
+                leaves, _, _ = _run_branch(fn, dict(zip(read_ids, ops)))
+                return tuple(leaves)
+            return branch
+
+        return jax.lax.switch(sel, [mk(f) for f in all_fns], tuple(op_vals))
+
+    flat = apply("case", _case_impl, *pred_ts, *reads)
+    return _rebuild_out(trees[0], list(flat))
+
+
+# --------------------------------------------------------------------------
+# while_loop
+# --------------------------------------------------------------------------
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Repeat ``body`` while ``cond`` holds (reference
+    ``static/nn/control_flow.py:687``)."""
+    if not callable(cond) or not callable(body):
+        raise TypeError("while_loop: cond and body must be callable")
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise TypeError("while_loop: loop_vars must be a non-empty "
+                        "list|tuple")
+    # Python-scalar loop vars become Tensors so the carry stays a traced
+    # leaf (a plain `0` counter would otherwise be a changing constant and
+    # trip the structure check under capture).
+    loop_vars = type(loop_vars)(_tensorize(v) for v in loop_vars)
+
+    def run_python_loop():
+        vars_ = tuple(loop_vars)
+        while bool(unwrap(cond(*vars_))):
+            out = body(*vars_)
+            if not isinstance(out, (list, tuple)):
+                out = (out,)
+            if len(out) != len(vars_):
+                raise ValueError(
+                    "while_loop: body must return as many values as "
+                    f"loop_vars (got {len(out)}, want {len(vars_)})")
+            vars_ = tuple(out)
+        return list(vars_) if isinstance(loop_vars, list) else vars_
+
+    if tensor_mod._tracker is None:
+        return run_python_loop()
+
+    # ---- capture: probe for closed-over invariants and the carry tree
+    carry_leaves, carry_tree = _flatten_out(tuple(loop_vars))
+    carry_ts = list(_iter_tensors(loop_vars))
+    carry_ids = [id(t) for t in carry_ts]
+
+    def probe_body():
+        out = body(*loop_vars)
+        return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+
+    (_, body_tree), reads = _hoist([lambda: cond(*loop_vars), probe_body])
+    _check_same_structure([carry_tree, body_tree], "while_loop")
+    reads = [t for t in reads if id(t) not in set(carry_ids)]
+    read_ids = [id(t) for t in reads]
+    n_carry = len(carry_leaves)
+
+    if _needs_grad(carry_ts + reads):
+        # lax.while_loop has no reverse-mode rule; run the Python loop.
+        # During discovery this unrolls into the capture; the replay pass
+        # then hits bool(tracer) and to_static falls back to eager, where
+        # the loop differentiates through the tape (see module docstring).
+        return run_python_loop()
+
+    def _while_impl(*vals):
+        init = tuple(vals[:n_carry])
+        inv = dict(zip(read_ids, vals[n_carry:]))
+
+        def wrap_vars(carry):
+            ts = [Tensor(v) for v in carry]
+            return _rebuild_out(carry_tree, ts)
+
+        def subs_for(carry):
+            # closures over the ORIGINAL loop-var objects see the current
+            # carry (the static-mode semantics: the var IS the loop slot)
+            s = dict(inv)
+            s.update(zip(carry_ids, carry))
+            return s
+
+        def cond_w(carry):
+            leaves, _, _ = _run_branch(
+                lambda: cond(*_as_tuple(wrap_vars(carry))),
+                subs_for(carry))
+            return _as_bool_scalar(leaves[0])
+
+        def body_w(carry):
+            def run():
+                out = body(*_as_tuple(wrap_vars(carry)))
+                return tuple(out) if isinstance(out, (list, tuple)) \
+                    else (out,)
+            leaves, _, _ = _run_branch(run, subs_for(carry))
+            return tuple(leaves)
+
+        return jax.lax.while_loop(cond_w, body_w, init)
+
+    flat = apply("while_loop", _while_impl, *carry_ts, *reads)
+    res = _rebuild_out(carry_tree, list(flat))
+    return list(res) if isinstance(loop_vars, list) else res
+
+
+def _as_tuple(x):
+    return x if isinstance(x, tuple) else tuple(x)
+
+
+def _tensorize(v):
+    """Promote scalar/array loop vars to Tensors; leave nests to the user
+    (the reference requires loop_vars to be Variables too)."""
+    if isinstance(v, Tensor) or isinstance(v, (list, tuple, dict)):
+        return v
+    if isinstance(v, (bool, int, float, np.ndarray, np.generic, jax.Array)):
+        return Tensor(jnp.asarray(v))
+    return v
+
+
+def _iter_tensors(obj):
+    """Tensor leaves in _flatten_out's traversal order (same walk as
+    jit._flatten_tensors; kept in lock-step with _flatten_out because
+    carry ids are zipped positionally against carry leaves)."""
+    from ..jit import _flatten_tensors
+    return iter(_flatten_tensors(obj, []))

@@ -1,0 +1,8 @@
+"""``paddle.static.nn`` parity surface (reference
+``python/paddle/static/nn/__init__.py``): the pieces with TPU-side meaning.
+Control flow lowers onto lax primitives; the layer builders of the
+reference's static mode (fc, embedding, ...) are the dygraph layers here —
+static mode IS the jit capture cache (see ``paddle_tpu.static``)."""
+from ..control_flow import case, cond, switch_case, while_loop  # noqa: F401
+
+__all__ = ["cond", "while_loop", "switch_case", "case"]

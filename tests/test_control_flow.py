@@ -1,0 +1,265 @@
+"""Control flow: cond/while_loop/switch_case/case eagerly and under
+to_static capture (lax.cond/switch/while inside the compiled program), plus
+the jit fallback retry policy (VERDICT r2 #4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static.nn import case, cond, switch_case, while_loop
+
+
+def _sf(fn):
+    return fn if hasattr(fn, "_fallback_keys") else fn.__wrapped__
+
+
+def _t(x, **kw):
+    return paddle.to_tensor(np.asarray(x), **kw)
+
+
+# ---------------------------------------------------------------- eager ----
+
+def test_cond_eager_runs_one_branch():
+    x = _t([2.0])
+    out = cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+    np.testing.assert_allclose(out.numpy(), [4.0])
+    out = cond(x.sum() < 0, lambda: x * 2, lambda: x - 1)
+    np.testing.assert_allclose(out.numpy(), [1.0])
+
+
+def test_cond_eager_grads_through_taken_branch():
+    x = _t([3.0], stop_gradient=False)
+    out = cond(_t(True), lambda: (x * x).sum(), lambda: x.sum())
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_while_loop_eager_and_grads():
+    x = _t([1.0], stop_gradient=False)
+    i = _t(0)
+
+    def c(i, v):
+        return i < 3
+
+    def b(i, v):
+        return i + 1, v * 2
+
+    i_out, v_out = while_loop(c, b, [i, x])
+    np.testing.assert_allclose(v_out.numpy(), [8.0])
+    v_out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_switch_case_eager():
+    x = _t([1.0])
+    fns = {1: lambda: x + 1, 3: lambda: x + 3}
+    np.testing.assert_allclose(
+        switch_case(_t(3), fns).numpy(), [4.0])
+    # no match -> default
+    np.testing.assert_allclose(
+        switch_case(_t(7), fns, default=lambda: x * 10).numpy(), [10.0])
+    # no match, no default -> max key
+    np.testing.assert_allclose(switch_case(_t(7), fns).numpy(), [4.0])
+
+
+def test_case_eager_first_true_wins():
+    x = _t([1.0])
+    out = case([(_t(False), lambda: x + 1), (_t(True), lambda: x + 2),
+                (_t(True), lambda: x + 3)])
+    np.testing.assert_allclose(out.numpy(), [3.0])
+    out = case([(_t(False), lambda: x + 1)], default=lambda: x - 1)
+    np.testing.assert_allclose(out.numpy(), [0.0])
+
+
+# ------------------------------------------------------------ to_static ----
+
+def test_cond_compiles_data_dependent_branch():
+    """The r2 gap: data-dependent branching now stays compiled (no eager
+    fallback) because cond emits lax.cond instead of bool(tracer)."""
+
+    @paddle.jit.to_static
+    def fn(x):
+        return cond(x.sum() > 0, lambda: x * 2.0, lambda: x - 1.0)
+
+    xp = _t(np.array([1.0, 2.0], np.float32))
+    xn = _t(np.array([-1.0, -2.0], np.float32))
+    np.testing.assert_allclose(fn(xp).numpy(), [2.0, 4.0])
+    # same signature, other branch: MUST reuse the same compiled program
+    np.testing.assert_allclose(fn(xn).numpy(), [-2.0, -3.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys, "cond fell back to eager"
+    assert len(sf._cache) == 1
+
+
+def test_cond_grads_through_closure_weights_under_jit():
+    w = _t(np.array([2.0], np.float32), stop_gradient=False)
+
+    @paddle.jit.to_static
+    def fn(x):
+        w.clear_grad()  # grads are per-call outputs of the program
+        loss = cond(x.sum() > 0,
+                    lambda: (w * x).sum(),
+                    lambda: (w * w * x).sum()).sum()
+        loss.backward()
+        return loss
+
+    xp = _t(np.array([3.0], np.float32))
+    fn(xp)
+    np.testing.assert_allclose(w.grad.numpy(), [3.0])  # d(w*x)/dw = x
+    xn = _t(np.array([-3.0], np.float32))
+    fn(xn)
+    # false branch: d(w^2 x)/dw = 2wx = -12
+    np.testing.assert_allclose(w.grad.numpy(), [-12.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys and not sf._fallback_counts
+    assert len(sf._cache) == 1
+
+
+def test_switch_case_under_jit():
+    @paddle.jit.to_static
+    def fn(idx, x):
+        return switch_case(idx, {0: lambda: x + 10.0, 2: lambda: x * 3.0},
+                           default=lambda: x * 0.0)
+
+    x = _t(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(fn(_t(0), x).numpy(), [11.0, 12.0])
+    np.testing.assert_allclose(fn(_t(2), x).numpy(), [3.0, 6.0])
+    np.testing.assert_allclose(fn(_t(5), x).numpy(), [0.0, 0.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys
+    assert len(sf._cache) == 1
+
+
+def test_while_loop_compiles_without_grads():
+    @paddle.jit.to_static
+    def fn(x):
+        with paddle.no_grad():
+            i, y = while_loop(
+                lambda i, y: i < 4,
+                lambda i, y: (i + 1, y * 2.0),
+                [_t(0), x])
+        return y
+
+    x = _t(np.array([1.5], np.float32))
+    np.testing.assert_allclose(fn(x).numpy(), [24.0])
+    np.testing.assert_allclose(fn(x).numpy(), [24.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys, "while_loop fell back"
+    assert len(sf._cache) == 1
+
+
+def test_while_loop_python_scalar_loop_var_compiles():
+    """A plain `0` counter must be promoted to a Tensor carry, not crash
+    the structure check during discovery."""
+
+    @paddle.jit.to_static
+    def fn(x):
+        with paddle.no_grad():
+            i, y = while_loop(lambda i, y: i < 3,
+                              lambda i, y: (i + 1, y + 1.0), [0, x])
+        return y
+
+    x = _t(np.array([1.0], np.float32))
+    np.testing.assert_allclose(fn(x).numpy(), [4.0])
+    np.testing.assert_allclose(fn(x).numpy(), [4.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys and not sf._fallback_counts
+
+
+def test_while_loop_with_grads_falls_back_but_works():
+    """Grad-requiring while cannot lower to lax.while_loop; to_static must
+    degrade to eager (retry budget then pin) and stay CORRECT."""
+    w = _t(np.array([1.0], np.float32), stop_gradient=False)
+
+    @paddle.jit.to_static
+    def fn(x):
+        i, y = while_loop(lambda i, y: i < 3,
+                          lambda i, y: (i + 1, y * w),
+                          [_t(0), x])
+        loss = y.sum()
+        loss.backward()
+        return loss
+
+    x = _t(np.array([2.0], np.float32))
+    with pytest.warns(UserWarning, match="to_static"):
+        out = fn(x)
+    np.testing.assert_allclose(out.numpy(), 2.0)
+    np.testing.assert_allclose(w.grad.numpy(), [6.0])  # d(w^3*2)/dw at w=1
+
+
+def test_branch_structure_mismatch_raises():
+    @paddle.jit.to_static(full_graph=True)
+    def fn(x):
+        return cond(x.sum() > 0, lambda: (x, x), lambda: x)
+
+    with pytest.raises(Exception, match="same structure"):
+        fn(_t(np.array([1.0], np.float32)))
+
+
+def test_branch_outer_write_rejected_under_jit():
+    acc = _t(np.array([0.0], np.float32))
+
+    @paddle.jit.to_static(full_graph=True)
+    def fn(x):
+        def t():
+            acc[0] = x[0]  # in-place write to outer state
+            return x
+
+        return cond(x.sum() > 0, t, lambda: x)
+
+    with pytest.raises(Exception, match="outside the branch"):
+        fn(_t(np.array([1.0], np.float32)))
+
+
+# -------------------------------------------------------- retry policy ----
+
+def test_fallback_retry_then_recover(monkeypatch):
+    """A transient trace failure no longer pins the key to eager forever:
+    the next call retries and compiles (VERDICT r2 weak #4)."""
+    from paddle_tpu import jit as jit_mod
+
+    calls = {"n": 0}
+    orig = jit_mod._Executable.build
+
+    def flaky(self, *a, **kw):
+        if calls["n"] == 0:
+            calls["n"] += 1
+            raise RuntimeError("transient trace failure")
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(jit_mod._Executable, "build", flaky)
+
+    @paddle.jit.to_static
+    def fn(x):
+        return x * 2.0
+
+    x = _t(np.array([1.0], np.float32))
+    with pytest.warns(UserWarning, match="retry 1/"):
+        np.testing.assert_allclose(fn(x).numpy(), [2.0])  # eager fallback
+    np.testing.assert_allclose(fn(x).numpy(), [2.0])      # retried: compiles
+    sf = _sf(fn)
+    assert len(sf._cache) == 1 and not sf._fallback_keys
+    assert not sf._fallback_counts  # cleared on success
+
+
+def test_fallback_pins_after_limit(monkeypatch):
+    from paddle_tpu import jit as jit_mod
+
+    def always_fail(self, *a, **kw):
+        raise RuntimeError("permanent trace failure")
+
+    monkeypatch.setattr(jit_mod._Executable, "build", always_fail)
+    monkeypatch.setattr(jit_mod, "_fallback_retry_limit", 2)
+
+    @paddle.jit.to_static
+    def fn(x):
+        return x + 1.0
+
+    x = _t(np.array([1.0], np.float32))
+    with pytest.warns(UserWarning, match="retry 1/2"):
+        fn(x)
+    with pytest.warns(UserWarning, match="pinning"):
+        fn(x)
+    sf = _sf(fn)
+    assert sf._fallback_keys  # pinned
+    # still correct, silently eager now
+    np.testing.assert_allclose(fn(x).numpy(), [2.0])

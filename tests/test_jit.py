@@ -74,6 +74,12 @@ def test_jit_graph_break_falls_back():
         b = fn(pt.ones([2]))
     np.testing.assert_allclose(a.numpy(), [2, 2])
     np.testing.assert_allclose(b.numpy(), [2, 2])
+    # retry policy: counted, then pinned once the limit is exhausted
+    assert fn._fallback_counts
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for _ in range(pt.jit.get_fallback_retry_limit()):
+            fn(pt.ones([2]))
     assert fn._fallback_keys
 
 
