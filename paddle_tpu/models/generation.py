@@ -54,6 +54,36 @@ def cache_attention(q, k_new, v_new, k_cache, v_cache, pos,
 
 
 @primitive
+def paged_cache_attention(q, k_new, v_new, k_pages, v_pages, pos,
+                          block_tables=None, scale=None):
+    """One decode step against a PAGED KV cache (the reference's
+    ``block_multi_head_attention`` capability — SURVEY C12).
+
+    q/k_new/v_new: [B, 1, H(q|kv), D]; page pools [Hkv, P, page_size, D];
+    ``block_tables`` (static attr) [B, pages_per_seq] page ids; pos [1]
+    traced. Appends the new token into its (page, slot) and attends over
+    the pages via the Pallas paged-decode kernel (attention cost scales
+    with the current length, not max_len).
+    """
+    from ..ops.pallas.paged_attention import paged_decode_attention
+
+    p = pos.reshape(())
+    bt = jnp.asarray(np.asarray(block_tables), jnp.int32)   # [B, NP]
+    b = q.shape[0]
+    ps = k_pages.shape[2]
+    page = bt[jnp.arange(b), p // ps]                       # [B]
+    slot = p % ps
+    kn = jnp.swapaxes(k_new[:, 0], 0, 1).astype(k_pages.dtype)  # [Hk, B, D]
+    vn = jnp.swapaxes(v_new[:, 0], 0, 1).astype(v_pages.dtype)
+    k_pages = k_pages.at[:, page, slot].set(kn)
+    v_pages = v_pages.at[:, page, slot].set(vn)
+    seq_lens = jnp.full((b,), p + 1, jnp.int32)
+    out = paged_decode_attention(q[:, 0], k_pages, v_pages, bt, seq_lens,
+                                 scale=scale)
+    return out[:, None].astype(q.dtype), k_pages, v_pages
+
+
+@primitive
 def rope_at(x, pos, theta=10000.0):
     """Half-rotation rope for ONE position (decode): x [B, 1, H, D],
     pos [1] traced. Convention comes from llama.rope_angles (single
@@ -78,7 +108,7 @@ def _empty_caches(model, batch, max_len):
     return caches
 
 
-def _gpt_decode(model, ids_t, pos, caches):
+def _gpt_decode(model, ids_t, pos, caches, attend=cache_attention):
     """One-token logits for GPTForCausalLM given flat [k0,v0,k1,v1,...]
     caches; returns (logits [B, V], new caches)."""
     from .. import ops
@@ -93,7 +123,7 @@ def _gpt_decode(model, ids_t, pos, caches):
                           [b, 1, 3, blk.attn.num_heads,
                            blk.attn.head_dim])
         q, k, v = ops.unbind(qkv, axis=2)
-        att, kc, vc = cache_attention(q, k, v, kc, vc, pos)
+        att, kc, vc = attend(q, k, v, kc, vc, pos)
         x = x + blk.attn.proj(ops.reshape(att, [b, 1, hidden]))
         x = x + blk.mlp(blk.ln2(x))
         new.extend([kc, vc])
@@ -105,7 +135,7 @@ def _gpt_decode(model, ids_t, pos, caches):
     return ops.reshape(logits, [logits.shape[0], -1]), new
 
 
-def _llama_decode(model, ids_t, pos, caches):
+def _llama_decode(model, ids_t, pos, caches, attend=cache_attention):
     from .. import ops
     lm = model.llama
     x = lm.embed_tokens(ids_t)
@@ -122,7 +152,7 @@ def _llama_decode(model, ids_t, pos, caches):
                         [b, 1, a.num_kv_heads, a.head_dim])
         q = rope_at(q, pos, theta=a.rope_theta)
         k = rope_at(k, pos, theta=a.rope_theta)
-        att, kc, vc = cache_attention(q, k, v, kc, vc, pos)
+        att, kc, vc = attend(q, k, v, kc, vc, pos)
         x = x + a.o_proj(ops.reshape(att, [b, 1, -1]))
         x = x + layer.mlp(layer.post_norm(x))
         new.extend([kc, vc])
@@ -146,17 +176,45 @@ def _decode_fn(model):
     raise TypeError(f"generate: unsupported model {type(model).__name__}")
 
 
+def _empty_paged_caches(model, batch, max_len, page_size):
+    """Per-layer page pools [Hkv, B * pages_per_seq, page_size, D] plus the
+    static block table (sequence b owns pages [b*NP, (b+1)*NP) — the
+    deterministic allocation of uniform batched decode; a serving-style
+    allocator would supply its own table)."""
+    cfg = model.cfg
+    n_kv = getattr(cfg, "num_kv_heads", cfg.num_heads)
+    np_per_seq = -(-max_len // page_size)
+    bt = np.arange(batch * np_per_seq, dtype=np.int32).reshape(
+        batch, np_per_seq)
+    caches = []
+    for _ in range(cfg.num_layers):
+        shape = (n_kv, batch * np_per_seq, page_size, cfg.head_dim)
+        caches.extend([Tensor(jnp.zeros(shape, jnp.float32)),
+                       Tensor(jnp.zeros(shape, jnp.float32))])
+    return caches, bt
+
+
 def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
-             top_p=None, eos_token_id=None, seed=None, use_jit=True):
+             top_p=None, eos_token_id=None, seed=None, use_jit=True,
+             kv_cache="dense", page_size=16):
     """Greedy / temperature / nucleus decoding with a KV cache.
 
     ``input_ids`` [B, S] prompt; returns [B, S + max_new_tokens] int32
     (rows stop changing after ``eos_token_id``). One compiled decode step
     serves both prefill and generation (same static shapes).
+
+    ``kv_cache="paged"`` stores KV in a page pool with per-sequence block
+    tables and attends through the Pallas paged-decode kernel (the
+    reference's ``block_multi_head_attention`` serving path): attention
+    compute scales with the current length instead of ``max_len``, the
+    win at long sequences.
     """
     from .. import jit as jit_mod
     from ..ops.special import top_p_sampling
 
+    if kv_cache not in ("dense", "paged"):
+        raise ValueError(f"kv_cache must be 'dense' or 'paged', "
+                         f"got {kv_cache!r}")
     decode, hard_limit = _decode_fn(model)
     ids = np.asarray(input_ids.numpy()
                      if isinstance(input_ids, Tensor) else input_ids)
@@ -170,13 +228,21 @@ def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
         import warnings
         warnings.warn(f"generating past max_seq_len ({max_len} > "
                       f"{cfg.max_seq_len}): rope extrapolation territory")
-    caches = _empty_caches(model, batch, max_len)
+    if kv_cache == "paged":
+        import functools
+        caches, bt = _empty_paged_caches(model, batch, max_len, page_size)
+        attend = functools.partial(paged_cache_attention,
+                                   block_tables=bt.tolist())
+    else:
+        caches = _empty_caches(model, batch, max_len)
+        attend = cache_attention
     was_training = model.training
     model.eval()
     try:
         return _generate_loop(model, decode, ids, batch, prompt_len,
                               max_len, max_new_tokens, temperature, top_p,
-                              eos_token_id, seed, use_jit, caches)
+                              eos_token_id, seed, use_jit, caches,
+                              attend, kv_cache)
     finally:
         if was_training:
             model.train()
@@ -184,13 +250,17 @@ def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
 
 def _generate_loop(model, decode, ids, batch, prompt_len, max_len,
                    max_new_tokens, temperature, top_p, eos_token_id,
-                   seed, use_jit, caches):
+                   seed, use_jit, caches, attend=cache_attention,
+                   kv_cache="dense"):
     from .. import jit as jit_mod
     from ..ops.special import top_p_sampling
 
     # compiled decode step cached per (batch, max_len) ON the model:
-    # repeat generate() calls reuse the program instead of re-tracing
-    cache_key = (batch, max_len)
+    # repeat generate() calls reuse the program instead of re-tracing.
+    # page geometry is part of the key: the attend closure bakes in the
+    # block table, whose shape depends on page_size.
+    n_pages = caches[0].shape[1] if kv_cache == "paged" else 0
+    cache_key = (batch, max_len, kv_cache, n_pages)
     step_cache = model.__dict__.setdefault("_decode_step_cache", {})
     step_fn = step_cache.get(cache_key)
     if step_fn is None:
@@ -198,7 +268,8 @@ def _generate_loop(model, decode, ids, batch, prompt_len, max_len,
         def step(tok, pos, *cs):
             import paddle_tpu as pp
             with pp.no_grad():
-                logits, new = decode(model, tok, pos, list(cs))
+                logits, new = decode(model, tok, pos, list(cs),
+                                     attend=attend)
             return (logits,) + tuple(new)
 
         step_fn = jit_mod.to_static(step) if use_jit else step

@@ -13,6 +13,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...core.dispatch import apply
 
@@ -138,9 +139,13 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
                         causal=False, return_softmax=False, training=True,
                         name=None):
-    """Varlen flash attention (reference ``flash_attention.py:303``):
-    packed [total_tokens, heads, dim] with cu_seqlens prefix sums. The TPU
-    path segments via a block-diagonal mask — static shapes keep XLA happy."""
+    """Varlen flash attention (reference ``flash_attention.py:303``,
+    kernel ``flash_attn_kernel.cu:91`` flash_attn_varlen_fwd): packed
+    [total_tokens, heads, dim] with cu_seqlens prefix sums.
+
+    On TPU with identically-packed q/k this runs the Pallas flash kernel
+    with per-token segment ids (no [S,S] mask ever materializes); otherwise
+    it falls back to the masked XLA path (still static-shaped)."""
     args = [query, key, value, cu_seqlens_q, cu_seqlens_k]
 
     def impl(q, k, v, cu_q, cu_k):
@@ -152,15 +157,33 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         pos_k = jnp.arange(total_k)
         seg_q = jnp.searchsorted(cu_q, pos_q, side="right") - 1
         seg_k = jnp.searchsorted(cu_k, pos_k, side="right") - 1
+        # "same packing" must be decided statically (it picks the traced
+        # program): same object always qualifies; equal VALUES qualify only
+        # fully eagerly, so a captured program can't diverge between the
+        # discovery (concrete) and replay (traced) passes.
+        from ...core import tensor as tensor_mod
+        same_packing = total_q == total_k and (
+            cu_q is cu_k
+            or (tensor_mod._tracker is None
+                and not isinstance(cu_q, jax.core.Tracer)
+                and not isinstance(cu_k, jax.core.Tracer)
+                and bool(np.array_equal(np.asarray(cu_q),
+                                        np.asarray(cu_k)))))
+        if _use_pallas(q) and (same_packing or not causal):
+            # per-segment causal == global causal only when q/k share the
+            # packing; non-causal needs no position alignment at all
+            from ...ops.pallas import flash_attention as fa
+            out = fa.flash_attention(
+                q[None], k[None], v[None], causal=causal, scale=scale,
+                segment_ids=(seg_q[None], seg_k[None]))
+            return out[0]
         mask = seg_q[:, None] == seg_k[None, :]
         if causal:
             off_q = pos_q - jnp.take(cu_q, seg_q)
             off_k = pos_k - jnp.take(cu_k, seg_k)
             mask = mask & (off_q[:, None] >= off_k[None, :])
-        qb = q[None]  # [1, Sq, H, D]
-        kb = k[None]
-        vb = v[None]
-        out = _sdpa_xla(qb, kb, vb, mask=mask[None, None], scale=scale)
+        out = _sdpa_xla(q[None], k[None], v[None], mask=mask[None, None],
+                        scale=scale)
         return out[0]
 
     out = apply("flash_attn_unpadded", impl, *args)

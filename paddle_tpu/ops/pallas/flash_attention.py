@@ -53,12 +53,20 @@ def _pad_to(x, axis, mult):
 # --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, has_seg,
                 sq, sk, bq, bk):
     """One (batch, q-head, q-block) program: stream k/v blocks with online
     softmax. Block shapes: q/o [1,1,bq,D]; k/v [1,1,Skp,D]; lse
     [1,1,bq,LANE] (Mosaic needs the trailing dims tile-aligned, so the
-    per-row logsumexp is replicated across a small lane axis)."""
+    per-row logsumexp is replicated across a small lane axis). With
+    ``has_seg``, per-token segment ids (q [1,bq], kv [1,Skp]) confine
+    attention to same-segment pairs (varlen/packed-sequence support —
+    the reference's ``flash_attn_varlen_fwd`` capability)."""
+    if has_seg:
+        qs_ref, ks_ref, o_ref, lse_ref = refs
+    else:
+        o_ref, lse_ref = refs
+        qs_ref = ks_ref = None
     iq = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * scale       # [bq, D]
     offset = sk - sq                                   # causal diagonal shift
@@ -84,6 +92,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         mask = cols < sk                               # k padding
         if causal:
             mask = mask & (rows + offset >= cols)
+        if has_seg:
+            qs = qs_ref[0]                             # [bq]
+            ks = ks_ref[0, pl.ds(j * bk, bk)]          # [bk]
+            mask = mask & (qs[:, None] == ks[None, :])
         s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m_i, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)                         # [bq, bk]
@@ -104,11 +116,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[0, 0] = jnp.broadcast_to(m_f + jnp.log(l_safe), (bq, _LANE))
 
 
-def _fwd(q, k, v, scale, causal, interpret, blocks=None):
-    """q [B,Hq,Sq,D]; k,v [B,Hk,Sk,D] -> (o [B,Hq,Sq,D], lse [B,Hq,Sq])."""
+def _fwd(q, k, v, seg_q, seg_k, scale, causal, interpret, blocks=None):
+    """q [B,Hq,Sq,D]; k,v [B,Hk,Sk,D]; seg_q/seg_k optional [B,Sq]/[B,Sk]
+    int32 segment ids -> (o [B,Hq,Sq,D], lse [B,Hq,Sq])."""
     b, hq, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
     rep = hq // hk
+    has_seg = seg_q is not None
     bq, bk = blocks if blocks is not None else _block_sizes(sq, sk)
     bq, bk = min(bq, sq), min(bk, sk)
     qp = _pad_to(q, 2, bq)
@@ -118,17 +132,26 @@ def _fwd(q, k, v, scale, causal, interpret, blocks=None):
     grid = (b, hq, sqp // bq)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               sq=sq, sk=sk, bq=bq, bk=bk)
+                               has_seg=has_seg, sq=sq, sk=sk, bq=bq, bk=bk)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
+        pl.BlockSpec((1, 1, skp, d),
+                     lambda ib, ih, iq, _rep=rep: (ib, ih // _rep, 0, 0)),
+        pl.BlockSpec((1, 1, skp, d),
+                     lambda ib, ih, iq, _rep=rep: (ib, ih // _rep, 0, 0)),
+    ]
+    args = [qp, kp, vp]
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, bq), lambda ib, ih, iq: (ib, iq)),
+            pl.BlockSpec((1, skp), lambda ib, ih, iq: (ib, 0)),
+        ]
+        args += [_pad_to(seg_q.astype(jnp.int32), 1, bq),
+                 _pad_to(seg_k.astype(jnp.int32), 1, bk)]
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
-            pl.BlockSpec((1, 1, skp, d),
-                         lambda ib, ih, iq, _rep=rep: (ib, ih // _rep, 0, 0)),
-            pl.BlockSpec((1, 1, skp, d),
-                         lambda ib, ih, iq, _rep=rep: (ib, ih // _rep, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
             pl.BlockSpec((1, 1, bq, _LANE),
@@ -139,7 +162,7 @@ def _fwd(q, k, v, scale, causal, interpret, blocks=None):
             jax.ShapeDtypeStruct((b, hq, sqp, _LANE), jnp.float32),
         ],
         interpret=interpret,
-    )(qp, kp, vp)
+    )(*args)
     return o[:, :, :sq], lse[:, :, :sq, 0]
 
 
@@ -147,9 +170,14 @@ def _fwd(q, k, v, scale, causal, interpret, blocks=None):
 # backward
 # --------------------------------------------------------------------------
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, sq, sk, bq, bk):
+                    *refs, scale, causal, has_seg, sq, sk, bq, bk):
     """One (batch, q-head, k-block) program: accumulate this k block's
     dk/dv over all attending q blocks. GQA heads are summed by the caller."""
+    if has_seg:
+        qs_ref, ks_ref, dk_ref, dv_ref = refs
+    else:
+        dk_ref, dv_ref = refs
+        qs_ref = ks_ref = None
     ik = pl.program_id(2)
     kb = k_ref[0, 0].astype(jnp.float32)               # [bk, D]
     vb = v_ref[0, 0].astype(jnp.float32)
@@ -177,6 +205,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = (cols < sk) & (rows < sq)
         if causal:
             mask = mask & (rows + offset >= cols)
+        if has_seg:
+            qs = qs_ref[0, pl.ds(iq * bq, bq)]         # [bq]
+            ks = ks_ref[0, pl.ds(ik * bk, bk)]         # [bk]
+            mask = mask & (qs[:, None] == ks[None, :])
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dv = dv + jax.lax.dot_general(
             p, dob, (((0,), (0,)), ((), ())),
@@ -197,8 +229,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, *, scale, causal, sq, sk, bq, bk):
+                   *refs, scale, causal, has_seg, sq, sk, bq, bk):
     """One (batch, q-head, q-block) program: this q block's dq."""
+    if has_seg:
+        qs_ref, ks_ref, dq_ref = refs
+    else:
+        (dq_ref,) = refs
+        qs_ref = ks_ref = None
     iq = pl.program_id(2)
     qb = q_ref[0, 0].astype(jnp.float32) * scale       # [bq, D]
     dob = do_ref[0, 0].astype(jnp.float32)
@@ -225,6 +262,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = cols < sk
         if causal:
             mask = mask & (rows + offset >= cols)
+        if has_seg:
+            qs = qs_ref[0]                             # [bq]
+            ks = ks_ref[0, pl.ds(j * bk, bk)]          # [bk]
+            mask = mask & (qs[:, None] == ks[None, :])
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())),
@@ -240,13 +281,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(scale, causal, interpret, blocks, res, g):
-    q, k, v, o, lse = res
+    q, k, v, seg_q, seg_k, o, lse = res
     do = g
     b, hq, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
     rep = hq // hk
+    has_seg = seg_q is not None
     bq, bk = blocks if blocks is not None else _block_sizes(sq, sk)
     bq, bk = min(bq, sq), min(bk, sk)
+    if has_seg:
+        sqp_pad = _pad_to(seg_q.astype(jnp.int32), 1, bq)
+        skp_pad = _pad_to(seg_k.astype(jnp.int32), 1, bk)
 
     # delta_i = rowsum(dO * O): the FA2 precompute — one fused XLA reduce
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -265,17 +310,25 @@ def _bwd(scale, causal, interpret, blocks, res, g):
     # --- dk/dv: grid over k blocks; one output copy per q head, summed
     # over the GQA group afterwards (B*Hq programs write disjoint slices).
     kernel = functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                               sq=sq, sk=sk, bq=bq, bk=bk)
+                               has_seg=has_seg, sq=sq, sk=sk, bq=bq, bk=bk)
     kv_spec = pl.BlockSpec(
         (1, 1, bk, d),
         lambda ib, ih, ikb, _rep=rep: (ib, ih // _rep, ikb, 0))
     q_full = pl.BlockSpec((1, 1, sqp, d), lambda ib, ih, ikb: (ib, ih, 0, 0))
     v1_full = pl.BlockSpec((1, 1, sqp, _LANE),
                            lambda ib, ih, ikb: (ib, ih, 0, 0))
+    in_specs = [q_full, kv_spec, kv_spec, q_full, v1_full, v1_full]
+    args = [qp, kp, vp, dop, lsep, dltp]
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, sqp), lambda ib, ih, ikb: (ib, 0)),
+            pl.BlockSpec((1, skp), lambda ib, ih, ikb: (ib, 0)),
+        ]
+        args += [sqp_pad, skp_pad]
     dkh, dvh = pl.pallas_call(
         kernel,
         grid=(b, hq, skp // bk),
-        in_specs=[q_full, kv_spec, kv_spec, q_full, v1_full, v1_full],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bk, d), lambda ib, ih, ikb: (ib, ih, ikb, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda ib, ih, ikb: (ib, ih, ikb, 0)),
@@ -285,7 +338,7 @@ def _bwd(scale, causal, interpret, blocks, res, g):
             jax.ShapeDtypeStruct((b, hq, skp, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, dltp)
+    )(*args)
     if rep > 1:
         dkh = dkh.reshape(b, hk, rep, skp, d).sum(axis=2)
         dvh = dvh.reshape(b, hk, rep, skp, d).sum(axis=2)
@@ -294,36 +347,46 @@ def _bwd(scale, causal, interpret, blocks, res, g):
 
     # --- dq: grid over q blocks
     kernel = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                               sq=sq, sk=sk, bq=bq, bk=bk)
+                               has_seg=has_seg, sq=sq, sk=sk, bq=bq, bk=bk)
     qb_spec = pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq: (ib, ih, iq, 0))
     kv_spec = pl.BlockSpec((1, 1, skp, d),
                            lambda ib, ih, iq, _rep=rep: (ib, ih // _rep, 0, 0))
     v1_spec = pl.BlockSpec((1, 1, bq, _LANE),
                            lambda ib, ih, iq: (ib, ih, iq, 0))
+    in_specs = [qb_spec, kv_spec, kv_spec, qb_spec, v1_spec, v1_spec]
+    args = [qp, kp, vp, dop, lsep, dltp]
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, bq), lambda ib, ih, iq: (ib, iq)),
+            pl.BlockSpec((1, skp), lambda ib, ih, iq: (ib, 0)),
+        ]
+        args += [sqp_pad, skp_pad]
     dq = pl.pallas_call(
         kernel,
         grid=(b, hq, sqp // bq),
-        in_specs=[qb_spec, kv_spec, kv_spec, qb_spec, v1_spec, v1_spec],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda ib, ih, iq: (ib, ih, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((b, hq, sqp, d), q.dtype),
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, dltp)
-    return dq[:, :, :sq], dk, dv
+    )(*args)
+    return dq[:, :, :sq], dk, dv, None, None
 
 
 # --------------------------------------------------------------------------
 # public API
 # --------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, scale, causal, interpret, blocks=None):
-    o, _ = _fwd(q, k, v, scale, causal, interpret, blocks)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_bhsd(q, k, v, seg_q, seg_k, scale, causal, interpret,
+                blocks=None):
+    o, _ = _fwd(q, k, v, seg_q, seg_k, scale, causal, interpret, blocks)
     return o
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, interpret, blocks=None):
-    o, lse = _fwd(q, k, v, scale, causal, interpret, blocks)
-    return o, (q, k, v, o, lse)
+def _flash_fwd_rule(q, k, v, seg_q, seg_k, scale, causal, interpret,
+                    blocks=None):
+    o, lse = _fwd(q, k, v, seg_q, seg_k, scale, causal, interpret, blocks)
+    return o, (q, k, v, seg_q, seg_k, o, lse)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
@@ -362,7 +425,8 @@ def _autotuned_blocks(qt, kt, scale, causal):
             def chained(a, bb, cc, _cand=tuple(cand)):
                 y = a
                 for _ in range(8):
-                    y = _flash_bhsd(y, bb, cc, scale, causal, False, _cand)
+                    y = _flash_bhsd(y, bb, cc, None, None, scale, causal,
+                                    False, _cand)
                 return y
             f = runners[cand] = jax.jit(chained)
         out = f(qt, kt, kt)
@@ -372,7 +436,7 @@ def _autotuned_blocks(qt, kt, scale, causal):
 
 
 def flash_attention(q, k, v, causal=False, scale=None, interpret=None,
-                    blocks=None):
+                    blocks=None, segment_ids=None):
     """Flash attention in paddle layout [batch, seq, num_heads, head_dim].
 
     ``num_heads(q)`` may be a multiple of ``num_heads(k) == num_heads(v)``
@@ -380,6 +444,12 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=None,
     ``blocks``: optional (block_q, block_k) override; with autotuning
     enabled (``incubate.autotune.set_config``) the best pair is measured
     on-device and cached per shape.
+    ``segment_ids``: varlen/packed-sequence support (the capability of the
+    reference's ``flash_attn_varlen_fwd``,
+    ``paddle/phi/kernels/gpu/flash_attn_kernel.cu:91``): an int array
+    [batch, seq] (shared q/kv when lengths match) or a pair
+    ``(q_seg [B,Sq], kv_seg [B,Sk])``; attention is confined to positions
+    with equal segment id, composing with ``causal``.
     """
     if interpret is None:
         from . import use_interpret
@@ -391,13 +461,25 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=None,
         raise ValueError(
             f"flash_attention: query heads ({hq}) must be a multiple of "
             f"key/value heads ({hk}) for grouped-query attention")
+    seg_q = seg_k = None
+    if segment_ids is not None:
+        if isinstance(segment_ids, (tuple, list)):
+            seg_q, seg_k = segment_ids
+        else:
+            if q.shape[1] != k.shape[1]:
+                raise ValueError(
+                    "flash_attention: a single segment_ids array needs "
+                    "seq_q == seq_k; pass (q_seg, kv_seg) otherwise")
+            seg_q = seg_k = segment_ids
+        seg_q = jnp.asarray(seg_q, jnp.int32)
+        seg_k = jnp.asarray(seg_k, jnp.int32)
     qt = jnp.swapaxes(q, 1, 2)  # -> [B, H, S, D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    if blocks is None and not interpret:
+    if blocks is None and not interpret and segment_ids is None:
         from . import autotune as at
         if at.enabled():
             blocks = _autotuned_blocks(qt, kt, float(scale), bool(causal))
-    o = _flash_bhsd(qt, kt, vt, float(scale), bool(causal),
+    o = _flash_bhsd(qt, kt, vt, seg_q, seg_k, float(scale), bool(causal),
                     bool(interpret), blocks)
     return jnp.swapaxes(o, 1, 2)

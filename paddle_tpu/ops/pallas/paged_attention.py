@@ -1,0 +1,143 @@
+"""Pallas TPU paged-KV decode attention.
+
+Capability analog of the reference's paged/block KV serving kernels
+(``paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu``,
+``masked_multihead_attention_kernel.cu``) — TPU-native design:
+
+* the KV cache lives in a PAGE POOL ``[num_kv_heads, total_pages,
+  page_size, head_dim]``; each sequence owns a list of page indices (its
+  block table) instead of a contiguous ``max_len`` slab, so HBM scales with
+  tokens actually generated and attention cost scales with the *current*
+  length (the dense cache path computes over ``max_len`` every step);
+* one decode step = grid ``(batch, kv_head, page)``; the block table and
+  sequence lengths ride the scalar-prefetch channel so the BlockSpec index
+  map gathers exactly the pages each sequence owns — no host gather, no
+  materialized contiguous copy;
+* online softmax across pages in VMEM scratch (same flash recurrence as
+  flash_attention.py), GQA by grouping the ``rep = Hq // Hk`` query heads
+  of a kv head into the sublane dimension of one program.
+
+Public entry: ``paged_decode_attention(q, k_pages, v_pages, block_tables,
+seq_lens)``. Decode-only (one query token per sequence) — prefill uses the
+regular flash kernel.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANE = 128    # lane width for per-row stats kept in VMEM scratch
+_MIN_SUB = 8   # Mosaic sublane minimum: q-head group padded up to this
+
+
+def _kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
+            m_s, l_s, acc_s, *, scale, page_size, npages):
+    """One (b, kv_head, page) program. Scalars: bt [B, NP] page table,
+    sl [B] sequence lengths. Blocks: q/o [1, 1, rep_p, D]; k/v page
+    [1, 1, page_size, D]. Scratch: m/l [rep_p, _LANE], acc [rep_p, D]."""
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        m_s[...] = jnp.full(m_s.shape, NEG_INF, jnp.float32)
+        l_s[...] = jnp.zeros(l_s.shape, jnp.float32)
+        acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
+
+    @pl.when(i * page_size < sl_ref[b])  # skip pages past the seq length
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale    # [rep_p, D]
+        kb = k_ref[0, 0].astype(jnp.float32)           # [ps, D]
+        vb = v_ref[0, 0].astype(jnp.float32)
+
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        pos = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+               + i * page_size)
+        s = jnp.where(pos < sl_ref[b], s, NEG_INF)
+
+        m_prev = m_s[:, 0:1]
+        l_prev = l_s[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                         # [rep_p, ps]
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_s[...] = acc_s[...] * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(i == npages - 1)
+    def _finish():
+        l = l_s[:, 0:1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_s[...] / l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
+                           scale=None, interpret=None):
+    """One decode step of attention over a paged KV cache.
+
+    q: [B, Hq, D] (one query token per sequence);
+    k_pages/v_pages: [Hk, total_pages, page_size, D] page pool;
+    block_tables: [B, pages_per_seq] int32 — global page ids per sequence;
+    seq_lens: [B] int32 — valid tokens (including the current one).
+    Returns [B, Hq, D]. ``Hq`` must be a multiple of ``Hk`` (GQA).
+    """
+    if interpret is None:
+        from . import use_interpret
+        interpret = use_interpret()
+    b, hq, d = q.shape
+    hk, _, page_size, _ = k_pages.shape
+    if hk == 0 or hq % hk != 0:
+        raise ValueError(f"paged_decode_attention: {hq} q heads not a "
+                         f"multiple of {hk} kv heads")
+    rep = hq // hk
+    rep_p = max(rep, _MIN_SUB)
+    npages = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    qg = q.reshape(b, hk, rep, d)
+    if rep_p != rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rep_p - rep), (0, 0)))
+
+    grid = (b, hk, npages)
+    kernel = functools.partial(_kernel, scale=float(scale),
+                               page_size=page_size, npages=npages)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, rep_p, d),
+                             lambda ib, ih, ip, bt, sl: (ib, ih, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda ib, ih, ip, bt, sl:
+                             (ih, bt[ib, ip], 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda ib, ih, ip, bt, sl:
+                             (ih, bt[ib, ip], 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, rep_p, d),
+                lambda ib, ih, ip, bt, sl: (ib, ih, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rep_p, _LANE), jnp.float32),
+                pltpu.VMEM((rep_p, _LANE), jnp.float32),
+                pltpu.VMEM((rep_p, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hk, rep_p, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      qg, k_pages, v_pages)
+    return out[:, :, :rep].reshape(b, hq, d)

@@ -52,7 +52,7 @@ def test_generate_compiles_once():
     prompt = np.zeros((1, 3), np.int32)
     out = generate(model, prompt, max_new_tokens=8)
     assert tuple(out.shape) == (1, 11)
-    step_fn = model._decode_step_cache[(1, 11)]
+    step_fn = model._decode_step_cache[(1, 11, "dense", 0)]
     assert len(step_fn._cache) == 1  # one signature, one program
     exe = next(iter(step_fn._cache.values()))
     n = getattr(exe, "trace_count", 1)
@@ -81,3 +81,27 @@ def test_top_p_and_eos():
                     eos_token_id=eos).numpy()
     assert out3.shape[1] <= 3 + 5
     assert out3[0, 3] == eos
+
+
+@pytest.mark.parametrize("family", ["gpt", "llama"])
+def test_paged_kv_cache_matches_dense(family):
+    """Paged decode (page pool + block tables + Pallas paged kernel) must
+    reproduce the dense-cache greedy sequence exactly."""
+    paddle.seed(0)
+    if family == "gpt":
+        model = GPTForCausalLM(GPTConfig(
+            vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+            max_seq_len=64, dropout=0.0))
+    else:
+        model = LlamaForCausalLM(LlamaConfig(
+            vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, max_seq_len=64))
+    model.eval()
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 96, (2, 5)).astype(np.int32)
+
+    dense = generate(model, prompt, max_new_tokens=9).numpy()
+    # page_size=8 with max_len 14 -> 2 pages/seq, second partially filled
+    paged = generate(model, prompt, max_new_tokens=9,
+                     kv_cache="paged", page_size=8).numpy()
+    np.testing.assert_array_equal(paged, dense)

@@ -113,6 +113,101 @@ def test_flash_attention_multiblock_grads():
         np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
 
 
+def _ref_segmented(q, k, v, seg_q, seg_k, causal):
+    from paddle_tpu.nn.functional.attention import _sdpa_xla
+    mask = seg_q[:, None, :, None] == seg_k[:, None, None, :]
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        iq = jnp.arange(sq)[:, None] + (sk - sq)
+        mask = mask & (iq >= jnp.arange(sk)[None, :])[None, None]
+    return _sdpa_xla(q, k, v, mask=mask, causal=False)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_segment_ids(causal):
+    """Varlen via segment ids (the reference flash_attn_varlen capability):
+    attention confined to same-segment pairs, parity vs masked XLA."""
+    B, S, H, D = 2, 96, 4, 32
+    q = _rand((B, S, H, D), seed=1)
+    k = _rand((B, S, H, D), seed=2)
+    v = _rand((B, S, H, D), seed=3)
+    # ragged packing: row 0 -> [40, 56], row 1 -> [10, 30, 56]
+    seg = np.zeros((B, S), np.int32)
+    seg[0, 40:] = 1
+    seg[1, 10:40] = 1
+    seg[1, 40:] = 2
+    seg = jnp.asarray(seg)
+    out = fa.flash_attention(q, k, v, causal=causal, interpret=True,
+                             segment_ids=seg)
+    ref = _ref_segmented(q, k, v, seg, seg, causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_segment_ids_grads():
+    B, S, H, D = 1, 64, 2, 32
+    q = _rand((B, S, H, D), seed=4)
+    k = _rand((B, S, H, D), seed=5)
+    v = _rand((B, S, H, D), seed=6)
+    seg = jnp.asarray(np.repeat([[0, 1]], B, 0).repeat(S // 2, axis=1))
+
+    def loss_pl(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=True, interpret=True,
+                               segment_ids=seg)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(_ref_segmented(q, k, v, seg, seg, True)))
+
+    gp = jax.grad(loss_pl, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(a, b, atol=3e-5, rtol=3e-5)
+
+
+def test_flash_attention_segment_ids_gqa_multiblock():
+    # segments spanning block boundaries + GQA head mapping
+    B, S, H, D = 1, 300, 4, 32
+    q = _rand((B, S, H, D), seed=7)
+    k = _rand((B, S, 2, D), seed=8)
+    v = _rand((B, S, 2, D), seed=9)
+    seg = np.zeros((B, S), np.int32)
+    seg[0, 130:] = 1
+    seg[0, 250:] = 2
+    seg = jnp.asarray(seg)
+    out = fa.flash_attention(q, k, v, causal=True, interpret=True,
+                             segment_ids=seg, blocks=(128, 128))
+    ref = _ref_segmented(q, jnp.repeat(k, 2, 2), jnp.repeat(v, 2, 2),
+                         seg, seg, True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attn_unpadded_functional():
+    """paddle.nn.functional.flash_attn_unpadded parity: packed rows with
+    cu_seqlens match per-sequence dense attention."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(0)
+    lens = [24, 40]
+    total, H, D = sum(lens), 2, 16
+    q = rng.normal(size=(total, H, D)).astype(np.float32)
+    k = rng.normal(size=(total, H, D)).astype(np.float32)
+    v = rng.normal(size=(total, H, D)).astype(np.float32)
+    cu = np.cumsum([0] + lens).astype(np.int32)
+    out, _ = F.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu), paddle.to_tensor(cu),
+        max(lens), max(lens), causal=True)
+    out = out.numpy()
+    # each packed sequence == standalone causal attention
+    from paddle_tpu.nn.functional.attention import _sdpa_xla
+    for i, ln in enumerate(lens):
+        s, e = cu[i], cu[i + 1]
+        ref = _sdpa_xla(jnp.asarray(q[None, s:e]), jnp.asarray(k[None, s:e]),
+                        jnp.asarray(v[None, s:e]), causal=True)[0]
+        np.testing.assert_allclose(out[s:e], ref, atol=2e-5, rtol=2e-5)
+
+
 def test_flash_attention_bf16():
     q = _rand((1, 64, 2, 64), jnp.bfloat16, seed=1)
     k = _rand((1, 64, 2, 64), jnp.bfloat16, seed=2)
