@@ -1,0 +1,198 @@
+"""Elastic membership, re-rendezvous, and hang detection.
+
+Capability analog of the reference ElasticManager
+(``python/paddle/distributed/fleet/elastic/manager.py:126``: etcd
+heartbeat membership, scale-up/down, rank re-map) and of the collective
+hang watchdog (``paddle/phi/core/distributed/comm_task_manager.h:37``
+aborts comms after ``pg_timeout``) — TPU-shaped:
+
+* membership rides the framework's own TCPStore instead of etcd: each
+  node agent appends itself to a registration log and heartbeats a key;
+  the master agent derives the alive set and publishes a new
+  ``generation`` (member list + rank re-map) whenever it changes;
+* on a generation change every agent stops its workers and respawns them
+  with the re-mapped ``PADDLE_TRAINER_ID`` / ``PADDLE_TRAINERS_NUM`` env
+  (the launcher is the supervisor — on TPU the collectives live inside
+  compiled XLA programs, so "abort the comm" means "kill and relaunch
+  the process", there is no finer-grained handle);
+* hang detection is a per-step progress heartbeat: each worker touches a
+  progress file every compiled step (``jit`` does this automatically when
+  ``PADDLE_PROGRESS_FILE`` is set; ``report_progress`` for custom loops).
+  A desynced SPMD program stops completing steps on every rank, the file
+  goes stale, and the launcher kills/restarts within the timeout — the
+  TPU analog of the reference's comm-task timeout abort.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+
+__all__ = ["ElasticManager", "report_progress"]
+
+_REG_COUNT = "elastic/nreg"
+_REG_KEY = "elastic/reg/{}"
+_HB_KEY = "elastic/hb/{}"
+_GEN_LATEST = "elastic/gen_latest"
+_MEMBERS_KEY = "elastic/members/{}"
+
+
+def report_progress(step=None):
+    """Touch this worker's progress heartbeat (no-op when the launcher did
+    not request one). Compiled-step invocations already call this through
+    the jit executor; explicit calls serve custom eager loops."""
+    path = os.environ.get("PADDLE_PROGRESS_FILE")
+    if not path:
+        return
+    try:
+        with open(path, "w") as f:
+            f.write("" if step is None else str(step))
+    except OSError:
+        pass
+
+
+class ElasticManager:
+    """One per node agent (launcher process). The node whose store is
+    ``is_master`` also runs the membership scan and publishes generations.
+    """
+
+    def __init__(self, store, node_id, is_master, heartbeat_interval=1.0,
+                 heartbeat_timeout=5.0, min_nodes=1):
+        self.store = store
+        self.node_id = str(node_id)
+        self.is_master = bool(is_master)
+        self.hb_interval = float(heartbeat_interval)
+        self.hb_timeout = float(heartbeat_timeout)
+        # the FIRST generation waits for min_nodes (the reference waits for
+        # np nodes before the initial rendezvous); later scale-downs below
+        # it still publish — a survivor must be able to continue
+        self.min_nodes = int(min_nodes)
+        self._stop = threading.Event()
+        self._gen = 0
+        self._members: list[str] = []
+        self._lock = threading.Lock()
+        self._hb_seq = 0
+        # liveness is derived from heartbeat CHANGES observed on the
+        # master's own clock (remote time.time() would make clock skew >
+        # timeout look like death): nid -> (last value, local time seen)
+        self._hb_seen: dict[str, tuple[bytes, float]] = {}
+
+    # -------------------------------------------------------------- join --
+    def start(self):
+        """Register, start heartbeating (and the master scan), then block
+        until the first generation that includes this node is published.
+        Returns (generation, members)."""
+        idx = self.store.add(_REG_COUNT, 1) - 1
+        self.store.set(_REG_KEY.format(idx), self.node_id.encode())
+        self._beat()
+        threading.Thread(target=self._hb_loop, daemon=True).start()
+        if self.is_master:
+            threading.Thread(target=self._scan_loop, daemon=True).start()
+        while True:
+            gen, members = self.wait_generation(self._gen, timeout=None)
+            if self.node_id in members:
+                return gen, members
+
+    def stop(self):
+        self._stop.set()
+
+    # ---------------------------------------------------------- heartbeat --
+    def _beat(self):
+        self._hb_seq += 1
+        self.store.set(_HB_KEY.format(self.node_id),
+                       str(self._hb_seq).encode())
+
+    def _hb_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._beat()
+            except OSError:
+                return  # store gone: the job is over
+            self._stop.wait(self.hb_interval)
+
+    # ------------------------------------------------------- master scan --
+    def _registered(self):
+        """Ordered, deduped registration log (append-only; re-joins
+        re-append, order = first appearance). A slot whose value is not
+        yet set (joiner crashed between add and set) is skipped — it must
+        not kill the scan."""
+        n = self.store.add(_REG_COUNT, 0)
+        seen, out = set(), []
+        for i in range(n):
+            try:
+                nid = self.store.get(_REG_KEY.format(i),
+                                     timeout=2.0).decode()
+            except (TimeoutError, ValueError):
+                continue
+            if nid not in seen:
+                seen.add(nid)
+                out.append(nid)
+        return out
+
+    def _alive(self):
+        now = time.time()
+        alive = []
+        for nid in self._registered():
+            try:
+                val = self.store.get(_HB_KEY.format(nid), timeout=1.0)
+            except Exception:
+                continue
+            prev = self._hb_seen.get(nid)
+            if prev is None or prev[0] != val:
+                self._hb_seen[nid] = (val, now)
+                alive.append(nid)
+            elif now - prev[1] <= self.hb_timeout:
+                alive.append(nid)
+        return alive
+
+    def _scan_loop(self):
+        current: list[str] = []
+        published = False
+        while not self._stop.is_set():
+            try:
+                alive = self._alive()
+            except ConnectionError:
+                return  # store gone: the job is over
+            except OSError:
+                alive = current  # transient: keep the last view
+            if not published and len(alive) < self.min_nodes:
+                self._stop.wait(self.hb_interval)
+                continue
+            if alive and alive != current:
+                current = alive
+                gen = self.store.add("elastic/gen", 1)
+                self.store.set(_MEMBERS_KEY.format(gen),
+                               pickle.dumps(current))
+                self.store.set(_GEN_LATEST, str(gen).encode())
+                published = True
+            self._stop.wait(self.hb_interval)
+
+    # ------------------------------------------------------------- watch --
+    def wait_generation(self, known_gen, timeout=0.5):
+        """Return (gen, members); blocks up to ``timeout`` for a NEWER
+        generation than ``known_gen`` (None = wait forever). Falls back to
+        the current one on timeout."""
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            try:
+                gen = int(self.store.get(_GEN_LATEST, timeout=1.0).decode())
+            except Exception:
+                gen = 0
+            if gen > known_gen or (deadline and time.time() > deadline):
+                break
+            if deadline is None:
+                time.sleep(self.hb_interval / 2)
+            else:
+                time.sleep(0.05)
+        if gen == 0:
+            return 0, []
+        members = pickle.loads(
+            self.store.get(_MEMBERS_KEY.format(gen), timeout=5.0))
+        with self._lock:
+            self._gen, self._members = gen, members
+        return gen, members
+
+    def rank_of(self, members):
+        """Re-mapped node rank under the given membership."""
+        return members.index(self.node_id)

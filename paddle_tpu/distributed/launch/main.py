@@ -1,9 +1,19 @@
-"""Launcher implementation (see package docstring)."""
+"""Launcher implementation (see package docstring).
+
+Elastic mode (``--elastic 1``) adds the reference ElasticManager's
+capabilities (``fleet/elastic/manager.py:126``): TCPStore-based heartbeat
+membership, scale-up/down with rank re-map, and automatic worker respawn
+on a membership change. ``--progress_timeout`` adds the hang watchdog
+(the TPU analog of ``comm_task_manager.h:37``): workers heartbeat a
+progress file every compiled step; a stalled worker (e.g. a desynced
+collective hanging all ranks) is killed and restarted.
+"""
 from __future__ import annotations
 
 import argparse
 import os
 import signal
+import socket
 import subprocess
 import sys
 import time
@@ -24,6 +34,14 @@ def _parse(argv):
                    help="controllers per host (1 on TPU: PJRT owns chips)")
     p.add_argument("--max_restart_times", type=int, default=0,
                    help="elastic: restart a failed child up to N times")
+    p.add_argument("--elastic", type=int, default=0,
+                   help="1 = heartbeat membership + re-rendezvous on "
+                        "scale-up/down (requires --master for the store)")
+    p.add_argument("--heartbeat_interval", type=float, default=1.0)
+    p.add_argument("--heartbeat_timeout", type=float, default=5.0)
+    p.add_argument("--progress_timeout", type=float, default=0.0,
+                   help="seconds without worker progress before the "
+                        "watchdog kills/restarts it (0 = off)")
     p.add_argument("--log_dir", default=None)
     p.add_argument("--run_mode", default="collective")  # parity: accepted
     p.add_argument("--devices", default=None)           # parity: accepted
@@ -32,16 +50,18 @@ def _parse(argv):
     return p.parse_args(argv)
 
 
-def _child_env(args, local_rank):
+def _child_env(args, local_rank, nnodes=None, node_rank=None):
     env = dict(os.environ)
-    world = args.nnodes * args.nproc_per_node
-    rank = args.node_rank * args.nproc_per_node + local_rank
+    nnodes = args.nnodes if nnodes is None else nnodes
+    node_rank = args.node_rank if node_rank is None else node_rank
+    world = nnodes * args.nproc_per_node
+    rank = node_rank * args.nproc_per_node + local_rank
     env.update({
         "PADDLE_TRAINERS_NUM": str(world),
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_LOCAL_RANK": str(local_rank),
-        "PADDLE_NNODES": str(args.nnodes),
-        "PADDLE_NODE_RANK": str(args.node_rank),
+        "PADDLE_NNODES": str(nnodes),
+        "PADDLE_NODE_RANK": str(node_rank),
     })
     if args.master:
         env["PADDLE_MASTER"] = args.master
@@ -53,62 +73,173 @@ def _child_env(args, local_rank):
     return env
 
 
+class _Worker:
+    """One child process + its restart budget and progress file."""
+
+    def __init__(self, args, local_rank, nnodes, node_rank):
+        self.args = args
+        self.lr = local_rank
+        self.restarts = 0
+        self.stdout = None
+        if args.log_dir:
+            self.stdout = open(os.path.join(
+                args.log_dir, f"worker.{node_rank}.{local_rank}.log"), "ab")
+        self.progress = None
+        if args.progress_timeout > 0:
+            base = args.log_dir or "/tmp"
+            self.progress = os.path.join(
+                base, f".progress.{os.getpid()}.{local_rank}")
+        self.proc = None
+        self.spawn(nnodes, node_rank)
+
+    def spawn(self, nnodes, node_rank):
+        env = _child_env(self.args, self.lr, nnodes, node_rank)
+        if self.progress:
+            env["PADDLE_PROGRESS_FILE"] = self.progress
+            with open(self.progress, "w"):  # clock starts at spawn
+                pass
+        cmd = [sys.executable, self.args.script] + self.args.script_args
+        self.proc = subprocess.Popen(cmd, env=env, stdout=self.stdout,
+                                     stderr=self.stdout)
+
+    def stalled(self, timeout):
+        if not self.progress or self.proc.poll() is not None:
+            return False
+        try:
+            return time.time() - os.path.getmtime(self.progress) > timeout
+        except OSError:
+            return False
+
+    def terminate(self, grace=10.0):
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            deadline = time.time() + grace
+            while self.proc.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            if self.proc.poll() is None:
+                # last resort (note: can wedge a held TPU claim; the
+                # lease times out server-side)
+                self.proc.kill()
+                self.proc.wait()  # reap: no zombie across generations
+
+    def close(self):
+        self.terminate()
+        if self.stdout is not None:
+            try:
+                self.stdout.close()
+            except OSError:
+                pass
+
+
+def _watch(args, workers, nnodes, node_rank, em=None, gen=0):
+    """Supervise one generation. Returns ('done', code) or
+    ('regen', new_gen, members)."""
+    while True:
+        alive = False
+        for w in workers:
+            code = w.proc.poll()
+            if code is None:
+                if args.progress_timeout > 0 and \
+                        w.stalled(args.progress_timeout):
+                    print(f"[launch] worker {w.lr} made no progress for "
+                          f"{args.progress_timeout}s: killing "
+                          f"(hang watchdog)", file=sys.stderr)
+                    w.terminate()
+                    code = w.proc.poll() or 1
+                else:
+                    alive = True
+                    continue
+            if code != 0:
+                if w.restarts < args.max_restart_times:
+                    w.restarts += 1
+                    print(f"[launch] worker {w.lr} exited {code}; restart "
+                          f"{w.restarts}/{args.max_restart_times}",
+                          file=sys.stderr)
+                    w.spawn(nnodes, node_rank)
+                    alive = True
+                else:
+                    for other in workers:
+                        other.terminate()
+                    return ("done", code)
+        if not alive:
+            return ("done", 0)
+        if em is not None:
+            new_gen, members = em.wait_generation(gen, timeout=0.0)
+            if new_gen > gen:
+                print(f"[launch] membership changed (gen {gen} -> "
+                      f"{new_gen}, {len(members)} nodes): re-rendezvous",
+                      file=sys.stderr)
+                for w in workers:
+                    w.terminate()
+                return ("regen", new_gen, members)
+        time.sleep(0.2)
+
+
+def _launch_static(args):
+    workers = [_Worker(args, lr, args.nnodes, args.node_rank)
+               for lr in range(args.nproc_per_node)]
+    try:
+        res = _watch(args, workers, args.nnodes, args.node_rank)
+        return res[1]
+    except KeyboardInterrupt:
+        for w in workers:
+            w.terminate()
+        return 130
+
+
+def _launch_elastic(args):
+    from ..elastic import ElasticManager
+    from ..store import TCPStore
+
+    host, port = args.master.rsplit(":", 1)
+    is_master = args.node_rank == 0
+    store = TCPStore(host, int(port), is_master=is_master)
+    node_id = os.environ.get(
+        "PADDLE_ELASTIC_NODE_ID",
+        f"{socket.gethostname()}-{args.node_rank}-{os.getpid()}")
+    em = ElasticManager(store, node_id, is_master,
+                        heartbeat_interval=args.heartbeat_interval,
+                        heartbeat_timeout=args.heartbeat_timeout,
+                        min_nodes=args.nnodes)
+    gen, members = em.start()
+    workers = []
+    try:
+        while True:
+            nnodes, node_rank = len(members), em.rank_of(members)
+            print(f"[launch] gen {gen}: {nnodes} nodes, this node rank "
+                  f"{node_rank}", file=sys.stderr)
+            workers = [_Worker(args, lr, nnodes, node_rank)
+                       for lr in range(args.nproc_per_node)]
+            res = _watch(args, workers, nnodes, node_rank, em, gen)
+            if res[0] == "done":
+                return res[1]
+            for w in workers:  # old generation: reap + release log fds
+                w.close()
+            workers = []
+            gen, members = res[1], res[2]
+            while node_id not in members:  # dropped: wait to be re-seen
+                gen, members = em.wait_generation(gen, timeout=None)
+    except KeyboardInterrupt:
+        return 130
+    finally:
+        for w in workers:
+            w.close()
+        em.stop()
+        store.close()
+
+
 def launch(argv=None):
     args = _parse(argv if argv is not None else sys.argv[1:])
     if args.nnodes > 1 and not args.master:
         raise SystemExit("--master host:port is required for nnodes > 1")
+    if args.elastic and not args.master:
+        raise SystemExit("--elastic requires --master host:port "
+                         "(the membership store)")
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
-
-    procs = []
-    for lr in range(args.nproc_per_node):
-        cmd = [sys.executable, args.script] + args.script_args
-        stdout = None
-        if args.log_dir:
-            stdout = open(os.path.join(
-                args.log_dir, f"worker.{args.node_rank}.{lr}.log"), "ab")
-        procs.append([subprocess.Popen(cmd, env=_child_env(args, lr),
-                                       stdout=stdout, stderr=stdout),
-                      0, stdout, lr])
-
-    def terminate_all():
-        for rec in procs:
-            if rec[0].poll() is None:
-                rec[0].send_signal(signal.SIGTERM)
-
-    exit_code = 0
-    try:
-        while True:
-            alive = False
-            for rec in procs:
-                proc, restarts, stdout, lr = rec
-                code = proc.poll()
-                if code is None:
-                    alive = True
-                elif code != 0:
-                    if restarts < args.max_restart_times:
-                        # elastic restart path (reference fleet/elastic
-                        # manager watchdog)
-                        rec[1] += 1
-                        print(f"[launch] worker {lr} exited {code}; "
-                              f"restart {rec[1]}/{args.max_restart_times}",
-                              file=sys.stderr)
-                        rec[0] = subprocess.Popen(
-                            [sys.executable, args.script]
-                            + args.script_args,
-                            env=_child_env(args, lr), stdout=stdout,
-                            stderr=stdout)
-                        alive = True
-                    else:
-                        exit_code = code
-                        terminate_all()
-                        return exit_code
-            if not alive:
-                return exit_code
-            time.sleep(0.2)
-    except KeyboardInterrupt:
-        terminate_all()
-        return 130
+    if args.elastic:
+        return _launch_elastic(args)
+    return _launch_static(args)
 
 
 def main():

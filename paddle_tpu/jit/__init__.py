@@ -286,6 +286,11 @@ class _Executable:
                 t._grad._node = None
             else:
                 t._grad = Tensor(v, stop_gradient=True)
+        if "PADDLE_PROGRESS_FILE" in os.environ:
+            # hang-watchdog heartbeat: every completed compiled step
+            # (see distributed/elastic.py)
+            from ..distributed.elastic import report_progress
+            report_progress()
         return self.ret_rebuild([Tensor(v) for v in ret_vals])
 
 
