@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Attention implementation/block-size sweep at the bench shape, using
+the dispatch-free scan-slope method (see calibrate.py). Prints a ranked
+table; argv[1] = optional JSON output path."""
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def _sync(x):
+    while isinstance(x, (tuple, list)):
+        x = x[0]
+    return float(jnp.asarray(x).reshape(-1)[0].astype(jnp.float32))
+
+
+def _time_call(fn, *args, iters=4, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _slope(make_fn, args, r1=8, r2=48):
+    f1 = make_fn(r1)
+    f2 = make_fn(r2)
+    t1 = _time_call(f1, *args)
+    t2 = _time_call(f2, *args)
+    return max((t2 - t1) / (r2 - r1), 1e-9)
+
+
+def sweep(batch=8, heads=12, seq=1024, d=64, causal=True):
+    rng = np.random.default_rng(0)
+    shp = (batch, seq, heads, d)   # paddle layout for our kernel
+    q = jnp.asarray(rng.normal(size=shp) * 0.1, jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=shp) * 0.1, jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=shp) * 0.1, jnp.bfloat16)
+    flops_f = 4.0 * batch * heads * seq * seq * d * (0.5 if causal else 1.0)
+    results = {}
+
+    def measure(name, one_fwd):
+        def mk_f(reps):
+            @jax.jit
+            def f(q, k, v):
+                def body(c, i):
+                    return c + one_fwd(q + i.astype(q.dtype) * 1e-6,
+                                       k, v), None
+                return jax.lax.scan(body, jnp.zeros_like(q),
+                                    jnp.arange(reps))[0]
+            return f
+
+        grad = jax.grad(
+            lambda q, k, v: one_fwd(q, k, v).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2))
+
+        def mk_b(reps):
+            @jax.jit
+            def f(q, k, v):
+                def body(c, i):
+                    dq, _, _ = grad(q + i.astype(q.dtype) * 1e-6, k, v)
+                    return c + dq.astype(q.dtype), None
+                return jax.lax.scan(body, jnp.zeros_like(q),
+                                    jnp.arange(reps))[0]
+            return f
+
+        try:
+            tf_ = _slope(mk_f, (q, k, v))
+            tb = _slope(mk_b, (q, k, v))
+        except Exception as e:
+            _log(f"{name}: FAILED {type(e).__name__}: {e}")
+            return
+        # the grad call runs fwd (residuals) + bwd kernels, which is
+        # exactly one training step's attention work — so gradcall_ms IS
+        # the per-step cost; fwd_ms alone is the inference cost
+        results[name] = {
+            "fwd_ms": round(tf_ * 1e3, 3),
+            "gradcall_ms": round(tb * 1e3, 3),
+            "fwd_tflops": round(flops_f / tf_ / 1e12, 2),
+            "train_step_ms": round(tb * 1e3, 3)}
+        _log(f"{name}: fwd {tf_*1e3:.3f} ms ({flops_f/tf_/1e12:.1f} TF/s) "
+             f"gradcall {tb*1e3:.3f} ms")
+
+    # ours, block-size grid
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    for bq, bk in ((128, 128), (256, 256), (256, 512), (512, 256),
+                   (512, 512), (512, 1024), (1024, 512), (1024, 1024)):
+        if bq > seq or bk > seq:
+            continue
+        measure(f"ours_{bq}x{bk}", functools.partial(
+            lambda q, k, v, blocks: fa.flash_attention(
+                q, k, v, causal=causal, blocks=blocks), blocks=(bq, bk)))
+
+    # jax in-tree pallas flash attention (layout [B,H,S,D])
+    try:
+        from jax.experimental.pallas.ops.tpu import flash_attention as jfa
+
+        def intree(q, k, v):
+            qt = jnp.swapaxes(q, 1, 2)
+            kt = jnp.swapaxes(k, 1, 2)
+            vt = jnp.swapaxes(v, 1, 2)
+            o = jfa.flash_attention(qt, kt, vt, causal=causal,
+                                    sm_scale=1.0 / np.sqrt(d))
+            return jnp.swapaxes(o, 1, 2)
+        measure("jax_intree", intree)
+    except Exception as e:
+        _log(f"jax_intree unavailable: {e}")
+
+    # naive XLA
+    def xla(q, k, v):
+        qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+        kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+        vt = jnp.swapaxes(v, 1, 2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt,
+                       preferred_element_type=jnp.float32) / np.sqrt(d)
+        if causal:
+            i = jnp.arange(seq)
+            s = jnp.where((i[:, None] >= i[None, :])[None, None], s, -1e30)
+        p = jax.nn.softmax(s, -1).astype(jnp.bfloat16)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+        return jnp.swapaxes(o, 1, 2)
+    measure("xla_naive", xla)
+
+    return results
+
+
+if __name__ == "__main__":
+    res = sweep()
+    print(json.dumps(res, indent=2))
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "w") as f:
+            json.dump(res, f, indent=2)

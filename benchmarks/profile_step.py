@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Profile the bench train step on the real chip and print the op-level
+time breakdown (xprof framework_op_stats). argv[1] = optional trace dir."""
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.amp as amp
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/pdtpu_trace"
+
+    cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                    num_heads=12, max_seq_len=1024, dropout=0.0,
+                    recompute=True, recompute_policy="dots_saveable")
+    batch, seq = 8, 1024
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.train()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    model, opt = amp.decorate(models=model, optimizers=opt, level="O2",
+                              dtype="bfloat16", master_weight=True)
+
+    @paddle.jit.to_static
+    def train_step(ids, labels):
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            loss = model(ids, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.default_rng(0)
+
+    def batch_fn():
+        ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        lab = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        return paddle.to_tensor(ids), paddle.to_tensor(lab)
+
+    for _ in range(3):
+        loss = train_step(*batch_fn())
+    float(loss)
+
+    with jax.profiler.trace(trace_dir):
+        for _ in range(5):
+            loss = train_step(*batch_fn())
+        float(loss)
+
+    # ---- parse with xprof
+    from xprof.convert import raw_to_tool_data as rtd
+
+    run_dirs = sorted(glob.glob(os.path.join(trace_dir, "plugins",
+                                             "profile", "*")))
+    data, _ = rtd.xspace_to_tool_data([run_dirs[-1]],
+                                      "framework_op_stats", {})
+    import csv
+    import io
+    if isinstance(data, bytes):
+        data = data.decode()
+    rows = list(csv.DictReader(io.StringIO(data)))
+    agg = {}
+    for r in rows:
+        if r.get("host_or_device") != "Device":
+            continue
+        cat = r.get("category") or r.get("type", "?")
+        name = r.get("operation") or r.get("op_name", "?")
+        t = float(r.get("total_self_time_in_us") or
+                  r.get("self_time_us") or 0)
+        occ = int(float(r.get("occurrences") or 1))
+        k = (cat, name[:60])
+        a = agg.setdefault(k, [0.0, 0])
+        a[0] += t
+        a[1] += occ
+    total = sum(a[0] for a in agg.values())
+    print(f"\ndevice total self time: {total/1e3:.2f} ms over 5 steps "
+          f"(= {total/5e3:.2f} ms/step)\n")
+    print(f"{'category':24s} {'op':60s} {'ms/step':>9s} {'%':>6s} {'n':>6s}")
+    for (cat, name), (t, occ) in sorted(agg.items(),
+                                        key=lambda kv: -kv[1][0])[:40]:
+        print(f"{cat:24s} {name:60s} {t/5e3:9.3f} {100*t/total:6.2f} "
+              f"{occ:6d}")
+    # category rollup
+    cats = {}
+    for (cat, _), (t, _o) in agg.items():
+        cats[cat] = cats.get(cat, 0.0) + t
+    print("\n-- by category --")
+    for cat, t in sorted(cats.items(), key=lambda kv: -kv[1]):
+        print(f"{cat:40s} {t/5e3:9.3f} ms/step {100*t/total:6.2f}%")
+
+
+if __name__ == "__main__":
+    main()

@@ -12,10 +12,22 @@ from .distributions import (  # noqa: F401
     Dirichlet, Gamma, Binomial, Exponential, Laplace, LogNormal, Gumbel, Cauchy,
     Geometric, Poisson, Multinomial, kl_divergence, register_kl,
 )
+from .transform import (  # noqa: F401
+    Transform, AbsTransform, AffineTransform, ChainTransform,
+    ExpTransform, IndependentTransform, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform,
+)
+from .transformed_distribution import TransformedDistribution  # noqa: F401
 
 __all__ = [
     "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
     "Beta", "Dirichlet", "Gamma", "Binomial", "Exponential", "Laplace", "LogNormal",
     "Gumbel", "Cauchy", "Geometric", "Poisson", "Multinomial",
     "kl_divergence", "register_kl",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "TransformedDistribution",
 ]

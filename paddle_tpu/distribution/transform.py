@@ -1,0 +1,351 @@
+"""Probability transforms (reference
+``python/paddle/distribution/transform.py``: Transform ``:59``,
+AbsTransform ``:350``, AffineTransform ``:422``, ChainTransform ``:504``,
+ExpTransform ``:629``, IndependentTransform ``:678``, PowerTransform
+``:773``, ReshapeTransform ``:837``, SigmoidTransform ``:960``,
+SoftmaxTransform ``:1003``, StackTransform ``:1059``,
+StickBreakingTransform ``:1179``, TanhTransform ``:1245``).
+
+Pure-jnp bijector algebra: forward / inverse / log-det-Jacobian pairs with
+shape propagation, composing via ChainTransform and lifting over batch
+dims via IndependentTransform. Consumed by TransformedDistribution."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distributions import Tensor, _t, _wrap
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class Transform:
+    """Bijector base (reference ``transform.py:59``)."""
+
+    _is_injective = True
+    # how many rightmost dims one application consumes (event ndim)
+    _domain_event_ndim = 0
+    _codomain_event_ndim = 0
+
+    def forward(self, x):
+        return _wrap(self._forward(_t(x)))
+
+    def inverse(self, y):
+        return _wrap(self._inverse(_t(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _wrap(self._forward_log_det_jacobian(_t(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        y = _t(y)
+        return _wrap(-self._forward_log_det_jacobian(self._inverse(y)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # ---- jnp-level implementations (subclasses override) ----
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def __call__(self, x):
+        from .transformed_distribution import TransformedDistribution
+        from .distributions import Distribution
+        if isinstance(x, Distribution):
+            return TransformedDistribution(x, [self])
+        return self.forward(x)
+
+
+class AbsTransform(Transform):
+    """y = |x| (reference ``:350``). Not injective: ``inverse`` returns
+    the positive preimage like the reference."""
+
+    _is_injective = False
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def inverse_log_det_jacobian(self, y):
+        return _wrap(jnp.zeros_like(_t(y)))
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (reference ``:422``)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    """y = exp(x) (reference ``:629``)."""
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power on the positive reals (reference ``:773``)."""
+
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) (reference ``:960``)."""
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (reference ``:1245``)."""
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) = 2*(log2 - x - softplus(-2x)), numerically
+        # stable for large |x| (same identity as the reference)
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class ChainTransform(Transform):
+    """Composition t_n(...t_1(x)) (reference ``:504``)."""
+
+    def __init__(self, transforms):
+        if not isinstance(transforms, (list, tuple)) or not transforms:
+            raise TypeError("ChainTransform expects a non-empty sequence "
+                            "of Transforms")
+        for t in transforms:
+            if not isinstance(t, Transform):
+                raise TypeError(f"not a Transform: {t!r}")
+        self.transforms = list(transforms)
+        self._is_injective = all(t._is_injective for t in transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._forward_log_det_jacobian(x)
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return tuple(shape)
+
+
+class IndependentTransform(Transform):
+    """Reinterpret ``reinterpreted_batch_ndims`` rightmost batch dims as
+    event dims: the log-det sums over them (reference ``:678``)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        if not isinstance(base, Transform):
+            raise TypeError("base must be a Transform")
+        self.base = base
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ld = self.base._forward_log_det_jacobian(x)
+        axes = tuple(range(-self.reinterpreted_batch_ndims, 0))
+        return jnp.sum(ld, axis=axes) if axes else ld
+
+    def forward_shape(self, shape):
+        return self.base.forward_shape(shape)
+
+    def inverse_shape(self, shape):
+        return self.base.inverse_shape(shape)
+
+
+class ReshapeTransform(Transform):
+    """Reshape the event part (reference ``:837``)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        import numpy as _np
+        if int(_np.prod(self.in_event_shape)) != int(
+                _np.prod(self.out_event_shape)):
+            raise ValueError("in/out event shapes must have equal size")
+
+    def _batch(self, shape, event):
+        n = len(shape) - len(event)
+        if n < 0 or tuple(shape[n:]) != tuple(event):
+            raise ValueError(f"shape {shape} does not end in {event}")
+        return tuple(shape[:n])
+
+    def _forward(self, x):
+        b = self._batch(x.shape, self.in_event_shape)
+        return jnp.reshape(x, b + self.out_event_shape)
+
+    def _inverse(self, y):
+        b = self._batch(y.shape, self.out_event_shape)
+        return jnp.reshape(y, b + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        b = self._batch(x.shape, self.in_event_shape)
+        return jnp.zeros(b, jnp.float32)
+
+    def forward_shape(self, shape):
+        return self._batch(shape, self.in_event_shape) \
+            + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        return self._batch(shape, self.out_event_shape) \
+            + self.in_event_shape
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last dim (reference ``:1003``; like the
+    reference, not a bijection — no log-det)."""
+
+    _is_injective = False
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        x = jnp.log(y)
+        return x - x.mean(axis=-1, keepdims=True)
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to slice i along ``axis`` (reference
+    ``:1059``)."""
+
+    def __init__(self, transforms, axis=0):
+        if not isinstance(transforms, (list, tuple)) or not transforms:
+            raise TypeError("StackTransform expects a non-empty sequence")
+        for t in transforms:
+            if not isinstance(t, Transform):
+                raise TypeError(f"not a Transform: {t!r}")
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, fn_name, v):
+        parts = jnp.split(v, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, fn_name)(jnp.squeeze(p, self.axis))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map("_forward_log_det_jacobian", x)
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^K -> (K+1)-simplex via stick breaking (reference
+    ``:1179``)."""
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zpad = jnp.concatenate(
+            [z, jnp.ones(x.shape[:-1] + (1,), x.dtype)], axis=-1)
+        one_minus = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), 1 - z], axis=-1)
+        return zpad * jnp.cumprod(one_minus, axis=-1)
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        k = y_crop.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        rem = 1.0 - jnp.cumsum(y_crop, axis=-1) + y_crop
+        z = y_crop / rem
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        one_minus = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), 1 - z[..., :-1]],
+            axis=-1)
+        rem = jnp.cumprod(one_minus, axis=-1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(rem), axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)

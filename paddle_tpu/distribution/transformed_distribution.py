@@ -1,0 +1,52 @@
+"""TransformedDistribution (reference
+``python/paddle/distribution/transformed_distribution.py:24``): push a
+base distribution through a chain of Transforms; ``log_prob`` applies the
+change-of-variables formula with the inverse log-det Jacobian."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distributions import Distribution, Tensor, _t, _wrap
+from .transform import ChainTransform, Transform
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        if not isinstance(base, Distribution):
+            raise TypeError("base must be a Distribution")
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        if not isinstance(transforms, (list, tuple)) or not transforms:
+            raise TypeError("transforms must be a non-empty sequence of "
+                            "Transforms")
+        for t in transforms:
+            if not isinstance(t, Transform):
+                raise TypeError(f"not a Transform: {t!r}")
+        self.base = base
+        self.transforms = list(transforms)
+        chain = ChainTransform(self.transforms)
+        shape = chain.forward_shape(
+            tuple(base.batch_shape) + tuple(base.event_shape))
+        super().__init__(batch_shape=shape, event_shape=())
+        self._chain = chain
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self._chain.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self._chain.forward(x)
+
+    def log_prob(self, value):
+        y = _t(value)
+        lp = 0.0
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            lp = lp - t._forward_log_det_jacobian(x)
+            y = x
+        base_lp = _t(self.base.log_prob(_wrap(y)))
+        return _wrap(base_lp + lp)
+
+    def prob(self, value):
+        return _wrap(jnp.exp(_t(self.log_prob(value))))

@@ -10,14 +10,15 @@ from .conv import (  # noqa: F401
     conv3d_transpose,
 )
 from .pooling import (  # noqa: F401
-    max_unpool2d,
+    max_unpool1d, max_unpool2d, max_unpool3d,
+    fractional_max_pool2d, fractional_max_pool3d,
     max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
     adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
 )
 from .norm import (  # noqa: F401
     layer_norm, rms_norm, batch_norm, instance_norm, group_norm,
-    local_response_norm,
+    local_response_norm, spectral_norm,
 )
 from .loss import (  # noqa: F401
     cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,

@@ -224,3 +224,39 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
         return (v.astype(jnp.float32) / div).astype(v.dtype)
 
     return apply("local_response_norm", impl, x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Reference ``spectral_norm`` op
+    (``python/paddle/static/nn/common.py`` spectral_norm;
+    ``paddle/phi/kernels/impl/spectral_norm_kernel_impl.h``): normalize a
+    weight by its largest singular value, estimated with ``power_iters``
+    rounds of power iteration on W reshaped to [shape[dim], -1].
+
+    Deterministic u/v start vectors (unit-normalized ones) keep the op
+    functional — the reference keeps persistent U/V buffers; the layer
+    wrapper owns those here."""
+    import jax.numpy as jnp
+
+    from ...core.dispatch import apply
+
+    def impl(w):
+        d = dim if dim >= 0 else w.ndim + dim
+        perm = [d] + [i for i in range(w.ndim) if i != d]
+        mat = jnp.transpose(w, perm).reshape(w.shape[d], -1)
+        h, wdim = mat.shape
+        u = jnp.full((h,), 1.0 / jnp.sqrt(float(h)), jnp.float32)
+        v = None
+        m = mat.astype(jnp.float32)
+        for _ in range(max(1, int(power_iters))):
+            v = m.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = m @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ (m @ v)
+        out = (m / jnp.maximum(sigma, eps)).astype(w.dtype)
+        inv = [perm.index(i) for i in range(w.ndim)]
+        return jnp.transpose(
+            out.reshape([w.shape[p] for p in perm]), inv)
+
+    return apply("spectral_norm", impl, weight)

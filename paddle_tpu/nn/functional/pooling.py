@@ -320,3 +320,191 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
         return flat.reshape(n, c, oh, ow)
 
     return apply("max_unpool2d", impl, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Reference ``unpool`` 1d variant (scatter by recorded indices)."""
+    import jax.numpy as jnp
+
+    from ...core.dispatch import apply
+
+    if data_format != "NCL":
+        raise ValueError("max_unpool1d supports NCL")
+    ks = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    st = ks if stride is None else (
+        stride if isinstance(stride, int) else stride[0])
+    pd = padding if isinstance(padding, int) else padding[0]
+
+    def impl(v, idx):
+        n, c, l = v.shape
+        ol = (output_size[-1] if output_size is not None
+              else (l - 1) * st - 2 * pd + ks)
+        flat = jnp.zeros((n, c, ol), v.dtype)
+        bn = jnp.arange(n)[:, None, None]
+        cn = jnp.arange(c)[None, :, None]
+        return flat.at[bn, cn, idx.astype(jnp.int32)].set(v)
+
+    return apply("max_unpool1d", impl, x, indices)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """Reference ``unpool3d`` op: scatter pooled values back to the flat
+    per-volume positions from ``max_pool3d(..., return_mask=True)``."""
+    import jax.numpy as jnp
+
+    from ...core.dispatch import apply
+
+    if data_format != "NCDHW":
+        raise ValueError("max_unpool3d supports NCDHW")
+
+    def _tup3(v):
+        return (v,) * 3 if isinstance(v, int) else tuple(v)
+
+    ks, pd = _tup3(kernel_size), _tup3(padding)
+    st = ks if stride is None else _tup3(stride)
+
+    def impl(v, idx):
+        n, c, d, h, w = v.shape
+        if output_size is not None:
+            od, oh, ow = output_size[-3], output_size[-2], output_size[-1]
+        else:
+            od = (d - 1) * st[0] - 2 * pd[0] + ks[0]
+            oh = (h - 1) * st[1] - 2 * pd[1] + ks[1]
+            ow = (w - 1) * st[2] - 2 * pd[2] + ks[2]
+        flat = jnp.zeros((n, c, od * oh * ow), v.dtype)
+        upd = jnp.reshape(v, (n, c, -1))
+        ii = idx.reshape(n, c, -1).astype(jnp.int32)
+        bn = jnp.arange(n)[:, None, None]
+        cn = jnp.arange(c)[None, :, None]
+        flat = flat.at[bn, cn, ii].set(upd)
+        return flat.reshape(n, c, od, oh, ow)
+
+    return apply("max_unpool3d", impl, x, indices)
+
+
+def _fractional_starts(in_size, out_size, u):
+    """Pseudo-random pooling boundaries (Graham, Fractional Max-Pooling;
+    reference ``fractional_max_pool2d`` kernel): region i spans
+    [a_i, a_{i+1}) with a_i = ceil(alpha * (i + u)) - 1, a_0 = 0."""
+    import numpy as np
+    alpha = in_size / out_size
+    idx = np.arange(1, out_size, dtype=np.float64)
+    starts = np.ceil(alpha * (idx + u)).astype(np.int64) - 1
+    starts = np.concatenate([[0], starts])
+    ends = np.concatenate([starts[1:], [in_size]])
+    return starts, np.maximum(ends - starts, 1)
+
+
+_frac_generator = None
+
+
+def _frac_rng():
+    global _frac_generator
+    if _frac_generator is None:
+        import numpy as np
+
+        from ... import core
+        _frac_generator = np.random.default_rng(
+            core.state.default_rng._seed)
+    return _frac_generator
+
+
+def _fractional_pool_nd(v, out_sz, u, kernel_caps):
+    """Gather every fractional region of every spatial axis, then reduce:
+    returns (max, flat argmax index over the ORIGINAL spatial dims)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    nd = len(out_sz)
+    spatial = v.shape[2:]
+    starts_all, lens_max = [], []
+    cur = v
+    for i in range(nd):
+        axis = 2 + 2 * i  # earlier axes each expanded into [out, L]
+        in_size = cur.shape[axis]
+        starts, ln = _fractional_starts(in_size, out_sz[i], u)
+        if kernel_caps and kernel_caps[i]:
+            ln = np.minimum(ln, kernel_caps[i])
+        L = int(ln.max())
+        gm = np.minimum(starts[:, None] + np.arange(L)[None, :],
+                        in_size - 1)
+        cur = jnp.take(cur, jnp.asarray(gm.reshape(-1)), axis=axis)
+        shp = list(cur.shape)
+        shp[axis:axis + 1] = [out_sz[i], L]
+        cur = cur.reshape(shp)
+        vmask = np.arange(L)[None, :] < ln[:, None]
+        ms = [1] * len(shp)
+        ms[axis], ms[axis + 1] = out_sz[i], L
+        cur = jnp.where(jnp.asarray(vmask).reshape(ms), cur, -jnp.inf)
+        starts_all.append(starts)
+        lens_max.append(L)
+    # [N, C, o1, L1, o2, L2, ...] -> L dims last, flattened
+    perm = ([0, 1] + [2 + 2 * i for i in range(nd)]
+            + [3 + 2 * i for i in range(nd)])
+    cur = jnp.transpose(cur, perm)
+    flat = cur.reshape(cur.shape[:2 + nd] + (-1,))
+    out = jnp.max(flat, axis=-1)
+    arg = jnp.argmax(flat, axis=-1)
+    offs, rem = [], arg
+    for L in reversed(lens_max):
+        offs.append(rem % L)
+        rem = rem // L
+    offs = offs[::-1]
+    flat_idx = jnp.zeros(out.shape, jnp.int32)
+    for i in range(nd):
+        shape = [1] * (2 + nd)
+        shape[2 + i] = out_sz[i]
+        pos = (jnp.asarray(starts_all[i], jnp.int32).reshape(shape)
+               + offs[i].astype(jnp.int32))
+        flat_idx = flat_idx * spatial[i] + pos
+    return out, flat_idx
+
+
+def _fractional_pool(name, x, nd, output_size, kernel_size, random_u,
+                     return_mask):
+    from ... import core
+    from ...core.dispatch import apply
+
+    if random_u is None:
+        # fresh u per call (the reference redraws per invocation); stream
+        # seeded from paddle.seed for reproducibility. Under jit capture
+        # the draw happens at trace time and is baked into the program —
+        # pass random_u explicitly for traced-fresh randomness.
+        random_u = float(_frac_rng().uniform(0.05, 0.95))
+    u = float(random_u)
+    if not 0.0 < u < 1.0:
+        raise ValueError(f"random_u must be in (0, 1), got {u}")
+    out_sz = ((output_size,) * nd if isinstance(output_size, int)
+              else tuple(output_size))
+    caps = None
+    if kernel_size is not None:
+        caps = ((kernel_size,) * nd if isinstance(kernel_size, int)
+                else tuple(kernel_size))
+
+    def impl(v):
+        out, _ = _fractional_pool_nd(v, out_sz, u, caps)
+        return out.astype(v.dtype)
+
+    def impl_mask(v):
+        out, idx = _fractional_pool_nd(v, out_sz, u, caps)
+        return out.astype(v.dtype), idx
+
+    if return_mask:
+        return apply(name, impl_mask, x)
+    return apply(name, impl, x)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Reference ``fractional_max_pool2d`` (ops.yaml): pseudo-random
+    fractional pooling regions; ``random_u`` pins the sequence."""
+    return _fractional_pool("fractional_max_pool2d", x, 2, output_size,
+                            kernel_size, random_u, return_mask)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_pool("fractional_max_pool3d", x, 3, output_size,
+                            kernel_size, random_u, return_mask)

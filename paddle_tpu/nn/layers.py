@@ -536,10 +536,26 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
+    """Reference ``nn.SpectralNorm`` (``python/paddle/nn/layer/norm.py``):
+    forward(weight) returns the spectrally-normalized weight via power
+    iteration (functional ``F.spectral_norm``)."""
+
     def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
                  dtype="float32"):
         super().__init__()
-        raise NotImplementedError("SpectralNorm lands with the GAN toolkit")
+        self._weight_shape = tuple(weight_shape)
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+
+    def forward(self, weight):
+        from .functional import spectral_norm
+        if tuple(weight.shape) != self._weight_shape:
+            raise ValueError(
+                f"SpectralNorm: expected weight shape "
+                f"{self._weight_shape}, got {tuple(weight.shape)}")
+        return spectral_norm(weight, dim=self._dim,
+                             power_iters=self._power_iters, eps=self._eps)
 
 
 # --------------------------------------------------------------------------

@@ -70,6 +70,12 @@ def _device_kind() -> str:
     return str(getattr(d, "device_kind", d.platform))
 
 
+def _same_candidate(a, b):
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return list(a) == list(b)
+    return a == b
+
+
 def autotune(op: str, signature: str, candidates: Sequence,
              run: Callable, repeats: int = 3):
     """Pick the fastest candidate for ``run(candidate)`` and cache it.
@@ -81,11 +87,15 @@ def autotune(op: str, signature: str, candidates: Sequence,
     key = f"{_device_kind()}|{op}|{signature}"
     cache = _load_cache()
     if key in cache:
-        idx = cache[key]
-        if 0 <= idx < len(candidates):
-            return candidates[idx]
+        # the cached WINNER (value, not index: an index would silently
+        # remap whenever the candidate list evolves); honor it only while
+        # it is still a known candidate
+        cached = cache[key]
+        for cand in candidates:
+            if _same_candidate(cand, cached):
+                return cand
     best, best_t = None, float("inf")
-    for i, cand in enumerate(candidates):
+    for cand in candidates:
         try:
             run(cand)  # compile + warm
             ts = []
@@ -97,11 +107,11 @@ def autotune(op: str, signature: str, candidates: Sequence,
         except Exception:
             continue
         if t < best_t:
-            best, best_t, best_i = cand, t, i
+            best, best_t = cand, t
     if best is None:
         raise RuntimeError(f"autotune: every candidate failed for {op} "
                            f"{signature}")
-    cache[key] = best_i
+    cache[key] = list(best) if isinstance(best, (list, tuple)) else best
     _store_cache()
     return best
 

@@ -257,3 +257,53 @@ def identity_loss(x, reduction="none"):
     if red == "sum":
         return jnp.sum(x)
     return x
+
+
+def auc(input, label, stat_pos=None, stat_neg=None, curve="ROC",
+        num_thresholds=4095, slide_steps=0, name=None):
+    """Reference ``auc`` op (ops.yaml ``auc``; static surface
+    ``python/paddle/static/nn/metric.py`` auc): histogram-bucketed AUC
+    with running positive/negative stat buffers.
+
+    input: [N, 2] probabilities (column 1 = positive class) or [N, 1];
+    label: [N, 1] or [N] in {0, 1}. Returns
+    (auc_value, stat_pos_out, stat_neg_out).
+    """
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    if curve != "ROC":
+        raise NotImplementedError(f"auc: curve {curve!r} (ROC only)")
+    if slide_steps:
+        raise NotImplementedError(
+            "auc: slide_steps (sliding-window stats) is not implemented — "
+            "pass slide_steps=0 and manage windows by resetting "
+            "stat_pos/stat_neg")
+    nbins = num_thresholds + 1
+    args = [input, label]
+    has_stats = stat_pos is not None
+    if has_stats:
+        args += [stat_pos, stat_neg]
+
+    def impl(pred, lab, *stats):
+        p = pred[:, -1] if pred.ndim == 2 else pred
+        y = lab.reshape(-1).astype(jnp.float32)
+        idx = jnp.clip((p * num_thresholds).astype(jnp.int32), 0,
+                       num_thresholds)
+        pos = jnp.zeros((nbins,), jnp.float32).at[idx].add(y)
+        neg = jnp.zeros((nbins,), jnp.float32).at[idx].add(1.0 - y)
+        if stats:
+            pos = pos + stats[0].reshape(-1).astype(jnp.float32)
+            neg = neg + stats[1].reshape(-1).astype(jnp.float32)
+        # trapezoid in ROC space, thresholds descending: x = FP, y = TP;
+        # area = sum dFP * (TP - dTP/2) (reference auc kernel)
+        tot_pos = jnp.cumsum(pos[::-1])
+        tot_neg = jnp.cumsum(neg[::-1])
+        d_tp = jnp.diff(jnp.concatenate([jnp.zeros(1), tot_pos]))
+        d_fp = jnp.diff(jnp.concatenate([jnp.zeros(1), tot_neg]))
+        area = jnp.sum(d_fp * (tot_pos - 0.5 * d_tp))
+        denom = jnp.maximum(tot_pos[-1] * tot_neg[-1], 1e-12)
+        return area / denom, pos.astype(jnp.int64), neg.astype(jnp.int64)
+
+    return apply("auc", impl, *args)

@@ -110,4 +110,9 @@ class Cifar100(Cifar10):
     _test_members = ["test"]
 
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+from .folder import DatasetFolder, ImageFolder  # noqa: E402,F401
+from .flowers import Flowers  # noqa: E402,F401
+from .voc2012 import VOC2012  # noqa: E402,F401
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100",
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]

@@ -1,0 +1,57 @@
+"""Oxford Flowers-102 (reference
+``python/paddle/vision/datasets/flowers.py:34``): images tarball +
+``imagelabels.mat`` + ``setid.mat``. No network egress here, so the three
+files must be local (download=False semantics)."""
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+from . import _require
+
+MODE_KEYS = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+
+class Flowers(Dataset):
+    """Items are (image HWC uint8, label int64 in [0, 102))."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend=None):
+        if mode not in MODE_KEYS:
+            raise ValueError(f"mode must be one of {sorted(MODE_KEYS)}, "
+                             f"got {mode!r}")
+        self.mode = mode
+        self.transform = transform
+        data_file = _require(data_file, "flowers images (102flowers.tgz)")
+        label_file = _require(label_file, "flowers imagelabels.mat")
+        setid_file = _require(setid_file, "flowers setid.mat")
+
+        from scipy.io import loadmat
+        self.labels = loadmat(label_file)["labels"][0]  # 1-based, per file
+        self.indexes = loadmat(setid_file)[MODE_KEYS[mode]][0]  # 1-based
+
+        # keep the tar handle; images decode lazily per access
+        self.data_tar = tarfile.open(data_file)
+        self._members = {os.path.basename(m.name): m
+                         for m in self.data_tar.getmembers()
+                         if m.name.endswith(".jpg")}
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        index = int(self.indexes[idx])
+        fname = f"image_{index:05d}.jpg"
+        with self.data_tar.extractfile(self._members[fname]) as f:
+            img = np.asarray(Image.open(io.BytesIO(f.read()))
+                             .convert("RGB"))
+        if self.transform is not None:
+            img = self.transform(img)
+        label = np.int64(self.labels[index - 1] - 1)
+        return img, label
+
+    def __len__(self):
+        return len(self.indexes)

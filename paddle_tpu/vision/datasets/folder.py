@@ -1,0 +1,114 @@
+"""``DatasetFolder`` / ``ImageFolder`` (reference
+``python/paddle/vision/datasets/folder.py:41,274``): directory-tree image
+datasets — one class per subdirectory (DatasetFolder) or a flat unlabeled
+listing (ImageFolder). Decoding via PIL (no cv2 in this environment)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...io import Dataset
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                  ".tif", ".tiff", ".webp")
+
+
+def default_loader(path):
+    from PIL import Image
+    with Image.open(path) as img:
+        return np.asarray(img.convert("RGB"))
+
+
+def has_valid_extension(filename, extensions=IMG_EXTENSIONS):
+    return filename.lower().endswith(tuple(extensions))
+
+
+def make_dataset(directory, class_to_idx, extensions=None,
+                 is_valid_file=None):
+    """(path, class_index) samples for every valid file, reference
+    ``folder.py`` make_dataset semantics."""
+    if (extensions is None) == (is_valid_file is None):
+        raise ValueError("exactly one of extensions / is_valid_file "
+                         "must be given")
+    if is_valid_file is None:
+        def is_valid_file(p):
+            return has_valid_extension(p, extensions)
+    samples = []
+    for cls in sorted(class_to_idx):
+        d = os.path.join(directory, cls)
+        if not os.path.isdir(d):
+            continue
+        for root, _, names in sorted(os.walk(d, followlinks=True)):
+            for name in sorted(names):
+                path = os.path.join(root, name)
+                if is_valid_file(path):
+                    samples.append((path, class_to_idx[cls]))
+    return samples
+
+
+class DatasetFolder(Dataset):
+    """Reference ``folder.py:41``: root/<class_x>/xxx.png layout; items
+    are (image, class_index); ``classes``/``class_to_idx`` exposed."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or default_loader
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        classes = sorted(e.name for e in os.scandir(root) if e.is_dir())
+        if not classes:
+            raise RuntimeError(f"no class folders found in {root!r}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = make_dataset(root, self.class_to_idx, extensions,
+                                    is_valid_file)
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root!r}")
+        self.targets = [t for _, t in self.samples]
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Reference ``folder.py:274``: flat recursive listing, items are
+    [image] (no labels)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or default_loader
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return has_valid_extension(p, extensions)
+        samples = []
+        for r, _, names in sorted(os.walk(root, followlinks=True)):
+            for name in sorted(names):
+                p = os.path.join(r, name)
+                if is_valid_file(p):
+                    samples.append(p)
+        if not samples:
+            raise RuntimeError(f"no valid files found under {root!r}")
+        self.samples = samples
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,53 @@
+"""VOC2012 segmentation dataset (reference
+``python/paddle/vision/datasets/voc2012.py:30``): items are
+(image HWC uint8, segmentation mask HW uint8) read from the standard
+VOCtrainval tar. No network egress: the tar must be local."""
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+from ...io import Dataset
+from . import _require
+
+_VOC_ROOT = "VOCdevkit/VOC2012/"
+_SETS = {"train": "train", "valid": "val", "test": "trainval"}
+
+
+class VOC2012(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if mode not in _SETS:
+            raise ValueError(f"mode must be one of {sorted(_SETS)}, "
+                             f"got {mode!r}")
+        self.mode = mode
+        self.transform = transform
+        data_file = _require(data_file, "VOC2012 tar (VOCtrainval)")
+
+        import tarfile
+        self.data_tar = tarfile.open(data_file)
+        self._members = {m.name: m for m in self.data_tar.getmembers()}
+        setfile = (_VOC_ROOT + "ImageSets/Segmentation/"
+                   + _SETS[mode] + ".txt")
+        with self.data_tar.extractfile(self._members[setfile]) as f:
+            self.names = [ln.strip() for ln in
+                          f.read().decode().splitlines() if ln.strip()]
+
+    def _read(self, path):
+        from PIL import Image
+        with self.data_tar.extractfile(self._members[path]) as f:
+            return Image.open(io.BytesIO(f.read()))
+
+    def __getitem__(self, idx):
+        name = self.names[idx]
+        img = np.asarray(self._read(
+            _VOC_ROOT + f"JPEGImages/{name}.jpg").convert("RGB"))
+        mask = np.asarray(self._read(
+            _VOC_ROOT + f"SegmentationClass/{name}.png"))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self.names)

@@ -1,19 +1,38 @@
 """``paddle.vision.models`` parity (reference ``python/paddle/vision/models/``:
-lenet.py, resnet.py, vgg.py, alexnet.py, mobilenetv2.py). Same
-architectures and constructor surfaces; ``pretrained=True`` is rejected
-(no weight hub in this environment — load weights with
-``paddle.load``/``set_state_dict`` instead).
+all 12 in-tree families). Same architectures and constructor surfaces;
+``pretrained=True`` is rejected (no weight hub in this environment — load
+weights with ``paddle.load``/``set_state_dict`` instead).
 """
 from .lenet import LeNet
 from .resnet import (ResNet, BasicBlock, BottleneckBlock, resnet18,
                      resnet34, resnet50, resnet101, resnet152)
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .alexnet import AlexNet, alexnet
+from .mobilenetv1 import MobileNetV1, mobilenet_v1
 from .mobilenetv2 import MobileNetV2, mobilenet_v2
+from .mobilenetv3 import (MobileNetV3Small, MobileNetV3Large,
+                          mobilenet_v3_small, mobilenet_v3_large)
+from .densenet import (DenseNet, densenet121, densenet161, densenet169,
+                       densenet201, densenet264)
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
+from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_25,
+                           shufflenet_v2_x0_33, shufflenet_v2_x0_5,
+                           shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+                           shufflenet_v2_x2_0, shufflenet_v2_swish)
+from .googlenet import GoogLeNet, googlenet
+from .inceptionv3 import InceptionV3, inception_v3
 
 __all__ = [
     "LeNet", "ResNet", "BasicBlock", "BottleneckBlock", "resnet18",
     "resnet34", "resnet50", "resnet101", "resnet152", "VGG", "vgg11",
-    "vgg13", "vgg16", "vgg19", "AlexNet", "alexnet", "MobileNetV2",
-    "mobilenet_v2",
+    "vgg13", "vgg16", "vgg19", "AlexNet", "alexnet",
+    "MobileNetV1", "mobilenet_v1", "MobileNetV2", "mobilenet_v2",
+    "MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+    "mobilenet_v3_large", "DenseNet", "densenet121", "densenet161",
+    "densenet169", "densenet201", "densenet264", "SqueezeNet",
+    "squeezenet1_0", "squeezenet1_1", "ShuffleNetV2",
+    "shufflenet_v2_x0_25", "shufflenet_v2_x0_33", "shufflenet_v2_x0_5",
+    "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+    "shufflenet_v2_swish", "GoogLeNet", "googlenet", "InceptionV3",
+    "inception_v3",
 ]

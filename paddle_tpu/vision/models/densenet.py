@@ -1,0 +1,133 @@
+"""DenseNet (reference ``python/paddle/vision/models/densenet.py``:
+DenseLayer/DenseBlock/TransitionLayer/DenseNet + densenet121..264).
+Dense connectivity: each layer consumes the concat of all earlier feature
+maps in its block — the concat-heavy pattern XLA fuses well on TPU."""
+from __future__ import annotations
+
+from ... import nn, ops
+
+_CONFIGS = {
+    121: ((6, 12, 24, 16), 32),
+    161: ((6, 12, 36, 24), 48),
+    169: ((6, 12, 32, 32), 32),
+    201: ((6, 12, 48, 32), 32),
+    264: ((6, 12, 64, 48), 32),
+}
+
+
+class _BNReLUConv(nn.Sequential):
+    def __init__(self, cin, cout, k, stride=1, pad=0):
+        super().__init__(
+            nn.BatchNorm2D(cin), nn.ReLU(),
+            nn.Conv2D(cin, cout, k, stride=stride, padding=pad,
+                      bias_attr=False))
+
+
+class DenseLayer(nn.Layer):
+    def __init__(self, cin, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.bottleneck = _BNReLUConv(cin, bn_size * growth_rate, 1)
+        self.conv = _BNReLUConv(bn_size * growth_rate, growth_rate, 3,
+                                pad=1)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        y = self.conv(self.bottleneck(x))
+        if self.dropout is not None:
+            y = self.dropout(y)
+        return ops.concat([x, y], axis=1)
+
+
+class DenseBlock(nn.Layer):
+    def __init__(self, cin, num_layers, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.layers = nn.LayerList([
+            DenseLayer(cin + i * growth_rate, growth_rate, bn_size,
+                       dropout) for i in range(num_layers)])
+        self.out_channels = cin + num_layers * growth_rate
+
+    def forward(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+class TransitionLayer(nn.Layer):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.conv = _BNReLUConv(cin, cout, 1)
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(x))
+
+
+class DenseNet(nn.Layer):
+    """Reference ``densenet.py`` DenseNet(layers, bn_size, dropout,
+    num_classes, with_pool)."""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        if layers not in _CONFIGS:
+            raise ValueError(
+                f"supported layers are {sorted(_CONFIGS)}, got {layers}")
+        block_cfg, growth = _CONFIGS[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        c = 2 * growth
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, c, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(c), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        blocks = []
+        for i, n in enumerate(block_cfg):
+            blk = DenseBlock(c, n, growth, bn_size, dropout)
+            blocks.append(blk)
+            c = blk.out_channels
+            if i != len(block_cfg) - 1:
+                blocks.append(TransitionLayer(c, c // 2))
+                c //= 2
+        self.blocks = nn.Sequential(*blocks)
+        self.final = nn.Sequential(nn.BatchNorm2D(c), nn.ReLU())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.final(self.blocks(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = ops.flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+def _densenet(layers, pretrained, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled; load them "
+                         "with paddle.load + set_state_dict")
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)

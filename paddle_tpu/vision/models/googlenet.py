@@ -1,0 +1,101 @@
+"""GoogLeNet / Inception v1 (reference
+``python/paddle/vision/models/googlenet.py``: Inception/GoogLeNet +
+googlenet). Forward returns (out, aux1, aux2) like the reference (the aux
+classifiers feed the deep-supervision loss during training)."""
+from __future__ import annotations
+
+from ... import nn, ops
+
+
+class _ConvReLU(nn.Sequential):
+    def __init__(self, cin, cout, k, stride=1, pad=0):
+        super().__init__(
+            nn.Conv2D(cin, cout, k, stride=stride, padding=pad),
+            nn.ReLU())
+
+
+class Inception(nn.Layer):
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _ConvReLU(cin, c1, 1)
+        self.b3 = nn.Sequential(_ConvReLU(cin, c3r, 1),
+                                _ConvReLU(c3r, c3, 3, pad=1))
+        self.b5 = nn.Sequential(_ConvReLU(cin, c5r, 1),
+                                _ConvReLU(c5r, c5, 5, pad=2))
+        self.bp = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _ConvReLU(cin, proj, 1))
+
+    def forward(self, x):
+        return ops.concat(
+            [self.b1(x), self.b3(x), self.b5(x), self.bp(x)], axis=1)
+
+
+class _AuxHead(nn.Layer):
+    def __init__(self, cin, num_classes):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(4)
+        self.conv = _ConvReLU(cin, 128, 1)
+        self.fc1 = nn.Linear(128 * 16, 1024)
+        self.relu = nn.ReLU()
+        self.drop = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x))
+        x = self.relu(self.fc1(ops.flatten(x, 1)))
+        return self.fc2(self.drop(x))
+
+
+class GoogLeNet(nn.Layer):
+    """Reference GoogLeNet(num_classes, with_pool); forward returns
+    (main_logits, aux1_logits, aux2_logits)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        pool = lambda: nn.MaxPool2D(3, stride=2, padding=1)  # noqa: E731
+
+        self.stem = nn.Sequential(
+            _ConvReLU(3, 64, 7, stride=2, pad=3), pool(),
+            _ConvReLU(64, 64, 1), _ConvReLU(64, 192, 3, pad=1), pool())
+        self.i3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = pool()
+        self.i4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = pool()
+        self.i5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
+
+    def forward(self, x):
+        x = self.i3b(self.i3a(self.stem(x)))
+        x = self.i4a(self.pool3(x))
+        aux1 = self.aux1(x) if self.num_classes > 0 else None
+        x = self.i4d(self.i4c(self.i4b(x)))
+        aux2 = self.aux2(x) if self.num_classes > 0 else None
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = ops.flatten(x, 1)
+            x = self.fc(self.drop(x))
+        return x, aux1, aux2
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled; load them "
+                         "with paddle.load + set_state_dict")
+    return GoogLeNet(**kwargs)

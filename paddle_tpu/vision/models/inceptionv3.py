@@ -1,0 +1,162 @@
+"""Inception v3 (reference
+``python/paddle/vision/models/inceptionv3.py``: InceptionStem /
+InceptionA-E / InceptionV3 + inception_v3). Factorized convolutions
+(1xN / Nx1 pairs) — all dense convs, MXU-friendly."""
+from __future__ import annotations
+
+from ... import nn, ops
+
+
+class _ConvBN(nn.Sequential):
+    def __init__(self, cin, cout, k, stride=1, pad=0):
+        super().__init__(
+            nn.Conv2D(cin, cout, k, stride=stride, padding=pad,
+                      bias_attr=False),
+            nn.BatchNorm2D(cout), nn.ReLU())
+
+
+class InceptionStem(nn.Sequential):
+    def __init__(self):
+        super().__init__(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, pad=1), nn.MaxPool2D(3, stride=2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+
+
+class InceptionA(nn.Layer):
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.b1 = _ConvBN(cin, 64, 1)
+        self.b5 = nn.Sequential(_ConvBN(cin, 48, 1),
+                                _ConvBN(48, 64, 5, pad=2))
+        self.b3 = nn.Sequential(_ConvBN(cin, 64, 1),
+                                _ConvBN(64, 96, 3, pad=1),
+                                _ConvBN(96, 96, 3, pad=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _ConvBN(cin, pool_features, 1))
+
+    def forward(self, x):
+        return ops.concat(
+            [self.b1(x), self.b5(x), self.b3(x), self.bp(x)], axis=1)
+
+
+class InceptionB(nn.Layer):
+    """Grid reduction 35 -> 17."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _ConvBN(cin, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_ConvBN(cin, 64, 1),
+                                 _ConvBN(64, 96, 3, pad=1),
+                                 _ConvBN(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return ops.concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class InceptionC(nn.Layer):
+    """Factorized 7x7 branches."""
+
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = _ConvBN(cin, 192, 1)
+        self.b7 = nn.Sequential(
+            _ConvBN(cin, c7, 1),
+            _ConvBN(c7, c7, (1, 7), pad=(0, 3)),
+            _ConvBN(c7, 192, (7, 1), pad=(3, 0)))
+        self.b7d = nn.Sequential(
+            _ConvBN(cin, c7, 1),
+            _ConvBN(c7, c7, (7, 1), pad=(3, 0)),
+            _ConvBN(c7, c7, (1, 7), pad=(0, 3)),
+            _ConvBN(c7, c7, (7, 1), pad=(3, 0)),
+            _ConvBN(c7, 192, (1, 7), pad=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _ConvBN(cin, 192, 1))
+
+    def forward(self, x):
+        return ops.concat(
+            [self.b1(x), self.b7(x), self.b7d(x), self.bp(x)], axis=1)
+
+
+class InceptionD(nn.Layer):
+    """Grid reduction 17 -> 8."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = nn.Sequential(_ConvBN(cin, 192, 1),
+                                _ConvBN(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _ConvBN(cin, 192, 1),
+            _ConvBN(192, 192, (1, 7), pad=(0, 3)),
+            _ConvBN(192, 192, (7, 1), pad=(3, 0)),
+            _ConvBN(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return ops.concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class InceptionE(nn.Layer):
+    """Expanded-filter-bank output blocks."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = _ConvBN(cin, 320, 1)
+        self.b3_stem = _ConvBN(cin, 384, 1)
+        self.b3_a = _ConvBN(384, 384, (1, 3), pad=(0, 1))
+        self.b3_b = _ConvBN(384, 384, (3, 1), pad=(1, 0))
+        self.b3d_stem = nn.Sequential(_ConvBN(cin, 448, 1),
+                                      _ConvBN(448, 384, 3, pad=1))
+        self.b3d_a = _ConvBN(384, 384, (1, 3), pad=(0, 1))
+        self.b3d_b = _ConvBN(384, 384, (3, 1), pad=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _ConvBN(cin, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return ops.concat(
+            [self.b1(x),
+             ops.concat([self.b3_a(s), self.b3_b(s)], axis=1),
+             ops.concat([self.b3d_a(d), self.b3d_b(d)], axis=1),
+             self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """Reference InceptionV3(num_classes, with_pool); input 299x299."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = InceptionStem()
+        self.blocks = nn.Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160),
+            InceptionC(768, 160), InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.2)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = ops.flatten(x, 1)
+            x = self.fc(self.drop(x))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled; load them "
+                         "with paddle.load + set_state_dict")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,61 @@
+"""MobileNetV1 (reference ``python/paddle/vision/models/mobilenetv1.py``:
+ConvBNLayer/DepthwiseSeparable/MobileNetV1 + mobilenet_v1). Depthwise
+convs lower to XLA grouped convolutions (feature_group_count)."""
+from __future__ import annotations
+
+from ... import nn, ops
+
+
+class ConvBNReLU(nn.Sequential):
+    def __init__(self, cin, cout, k, stride=1, padding=0, groups=1):
+        super().__init__(
+            nn.Conv2D(cin, cout, k, stride=stride, padding=padding,
+                      groups=groups, bias_attr=False),
+            nn.BatchNorm2D(cout), nn.ReLU())
+
+
+class DepthwiseSeparable(nn.Sequential):
+    def __init__(self, cin, cout, stride):
+        super().__init__(
+            ConvBNReLU(cin, cin, 3, stride=stride, padding=1, groups=cin),
+            ConvBNReLU(cin, cout, 1))
+
+
+class MobileNetV1(nn.Layer):
+    """Reference MobileNetV1(scale, num_classes, with_pool)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return int(ch * scale)
+
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + \
+              [(512, 512, 1)] * 5 + [(512, 1024, 2), (1024, 1024, 1)]
+        layers = [ConvBNReLU(3, c(32), 3, stride=2, padding=1)]
+        layers += [DepthwiseSeparable(c(i), c(o), s) for i, o, s in cfg]
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = ops.flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled; load them "
+                         "with paddle.load + set_state_dict")
+    return MobileNetV1(scale=scale, **kwargs)

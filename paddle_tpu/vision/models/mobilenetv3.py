@@ -1,0 +1,152 @@
+"""MobileNetV3 (reference
+``python/paddle/vision/models/mobilenetv3.py``: SqueezeExcitation /
+InvertedResidual / MobileNetV3Small / MobileNetV3Large +
+mobilenet_v3_small / mobilenet_v3_large)."""
+from __future__ import annotations
+
+from ... import nn, ops
+from .mobilenetv2 import _make_divisible
+
+# (kernel, expanded, out, use_se, activation, stride)
+_LARGE = [
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+def _act(name):
+    return nn.Hardswish() if name == "hardswish" else nn.ReLU()
+
+
+class _ConvBNAct(nn.Sequential):
+    def __init__(self, cin, cout, k, stride=1, groups=1, act=None):
+        layers = [nn.Conv2D(cin, cout, k, stride=stride,
+                            padding=(k - 1) // 2, groups=groups,
+                            bias_attr=False),
+                  nn.BatchNorm2D(cout)]
+        if act is not None:
+            layers.append(_act(act))
+        super().__init__(*layers)
+
+
+class SqueezeExcitation(nn.Layer):
+    """Reference ``mobilenetv3.py:52``: avgpool -> fc(relu) ->
+    fc(hardsigmoid) channel gate."""
+
+    def __init__(self, channels, squeeze):
+        super().__init__()
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(channels, squeeze, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(squeeze, channels, 1)
+        self.gate = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.gate(self.fc2(self.relu(self.fc1(self.avgpool(x)))))
+        return x * s
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, cin, expanded, cout, k, use_se, act, stride):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expanded != cin:
+            layers.append(_ConvBNAct(cin, expanded, 1, act=act))
+        layers.append(_ConvBNAct(expanded, expanded, k, stride=stride,
+                                 groups=expanded, act=act))
+        if use_se:
+            layers.append(SqueezeExcitation(
+                expanded, _make_divisible(expanded // 4)))
+        layers.append(_ConvBNAct(expanded, cout, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_channel, scale, num_classes, with_pool):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return _make_divisible(ch * scale)
+
+        cin = c(16)
+        layers = [_ConvBNAct(3, cin, 3, stride=2, act="hardswish")]
+        for k, exp, out, se, act, stride in cfg:
+            layers.append(InvertedResidual(
+                cin, c(exp), c(out), k, se, act, stride))
+            cin = c(out)
+        lastconv = 6 * cin
+        layers.append(_ConvBNAct(cin, lastconv, 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(lastconv, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = ops.flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 1024, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 1280, scale, num_classes, with_pool)
+
+
+def _v3(cls, pretrained, scale, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled; load them "
+                         "with paddle.load + set_state_dict")
+    return cls(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return _v3(MobileNetV3Small, pretrained, scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return _v3(MobileNetV3Large, pretrained, scale, **kwargs)

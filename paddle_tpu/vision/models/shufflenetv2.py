@@ -1,0 +1,152 @@
+"""ShuffleNetV2 (reference ``python/paddle/vision/models/shufflenetv2.py``:
+channel_shuffle/InvertedResidual/InvertedResidualDS/ShuffleNetV2 +
+shufflenet_v2_x0_25..x2_0, shufflenet_v2_swish)."""
+from __future__ import annotations
+
+from ... import nn, ops
+
+_STAGE_REPEATS = (4, 8, 4)
+_STAGE_CHANNELS = {
+    0.25: (24, 24, 48, 96, 512),
+    0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+
+
+def channel_shuffle(x, groups):
+    """Reference ``shufflenetv2.py`` channel_shuffle: interleave channel
+    groups so information crosses the split branches."""
+    n, c, h, w = x.shape
+    x = ops.reshape(x, [n, groups, c // groups, h, w])
+    x = ops.transpose(x, [0, 2, 1, 3, 4])
+    return ops.reshape(x, [n, c, h, w])
+
+
+def _act(act):
+    if act == "swish":
+        return nn.Swish()
+    return nn.ReLU()
+
+
+class _ConvBNAct(nn.Sequential):
+    def __init__(self, cin, cout, k, stride=1, pad=0, groups=1,
+                 act="relu"):
+        layers = [nn.Conv2D(cin, cout, k, stride=stride, padding=pad,
+                            groups=groups, bias_attr=False),
+                  nn.BatchNorm2D(cout)]
+        if act is not None:
+            layers.append(_act(act))
+        super().__init__(*layers)
+
+
+class InvertedResidual(nn.Layer):
+    """stride-1 unit: split channels, transform one half, shuffle."""
+
+    def __init__(self, ch, act):
+        super().__init__()
+        mid = ch // 2
+        self.branch = nn.Sequential(
+            _ConvBNAct(mid, mid, 1, act=act),
+            _ConvBNAct(mid, mid, 3, stride=1, pad=1, groups=mid, act=None),
+            _ConvBNAct(mid, mid, 1, act=act))
+
+    def forward(self, x):
+        x1, x2 = ops.split(x, 2, axis=1)
+        out = ops.concat([x1, self.branch(x2)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class InvertedResidualDS(nn.Layer):
+    """stride-2 (downsample) unit: both branches transform."""
+
+    def __init__(self, cin, cout, act):
+        super().__init__()
+        mid = cout // 2
+        self.branch1 = nn.Sequential(
+            _ConvBNAct(cin, cin, 3, stride=2, pad=1, groups=cin, act=None),
+            _ConvBNAct(cin, mid, 1, act=act))
+        self.branch2 = nn.Sequential(
+            _ConvBNAct(cin, mid, 1, act=act),
+            _ConvBNAct(mid, mid, 3, stride=2, pad=1, groups=mid, act=None),
+            _ConvBNAct(mid, mid, 1, act=act))
+
+    def forward(self, x):
+        out = ops.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    """Reference ShuffleNetV2(scale, act, num_classes, with_pool)."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if scale not in _STAGE_CHANNELS:
+            raise ValueError(f"supported scales are "
+                             f"{sorted(_STAGE_CHANNELS)}, got {scale}")
+        chans = _STAGE_CHANNELS[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv1 = _ConvBNAct(3, chans[0], 3, stride=2, pad=1, act=act)
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        cin = chans[0]
+        for i, reps in enumerate(_STAGE_REPEATS):
+            cout = chans[i + 1]
+            stages.append(InvertedResidualDS(cin, cout, act))
+            stages += [InvertedResidual(cout, act) for _ in range(reps - 1)]
+            cin = cout
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _ConvBNAct(cin, chans[-1], 1, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(chans[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.max_pool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = ops.flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def _shufflenet(scale, act, pretrained, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled; load them "
+                         "with paddle.load + set_state_dict")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "swish", pretrained, **kwargs)

@@ -1,0 +1,80 @@
+"""SqueezeNet (reference ``python/paddle/vision/models/squeezenet.py``:
+MakeFire/SqueezeNet + squeezenet1_0/1_1). Fire modules: 1x1 squeeze then
+parallel 1x1/3x3 expands concatenated on channels."""
+from __future__ import annotations
+
+from ... import nn, ops
+
+
+class Fire(nn.Layer):
+    def __init__(self, cin, squeeze, expand1, expand3):
+        super().__init__()
+        self.squeeze = nn.Sequential(
+            nn.Conv2D(cin, squeeze, 1), nn.ReLU())
+        self.expand1 = nn.Sequential(
+            nn.Conv2D(squeeze, expand1, 1), nn.ReLU())
+        self.expand3 = nn.Sequential(
+            nn.Conv2D(squeeze, expand3, 3, padding=1), nn.ReLU())
+
+    def forward(self, x):
+        s = self.squeeze(x)
+        return ops.concat([self.expand1(s), self.expand3(s)], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """Reference SqueezeNet(version, num_classes, with_pool)."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        if version not in ("1.0", "1.1"):
+            raise ValueError(f"version must be '1.0' or '1.1', "
+                             f"got {version!r}")
+        self.version = version
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        pool = lambda: nn.MaxPool2D(3, stride=2, padding=0)  # noqa: E731
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(), pool(),
+                Fire(96, 16, 64, 64), Fire(128, 16, 64, 64),
+                Fire(128, 32, 128, 128), pool(),
+                Fire(256, 32, 128, 128), Fire(256, 48, 192, 192),
+                Fire(384, 48, 192, 192), Fire(384, 64, 256, 256), pool(),
+                Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2, padding=1), nn.ReLU(),
+                pool(),
+                Fire(64, 16, 64, 64), Fire(128, 16, 64, 64), pool(),
+                Fire(128, 32, 128, 128), Fire(256, 32, 128, 128), pool(),
+                Fire(256, 48, 192, 192), Fire(384, 48, 192, 192),
+                Fire(384, 64, 256, 256), Fire(512, 64, 256, 256))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1),
+                nn.ReLU())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.pool(x)
+        return ops.flatten(x, 1)
+
+
+def _squeezenet(version, pretrained, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled; load them "
+                         "with paddle.load + set_state_dict")
+    return SqueezeNet(version=version, **kwargs)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return _squeezenet("1.0", pretrained, **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return _squeezenet("1.1", pretrained, **kwargs)

@@ -521,3 +521,267 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
            "prior_box", "matrix_nms", "psroi_pool", "deform_conv2d",
            "distribute_fpn_proposals"]
+
+
+def read_file(filename, name=None):
+    """Reference ``read_file`` op (``python/paddle/vision/ops.py``): read
+    raw bytes into a 1-D uint8 tensor."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return Tensor(data)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Reference ``decode_jpeg`` op (nvjpeg-backed CUDA kernel,
+    ``paddle/phi/kernels/gpu/decode_jpeg_kernel.cu``): decode an encoded
+    JPEG byte tensor to CHW uint8. Host-side PIL decode here — image IO is
+    input-pipeline work that belongs on CPU feeding the TPU."""
+    import io
+
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+    from ..core.tensor import Tensor
+
+    raw = np.asarray(unwrap(x)).astype(np.uint8).tobytes()
+    from PIL import Image
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    elif mode != "unchanged":
+        raise ValueError(f"decode_jpeg: unsupported mode {mode!r}")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]            # [1, H, W]
+    else:
+        arr = arr.transpose(2, 0, 1)  # [C, H, W]
+    return Tensor(arr)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """Reference ``yolo_loss`` (YOLOv3 head loss,
+    ``python/paddle/vision/ops.py:58``; CPU kernel
+    ``paddle/phi/kernels/cpu/yolo_loss_kernel.cc``): per-image sum of
+    coordinate (x,y: BCE; w,h: L1), objectness (BCE, with ignore region
+    above ``ignore_thresh`` IoU) and classification (BCE) losses.
+
+    x: [N, A*(5+C), H, W] raw head output for the anchors in
+    ``anchor_mask``; gt_box [N, B, 4] (cx, cy, w, h, image-normalized);
+    gt_label [N, B] int; returns [N] loss.
+    """
+    from ..core.dispatch import apply
+
+    anchors_np = np.asarray(unwrap(anchors), np.float32).reshape(-1, 2)
+    mask = [int(m) for m in (anchor_mask if not hasattr(
+        anchor_mask, "numpy") else unwrap(anchor_mask))]
+    a_used = anchors_np[mask]                   # [A, 2] in input pixels
+    na = len(mask)
+
+    args = [x, gt_box, gt_label] + ([gt_score] if gt_score is not None
+                                    else [])
+
+    def impl(xv, gb, gl, *gs):
+        gs = gs[0] if gs else None
+        n, ch, h, w = xv.shape
+        assert ch == na * (5 + class_num), (
+            f"yolo_loss: channel {ch} != A*(5+C)={na * (5 + class_num)}")
+        in_w = w * downsample_ratio
+        in_h = h * downsample_ratio
+        pred = xv.reshape(n, na, 5 + class_num, h, w)
+        sig = jax.nn.sigmoid
+
+        # decoded box centers/sizes in image-normalized units
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        px = (sig(pred[:, :, 0]) * scale_x_y
+              - 0.5 * (scale_x_y - 1.0) + gx) / w
+        py = (sig(pred[:, :, 1]) * scale_x_y
+              - 0.5 * (scale_x_y - 1.0) + gy) / h
+        pw = jnp.exp(pred[:, :, 2]) * a_used[None, :, 0, None, None] / in_w
+        ph = jnp.exp(pred[:, :, 3]) * a_used[None, :, 1, None, None] / in_h
+
+        def iou_cwh(boxes_a, boxes_b):
+            # [..., (cx,cy,w,h)] pairwise-free elementwise IoU
+            ax1 = boxes_a[..., 0] - boxes_a[..., 2] / 2
+            ay1 = boxes_a[..., 1] - boxes_a[..., 3] / 2
+            ax2 = boxes_a[..., 0] + boxes_a[..., 2] / 2
+            ay2 = boxes_a[..., 1] + boxes_a[..., 3] / 2
+            bx1 = boxes_b[..., 0] - boxes_b[..., 2] / 2
+            by1 = boxes_b[..., 1] - boxes_b[..., 3] / 2
+            bx2 = boxes_b[..., 0] + boxes_b[..., 2] / 2
+            by2 = boxes_b[..., 1] + boxes_b[..., 3] / 2
+            ix = jnp.clip(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1),
+                          0, None)
+            iy = jnp.clip(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1),
+                          0, None)
+            inter = ix * iy
+            ua = (ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) \
+                - inter
+            return inter / jnp.maximum(ua, 1e-10)
+
+        # objectness ignore mask: max IoU of each prediction vs any gt
+        pb = jnp.stack([px, py, pw, ph], axis=-1)  # [N,A,H,W,4]
+        gb_e = gb[:, None, None, None]             # [N,1,1,1,B,4]
+        ious = iou_cwh(pb[..., None, :], gb_e)     # [N,A,H,W,B]
+        gt_valid = (gb[..., 2] > 0)[:, None, None, None]   # w>0 marks real
+        best_iou = jnp.max(jnp.where(gt_valid, ious, 0.0), axis=-1)
+        ignore = best_iou > ignore_thresh
+
+        # responsible anchor per gt: best IoU among the masked anchors at
+        # (0,0) center (shape-only match, the YOLOv3 assignment)
+        awh = jnp.asarray(a_used) / jnp.asarray([in_w, in_h],
+                                                jnp.float32)[None]
+        shape_a = jnp.concatenate([jnp.zeros_like(awh), awh], -1)
+        g_shape = jnp.concatenate(
+            [jnp.zeros_like(gb[..., :2]), gb[..., 2:4]], -1)
+        sim = iou_cwh(g_shape[:, :, None, :], shape_a[None, None])
+        best_a = jnp.argmax(sim, axis=-1)          # [N, B]
+
+        gi = jnp.clip((gb[..., 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gb[..., 1] * h).astype(jnp.int32), 0, h - 1)
+        valid = gb[..., 2] > 0                     # [N, B]
+
+        def bce(logit, target):
+            return (jax.nn.softplus(logit) - logit * target)
+
+        # gather predictions at assigned cells: [N, B, ...]
+        bi = jnp.arange(n)[:, None]
+        p_at = pred[bi, best_a, :, gj, gi]         # [N, B, 5+C]
+        tx = gb[..., 0] * w - gi
+        ty = gb[..., 1] * h - gj
+        tw = jnp.log(jnp.maximum(
+            gb[..., 2] * in_w / jnp.maximum(
+                jnp.asarray(a_used)[best_a][..., 0], 1e-9), 1e-9))
+        th = jnp.log(jnp.maximum(
+            gb[..., 3] * in_h / jnp.maximum(
+                jnp.asarray(a_used)[best_a][..., 1], 1e-9), 1e-9))
+        box_scale = 2.0 - gb[..., 2] * gb[..., 3]  # small boxes weigh more
+        score = (gs if gs is not None
+                 else jnp.ones(gl.shape, jnp.float32))
+        wloc = jnp.where(valid, box_scale * score, 0.0)
+        loss_xy = (bce(p_at[..., 0], tx) + bce(p_at[..., 1], ty)) * wloc
+        loss_wh = (jnp.abs(p_at[..., 2] - tw)
+                   + jnp.abs(p_at[..., 3] - th)) * wloc
+
+        # objectness: positives at assigned cells carry the gt score as
+        # target (mixup support, reference kernel obj = score), negatives
+        # elsewhere unless ignored
+        obj_logit = pred[:, :, 4]                  # [N,A,H,W]
+        pos = jnp.zeros((n, na, h, w), bool)
+        pos = pos.at[bi, best_a, gj, gi].set(valid, mode="drop")
+        obj_t = jnp.zeros((n, na, h, w), jnp.float32)
+        obj_t = obj_t.at[bi, best_a, gj, gi].set(
+            jnp.where(valid, score, 0.0), mode="drop")
+        l_obj = bce(obj_logit, obj_t)
+        neg_mask = (~pos) & (~ignore)
+        loss_obj = jnp.sum(
+            jnp.where(pos | neg_mask, l_obj, 0.0), axis=(1, 2, 3))
+
+        # classification at positives
+        smooth = 1.0 / class_num if (use_label_smooth
+                                     and class_num > 1) else 0.0
+        onehot = jax.nn.one_hot(gl.astype(jnp.int32), class_num)
+        tcls = onehot * (1 - smooth) + smooth * (1 - onehot) \
+            if smooth else onehot
+        l_cls = jnp.sum(bce(p_at[..., 5:], tcls), axis=-1)
+        l_cls = jnp.where(valid, l_cls * score, 0.0)
+
+        per_img = (jnp.sum(loss_xy + loss_wh, axis=1) + loss_obj
+                   + jnp.sum(l_cls, axis=1))
+        return per_img
+
+    return apply("yolo_loss", impl, *args)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """Reference ``generate_proposals`` (RPN head postprocess,
+    ``python/paddle/vision/ops.py:2038``; CUDA kernel
+    ``paddle/phi/kernels/gpu/generate_proposals_kernel.cu``): decode
+    anchor deltas, clip to the image, drop boxes below ``min_size``,
+    keep ``pre_nms_top_n`` by score, NMS, keep ``post_nms_top_n``.
+
+    Host-side like ``nms`` (data-dependent output sizes). Returns
+    (rois [R,4], roi_probs [R,1][, rois_num [N]]).
+    """
+    sc = np.asarray(unwrap(scores), np.float32)       # [N, A, H, W]
+    bd = np.asarray(unwrap(bbox_deltas), np.float32)  # [N, A*4, H, W]
+    ims = np.asarray(unwrap(img_size), np.float32)    # [N, 2] (h, w)
+    an = np.asarray(unwrap(anchors), np.float32).reshape(-1, 4)
+    var = np.asarray(unwrap(variances), np.float32).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+
+    all_rois, all_probs, nums = [], [], []
+    for i in range(n):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)          # [H*W*A]
+        d = bd[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s_i, d_i, an_i, var_i = s[order], d[order], an[order], var[order]
+
+        aw = an_i[:, 2] - an_i[:, 0] + off
+        ah = an_i[:, 3] - an_i[:, 1] + off
+        acx = an_i[:, 0] + aw * 0.5
+        acy = an_i[:, 1] + ah * 0.5
+        cx = var_i[:, 0] * d_i[:, 0] * aw + acx
+        cy = var_i[:, 1] * d_i[:, 1] * ah + acy
+        bw = np.exp(np.minimum(var_i[:, 2] * d_i[:, 2], 10.0)) * aw
+        bh = np.exp(np.minimum(var_i[:, 3] * d_i[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - bw * 0.5, cy - bh * 0.5,
+                          cx + bw * 0.5 - off, cy + bh * 0.5 - off], -1)
+        ih, iw = ims[i, 0], ims[i, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        # reference FilterBoxes clamps min_size up to 1.0
+        msz = max(float(min_size), 1.0)
+        keep = ((boxes[:, 2] - boxes[:, 0] + off >= msz)
+                & (boxes[:, 3] - boxes[:, 1] + off >= msz))
+        boxes, s_i = boxes[keep], s_i[keep]
+
+        # greedy NMS with the reference's adaptive threshold (eta < 1
+        # decays the threshold as selections accumulate)
+        order2 = np.argsort(-s_i)
+        sel = []
+        thresh = nms_thresh
+        while len(order2) and len(sel) < post_nms_top_n:
+            j = order2[0]
+            sel.append(j)
+            if len(order2) == 1:
+                break
+            rest = order2[1:]
+            x1 = np.maximum(boxes[j, 0], boxes[rest, 0])
+            y1 = np.maximum(boxes[j, 1], boxes[rest, 1])
+            x2 = np.minimum(boxes[j, 2], boxes[rest, 2])
+            y2 = np.minimum(boxes[j, 3], boxes[rest, 3])
+            inter = (np.clip(x2 - x1 + off, 0, None)
+                     * np.clip(y2 - y1 + off, 0, None))
+            area_j = ((boxes[j, 2] - boxes[j, 0] + off)
+                      * (boxes[j, 3] - boxes[j, 1] + off))
+            area_r = ((boxes[rest, 2] - boxes[rest, 0] + off)
+                      * (boxes[rest, 3] - boxes[rest, 1] + off))
+            iou = inter / np.maximum(area_j + area_r - inter, 1e-10)
+            order2 = rest[iou <= thresh]
+            if eta < 1.0 and thresh * eta > 0.5:
+                thresh *= eta
+        all_rois.append(boxes[sel])
+        all_probs.append(s_i[sel, None])
+        nums.append(len(sel))
+
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois)
+                              if all_rois else np.zeros((0, 4), "f4")))
+    probs = Tensor(jnp.asarray(np.concatenate(all_probs)
+                               if all_probs else np.zeros((0, 1), "f4")))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    return rois, probs

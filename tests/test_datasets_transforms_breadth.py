@@ -1,0 +1,193 @@
+"""Round-3 breadth: DatasetFolder/ImageFolder/Flowers/VOC2012 datasets and
+distribution transforms (VERDICT r2 missing #5/#6)."""
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _png_bytes(arr):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _jpg_bytes(arr):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+# ------------------------------------------------------------ datasets ----
+
+def test_dataset_folder(tmp_path):
+    from paddle_tpu.vision.datasets import DatasetFolder
+    rng = np.random.default_rng(0)
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            (d / f"{i}.png").write_bytes(_png_bytes(
+                rng.integers(0, 255, (8, 8, 3)).astype(np.uint8)))
+    ds = DatasetFolder(str(tmp_path))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 6
+    img, target = ds[0]
+    assert img.shape == (8, 8, 3) and target == 0
+    assert sorted(set(ds.targets)) == [0, 1]
+
+
+def test_image_folder_and_transform(tmp_path):
+    from paddle_tpu.vision.datasets import ImageFolder
+    (tmp_path / "a.png").write_bytes(_png_bytes(
+        np.zeros((6, 6, 3), np.uint8)))
+    (tmp_path / "skip.txt").write_text("not an image")
+    ds = ImageFolder(str(tmp_path),
+                     transform=lambda im: im.astype("float32") / 255)
+    assert len(ds) == 1
+    (img,) = ds[0]
+    assert img.dtype == np.float32
+
+
+def test_flowers_dataset(tmp_path):
+    from paddle_tpu.vision.datasets import Flowers
+    from scipy.io import savemat
+    rng = np.random.default_rng(1)
+    tgz = tmp_path / "102flowers.tgz"
+    with tarfile.open(tgz, "w:gz") as tf:
+        for i in range(1, 5):
+            data = _jpg_bytes(rng.integers(0, 255, (10, 10, 3))
+                              .astype(np.uint8))
+            info = tarfile.TarInfo(f"jpg/image_{i:05d}.jpg")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    savemat(tmp_path / "imagelabels.mat",
+            {"labels": np.array([[1, 2, 1, 3]])})
+    savemat(tmp_path / "setid.mat",
+            {"trnid": np.array([[1, 3]]), "valid": np.array([[2]]),
+             "tstid": np.array([[4]])})
+    ds = Flowers(data_file=str(tgz),
+                 label_file=str(tmp_path / "imagelabels.mat"),
+                 setid_file=str(tmp_path / "setid.mat"), mode="train")
+    assert len(ds) == 2
+    img, label = ds[0]
+    assert img.shape == (10, 10, 3) and int(label) == 0  # 1 -> 0-based
+
+
+def test_voc2012_dataset(tmp_path):
+    from paddle_tpu.vision.datasets import VOC2012
+    rng = np.random.default_rng(2)
+    tar = tmp_path / "voc.tar"
+    root = "VOCdevkit/VOC2012/"
+    with tarfile.open(tar, "w") as tf:
+        def add(name, data):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+        add(root + "ImageSets/Segmentation/train.txt", b"img1\n")
+        add(root + "ImageSets/Segmentation/val.txt", b"img1\n")
+        add(root + "ImageSets/Segmentation/trainval.txt", b"img1\n")
+        add(root + "JPEGImages/img1.jpg", _jpg_bytes(
+            rng.integers(0, 255, (12, 12, 3)).astype(np.uint8)))
+        add(root + "SegmentationClass/img1.png", _png_bytes(
+            rng.integers(0, 20, (12, 12)).astype(np.uint8)))
+    ds = VOC2012(data_file=str(tar), mode="train")
+    assert len(ds) == 1
+    img, mask = ds[0]
+    assert img.shape == (12, 12, 3) and mask.shape == (12, 12)
+
+
+# ----------------------------------------------------------- transforms ----
+
+def _np_t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+def test_affine_exp_sigmoid_tanh_roundtrip_and_jacobian():
+    x = np.linspace(-2, 2, 9).astype(np.float32)
+    for t, dydx in [
+        (D.AffineTransform(1.0, 3.0), lambda x: 3.0 * np.ones_like(x)),
+        (D.ExpTransform(), np.exp),
+        (D.SigmoidTransform(),
+         lambda x: 1 / (1 + np.exp(-x)) * (1 - 1 / (1 + np.exp(-x)))),
+        (D.TanhTransform(), lambda x: 1 - np.tanh(x) ** 2),
+        (D.PowerTransform(2.0), lambda x: 2 * np.abs(x)),
+    ]:
+        xs = np.abs(x) + 0.1 if isinstance(
+            t, (D.PowerTransform,)) else x
+        y = t.forward(_np_t(xs)).numpy()
+        back = t.inverse(paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(back, xs, rtol=1e-4, atol=1e-5)
+        ld = t.forward_log_det_jacobian(_np_t(xs)).numpy()
+        np.testing.assert_allclose(ld, np.log(np.abs(dydx(xs))),
+                                   rtol=1e-4, atol=1e-5)
+        # inverse_log_det = -forward_log_det at the preimage
+        ild = t.inverse_log_det_jacobian(paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(ild, -ld, rtol=1e-4, atol=1e-5)
+
+
+def test_chain_transform():
+    t = D.ChainTransform([D.AffineTransform(0.0, 2.0), D.ExpTransform()])
+    x = np.array([0.0, 1.0], np.float32)
+    y = t.forward(_np_t(x)).numpy()
+    np.testing.assert_allclose(y, np.exp(2 * x), rtol=1e-5)
+    np.testing.assert_allclose(t.inverse(paddle.to_tensor(y)).numpy(), x,
+                               rtol=1e-5)
+    ld = t.forward_log_det_jacobian(_np_t(x)).numpy()
+    np.testing.assert_allclose(ld, np.log(2.0) + 2 * x, rtol=1e-5)
+
+
+def test_stack_and_reshape_and_independent():
+    st = D.StackTransform([D.ExpTransform(), D.AffineTransform(0.0, -2.0)],
+                          axis=0)
+    x = np.stack([np.ones(3, np.float32), np.ones(3, np.float32)])
+    y = st.forward(_np_t(x)).numpy()
+    np.testing.assert_allclose(y[0], np.e * np.ones(3), rtol=1e-5)
+    np.testing.assert_allclose(y[1], -2 * np.ones(3), rtol=1e-5)
+
+    rt = D.ReshapeTransform((6,), (2, 3))
+    z = rt.forward(_np_t(np.arange(6))).numpy()
+    assert z.shape == (2, 3)
+    assert rt.forward_shape((5, 6)) == (5, 2, 3)
+    assert rt.inverse_shape((5, 2, 3)) == (5, 6)
+
+    it = D.IndependentTransform(D.ExpTransform(), 1)
+    ld = it.forward_log_det_jacobian(_np_t(np.ones((4, 3)))).numpy()
+    assert ld.shape == (4,)
+    np.testing.assert_allclose(ld, 3.0 * np.ones(4), rtol=1e-5)
+
+
+def test_stick_breaking_simplex():
+    t = D.StickBreakingTransform()
+    x = np.random.default_rng(0).normal(size=(5, 4)).astype(np.float32)
+    y = t.forward(_np_t(x)).numpy()
+    assert y.shape == (5, 5)
+    assert (y > 0).all()
+    np.testing.assert_allclose(y.sum(-1), np.ones(5), rtol=1e-5)
+    np.testing.assert_allclose(t.inverse(paddle.to_tensor(y)).numpy(), x,
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_transformed_distribution_lognormal():
+    """Normal pushed through Exp == LogNormal: log_prob and samples."""
+    paddle.seed(0)
+    base = D.Normal(0.0, 1.0)
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    v = np.array([0.5, 1.0, 2.5], np.float32)
+    got = td.log_prob(paddle.to_tensor(v)).numpy()
+    ref = D.LogNormal(0.0, 1.0).log_prob(paddle.to_tensor(v)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+    s = td.sample((1000,)).numpy()
+    assert (s > 0).all()
+
+
+def test_transform_call_on_distribution():
+    td = D.ExpTransform()(D.Normal(0.0, 1.0))
+    assert isinstance(td, D.TransformedDistribution)

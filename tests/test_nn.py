@@ -241,3 +241,38 @@ def test_pad_modes():
     assert y.shape == [1, 1, 5, 5]
     y2 = F.pad(x, [1, 1, 1, 1], mode="reflect")
     assert y2.shape == [1, 1, 5, 5]
+
+
+def test_cross_entropy_fast_path_matches_logp_path():
+    """The fused hard-label fast path (no [N, V] fp32 logp) must match the
+    general log_softmax path in value AND gradient, incl. ignore_index."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(64, 37)).astype(np.float32)
+    labels = rng.integers(0, 37, (64,))
+    labels[::7] = -100  # ignore_index holes
+
+    x = pt.to_tensor(logits, stop_gradient=False)
+    y = pt.to_tensor(labels.astype(np.int64))
+    loss = F.cross_entropy(x, y)   # fast path
+    loss.backward()
+    g_fast = x.grad.numpy()
+
+    # reference: explicit log_softmax formulation
+    def ref(lg):
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        idx = jnp.where(labels == -100, 0, labels)
+        nll = -jnp.take_along_axis(logp, idx[:, None], axis=-1)[:, 0]
+        nll = jnp.where(labels == -100, 0.0, nll)
+        return nll.sum() / jnp.maximum((labels != -100).sum(), 1)
+
+    val_ref = float(ref(jnp.asarray(logits)))
+    g_ref = np.asarray(jax.grad(ref)(jnp.asarray(logits)))
+    np.testing.assert_allclose(float(loss), val_ref, rtol=1e-5)
+    np.testing.assert_allclose(g_fast, g_ref, atol=1e-5)
+    # smoothing/weights still take the general path and agree with it
+    loss_s = F.cross_entropy(pt.to_tensor(logits), y,
+                             label_smoothing=0.1)
+    assert np.isfinite(float(loss_s))

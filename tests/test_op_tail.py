@@ -1,0 +1,185 @@
+"""Round-3 op tail (VERDICT r2 missing #7): auc, yolo_loss,
+generate_proposals, fractional pools, unpool1d/3d, decode_jpeg/read_file,
+spectral_norm."""
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def test_max_unpool_1d_3d_roundtrip():
+    rng = np.random.default_rng(0)
+    x1 = _t(rng.normal(size=(2, 3, 8)).astype("float32"))
+    o, m = F.max_pool1d(x1, 2, return_mask=True)
+    up = F.max_unpool1d(o, m, 2)
+    assert tuple(up.shape) == (2, 3, 8)
+    flat = x1.numpy().reshape(2, 3, -1)
+    picked = np.take_along_axis(flat, m.numpy().reshape(2, 3, -1), -1)
+    np.testing.assert_allclose(picked.reshape(o.shape), o.numpy())
+
+    x3 = _t(rng.normal(size=(1, 2, 4, 4, 4)).astype("float32"))
+    o3, m3 = F.max_pool3d(x3, 2, return_mask=True)
+    up3 = F.max_unpool3d(o3, m3, 2)
+    assert tuple(up3.shape) == (1, 2, 4, 4, 4)
+    # every pooled value sits at its recorded position
+    flat3 = up3.numpy().reshape(1, 2, -1)
+    got = np.take_along_axis(flat3, m3.numpy().reshape(1, 2, -1), -1)
+    np.testing.assert_allclose(got.reshape(o3.shape), o3.numpy())
+
+
+@pytest.mark.parametrize("nd", [2, 3])
+def test_fractional_max_pool(nd):
+    rng = np.random.default_rng(1)
+    shape = (2, 3) + (9, 11, 7)[:nd]
+    out_sz = (4, 5, 3)[:nd]
+    x = _t(rng.normal(size=shape).astype("float32"))
+    fn = F.fractional_max_pool2d if nd == 2 else F.fractional_max_pool3d
+    out, idx = fn(x, output_size=out_sz, random_u=0.4, return_mask=True)
+    assert tuple(out.shape) == (2, 3) + out_sz
+    flat = x.numpy().reshape(2, 3, -1)
+    picked = np.take_along_axis(flat, idx.numpy().reshape(2, 3, -1), -1)
+    np.testing.assert_allclose(picked.reshape(out.shape), out.numpy())
+    # deterministic given random_u
+    out2 = fn(x, output_size=out_sz, random_u=0.4)
+    np.testing.assert_allclose(out.numpy(), out2.numpy())
+    # global max survives pooling (regions tile the input)
+    np.testing.assert_allclose(out.numpy().max(), x.numpy().max())
+
+
+def test_spectral_norm_unit_sigma():
+    rng = np.random.default_rng(2)
+    w = rng.normal(size=(6, 5)).astype("float32")
+    out = F.spectral_norm(_t(w), power_iters=50).numpy()
+    # largest singular value of the normalized weight ~ 1
+    np.testing.assert_allclose(np.linalg.svd(out)[1][0], 1.0, rtol=1e-3)
+    # direction preserved: out proportional to w / sigma
+    np.testing.assert_allclose(out, w / np.linalg.svd(w)[1][0], rtol=1e-3,
+                               atol=1e-4)
+    # layer wrapper
+    layer = paddle.nn.SpectralNorm((6, 5), power_iters=50)
+    np.testing.assert_allclose(layer(_t(w)).numpy(), out, rtol=1e-5)
+
+
+def test_spectral_norm_conv_dim():
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(4, 3, 2, 2)).astype("float32")
+    out = F.spectral_norm(_t(w), dim=1, power_iters=60).numpy()
+    mat = out.transpose(1, 0, 2, 3).reshape(3, -1)
+    np.testing.assert_allclose(np.linalg.svd(mat)[1][0], 1.0, rtol=1e-2)
+
+
+def test_read_file_decode_jpeg(tmp_path):
+    from PIL import Image
+
+    from paddle_tpu.vision.ops import decode_jpeg, read_file
+
+    # smooth gradient (JPEG is lossy; random noise would not survive)
+    gy, gx = np.mgrid[0:10, 0:12]
+    arr = np.stack([gy * 20, gx * 20, gy * 10 + gx * 10],
+                   axis=-1).astype(np.uint8)
+    p = tmp_path / "img.jpg"
+    Image.fromarray(arr).save(p, format="JPEG", quality=95)
+    raw = read_file(str(p))
+    assert raw.dtype == np.uint8 and raw.ndim == 1
+    img = decode_jpeg(raw, mode="rgb")
+    assert tuple(img.shape) == (3, 10, 12)
+    # jpeg is lossy; just require closeness
+    assert np.abs(img.numpy().transpose(1, 2, 0).astype(int)
+                  - arr.astype(int)).mean() < 12
+
+
+def test_auc_op():
+    from paddle_tpu.ops.special import auc
+
+    # perfectly separable predictions -> AUC 1
+    pred = np.array([[0.9, 0.1], [0.8, 0.2], [0.3, 0.7], [0.1, 0.9]],
+                    np.float32)
+    lab = np.array([[0], [0], [1], [1]], np.int64)
+    a, pos, neg = auc(_t(pred), _t(lab))
+    np.testing.assert_allclose(float(a), 1.0, atol=1e-6)
+    assert int(pos.numpy().sum()) == 2 and int(neg.numpy().sum()) == 2
+    # inverted labels -> AUC 0
+    a0, _, _ = auc(_t(pred), _t(1 - lab))
+    np.testing.assert_allclose(float(a0), 0.0, atol=1e-6)
+    # random-ish vs sklearn-style reference on a bigger draw
+    rng = np.random.default_rng(5)
+    p = rng.uniform(size=400).astype(np.float32)
+    y = (rng.uniform(size=400) < p).astype(np.int64)  # correlated
+    a2, pos2, neg2 = auc(_t(np.stack([1 - p, p], 1)), _t(y[:, None]))
+    # rank-based reference AUC
+    order = np.argsort(p)
+    ranks = np.empty(400)
+    ranks[order] = np.arange(1, 401)
+    n_pos, n_neg = y.sum(), (1 - y).sum()
+    ref = (ranks[y == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+    np.testing.assert_allclose(float(a2), ref, atol=2e-3)
+    # streaming: two halves with stat carry == one shot
+    a_h1, p1, n1 = auc(_t(np.stack([1 - p[:200], p[:200]], 1)),
+                       _t(y[:200, None]))
+    a_h2, p2, n2 = auc(_t(np.stack([1 - p[200:], p[200:]], 1)),
+                       _t(y[200:, None]), stat_pos=p1, stat_neg=n1)
+    np.testing.assert_allclose(float(a_h2), float(a2), atol=1e-6)
+
+
+def test_yolo_loss_shapes_and_learning_signal():
+    from paddle_tpu.vision.ops import yolo_loss
+
+    rng = np.random.default_rng(6)
+    n, c, h, w = 2, 3 * (5 + 4), 5, 5
+    x = _t(rng.normal(size=(n, c, h, w)).astype("float32") * 0.1)
+    gt_box = np.zeros((n, 3, 4), np.float32)
+    gt_box[:, 0] = [0.5, 0.5, 0.3, 0.4]   # one real box per image
+    gt_label = np.zeros((n, 3), np.int64)
+    loss = yolo_loss(x, _t(gt_box), _t(gt_label),
+                     anchors=[10, 13, 16, 30, 33, 23],
+                     anchor_mask=[0, 1, 2], class_num=4,
+                     ignore_thresh=0.7, downsample_ratio=32)
+    assert tuple(loss.shape) == (n,)
+    assert np.isfinite(loss.numpy()).all() and (loss.numpy() > 0).all()
+    # gradient flows to the head
+    xg = _t(rng.normal(size=(n, c, h, w)).astype("float32") * 0.1)
+    xg.stop_gradient = False
+    l = yolo_loss(xg, _t(gt_box), _t(gt_label),
+                  anchors=[10, 13, 16, 30, 33, 23],
+                  anchor_mask=[0, 1, 2], class_num=4,
+                  ignore_thresh=0.7, downsample_ratio=32)
+    l.sum().backward()
+    assert np.abs(xg.grad.numpy()).sum() > 0
+
+
+def test_generate_proposals():
+    from paddle_tpu.vision.ops import generate_proposals
+
+    rng = np.random.default_rng(7)
+    n, a, h, w = 1, 3, 4, 4
+    scores = rng.uniform(size=(n, a, h, w)).astype(np.float32)
+    deltas = (rng.normal(size=(n, a * 4, h, w)) * 0.1).astype(np.float32)
+    # anchors laid out per (H, W, A)
+    anchors = np.zeros((h, w, a, 4), np.float32)
+    for i in range(h):
+        for j in range(w):
+            for k in range(a):
+                cx, cy, sz = j * 16 + 8, i * 16 + 8, 8 * (k + 1)
+                anchors[i, j, k] = [cx - sz, cy - sz, cx + sz, cy + sz]
+    variances = np.ones_like(anchors)
+    rois, probs, num = generate_proposals(
+        _t(scores), _t(deltas), _t(np.array([[64.0, 64.0]], np.float32)),
+        _t(anchors.reshape(-1, 4)), _t(variances.reshape(-1, 4)),
+        pre_nms_top_n=30, post_nms_top_n=10, nms_thresh=0.5,
+        min_size=2.0, return_rois_num=True)
+    r = rois.numpy()
+    assert r.shape[1] == 4 and r.shape[0] == int(num.numpy()[0]) > 0
+    assert probs.shape[0] == r.shape[0]
+    # clipped to the image
+    assert (r >= 0).all() and (r[:, 0::2] <= 64).all() \
+        and (r[:, 1::2] <= 64).all()
+    # scores sorted descending
+    pr = probs.numpy().ravel()
+    assert (np.diff(pr) <= 1e-6).all()

@@ -1,0 +1,88 @@
+"""Forward-shape + trainability tests for the round-3 vision model batch
+(VERDICT r2 missing #6): densenet, squeezenet, shufflenetv2, inceptionv3,
+googlenet, mobilenetv1/v3. Reference test model:
+test/legacy_test/test_vision_models.py (forward on random input)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _fwd(model, size=64, batch=2):
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(batch, 3, size, size)).astype("float32"))
+    model.eval()
+    with paddle.no_grad():
+        return model(x)
+
+
+@pytest.mark.parametrize("ctor,kw", [
+    (models.densenet121, {}),
+    (models.densenet169, {}),
+    (models.squeezenet1_0, {}),
+    (models.squeezenet1_1, {}),
+    (models.mobilenet_v1, {"scale": 0.5}),
+    (models.mobilenet_v3_small, {}),
+    (models.mobilenet_v3_large, {}),
+    (models.shufflenet_v2_x0_25, {}),
+    (models.shufflenet_v2_x1_0, {}),
+    (models.shufflenet_v2_swish, {}),
+])
+def test_forward_shape(ctor, kw):
+    paddle.seed(0)
+    model = ctor(num_classes=10, **kw)
+    out = _fwd(model)
+    assert tuple(out.shape) == (2, 10)
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_inception_v3_forward():
+    paddle.seed(0)
+    model = models.inception_v3(num_classes=7)
+    out = _fwd(model, size=299, batch=1)
+    assert tuple(out.shape) == (1, 7)
+
+
+def test_googlenet_aux_heads():
+    paddle.seed(0)
+    model = models.GoogLeNet(num_classes=6)
+    out, aux1, aux2 = _fwd(model, size=96)
+    assert tuple(out.shape) == (2, 6)
+    assert tuple(aux1.shape) == (2, 6) and tuple(aux2.shape) == (2, 6)
+
+
+def test_new_models_train_step():
+    """One SGD step must run end-to-end (backward through concat/SE/
+    shuffle paths) and change the loss."""
+    paddle.seed(0)
+    model = models.shufflenet_v2_x0_25(num_classes=4)
+    model.train()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    x = paddle.to_tensor(np.random.default_rng(1).normal(
+        size=(2, 3, 64, 64)).astype("float32"))
+    y = paddle.to_tensor(np.array([1, 3], np.int64))
+    losses = []
+    for _ in range(3):
+        loss = paddle.nn.functional.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_pretrained_rejected():
+    with pytest.raises(ValueError, match="pretrained"):
+        models.densenet121(pretrained=True)
+
+
+def test_channel_shuffle_roundtrip():
+    """shuffle(groups) interleaves: shuffling twice with g and c//g
+    restores the original order."""
+    from paddle_tpu.vision.models.shufflenetv2 import channel_shuffle
+    x = paddle.to_tensor(
+        np.arange(2 * 8 * 2 * 2, dtype=np.float32).reshape(2, 8, 2, 2))
+    y = channel_shuffle(channel_shuffle(x, 2), 4)
+    np.testing.assert_array_equal(y.numpy(), x.numpy())
