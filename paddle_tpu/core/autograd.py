@@ -21,13 +21,17 @@ from . import state
 
 
 class Node:
-    """One recorded op in the grad graph. Analog of ``egr::GradNodeBase``."""
+    """One recorded op in the grad graph. Analog of ``egr::GradNodeBase``.
+
+    ``vjp_fn`` may be None: the linearization is built LAZILY at backward
+    time from ``pure`` + ``diff_vals`` (the forward-time input snapshot),
+    so grad-enabled forwards that never backward pay no jax.vjp cost."""
 
     __slots__ = ("name", "vjp_fn", "inputs", "out_ids", "out_avals",
-                 "consumed", "pure", "seq_type")
+                 "consumed", "pure", "seq_type", "diff_vals")
 
     def __init__(self, name, vjp_fn, inputs, out_ids, out_avals, pure=None,
-                 seq_type=None):
+                 seq_type=None, diff_vals=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = inputs        # diff-input Tensors (strong refs = TensorWrapper)
@@ -35,6 +39,7 @@ class Node:
         self.out_avals = out_avals  # ShapeDtypeStruct per output
         self.pure = pure            # primal fn of the diff inputs (for create_graph)
         self.seq_type = seq_type    # None | tuple | list: primal output pytree
+        self.diff_vals = diff_vals  # input values for lazy linearization
         self.consumed = False
 
     def pack_cots(self, cots):
@@ -225,6 +230,14 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, accumulate=True
             cots = _vjp_through_dispatch(n, out_grads)
         else:
             out_grads = [_val(g) for g in out_grads]
+            if n.vjp_fn is None:  # lazy: linearize on first backward
+                try:
+                    _, n.vjp_fn = jax.vjp(n.pure, *n.diff_vals)
+                except Exception as e:
+                    from . import errors as _errors
+                    raise _errors.InvalidArgumentError(
+                        _errors.op_error_context(
+                            "grad::" + n.name, n.diff_vals, e)) from e
             cots = n.vjp_fn(n.pack_cots(out_grads))
         processed.append(n)
         for ti, cot in zip(n.inputs, cots):
@@ -247,6 +260,7 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, accumulate=True
             n.vjp_fn = None
             n.inputs = ()
             n.pure = None  # frees the closure pinning forward buffers
+            n.diff_vals = None
             n.consumed = True
 
     if inputs is not None:

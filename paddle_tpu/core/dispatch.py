@@ -148,20 +148,30 @@ def _apply(name: str, fn: Callable, *args, **kwargs):
             merged[i] = dv
         return fn(*_rebuild(spec, merged), **kwargs)
 
+    # LAZY linearization: run the plain forward now; jax.vjp happens at
+    # backward time from the saved input values (autograd.run_backward).
+    # Measured (benchmarks/eager_bench.py): eager jax.vjp-per-op costs
+    # ~10x a plain dispatch, so grad-enabled forwards that never reach a
+    # backward (eval loops, branch probes) must not pay it. The trade: a
+    # backwarded op re-runs its primal inside jax.vjp (fwd executes
+    # twice); measured fwd+bwd cost moves ~4.7ms -> ~5.5ms per 256x256
+    # linear on CPU — eager is dispatch-bound, and the jit path (where
+    # throughput lives) traces identically either way.
     try:
-        out_vals, vjp_fn = jax.vjp(pure, *[vals[i] for i in diff_idx])
+        out_vals = fn(*_rebuild(spec, vals), **kwargs)
     except Exception as e:
         _reraise_with_op_context(name, vals, e)
     out, node_outs = _wrap_outputs(name, out_vals, node=..., any_grad=True)
     node = Node(
-        name, vjp_fn,
+        name, None,
         inputs=[tensors[i] for i in diff_idx],
         out_ids=[id(o) for o in node_outs],
         out_avals=[jax.ShapeDtypeStruct(o._data.shape, o._data.dtype)
                    for o in node_outs],
         pure=pure,
         seq_type=(tuple if isinstance(out_vals, tuple)
-                  else list if isinstance(out_vals, list) else None))
+                  else list if isinstance(out_vals, list) else None),
+        diff_vals=[vals[i] for i in diff_idx])
     for o in node_outs:
         o._node = node
     return out

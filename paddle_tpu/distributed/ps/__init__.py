@@ -22,8 +22,10 @@ layer), ``PSOptimizer`` (push grads / pull fresh params around an eager
 step), and the fleet role flow (``fleet.init(is_collective=False)``,
 ``is_server/run_server/init_worker/stop_worker``).
 """
-from .service import PsClient, PsServer
+from .service import GeoSparseMirror, PsClient, PsServer
+from .ssd_table import SsdSparseTable
 from .layers import SparseEmbedding
 from .optimizer import PSOptimizer
 
-__all__ = ["PsServer", "PsClient", "SparseEmbedding", "PSOptimizer"]
+__all__ = ["PsServer", "PsClient", "SparseEmbedding", "PSOptimizer",
+           "GeoSparseMirror", "SsdSparseTable"]

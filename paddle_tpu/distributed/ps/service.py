@@ -165,6 +165,10 @@ class PsServer:
             self._sock.close()
         except OSError:
             pass
+        for t in self._sparse.values():  # release spill files (ssd tables)
+            close = getattr(getattr(t, "store", None), "close", None)
+            if close:
+                close()
 
     # -- request handling ---------------------------------------------
     def _serve(self, conn):
@@ -195,11 +199,24 @@ class PsServer:
             return True
         if op == "create_sparse":
             dim, accessor, seed = args
+            accessor = dict(accessor)
+            table_class = accessor.pop("table_class", "memory")
+            max_mem_rows = accessor.pop("max_mem_rows", 4096)
             with self._lock:
                 if name not in self._sparse:
-                    self._sparse[name] = _SparseTable(dim, accessor,
-                                                      seed=seed)
+                    if table_class == "ssd":
+                        from .ssd_table import SsdSparseTable
+                        self._sparse[name] = SsdSparseTable(
+                            dim, accessor, seed=seed,
+                            max_mem_rows=max_mem_rows)
+                    else:
+                        self._sparse[name] = _SparseTable(dim, accessor,
+                                                          seed=seed)
             return True
+        if op == "sparse_stats":
+            t = self._sparse[name]
+            return (getattr(t, "mem_rows", len(getattr(t, "rows", {}))),
+                    getattr(t, "disk_rows", 0))
         if op == "pull_dense":
             (min_version,) = args
             return self._dense[name].pull(min_version)
@@ -350,3 +367,77 @@ class PsClient:
     def close(self):
         for c in self._conns:
             c.close()
+
+
+class GeoSparseMirror:
+    """Geo-async sparse training (reference geo mode,
+    ``python/paddle/distributed/fleet/meta_optimizers/parameter_server_optimizer.py``
+    geo strategy + ``ps/table`` geo recorder): the worker trains a LOCAL
+    copy of the embedding rows and every ``geo_steps`` updates ships the
+    accumulated DELTAS to the servers (accessor rule ``sum``), then
+    refreshes its touched rows from the global table. Between syncs,
+    training is fully local — the async trade that geo-SGD makes.
+    """
+
+    def __init__(self, client, name, dim, geo_steps=10, lr=0.01, seed=0,
+                 max_mirror_rows=100_000):
+        self.client = client
+        self.name = name
+        self.dim = dim
+        self.geo_steps = int(geo_steps)
+        self.lr = lr
+        self.max_mirror_rows = int(max_mirror_rows)
+        client.create_sparse_table(name, dim, rule="sum", seed=seed)
+        self._local: dict[int, np.ndarray] = {}
+        self._base: dict[int, np.ndarray] = {}
+        self._touched: set[int] = set()
+        self._step = 0
+
+    def _ensure(self, ids):
+        missing = [i for i in ids if int(i) not in self._local]
+        if missing:
+            rows = self.client.pull_sparse(self.name, missing)
+            for i, r in zip(missing, rows):
+                self._local[int(i)] = r.copy()
+                self._base[int(i)] = r.copy()
+
+    def lookup(self, ids):
+        ids = np.asarray(ids).reshape(-1)
+        self._ensure(ids)
+        return np.stack([self._local[int(i)] for i in ids])
+
+    def update(self, ids, grads):
+        """Local SGD on the mirrored rows; geo-sync when due."""
+        ids = np.asarray(ids).reshape(-1)
+        self._ensure(ids)
+        for i, g in zip(ids, np.asarray(grads, np.float32)):
+            self._local[int(i)] = self._local[int(i)] - self.lr * g
+            self._touched.add(int(i))
+        self._step += 1
+        if self._step % self.geo_steps == 0:
+            self.sync()
+
+    def sync(self, full_refresh=False):
+        """Push accumulated deltas and refresh the rows touched since the
+        last sync (per-sync traffic scales with the working set, not the
+        lifetime vocabulary). ``full_refresh=True`` re-pulls every
+        mirrored row — the end-of-training convergence pull."""
+        touched = [i for i in self._touched
+                   if not np.array_equal(self._local[i], self._base[i])]
+        if touched:
+            deltas = np.stack([self._local[i] - self._base[i]
+                               for i in touched])
+            self.client.push_sparse(self.name, touched, deltas)
+        refresh = list(self._local) if full_refresh else touched
+        if refresh:
+            rows = self.client.pull_sparse(self.name, refresh)
+            for i, r in zip(refresh, rows):
+                self._local[int(i)] = r.copy()
+                self._base[int(i)] = r.copy()
+        self._touched.clear()
+        if len(self._local) > self.max_mirror_rows:
+            for i in [k for k in self._local
+                      if k not in self._touched][:len(self._local)
+                                                 - self.max_mirror_rows]:
+                self._local.pop(i, None)
+                self._base.pop(i, None)

@@ -1,0 +1,163 @@
+"""Disk-spilling sparse table (capability analog of the reference's
+SSD/rocksdb-backed tables, ``paddle/fluid/distributed/ps/table/
+ssd_sparse_table.cc`` + ``depends/rocksdb``): a bounded in-memory LRU of
+hot rows over a log-structured file store for the cold tail, so the
+embedding table can exceed the server's memory budget.
+
+Store layout: one append-only data file of raw row blobs
+(value + optimizer-state arrays) with an in-memory ``{id: (offset,
+length)}`` index; overwrites append and orphan the old blob; compaction
+rewrites live blobs into a fresh file once garbage exceeds live bytes
+(the LSM analog, collapsed to one level — no merge hierarchy needed for
+a value-per-key workload)."""
+from __future__ import annotations
+
+import os
+import struct
+import tempfile
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from .service import _Accessor
+
+
+class _LogStore:
+    def __init__(self, path):
+        self.path = path
+        self.f = open(path, "w+b")
+        self.index: dict[int, tuple[int, int]] = {}
+        self.live_bytes = 0
+        self.garbage_bytes = 0
+
+    def put(self, key, blob: bytes):
+        old = self.index.get(key)
+        if old is not None:
+            self.garbage_bytes += old[1]
+            self.live_bytes -= old[1]
+        self.f.seek(0, os.SEEK_END)
+        off = self.f.tell()
+        self.f.write(blob)
+        self.index[key] = (off, len(blob))
+        self.live_bytes += len(blob)
+        if self.garbage_bytes > max(self.live_bytes, 1 << 20):
+            self._compact()
+
+    def get(self, key):
+        off, length = self.index[key]
+        self.f.seek(off)
+        return self.f.read(length)
+
+    def __contains__(self, key):
+        return key in self.index
+
+    def _compact(self):
+        newf = open(self.path + ".compact", "w+b")
+        newidx = {}
+        for k, (off, length) in self.index.items():
+            self.f.seek(off)
+            blob = self.f.read(length)
+            newidx[k] = (newf.tell(), length)
+            newf.write(blob)
+        self.f.close()
+        os.replace(self.path + ".compact", self.path)
+        self.f = newf
+        self.index = newidx
+        self.garbage_bytes = 0
+
+    def close(self):
+        try:
+            self.f.close()
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class SsdSparseTable:
+    """Same pull/push surface as the in-memory ``_SparseTable``; rows
+    beyond ``max_mem_rows`` spill to the log store (LRU eviction)."""
+
+    def __init__(self, dim, accessor, initializer_scale=0.01, seed=0,
+                 max_mem_rows=4096, path=None):
+        self.dim = dim
+        self.accessor = _Accessor(**accessor)
+        self.max_mem_rows = int(max_mem_rows)
+        self._rng = np.random.default_rng(seed)
+        self.lock = threading.Lock()
+        # hot set: id -> (value, state), LRU order
+        self._hot: OrderedDict[int, tuple] = OrderedDict()
+        if path is None:
+            fd, path = tempfile.mkstemp(prefix="pdtpu_ssd_", suffix=".tbl")
+            os.close(fd)
+        self.store = _LogStore(path)
+        self._state_keys = sorted(self.accessor.init_state((dim,)).keys())
+
+    # ------------------------------------------------------ serialization
+    def _encode(self, value, state) -> bytes:
+        parts = [value.astype(np.float32).tobytes()]
+        for k in self._state_keys:
+            v = state[k]
+            if isinstance(v, np.ndarray):
+                parts.append(v.astype(np.float32).tobytes())
+            else:                      # scalar counters (adam "t")
+                parts.append(struct.pack("<q", int(v)))
+        return b"".join(parts)
+
+    def _decode(self, blob: bytes):
+        n = self.dim * 4
+        value = np.frombuffer(blob[:n], np.float32).copy()
+        state = self.accessor.init_state((self.dim,))
+        off = n
+        for k in self._state_keys:
+            v = state[k]
+            if isinstance(v, np.ndarray):
+                state[k] = np.frombuffer(blob[off:off + n],
+                                         np.float32).copy()
+                off += n
+            else:
+                state[k] = struct.unpack("<q", blob[off:off + 8])[0]
+                off += 8
+        return value, state
+
+    # ------------------------------------------------------------- rows
+    def _evict_if_needed(self):
+        while len(self._hot) > self.max_mem_rows:
+            k, (v, s) = self._hot.popitem(last=False)  # LRU
+            self.store.put(k, self._encode(v, s))
+
+    def _row_entry(self, i):
+        i = int(i)
+        ent = self._hot.get(i)
+        if ent is not None:
+            self._hot.move_to_end(i)
+            return ent
+        if i in self.store:
+            ent = self._decode(self.store.get(i))
+        else:
+            ent = (self._rng.normal(0, 0.01, self.dim).astype(np.float32),
+                   self.accessor.init_state((self.dim,)))
+        self._hot[i] = ent
+        self._evict_if_needed()
+        return ent
+
+    # ------------------------------------------------------------ api
+    def pull(self, ids):
+        with self.lock:
+            return np.stack([self._row_entry(i)[0] for i in ids])
+
+    def push(self, ids, grads):
+        with self.lock:
+            for i, g in zip(ids, grads):
+                i = int(i)
+                value, state = self._row_entry(i)
+                self._hot[i] = (self.accessor.apply(value, g, state),
+                                state)
+
+    @property
+    def mem_rows(self):
+        return len(self._hot)
+
+    @property
+    def disk_rows(self):
+        return len(self.store.index)

@@ -3,15 +3,28 @@
 Analog of ``python/paddle/inference/wrapper.py`` (Config, create_predictor,
 Predictor/Tensor handles; native engine ``paddle/fluid/inference/api/``).
 TPU-native: a Predictor wraps a ``jit.save``d StableHLO program
-(TranslatedLayer) — XLA is the inference engine; Config's GPU/TensorRT
-toggles are accepted and ignored (XLA owns those decisions), memory/zero-
-copy handles are the program's device buffers.
+(TranslatedLayer) — XLA is the inference engine.
+
+Config toggle semantics (explicit, not silent): every accepted switch is
+recorded and visible via ``Config.summary()``; the ones XLA already owns
+(device placement, IR optimization, TensorRT, memory planning) are
+ACCEPTED-AND-IGNORED by design and ``summary()`` says so per switch.
 """
 from __future__ import annotations
 
 import numpy as np
 
 from ..core.tensor import Tensor
+
+# switches whose job XLA already does — accepted for API parity, ignored
+_NOOP_SWITCHES = {
+    "gpu": "device placement is XLA/PJRT's (runs on the jax backend)",
+    "memory_optim": "XLA buffer assignment already plans memory",
+    "ir_optim": "XLA optimizes the StableHLO program",
+    "trt": "no TensorRT on TPU; XLA fuses instead",
+    "cpu_threads": "XLA CPU thread pool is runtime-managed",
+    "mkldnn": "XLA CPU backend replaces oneDNN",
+}
 
 
 class Config:
@@ -25,7 +38,6 @@ class Config:
         self.path_prefix = str(prog_file).removesuffix(".pdmodel")
         self._switches = {}
 
-    # accepted-for-parity toggles (XLA owns device placement/fusion)
     def enable_use_gpu(self, *a, **k):
         self._switches["gpu"] = True
 
@@ -41,8 +53,21 @@ class Config:
     def enable_tensorrt_engine(self, *a, **k):
         self._switches["trt"] = True
 
+    def enable_mkldnn(self, *a, **k):
+        self._switches["mkldnn"] = True
+
     def set_cpu_math_library_num_threads(self, n):
         self._switches["cpu_threads"] = n
+
+    def summary(self):
+        """What each set switch actually does here (reference
+        ``Config.summary``)."""
+        lines = [f"model: {self.path_prefix}"]
+        for k, v in self._switches.items():
+            why = _NOOP_SWITCHES.get(k)
+            state = "accepted, NO-OP: " + why if why else f"= {v}"
+            lines.append(f"{k}: {state}")
+        return "\n".join(lines)
 
 
 class _IOTensor:
@@ -66,15 +91,26 @@ class _IOTensor:
 
 class Predictor:
     """Reference ``paddle.inference.Predictor`` surface over a loaded
-    StableHLO program."""
+    StableHLO program. Input names come from the export's InputSpec names
+    (``jit.save(input_spec=[InputSpec(..., name="ids")])``); unnamed
+    inputs fall back to ``x{i}``."""
 
     def __init__(self, config: Config):
         from .. import jit
         self._layer = jit.load(config.path_prefix)
-        n_in = len(self._layer._exported.in_avals) - len(self._layer._names)
-        self._input_names = [f"x{i}" for i in range(n_in)]
+        meta = getattr(self._layer, "_meta", {})
+        in_specs = meta.get("in_specs", [])
+        if in_specs:
+            self._input_names = [
+                (nm if nm else f"x{i}")
+                for i, (_shape, _dtype, nm) in enumerate(in_specs)]
+        else:
+            n_in = (len(self._layer._exported.in_avals)
+                    - len(self._layer._names))
+            self._input_names = [f"x{i}" for i in range(n_in)]
         self._inputs = {n: _IOTensor() for n in self._input_names}
         self._outputs = []
+        self._output_names = []
 
     def get_input_names(self):
         return list(self._input_names)
@@ -82,23 +118,55 @@ class Predictor:
     def get_input_handle(self, name):
         return self._inputs[name]
 
+    def _run_once(self, args):
+        out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [np.asarray(o._read() if isinstance(o, Tensor) else o)
+                for o in outs]
+
     def run(self, inputs=None):
         if inputs is not None:  # list-of-arrays convenience form
             for n, v in zip(self._input_names, inputs):
                 self._inputs[n].copy_from_cpu(v)
         args = [self._inputs[n].copy_to_cpu() for n in self._input_names]
-        out = self._layer(*args)
-        outs = out if isinstance(out, (list, tuple)) else [out]
+        res = self._run_once(args)
+        self._outputs = []
+        for o in res:
+            h = _IOTensor()
+            h.copy_from_cpu(o)
+            self._outputs.append(h)
+        self._output_names = [f"out{i}" for i in range(len(res))]
+        return [h.copy_to_cpu() for h in self._outputs]
+
+    def run_batch(self, inputs, batch_size):
+        """Serving helper: split axis-0 into ``batch_size`` chunks, run
+        each through the compiled program, concatenate the outputs.
+
+        Needs a symbolic batch dim in the export
+        (``InputSpec([None, ...])``) when ``n % batch_size != 0`` — a
+        concrete-shape export accepts only its fixed batch, so the
+        residual chunk would be rejected with a shape error."""
+        arrays = [np.asarray(v) for v in inputs]
+        n = arrays[0].shape[0]
+        parts = None
+        for lo in range(0, n, batch_size):
+            chunk = [a[lo:lo + batch_size] for a in arrays]
+            res = self._run_once(chunk)
+            if parts is None:
+                parts = [[] for _ in res]
+            for acc, r in zip(parts, res):
+                acc.append(r)
+        outs = [np.concatenate(p, axis=0) for p in (parts or [])]
         self._outputs = []
         for o in outs:
             h = _IOTensor()
-            h.copy_from_cpu(np.asarray(o._read() if isinstance(o, Tensor)
-                                       else o))
+            h.copy_from_cpu(o)
             self._outputs.append(h)
-        return [h.copy_to_cpu() for h in self._outputs]
+        self._output_names = [f"out{i}" for i in range(len(outs))]
+        return outs
 
     def get_output_names(self):
-        return [f"out{i}" for i in range(len(self._outputs))]
+        return list(self._output_names)
 
     def get_output_handle(self, name):
         return self._outputs[int(name.removeprefix("out"))]

@@ -690,6 +690,10 @@ class TranslatedLayer:
     ``jit/api.py:1246``): callable without the original model code."""
 
     def __init__(self, meta, params):
+        # slim metadata for consumers (inference.Predictor IO names) —
+        # everything except the serialized program, which would pin
+        # potentially hundreds of MB alongside the deserialized Exported
+        self._meta = {k: v for k, v in meta.items() if k != "stablehlo"}
         from jax import export as jexport
         self._exported = jexport.deserialize(bytearray(meta["stablehlo"]))
         self._names = meta["param_names"]
