@@ -67,6 +67,125 @@ def _calibration(cfg, batch, seq):
     }
 
 
+def _window_time(train_step, batches, repeats=2, with_loss=False):
+    """Best-of-N timed multi_step windows (compile via a first throwaway
+    window); returns seconds per window (and the last loss if asked)."""
+    import time as _time
+
+    from paddle_tpu.jit import multi_step
+
+    losses = multi_step(train_step, batches)
+    last = float(losses[-1])  # compile + sync
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = _time.perf_counter()
+        losses = multi_step(train_step, batches)
+        last = float(losses[-1])
+        best = min(best, _time.perf_counter() - t0)
+    return (best, last) if with_loss else best
+
+
+def _bench_resnet50(peak):
+    """North star #1 (BASELINE.json): ResNet50 images/sec/chip, AMP O2."""
+    import paddle_tpu as paddle
+    import paddle_tpu.amp as amp
+    from paddle_tpu.vision.models import resnet50
+
+    batch, iters = 32, 6
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    model.train()
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    model, opt = amp.decorate(models=model, optimizers=opt, level="O2",
+                              dtype="bfloat16", master_weight=True)
+
+    @paddle.jit.to_static
+    def step(x, y):
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            loss = paddle.nn.functional.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.default_rng(0)
+
+    def batch_fn():
+        x = rng.normal(size=(batch, 3, 224, 224)).astype(np.float32)
+        y = rng.integers(0, 1000, (batch,)).astype(np.int64)
+        return paddle.to_tensor(x), paddle.to_tensor(y)
+
+    for _ in range(2):
+        loss = step(*batch_fn())
+    float(loss)
+    dt = _window_time(step, [batch_fn() for _ in range(iters)])
+    img_s = batch * iters / dt
+    # ResNet50 fwd = 4.089e9 MACs/img = 8.18e9 FLOPs (2 per MAC, the
+    # same convention as the GPT/BERT 6N rows); train = fwd + ~2x bwd
+    achieved = img_s * 3 * 2 * 4.089e9
+    return {"metric": "resnet50_train_images_per_sec_per_chip",
+            "value": round(img_s, 1), "unit": "images/sec",
+            "extra": {"batch": batch,
+                      "step_time_ms": round(dt / iters * 1e3, 2),
+                      "amp": "O2-bf16-master",
+                      "model_tflops_per_sec": round(achieved / 1e12, 2),
+                      "mfu": round(achieved / peak, 4)}}
+
+
+def _bench_bert(peak):
+    """North star #2: BERT-base pretraining tokens/sec/chip (MLM+NSP)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.amp as amp
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+    batch, seq, iters = 16, 512, 6
+    cfg = BertConfig(recompute=True, recompute_policy="dots_saveable")
+    paddle.seed(0)
+    model = BertForPretraining(cfg)
+    model.train()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    model, opt = amp.decorate(models=model, optimizers=opt, level="O2",
+                              dtype="bfloat16", master_weight=True)
+
+    @paddle.jit.to_static
+    def step(ids, seg, mlm, nsp):
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            loss = model(ids, seg, mlm, nsp)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.default_rng(0)
+
+    def batch_fn():
+        ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        seg = np.zeros((batch, seq), np.int32)
+        mlm = np.where(rng.uniform(size=(batch, seq)) < 0.15,
+                       rng.integers(0, cfg.vocab_size, (batch, seq)),
+                       -100).astype(np.int32)
+        nsp = rng.integers(0, 2, (batch,)).astype(np.int64)
+        return tuple(paddle.to_tensor(v) for v in (ids, seg, mlm, nsp))
+
+    for _ in range(2):
+        loss = step(*batch_fn())
+    float(loss)
+    dt = _window_time(step, [batch_fn() for _ in range(iters)])
+    tok_s = batch * seq * iters / dt
+    n = model.num_params()
+    achieved = tok_s * (6.0 * n + 12 * cfg.num_layers
+                        * cfg.hidden_size * seq)
+    return {"metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+            "value": round(tok_s, 1), "unit": "tokens/sec",
+            "extra": {"batch": batch, "seq_len": seq,
+                      "step_time_ms": round(dt / iters * 1e3, 2),
+                      "params": n, "amp": "O2-bf16-master",
+                      "model_tflops_per_sec": round(achieved / 1e12, 2),
+                      "mfu": round(achieved / peak, 4)}}
+
+
 def main():
     import jax
 
@@ -142,19 +261,12 @@ def main():
     # timed window: ONE dispatch for all iters via the scanned multi-step
     # program — per-step host dispatch (~13 ms/step over the axon tunnel,
     # profiled) would otherwise be billed to the chip
-    from paddle_tpu.jit import multi_step
-    losses = multi_step(train_step, [batch_fn() for _ in range(iters)])
-    float(losses[-1])  # compile the scan window + sync
     # best of 3 windows: the axon tunnel adds +-10% run-to-run scheduling
     # noise (device busy time is stable — profiled); best-of reports the
     # chip's actual capability
-    dt = float("inf")
-    for _ in range(3):
-        bs = [batch_fn() for _ in range(iters)]
-        t0 = time.perf_counter()
-        losses = multi_step(train_step, bs)
-        final_loss = float(losses[-1])  # sync
-        dt = min(dt, time.perf_counter() - t0)
+    dt, final_loss = _window_time(
+        train_step, [batch_fn() for _ in range(iters)], repeats=3,
+        with_loss=True)
 
     tokens_per_sec = batch * seq * iters / dt
     flops_per_token = model.flops_per_token(seq)
@@ -178,6 +290,25 @@ def main():
     }
     if on_tpu:
         extra["calibration"] = _calibration(cfg, batch, seq)
+        # free the GPT params/moments/compiled programs BEFORE the
+        # secondary models — leaving them resident OOMs ResNet50/BERT
+        import gc
+        del train_step, model, opt
+        gc.collect()
+        # the BASELINE.json north-star configs, measured on the same chip
+        # (kept inside the ONE headline line so the driver's single-line
+        # contract holds; BASELINE.md carries the same rows)
+        import sys as _sys
+        for fn in (_bench_resnet50, _bench_bert):
+            try:
+                row = fn(peak)
+                extra.setdefault("secondary", {})[row["metric"]] = {
+                    "value": row["value"], "unit": row["unit"],
+                    **row["extra"]}
+            except Exception as e:  # secondary must never kill the bench
+                print(f"secondary bench failed: {type(e).__name__}: {e}",
+                      file=_sys.stderr)
+            gc.collect()
 
     print(json.dumps({
         "metric": "gpt124m_train_tokens_per_sec_per_chip",

@@ -7,9 +7,10 @@ Built on the framework stft (XLA FFT), so feature extraction is
 jit-fusible and differentiable end-to-end.
 """
 from . import functional  # noqa: F401
+from . import datasets  # noqa: F401
 from .features import (  # noqa: F401
     LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram,
 )
 
-__all__ = ["functional", "Spectrogram", "MelSpectrogram",
+__all__ = ["functional", "datasets", "Spectrogram", "MelSpectrogram",
            "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,153 @@
+"""``paddle.audio.datasets`` parity (reference
+``python/paddle/audio/datasets/``: ``dataset.py`` AudioClassificationDataset,
+``tess.py`` TESS, ``esc50.py`` ESC50). Zero-egress image: the archives must
+be local directories of wav files; ``feat_type`` routes through
+``paddle.audio.features`` exactly like the reference."""
+from __future__ import annotations
+
+import os
+import wave
+
+import numpy as np
+
+from ..io import Dataset
+
+_FEAT_TYPES = ("raw", "melspectrogram", "mfcc", "logmelspectrogram",
+               "spectrogram")
+
+
+def _read_wav(path):
+    """(waveform float32 [-1, 1], sample_rate) via the stdlib wav reader
+    (no soundfile/librosa in this image)."""
+    with wave.open(path, "rb") as w:
+        sr = w.getframerate()
+        n = w.getnframes()
+        width = w.getsampwidth()
+        ch = w.getnchannels()
+        raw = w.readframes(n)
+    if width == 2:
+        data = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+    elif width == 4:
+        data = np.frombuffer(raw, np.int32).astype(np.float32) / 2**31
+    elif width == 1:
+        data = (np.frombuffer(raw, np.uint8).astype(np.float32)
+                - 128.0) / 128.0
+    else:
+        raise ValueError(f"unsupported wav sample width {width}")
+    if ch > 1:
+        data = data.reshape(-1, ch).mean(axis=1)
+    return data, sr
+
+
+class AudioClassificationDataset(Dataset):
+    """Reference ``audio/datasets/dataset.py``: (feature, label) items;
+    ``feat_type='raw'`` yields the waveform, else a feature transform
+    from ``paddle.audio.features``."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **feat_kwargs):
+        if feat_type not in _FEAT_TYPES:
+            raise ValueError(f"feat_type must be one of {_FEAT_TYPES}")
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+        self.sample_rate = sample_rate
+        self._feat_layers = {}
+
+    def _feature(self, wav, sr):
+        if self.feat_type == "raw":
+            return wav
+        from ..core.tensor import Tensor
+        layer = self._feat_layers.get(sr)
+        if layer is None:  # mel/DCT bases are per-rate; build once
+            from . import features as feats
+            cls = {"melspectrogram": "MelSpectrogram",
+                   "logmelspectrogram": "LogMelSpectrogram",
+                   "mfcc": "MFCC",
+                   "spectrogram": "Spectrogram"}[self.feat_type]
+            kw = dict(self.feat_kwargs)
+            if cls != "Spectrogram":   # Spectrogram is rate-agnostic
+                kw.setdefault("sr", sr)
+            layer = self._feat_layers[sr] = getattr(feats, cls)(**kw)
+        return np.asarray(layer(Tensor(wav[None]))._read())[0]
+
+    def __getitem__(self, idx):
+        wav, sr = _read_wav(self.files[idx])
+        if self.sample_rate and sr != self.sample_rate:
+            # naive linear resample (keeps parity testable without scipy
+            # signal dependencies in the hot path)
+            n_out = int(round(len(wav) * self.sample_rate / sr))
+            wav = np.interp(np.linspace(0, len(wav) - 1, n_out),
+                            np.arange(len(wav)), wav).astype(np.float32)
+            sr = self.sample_rate
+        return self._feature(wav, sr), np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+class TESS(AudioClassificationDataset):
+    """Reference ``audio/datasets/tess.py:26``: Toronto emotional speech
+    set — 7 emotions encoded in the filename's last underscore field
+    (``..._angry.wav``). ``archive_path`` is the extracted directory."""
+
+    EMOTIONS = ("angry", "disgust", "fear", "happy", "neutral", "ps",
+                "sad")
+
+    def __init__(self, archive_path=None, mode="train", n_folds=5,
+                 split=1, feat_type="raw", **kwargs):
+        if archive_path is None or not os.path.isdir(archive_path):
+            raise RuntimeError(
+                "TESS: pass archive_path= the extracted TESS directory "
+                "(no network egress in this environment)")
+        files, labels = [], []
+        for root, _, names in sorted(os.walk(archive_path)):
+            for nm in sorted(names):
+                if not nm.lower().endswith(".wav"):
+                    continue
+                emotion = nm.rsplit("_", 1)[-1][:-4].lower()
+                if emotion not in self.EMOTIONS:
+                    continue
+                files.append(os.path.join(root, nm))
+                labels.append(self.EMOTIONS.index(emotion))
+        # fold split like the reference: every n_folds-th item is eval
+        sel = [(i % n_folds) != (split - 1) for i in range(len(files))]
+        keep = [i for i, s in enumerate(sel)
+                if (s if mode == "train" else not s)]
+        super().__init__([files[i] for i in keep],
+                         [labels[i] for i in keep],
+                         feat_type=feat_type, **kwargs)
+
+
+class ESC50(AudioClassificationDataset):
+    """Reference ``audio/datasets/esc50.py``: 50-class environmental
+    sounds; label and fold come from the filename
+    (``{fold}-{id}-{take}-{target}.wav``)."""
+
+    def __init__(self, archive_path=None, mode="train", split=1,
+                 feat_type="raw", **kwargs):
+        if archive_path is None or not os.path.isdir(archive_path):
+            raise RuntimeError(
+                "ESC50: pass archive_path= the extracted ESC-50 audio "
+                "directory (no network egress in this environment)")
+        files, labels, folds = [], [], []
+        for root, _, names in sorted(os.walk(archive_path)):
+            for nm in sorted(names):
+                if not nm.lower().endswith(".wav"):
+                    continue
+                parts = nm[:-4].split("-")
+                if len(parts) != 4:
+                    continue
+                files.append(os.path.join(root, nm))
+                folds.append(int(parts[0]))
+                labels.append(int(parts[3]))
+        keep = [i for i in range(len(files))
+                if ((folds[i] != split) if mode == "train"
+                    else (folds[i] == split))]
+        super().__init__([files[i] for i in keep],
+                         [labels[i] for i in keep],
+                         feat_type=feat_type, **kwargs)
+
+
+__all__ = ["AudioClassificationDataset", "TESS", "ESC50"]

@@ -76,7 +76,7 @@ def _pool(name, x, nd, kernel_size, stride, padding, ceil_mode, data_format,
         if exclusive and padcfg != "SAME":
             ones = jnp.ones(v.shape, v.dtype)
             cnt = jax.lax.reduce_window(
-                ones, jnp.asarray(0, v.dtype), jax.lax.add, win, strd, padcfg)
+                ones, v.dtype.type(0), jax.lax.add, win, strd, padcfg)
             return s / cnt
         return s / float(np.prod(ks))
 
@@ -220,14 +220,18 @@ def _adaptive_pool(name, x, nd, output_size, data_format, kind):
         if all(v.shape[a] % o == 0 for a, o in zip(axes, out_sz)):
             ks = [v.shape[a] // o for a, o in zip(axes, out_sz)]
             win = _window(nd, in_ndim, channel_last, ks)
+            # numpy-scalar init: keeps lax.reduce_window on its
+            # DIFFERENTIABLE max/add monoid primitives (an array init
+            # forces the generic primitive, whose vjp fails under trace)
             if kind == "max":
-                init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) \
-                    else jnp.iinfo(v.dtype).min
+                init = (v.dtype.type(-np.inf)
+                        if jnp.issubdtype(v.dtype, jnp.floating)
+                        else v.dtype.type(jnp.iinfo(v.dtype).min))
                 return jax.lax.reduce_window(
-                    v, jnp.asarray(init, v.dtype), jax.lax.max, win, win,
+                    v, init, jax.lax.max, win, win,
                     [(0, 0)] * in_ndim)
             s = jax.lax.reduce_window(
-                v, jnp.asarray(0, v.dtype), jax.lax.add, win, win,
+                v, v.dtype.type(0), jax.lax.add, win, win,
                 [(0, 0)] * in_ndim)
             return s / float(np.prod(ks))
         # general path: gather per-output windows axis by axis

@@ -61,4 +61,94 @@ class Imikolov(_SyntheticTextDataset):
         return toks[:-1], toks[1:]
 
 
-__all__ = ["gather_tree", "viterbi_decode", "Imdb", "Imikolov"]
+class UCIHousing(Dataset):
+    """Reference ``text/datasets/uci_housing.py:42``: items are
+    (features [13] f32, target [1] f32). Reads the standard whitespace
+    ``housing.data`` file when given, else a deterministic synthetic
+    regression with the same shapes (zero-egress image)."""
+
+    FEATURES = 13
+
+    def __init__(self, data_file=None, mode="train"):
+        if data_file is not None:
+            raw = np.loadtxt(data_file, dtype=np.float32)
+        else:
+            rng = np.random.default_rng(0)
+            x = rng.normal(size=(506, self.FEATURES)).astype("float32")
+            w = rng.normal(size=(self.FEATURES,)).astype("float32")
+            y = (x @ w + 0.1 * rng.normal(size=506)).astype("float32")
+            raw = np.concatenate([x, y[:, None]], axis=1)
+        split = int(len(raw) * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1].astype("float32"), row[-1:].astype("float32")
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(_SyntheticTextDataset):
+    """Reference ``text/datasets/conll05.py:39`` (SRL): items are
+    (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_ids, mark,
+    label_ids) — synthetic with the reference's 9-field structure."""
+
+    num_labels = 67
+
+    def __getitem__(self, i):
+        toks = self.tokens[i]
+        ctx = [np.roll(toks, k) for k in (2, 1, 0, -1, -2)]
+        pred = np.full_like(toks, int(self.labels[i]))
+        mark = (toks % 7 == 0).astype("int64")
+        lab = (toks % self.num_labels).astype("int64")
+        return (toks, *ctx, pred, mark, lab)
+
+
+class Movielens(Dataset):
+    """Reference ``text/datasets/movielens.py``: items are
+    (user_id, gender, age, job, movie_id, category-multi-hot-ish title
+    ids, rating [1] f32) — synthetic with the same field layout."""
+
+    def __init__(self, data_file=None, mode="train", n=512, seed=0):
+        rng = np.random.default_rng(seed + (mode != "train"))
+        self.user = rng.integers(1, 6041, n)
+        self.gender = rng.integers(0, 2, n)
+        self.age = rng.integers(0, 7, n)
+        self.job = rng.integers(0, 21, n)
+        self.movie = rng.integers(1, 3953, n)
+        self.title = rng.integers(0, 5175, (n, 8))
+        self.rating = rng.integers(1, 6, n).astype("float32")
+
+    def __getitem__(self, i):
+        return (np.int64(self.user[i]), np.int64(self.gender[i]),
+                np.int64(self.age[i]), np.int64(self.job[i]),
+                np.int64(self.movie[i]), self.title[i].astype("int64"),
+                np.asarray([self.rating[i]], "float32"))
+
+    def __len__(self):
+        return len(self.user)
+
+
+class _WMT(_SyntheticTextDataset):
+    """Shared structure of wmt14/wmt16 (reference
+    ``text/datasets/wmt14.py``/``wmt16.py``): items are
+    (src_ids, trg_ids, trg_ids_next) for seq2seq training."""
+
+    def __getitem__(self, i):
+        toks = self.tokens[i]
+        half = len(toks) // 2
+        src, trg = toks[:half], toks[half:]
+        return src, trg[:-1], trg[1:]
+
+
+class WMT14(_WMT):
+    """Reference ``text/datasets/wmt14.py`` structure."""
+
+
+class WMT16(_WMT):
+    """Reference ``text/datasets/wmt16.py`` structure."""
+
+
+__all__ = ["gather_tree", "viterbi_decode", "Imdb", "Imikolov",
+           "UCIHousing", "Conll05st", "Movielens", "WMT14", "WMT16"]

@@ -95,3 +95,75 @@ def test_audio_feature_layers():
     mfcc = paddle.audio.MFCC(sr=sr, n_mfcc=13, n_fft=512, n_mels=40)(
         paddle.to_tensor(tone))
     assert tuple(mfcc.shape)[1] == 13
+
+
+def test_text_dataset_breadth():
+    """Round-3: UCIHousing/Conll05st/Movielens/WMT14/WMT16 structural
+    parity (reference item layouts)."""
+    from paddle_tpu import text
+
+    h = text.UCIHousing()
+    x, y = h[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert len(text.UCIHousing(mode="test")) < len(h)
+
+    c = text.Conll05st()
+    item = c[0]
+    assert len(item) == 9            # word, 5 ctx, pred, mark, label
+    assert all(len(f) == len(item[0]) for f in item)
+
+    m = text.Movielens()
+    u, g, a, j, mv, title, rating = m[0]
+    assert title.shape == (8,) and rating.shape == (1,)
+
+    for cls in (text.WMT14, text.WMT16):
+        src, trg, trg_next = cls()[0]
+        assert len(trg) == len(trg_next)
+        np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+
+
+def _write_wav(path, sr=16000, n=1600, freq=440.0):
+    import wave
+    t = np.arange(n) / sr
+    data = (np.sin(2 * np.pi * freq * t) * 0.5 * 32767).astype(np.int16)
+    with wave.open(str(path), "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sr)
+        w.writeframes(data.tobytes())
+
+
+def test_audio_datasets(tmp_path):
+    """TESS/ESC50 over local wav trees: filename-encoded labels, fold
+    splits, raw + feature item types."""
+    from paddle_tpu.audio.datasets import ESC50, TESS
+
+    tess_dir = tmp_path / "tess"
+    tess_dir.mkdir()
+    for i, emo in enumerate(("angry", "happy", "sad", "neutral",
+                             "fear", "disgust", "ps", "angry")):
+        _write_wav(tess_dir / f"OAF_word{i}_{emo}.wav")
+    ds = TESS(archive_path=str(tess_dir), mode="train", n_folds=4,
+              split=1)
+    ds_eval = TESS(archive_path=str(tess_dir), mode="dev", n_folds=4,
+                   split=1)
+    assert len(ds) + len(ds_eval) == 8
+    wav, label = ds[0]
+    assert wav.ndim == 1 and wav.dtype == np.float32
+    assert 0 <= int(label) < 7
+
+    esc_dir = tmp_path / "esc"
+    esc_dir.mkdir()
+    for fold in (1, 2):
+        for tgt in (0, 7):
+            _write_wav(esc_dir / f"{fold}-1000{tgt}-A-{tgt}.wav")
+    tr = ESC50(archive_path=str(esc_dir), mode="train", split=1)
+    ev = ESC50(archive_path=str(esc_dir), mode="dev", split=1)
+    assert len(tr) == 2 and len(ev) == 2
+    _, lab = tr[0]
+    assert int(lab) in (0, 7)
+    # feature route: mfcc item is 2-D [n_mfcc, frames]
+    feat_ds = ESC50(archive_path=str(esc_dir), mode="train", split=1,
+                    feat_type="mfcc", n_mfcc=13)
+    f, _ = feat_ds[0]
+    assert f.ndim == 2 and f.shape[0] == 13
