@@ -785,3 +785,82 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     if return_rois_num:
         return rois, probs, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
     return rois, probs
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=-1, return_index=False,
+                   return_rois_num=True, rois_num=None, name=None):
+    """Reference ``multiclass_nms3`` (``python/paddle/vision/ops.py``;
+    kernel ``paddle/phi/kernels/cpu/multiclass_nms3_kernel.cc``): per-class
+    greedy NMS over [N, M, 4] boxes / [N, C, M] scores, then a cross-class
+    keep_top_k. Host-side like ``nms``/``matrix_nms`` (data-dependent
+    output counts). Returns (out [R, 6] = (class, score, x1, y1, x2, y2),
+    [index [R, 1],] nms_rois_num [N])."""
+    b = np.asarray(unwrap(bboxes), np.float32)
+    s = np.asarray(unwrap(scores), np.float32)
+    off = 0.0 if normalized else 1.0
+
+    outs, idxs, nums = [], [], []
+    for n in range(b.shape[0]):
+        dets, det_idx = [], []
+        for c in range(s.shape[1]):
+            if c == background_label:
+                continue
+            sc = s[n, c]
+            keep = np.flatnonzero(sc > score_threshold)
+            if keep.size == 0:
+                continue
+            keep = keep[np.argsort(-sc[keep])]
+            if nms_top_k > 0:
+                keep = keep[:nms_top_k]
+            boxes_c, sc_c = b[n, keep], sc[keep]
+            order = np.arange(len(keep))
+            sel = []
+            thresh = nms_threshold
+            while len(order):
+                j = order[0]
+                sel.append(j)
+                if len(order) == 1:
+                    break
+                rest = order[1:]
+                x1 = np.maximum(boxes_c[j, 0], boxes_c[rest, 0])
+                y1 = np.maximum(boxes_c[j, 1], boxes_c[rest, 1])
+                x2 = np.minimum(boxes_c[j, 2], boxes_c[rest, 2])
+                y2 = np.minimum(boxes_c[j, 3], boxes_c[rest, 3])
+                inter = (np.clip(x2 - x1 + off, 0, None)
+                         * np.clip(y2 - y1 + off, 0, None))
+                area_j = ((boxes_c[j, 2] - boxes_c[j, 0] + off)
+                          * (boxes_c[j, 3] - boxes_c[j, 1] + off))
+                area_r = ((boxes_c[rest, 2] - boxes_c[rest, 0] + off)
+                          * (boxes_c[rest, 3] - boxes_c[rest, 1] + off))
+                iou = inter / np.maximum(area_j + area_r - inter, 1e-10)
+                order = rest[iou <= thresh]
+                if nms_eta < 1.0 and thresh * nms_eta > 0.5:
+                    thresh *= nms_eta
+            for j in sel:
+                dets.append([c, sc_c[j], *boxes_c[j]])
+                det_idx.append(keep[j])
+        if dets:
+            dets = np.asarray(dets, np.float32)
+            order = np.argsort(-dets[:, 1])
+            if keep_top_k > 0:
+                order = order[:keep_top_k]
+            dets = dets[order]
+            det_idx = np.asarray(det_idx)[order]
+        else:
+            dets = np.zeros((0, 6), np.float32)
+            det_idx = np.zeros((0,), np.int64)
+        outs.append(dets)
+        idxs.append(det_idx + n * b.shape[1])
+        nums.append(len(dets))
+
+    out = Tensor(jnp.asarray(np.concatenate(outs) if outs
+                             else np.zeros((0, 6), np.float32)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(
+            np.concatenate(idxs)[:, None].astype(np.int64))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(nums, np.int32))))
+    return tuple(res) if len(res) > 1 else out

@@ -206,3 +206,30 @@ def test_interpolate_bicubic_mode():
     back = F.interpolate(_t(cub), size=(4, 4), mode="bicubic").numpy()
     np.testing.assert_allclose(back[0, 0, 1:3, 1:3], x[0, 0, 1:3, 1:3],
                                atol=0.5)
+
+
+def test_multiclass_nms():
+    from paddle_tpu.vision.ops import multiclass_nms
+
+    bboxes = np.array([[[0, 0, 10, 10], [0, 1, 10, 11],
+                        [20, 20, 30, 30]]], np.float32)
+    scores = np.array([[[0.9, 0.85, 0.1],     # class 0
+                        [0.2, 0.3, 0.8]]], np.float32)  # class 1
+    out, idx, num = multiclass_nms(
+        _t(bboxes), _t(scores), score_threshold=0.15, nms_top_k=10,
+        keep_top_k=10, nms_threshold=0.5, return_index=True)
+    o = out.numpy()
+    assert int(num.numpy()[0]) == o.shape[0]
+    got = {(int(r[0]), tuple(r[2:].astype(int))): r[1] for r in o}
+    # class 0: near-duplicates suppressed, best kept
+    assert (0, (0, 0, 10, 10)) in got
+    assert (0, (0, 1, 10, 11)) not in got
+    # class 1: box 2 kept (0.8), box 1 kept too (0.3 > 0.15, disjoint)
+    assert (1, (20, 20, 30, 30)) in got
+    # results sorted by score descending
+    assert (np.diff(o[:, 1]) <= 1e-6).all()
+    # background_label removes a class entirely
+    out2 = multiclass_nms(_t(bboxes), _t(scores), score_threshold=0.15,
+                          nms_top_k=10, keep_top_k=10,
+                          background_label=0, return_rois_num=False)
+    assert (out2.numpy()[:, 0] == 1).all()
