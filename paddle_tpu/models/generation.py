@@ -84,15 +84,52 @@ def paged_cache_attention(q, k_new, v_new, k_pages, v_pages, pos,
 
 
 @primitive
+def cache_prefill(k_new, v_new, k_cache, v_cache):
+    """Write the WHOLE prompt's K/V [B, S, Hkv, D] into cache[:, :S] in
+    one shot (batched prefill — the serving-path complement of the
+    per-token ``cache_attention``; the reference reaches this via its
+    fused multi-transformer prefill kernels)."""
+    kc = lax.dynamic_update_slice_in_dim(
+        k_cache, k_new.astype(k_cache.dtype), 0, axis=1)
+    vc = lax.dynamic_update_slice_in_dim(
+        v_cache, v_new.astype(v_cache.dtype), 0, axis=1)
+    return kc, vc
+
+
+@primitive
+def paged_cache_prefill(k_new, v_new, k_pages, v_pages,
+                        block_tables=None):
+    """Scatter the prompt's K/V [B, S, Hkv, D] into the page pools at
+    (page, slot) = (bt[b, t//ps], t%ps) for t in [0, S)."""
+    b, s, hk, d = k_new.shape
+    bt = jnp.asarray(np.asarray(block_tables), jnp.int32)
+    ps = k_pages.shape[2]
+    t = jnp.arange(s)
+    page = bt[:, t // ps]                        # [B, S]
+    slot = jnp.broadcast_to(t % ps, (b, s))      # [B, S]
+    kn = jnp.transpose(k_new, (2, 0, 1, 3)).astype(k_pages.dtype)
+    vn = jnp.transpose(v_new, (2, 0, 1, 3)).astype(v_pages.dtype)
+    k_pages = k_pages.at[:, page, slot].set(kn)
+    v_pages = v_pages.at[:, page, slot].set(vn)
+    return k_pages, v_pages
+
+
+def _apply_rope(x, cos, sin):
+    """Rotate-half application — the ONE body both rope primitives share
+    (llama.rope_angles is the one home of the angle convention)."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return x * cos + rotated * sin
+
+
+@primitive
 def rope_at(x, pos, theta=10000.0):
     """Half-rotation rope for ONE position (decode): x [B, 1, H, D],
     pos [1] traced. Convention comes from llama.rope_angles (single
     home — training and decode paths cannot drift)."""
     from .llama import rope_angles
     cos, sin = rope_angles(pos.reshape(()), x.shape[-1], theta)
-    x1, x2 = jnp.split(x, 2, axis=-1)
-    rotated = jnp.concatenate([-x2, x1], axis=-1)
-    return x * cos + rotated * sin
+    return _apply_rope(x, cos, sin)
 
 
 def _empty_caches(model, batch, max_len):
@@ -164,15 +201,94 @@ def _llama_decode(model, ids_t, pos, caches, attend=cache_attention):
     return ops.reshape(logits, [logits.shape[0], -1]), new
 
 
+@primitive
+def rope_span(x, theta=10000.0):
+    """Half-rotation rope over positions 0..S-1 for the prefill pass:
+    x [B, S, H, D]. Angles/application share the rope_at homes (f64
+    tables like the training path — the decode path's traced-f32 angles
+    differ in low-order bits, the same tolerance the cached-vs-full
+    parity test already covers)."""
+    from .llama import rope_angles
+    cos, sin = rope_angles(np.arange(x.shape[1]), x.shape[-1], theta)
+    return _apply_rope(x, jnp.asarray(cos)[None, :, None, :],
+                       jnp.asarray(sin)[None, :, None, :])
+
+
+def _prompt_attention(q, k, v, use_flash=True):
+    import paddle_tpu.nn.functional as F
+    return F.scaled_dot_product_attention(
+        q, k, v, is_causal=True, dropout_p=0.0,
+        backend=None if use_flash else "xla")
+
+
+def _gpt_prefill(model, ids, caches, write):
+    """Whole-prompt forward that fills the KV caches and returns the
+    LAST position's logits — one compiled pass instead of S decode
+    steps (the serving prefill/decode split)."""
+    from .. import ops
+    gpt = model.gpt
+    b, s = ids.shape
+    x = gpt.wte(ids) + gpt.wpe(ops.arange(0, s, dtype="int32"))
+    new = []
+    for li, blk in enumerate(gpt.blocks):
+        h = blk.ln1(x)
+        qkv = ops.reshape(blk.attn.qkv(h),
+                          [b, s, 3, blk.attn.num_heads, blk.attn.head_dim])
+        q, k, v = ops.unbind(qkv, axis=2)
+        kc, vc = write(k, v, caches[2 * li], caches[2 * li + 1])
+        att = _prompt_attention(q, k, v, blk.attn.use_flash)
+        x = x + blk.attn.proj(ops.reshape(att, [b, s, -1]))
+        x = x + blk.mlp(blk.ln2(x))
+        new.extend([kc, vc])
+    h = gpt.ln_f(x)
+    last = h[:, s - 1:s]
+    if model.lm_head is not None:
+        logits = model.lm_head(last)
+    else:
+        logits = ops.matmul(last, gpt.wte.weight, transpose_y=True)
+    return ops.reshape(logits, [b, -1]), new
+
+
+def _llama_prefill(model, ids, caches, write):
+    from .. import ops
+    lm = model.llama
+    b, s = ids.shape
+    x = lm.embed_tokens(ids)
+    new = []
+    for li, layer in enumerate(lm.layers):
+        att_in = layer.input_norm(x)
+        a = layer.attn
+        q = ops.reshape(a.q_proj(att_in), [b, s, a.num_heads, a.head_dim])
+        k = ops.reshape(a.k_proj(att_in),
+                        [b, s, a.num_kv_heads, a.head_dim])
+        v = ops.reshape(a.v_proj(att_in),
+                        [b, s, a.num_kv_heads, a.head_dim])
+        q = rope_span(q, theta=a.rope_theta)
+        k = rope_span(k, theta=a.rope_theta)
+        kc, vc = write(k, v, caches[2 * li], caches[2 * li + 1])
+        att = _prompt_attention(q, k, v,
+                                model.cfg.use_flash_attention)
+        x = x + a.o_proj(ops.reshape(att, [b, s, -1]))
+        x = x + layer.mlp(layer.post_norm(x))
+        new.extend([kc, vc])
+    h = lm.norm(x)
+    last = h[:, s - 1:s]
+    if model.lm_head is not None:
+        logits = model.lm_head(last)
+    else:
+        logits = ops.matmul(last, lm.embed_tokens.weight, transpose_y=True)
+    return ops.reshape(logits, [b, -1]), new
+
+
 def _decode_fn(model):
-    """(decode_fn, hard_position_limit): GPT's learned wpe table makes
-    max_seq_len a hard bound; LLaMA's rope extrapolates (soft)."""
+    """(decode_fn, prefill_fn, hard_position_limit): GPT's learned wpe
+    table makes max_seq_len a hard bound; LLaMA's rope extrapolates."""
     from .gpt import GPTForCausalLM
     from .llama import LlamaForCausalLM
     if isinstance(model, GPTForCausalLM):
-        return _gpt_decode, True
+        return _gpt_decode, _gpt_prefill, True
     if isinstance(model, LlamaForCausalLM):
-        return _llama_decode, False
+        return _llama_decode, _llama_prefill, False
     raise TypeError(f"generate: unsupported model {type(model).__name__}")
 
 
@@ -196,7 +312,7 @@ def _empty_paged_caches(model, batch, max_len, page_size):
 
 def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
              top_p=None, eos_token_id=None, seed=None, use_jit=True,
-             kv_cache="dense", page_size=16):
+             kv_cache="dense", page_size=16, prefill=True):
     """Greedy / temperature / nucleus decoding with a KV cache.
 
     ``input_ids`` [B, S] prompt; returns [B, S + max_new_tokens] int32
@@ -208,6 +324,11 @@ def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
     reference's ``block_multi_head_attention`` serving path): attention
     compute scales with the current length instead of ``max_len``, the
     win at long sequences.
+
+    ``prefill=True`` (default) processes the whole prompt in ONE compiled
+    forward that fills the KV caches — prompt cost is a single pass
+    instead of prompt_len decode steps (the serving prefill/decode
+    split). ``prefill=False`` keeps the pure token-by-token path.
     """
     from .. import jit as jit_mod
     from ..ops.special import top_p_sampling
@@ -215,7 +336,7 @@ def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
     if kv_cache not in ("dense", "paged"):
         raise ValueError(f"kv_cache must be 'dense' or 'paged', "
                          f"got {kv_cache!r}")
-    decode, hard_limit = _decode_fn(model)
+    decode, prefill_fn, hard_limit = _decode_fn(model)
     ids = np.asarray(input_ids.numpy()
                      if isinstance(input_ids, Tensor) else input_ids)
     batch, prompt_len = ids.shape
@@ -233,25 +354,30 @@ def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
         caches, bt = _empty_paged_caches(model, batch, max_len, page_size)
         attend = functools.partial(paged_cache_attention,
                                    block_tables=bt.tolist())
+        write = functools.partial(paged_cache_prefill,
+                                  block_tables=bt.tolist())
     else:
         caches = _empty_caches(model, batch, max_len)
         attend = cache_attention
+        write = cache_prefill
     was_training = model.training
     model.eval()
     try:
-        return _generate_loop(model, decode, ids, batch, prompt_len,
-                              max_len, max_new_tokens, temperature, top_p,
-                              eos_token_id, seed, use_jit, caches,
-                              attend, kv_cache)
+        return _generate_loop(model, decode, prefill_fn, ids, batch,
+                              prompt_len, max_len, max_new_tokens,
+                              temperature, top_p, eos_token_id, seed,
+                              use_jit, caches, attend, write, kv_cache,
+                              prefill)
     finally:
         if was_training:
             model.train()
 
 
-def _generate_loop(model, decode, ids, batch, prompt_len, max_len,
-                   max_new_tokens, temperature, top_p, eos_token_id,
-                   seed, use_jit, caches, attend=cache_attention,
-                   kv_cache="dense"):
+def _generate_loop(model, decode, prefill_fn, ids, batch, prompt_len,
+                   max_len, max_new_tokens, temperature, top_p,
+                   eos_token_id, seed, use_jit, caches,
+                   attend=cache_attention, write=cache_prefill,
+                   kv_cache="dense", prefill=True):
     from .. import jit as jit_mod
     from ..ops.special import top_p_sampling
 
@@ -279,13 +405,41 @@ def _generate_loop(model, decode, ids, batch, prompt_len, max_len,
     out = np.concatenate(
         [ids, np.zeros((batch, max_new_tokens), ids.dtype)], axis=1)
     finished = np.zeros(batch, bool)
-    for t in range(max_len - 1):  # the last token needs no forward
-        tok = Tensor(jnp.asarray(out[:, t:t + 1].astype(np.int32)))
-        pos = Tensor(jnp.asarray([t], jnp.int32))
-        res = step_fn(tok, pos, *caches)
-        logits, caches = res[0], list(res[1:])
+
+    # batched prefill: ONE compiled whole-prompt pass fills the caches
+    # and yields the first sampled token, replacing prompt_len-1 decode
+    # steps (cached per (batch, prompt_len, cache kind) on the model)
+    t_start = 0
+    prefill_logits = None
+    if prefill and prompt_len > 1:
+        pf_key = ("prefill", batch, prompt_len, kv_cache, n_pages)
+        pf_fn = step_cache.get(pf_key)
+        if pf_fn is None:
+
+            def pf(tok_ids, *cs):
+                import paddle_tpu as pp
+                with pp.no_grad():
+                    logits, new = prefill_fn(model, tok_ids, list(cs),
+                                             write)
+                return (logits,) + tuple(new)
+
+            pf_fn = jit_mod.to_static(pf) if use_jit else pf
+            if use_jit:
+                step_cache[pf_key] = pf_fn
+        res = pf_fn(Tensor(jnp.asarray(ids.astype(np.int32))), *caches)
+        prefill_logits, caches = res[0], list(res[1:])
+        t_start = prompt_len - 1
+
+    for t in range(t_start, max_len - 1):  # last token needs no forward
+        if t == t_start and prefill_logits is not None:
+            logits = prefill_logits
+        else:
+            tok = Tensor(jnp.asarray(out[:, t:t + 1].astype(np.int32)))
+            pos = Tensor(jnp.asarray([t], jnp.int32))
+            res = step_fn(tok, pos, *caches)
+            logits, caches = res[0], list(res[1:])
         if t < prompt_len - 1:
-            continue  # prefill: ignore logits, just fill the cache
+            continue  # prompt region: ignore logits, just fill the cache
         lg = logits.numpy().astype(np.float32)
         if temperature != 1.0:
             lg = lg / max(temperature, 1e-6)

@@ -105,3 +105,37 @@ def test_paged_kv_cache_matches_dense(family):
     paged = generate(model, prompt, max_new_tokens=9,
                      kv_cache="paged", page_size=8).numpy()
     np.testing.assert_array_equal(paged, dense)
+
+
+@pytest.mark.parametrize("family,cache", [("gpt", "dense"),
+                                          ("gpt", "paged"),
+                                          ("llama", "dense"),
+                                          ("llama", "paged")])
+def test_batched_prefill_matches_token_by_token(family, cache):
+    """One compiled whole-prompt prefill pass must reproduce the pure
+    token-by-token sequence exactly, for both cache kinds.
+
+    Numerics: on the CPU suite both paths run f32 XLA attention; the
+    llama rope differs between f64-table (prefill, same as the training
+    path) and traced-f32 (decode) angles — the identical low-order
+    tolerance the long-standing cached-vs-full parity test relies on,
+    so exact argmax equality holds at these scales."""
+    paddle.seed(0)
+    if family == "gpt":
+        model = GPTForCausalLM(GPTConfig(
+            vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+            max_seq_len=64, dropout=0.0))
+    else:
+        model = LlamaForCausalLM(LlamaConfig(
+            vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, max_seq_len=64))
+    model.eval()
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, 96, (2, 13)).astype(np.int32)  # odd length:
+    # paged pages (size 8) end mid-page after the prompt
+    kw = dict(kv_cache=cache, page_size=8) if cache == "paged" else {}
+    with_pf = generate(model, prompt, max_new_tokens=7, prefill=True,
+                       **kw).numpy()
+    without = generate(model, prompt, max_new_tokens=7, prefill=False,
+                       **kw).numpy()
+    np.testing.assert_array_equal(with_pf, without)
