@@ -288,16 +288,28 @@ def main():
                          "counted once, remat recompute NOT counted "
                          "(true-work MFU)"),
     }
+    def emit():
+        print(json.dumps({
+            "metric": "gpt124m_train_tokens_per_sec_per_chip",
+            "value": round(tokens_per_sec, 1),
+            "unit": "tokens/sec",
+            "vs_baseline": round(mfu / 0.40, 4),
+            "extra": extra,
+        }), flush=True)
+
+    # kill-safety: the headline is measured — emit it NOW. The enriched
+    # line (calibration + north-star secondaries, ~20 extra minutes of
+    # compiles) re-emits the same metric afterwards; line-scanning
+    # parsers get a valid record whether they take the first or the
+    # last line, even if the process is killed mid-extras.
     if on_tpu:
+        emit()
         extra["calibration"] = _calibration(cfg, batch, seq)
         # free the GPT params/moments/compiled programs BEFORE the
         # secondary models — leaving them resident OOMs ResNet50/BERT
         import gc
         del train_step, model, opt
         gc.collect()
-        # the BASELINE.json north-star configs, measured on the same chip
-        # (kept inside the ONE headline line so the driver's single-line
-        # contract holds; BASELINE.md carries the same rows)
         import sys as _sys
         for fn in (_bench_resnet50, _bench_bert):
             try:
@@ -310,13 +322,7 @@ def main():
                       file=_sys.stderr)
             gc.collect()
 
-    print(json.dumps({
-        "metric": "gpt124m_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/sec",
-        "vs_baseline": round(mfu / 0.40, 4),
-        "extra": extra,
-    }))
+    emit()
 
 
 if __name__ == "__main__":
