@@ -33,7 +33,8 @@ from . import rpc  # noqa: F401
 from . import ps  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from . import elastic  # noqa: F401
-from .ps_dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from .ps_dataset import (InMemoryDataset, QueueDataset,  # noqa: F401
+                         multi_slot_parser)
 from .store import TCPStore  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import random
 
-__all__ = ["InMemoryDataset", "QueueDataset"]
+__all__ = ["InMemoryDataset", "QueueDataset", "multi_slot_parser"]
 
 
 class _DatasetBase:
@@ -24,9 +24,14 @@ class _DatasetBase:
     def init(self, batch_size=1, thread_num=1, parse_fn=None, use_var=None,
              pipe_command=None, **kwargs):
         """Reference ``dataset.init``: configure batching/threads and the
-        line parser (``parse_fn(line) -> sample``; the data_generator)."""
+        line parser (``parse_fn(line) -> sample``; the data_generator).
+        With ``use_var`` (slot declarations) and no explicit parse_fn,
+        lines parse as the reference's MultiSlotDataFeed format
+        (``multi_slot_parser``)."""
         self._batch_size = batch_size
         self._thread_num = thread_num
+        if parse_fn is None and use_var:
+            parse_fn = multi_slot_parser(use_var)
         self._parse_fn = parse_fn or (lambda line: line)
         return self
 
@@ -95,3 +100,46 @@ class QueueDataset(_DatasetBase):
 
         buffered = reader_mod.buffered(creator, max(self._thread_num, 1) * 64)
         return self._batches(buffered())
+
+
+def multi_slot_parser(slots):
+    """Reference ``MultiSlotDataFeed`` line format
+    (``paddle/fluid/framework/data_feed.cc`` MultiSlotDataFeed): each
+    line holds, per slot in declared order, ``<count> v_1 ... v_count``.
+    ``slots`` is a list of (name, dtype) pairs (or dicts with
+    name/dtype); returns ``parse_fn(line) -> {name: np.ndarray}``."""
+    import numpy as np
+
+    spec = []
+    for s in slots:
+        if isinstance(s, dict):
+            spec.append((s["name"], s.get("dtype", "int64")))
+        elif isinstance(s, (tuple, list)):
+            spec.append((s[0], s[1] if len(s) > 1 else "int64"))
+        else:  # bare name -> sparse id slot
+            spec.append((str(s), "int64"))
+
+    def parse(line):
+        toks = line.split()
+        out = {}
+        i = 0
+        for name, dtype in spec:
+            if i >= len(toks):
+                raise ValueError(
+                    f"multi_slot line ended before slot {name!r}: "
+                    f"{line!r}")
+            n = int(toks[i])
+            i += 1
+            vals = toks[i:i + n]
+            if len(vals) != n:
+                raise ValueError(
+                    f"slot {name!r} declares {n} values, line has "
+                    f"{len(vals)}: {line!r}")
+            i += n
+            out[name] = np.asarray(vals).astype(dtype)
+        if i != len(toks):
+            raise ValueError(
+                f"trailing tokens after last slot: {line!r}")
+        return out
+
+    return parse

@@ -278,3 +278,35 @@ def test_geo_local_steps_do_not_touch_server(cluster):
     w.sync()
     moved = client.pull_sparse("emb2", [3])
     assert np.abs(moved - before).max() > 0.5       # deltas arrived
+
+
+def test_multi_slot_datafeed(tmp_path):
+    """Reference MultiSlotDataFeed line format: per slot '<n> v1..vn';
+    use_var slot declarations auto-install the parser."""
+    from paddle_tpu.distributed import InMemoryDataset
+
+    f = tmp_path / "part-000"
+    # slots: click (1 int label), ids (sparse int64), dense (3 floats)
+    f.write_text("1 1 3 101 102 103 3 0.5 0.25 0.125\n"
+                 "1 0 2 7 9 3 1.0 2.0 3.0\n")
+    ds = InMemoryDataset()
+    ds.init(batch_size=2, use_var=[("click", "int64"), ("ids", "int64"),
+                                   ("dense", "float32")])
+    ds.set_filelist([str(f)])
+    ds.load_into_memory()
+    (batch,) = list(ds)
+    assert len(batch) == 2
+    s0, s1 = batch
+    assert s0["click"].tolist() == [1] and s1["click"].tolist() == [0]
+    assert s0["ids"].tolist() == [101, 102, 103]
+    assert s1["ids"].tolist() == [7, 9]
+    np.testing.assert_allclose(s0["dense"], [0.5, 0.25, 0.125])
+    # malformed line raises with slot context
+    bad = tmp_path / "bad"
+    bad.write_text("1 1 5 101\n")
+    ds2 = InMemoryDataset()
+    ds2.init(batch_size=1, use_var=["click", "ids"])
+    ds2.set_filelist([str(bad)])
+    with pytest.raises(ValueError, match="ids"):
+        ds2.load_into_memory()
+        list(ds2)
