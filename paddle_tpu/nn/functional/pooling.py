@@ -267,28 +267,52 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
                           data_format, "avg")
 
 
+def _adaptive_max_with_mask(name, x, nd, output_size, data_format):
+    """Adaptive max pool WITH flat-spatial argmax indices (reference
+    ``max_pool2d_with_index`` adaptive=true) via the shared region
+    reducer."""
+    from ...core.dispatch import apply
+
+    if not data_format.startswith("NC"):
+        raise ValueError(f"{name}: return_mask needs channel-first")
+    out_sizes = _tuplize(output_size, nd)
+
+    def impl(v):
+        out_sz = tuple(v.shape[2 + i] if o is None else int(o)
+                       for i, o in enumerate(out_sizes))
+
+        def bounds(_i, in_size, out_size):
+            starts, ends = _adaptive_windows(in_size, out_size)
+            return starts, ends - starts
+
+        out, idx = _region_pool_nd(v, out_sz, bounds)
+        return out.astype(v.dtype), idx
+
+    return apply(name, impl, x)
+
+
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
-    out = _adaptive_pool("adaptive_max_pool1d", x, 1, output_size, "NCW",
-                         "max")
     if return_mask:
-        raise NotImplementedError("return_mask on TPU backend")
-    return out
+        return _adaptive_max_with_mask("adaptive_max_pool1d", x, 1,
+                                       output_size, "NCW")
+    return _adaptive_pool("adaptive_max_pool1d", x, 1, output_size, "NCW",
+                          "max")
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    out = _adaptive_pool("adaptive_max_pool2d", x, 2, output_size, "NCHW",
-                         "max")
     if return_mask:
-        raise NotImplementedError("return_mask on TPU backend")
-    return out
+        return _adaptive_max_with_mask("adaptive_max_pool2d", x, 2,
+                                       output_size, "NCHW")
+    return _adaptive_pool("adaptive_max_pool2d", x, 2, output_size, "NCHW",
+                          "max")
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
-    out = _adaptive_pool("adaptive_max_pool3d", x, 3, output_size, "NCDHW",
-                         "max")
     if return_mask:
-        raise NotImplementedError("return_mask on TPU backend")
-    return out
+        return _adaptive_max_with_mask("adaptive_max_pool3d", x, 3,
+                                       output_size, "NCDHW")
+    return _adaptive_pool("adaptive_max_pool3d", x, 3, output_size, "NCDHW",
+                          "max")
 
 
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
@@ -415,9 +439,11 @@ def _frac_rng():
     return _frac_generator
 
 
-def _fractional_pool_nd(v, out_sz, u, kernel_caps):
-    """Gather every fractional region of every spatial axis, then reduce:
-    returns (max, flat argmax index over the ORIGINAL spatial dims)."""
+def _region_pool_nd(v, out_sz, bounds):
+    """Gather each axis's regions (``bounds(in_size, out_size) ->
+    (starts, lens)``) and max-reduce: returns (max, flat argmax index
+    over the ORIGINAL spatial dims). Shared by fractional and adaptive
+    max pooling (both are variable-window region reductions)."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -428,9 +454,7 @@ def _fractional_pool_nd(v, out_sz, u, kernel_caps):
     for i in range(nd):
         axis = 2 + 2 * i  # earlier axes each expanded into [out, L]
         in_size = cur.shape[axis]
-        starts, ln = _fractional_starts(in_size, out_sz[i], u)
-        if kernel_caps and kernel_caps[i]:
-            ln = np.minimum(ln, kernel_caps[i])
+        starts, ln = bounds(i, in_size, out_sz[i])
         L = int(ln.max())
         gm = np.minimum(starts[:, None] + np.arange(L)[None, :],
                         in_size - 1)
@@ -487,12 +511,19 @@ def _fractional_pool(name, x, nd, output_size, kernel_size, random_u,
         caps = ((kernel_size,) * nd if isinstance(kernel_size, int)
                 else tuple(kernel_size))
 
+    def bounds(i, in_size, out_size):
+        import numpy as np
+        starts, ln = _fractional_starts(in_size, out_size, u)
+        if caps and caps[i]:
+            ln = np.minimum(ln, caps[i])
+        return starts, ln
+
     def impl(v):
-        out, _ = _fractional_pool_nd(v, out_sz, u, caps)
+        out, _ = _region_pool_nd(v, out_sz, bounds)
         return out.astype(v.dtype)
 
     def impl_mask(v):
-        out, idx = _fractional_pool_nd(v, out_sz, u, caps)
+        out, idx = _region_pool_nd(v, out_sz, bounds)
         return out.astype(v.dtype), idx
 
     if return_mask:

@@ -233,3 +233,29 @@ def test_multiclass_nms():
                           nms_top_k=10, keep_top_k=10,
                           background_label=0, return_rois_num=False)
     assert (out2.numpy()[:, 0] == 1).all()
+
+
+def test_adaptive_max_pool_return_mask():
+    """Adaptive max pool with indices (reference max_pool2d_with_index
+    adaptive mode): values match the maskless path, indices address the
+    flat spatial dims."""
+    rng = np.random.default_rng(9)
+    x = _t(rng.normal(size=(2, 3, 9, 11)).astype("float32"))
+    out, idx = F.adaptive_max_pool2d(x, (4, 5), return_mask=True)
+    plain = F.adaptive_max_pool2d(x, (4, 5))
+    np.testing.assert_allclose(out.numpy(), plain.numpy())
+    flat = x.numpy().reshape(2, 3, -1)
+    picked = np.take_along_axis(flat, idx.numpy().reshape(2, 3, -1), -1)
+    np.testing.assert_allclose(picked.reshape(out.shape), out.numpy())
+
+    x3 = _t(rng.normal(size=(1, 2, 6, 6, 6)).astype("float32"))
+    o3, i3 = F.adaptive_max_pool3d(x3, 2, return_mask=True)
+    flat3 = x3.numpy().reshape(1, 2, -1)
+    picked3 = np.take_along_axis(flat3, i3.numpy().reshape(1, 2, -1), -1)
+    np.testing.assert_allclose(picked3.reshape(o3.shape), o3.numpy())
+
+    x1 = _t(rng.normal(size=(2, 3, 10)).astype("float32"))
+    o1, i1 = F.adaptive_max_pool1d(x1, 4, return_mask=True)
+    flat1 = x1.numpy().reshape(2, 3, -1)
+    picked1 = np.take_along_axis(flat1, i1.numpy().reshape(2, 3, -1), -1)
+    np.testing.assert_allclose(picked1.reshape(o1.shape), o1.numpy())
