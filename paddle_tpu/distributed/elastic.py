@@ -10,6 +10,15 @@ aborts comms after ``pg_timeout``) — TPU-shaped:
   node agent appends itself to a registration log and heartbeats a key;
   the master agent derives the alive set and publishes a new
   ``generation`` (member list + rank re-map) whenever it changes;
+
+  KNOWN LIMITATION (single point of failure): the store and the
+  membership scan live in the master agent (node rank 0) — if that node
+  dies the job cannot re-rendezvous, unlike the reference whose etcd
+  store survives its clients (``manager.py:126``). Mitigation path:
+  point every agent at an externally hosted TCPStore endpoint
+  (``--master`` on a machine outside the job) so agent death never takes
+  the store down, and elect a new scanning master from the surviving
+  agents (smallest alive node rank) on master-heartbeat loss;
 * on a generation change every agent stops its workers and respawns them
   with the re-mapped ``PADDLE_TRAINER_ID`` / ``PADDLE_TRAINERS_NUM`` env
   (the launcher is the supervisor — on TPU the collectives live inside

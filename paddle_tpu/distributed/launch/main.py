@@ -36,7 +36,12 @@ def _parse(argv):
                    help="elastic: restart a failed child up to N times")
     p.add_argument("--elastic", type=int, default=0,
                    help="1 = heartbeat membership + re-rendezvous on "
-                        "scale-up/down (requires --master for the store)")
+                        "scale-up/down (requires --master for the store). "
+                        "NOTE: the membership store lives in node-rank-0's "
+                        "launcher — losing that node ends rendezvous for "
+                        "the job (the reference's external etcd survives "
+                        "its clients); host the store externally or use a "
+                        "standby master to remove the SPOF")
     p.add_argument("--heartbeat_interval", type=float, default=1.0)
     p.add_argument("--heartbeat_timeout", type=float, default=5.0)
     p.add_argument("--progress_timeout", type=float, default=0.0,

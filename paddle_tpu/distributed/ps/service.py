@@ -41,6 +41,29 @@ class _Accessor:
             return value - self.lr * mhat / (np.sqrt(vhat) + self.eps)
         return value - self.lr * grad  # sgd
 
+    def apply_batch(self, values, grads, states):
+        """Vectorized ``apply`` over n stacked rows (one numpy pass
+        instead of n Python-level calls). ``states`` is the list of
+        per-row state dicts; mutated in place like ``apply``."""
+        if self.rule == "sum":
+            return values + grads
+        if self.rule == "adam":
+            m = np.stack([s["m"] for s in states])
+            v = np.stack([s["v"] for s in states])
+            t = np.array([[s["t"] + 1] for s in states], np.float64)
+            m = self.beta1 * m + (1 - self.beta1) * grads
+            v = self.beta2 * v + (1 - self.beta2) * grads * grads
+            mhat = m / (1 - self.beta1 ** t)
+            vhat = v / (1 - self.beta2 ** t)
+            out = values - self.lr * mhat / (np.sqrt(vhat) + self.eps)
+            for i, s in enumerate(states):
+                # copies, not views — a view would pin the whole batch's
+                # m/v arrays alive through one surviving row
+                s["m"], s["v"] = m[i].copy(), v[i].copy()
+                s["t"] = s["t"] + 1
+            return out.astype(np.float32)
+        return values - self.lr * grads  # sgd
+
 
 class _DenseTable:
     def __init__(self, shape, accessor, n_workers, sync):
@@ -434,10 +457,12 @@ class GeoSparseMirror:
             for i, r in zip(refresh, rows):
                 self._local[int(i)] = r.copy()
                 self._base[int(i)] = r.copy()
-        self._touched.clear()
+        # evict BEFORE clearing the touched set so just-refreshed hot rows
+        # survive the mirror cap (cold rows go first)
         if len(self._local) > self.max_mirror_rows:
             for i in [k for k in self._local
                       if k not in self._touched][:len(self._local)
                                                  - self.max_mirror_rows]:
                 self._local.pop(i, None)
                 self._base.pop(i, None)
+        self._touched.clear()

@@ -9,7 +9,18 @@ Store layout: one append-only data file of raw row blobs
 length)}`` index; overwrites append and orphan the old blob; compaction
 rewrites live blobs into a fresh file once garbage exceeds live bytes
 (the LSM analog, collapsed to one level — no merge hierarchy needed for
-a value-per-key workload)."""
+a value-per-key workload).
+
+PERFORMANCE HONESTY: this is the capability analog of the reference's
+rocksdb path, correctness-grade, not throughput-grade. Pull/push batch
+their numpy work (misses are read in file-offset order, new rows are
+initialized in one RNG call, and the optimizer update runs as one
+vectorized ``apply_batch`` pass), but the store is still a single
+Python-locked file with a stop-the-world full-file compaction. A
+production embedding workload (millions of rows/s) would need sharded
+C++ stores with background incremental compaction and overlapped I/O —
+the reference spends ``ssd_sparse_table.cc`` + rocksdb on exactly
+that."""
 from __future__ import annotations
 
 import os
@@ -126,33 +137,60 @@ class SsdSparseTable:
             k, (v, s) = self._hot.popitem(last=False)  # LRU
             self.store.put(k, self._encode(v, s))
 
-    def _row_entry(self, i):
-        i = int(i)
-        ent = self._hot.get(i)
-        if ent is not None:
+    def _load_batch(self, ids):
+        """Materialize all ids into the hot set in one pass: hot hits
+        move-to-end, disk misses are read in file-offset order (sequential
+        I/O), and never-seen rows are initialized with one RNG call."""
+        misses = list(dict.fromkeys(
+            i for i in ids if i not in self._hot))
+        disk = [i for i in misses if i in self.store.index]
+        fresh = [i for i in misses if i not in self.store.index]
+        for i in sorted(disk, key=lambda k: self.store.index[k][0]):
+            self._hot[i] = self._decode(self.store.get(i))
+        if fresh:
+            init = self._rng.normal(
+                0, 0.01, (len(fresh), self.dim)).astype(np.float32)
+            for i, row in zip(fresh, init):
+                # per-row copy: a view would pin the whole batch array
+                # in memory for as long as any single row stays hot
+                self._hot[i] = (row.copy(),
+                                self.accessor.init_state((self.dim,)))
+        for i in ids:
             self._hot.move_to_end(i)
-            return ent
-        if i in self.store:
-            ent = self._decode(self.store.get(i))
-        else:
-            ent = (self._rng.normal(0, 0.01, self.dim).astype(np.float32),
-                   self.accessor.init_state((self.dim,)))
-        self._hot[i] = ent
-        self._evict_if_needed()
-        return ent
+        # NOTE: eviction runs in pull/push AFTER the access — a batch
+        # larger than max_mem_rows may transiently overshoot the budget
+        # but must stay resident while being read/updated
 
     # ------------------------------------------------------------ api
     def pull(self, ids):
+        ids = [int(i) for i in ids]
         with self.lock:
-            return np.stack([self._row_entry(i)[0] for i in ids])
+            self._load_batch(ids)
+            out = np.stack([self._hot[i][0] for i in ids])
+            self._evict_if_needed()
+            return out
 
     def push(self, ids, grads):
+        ids = [int(i) for i in ids]
+        grads = np.asarray(grads, np.float32)
         with self.lock:
-            for i, g in zip(ids, grads):
-                i = int(i)
-                value, state = self._row_entry(i)
-                self._hot[i] = (self.accessor.apply(value, g, state),
-                                state)
+            self._load_batch(ids)
+            # duplicate ids in one push must apply sequentially (each
+            # update sees the previous one) — batch only the unique-id
+            # fast path
+            if len(set(ids)) == len(ids):
+                entries = [self._hot[i] for i in ids]
+                values = np.stack([e[0] for e in entries])
+                states = [e[1] for e in entries]
+                out = self.accessor.apply_batch(values, grads, states)
+                for i, row, s in zip(ids, out, states):
+                    self._hot[i] = (row.copy(), s)
+            else:
+                for i, g in zip(ids, grads):
+                    value, state = self._hot[i]
+                    self._hot[i] = (
+                        self.accessor.apply(value, g, state), state)
+            self._evict_if_needed()
 
     @property
     def mem_rows(self):

@@ -26,6 +26,14 @@ __all__ = [
 ]
 
 
+def _sum_rightmost(x, n):
+    """Sum the ``n`` rightmost dims (reference ``transform.py``'s
+    ``_sum_rightmost``); n==0 is the identity."""
+    if n <= 0:
+        return x
+    return jnp.sum(x, axis=tuple(range(-n, 0)))
+
+
 class Transform:
     """Bijector base (reference ``transform.py:59``)."""
 
@@ -184,10 +192,36 @@ class ChainTransform(Transform):
             y = t._inverse(y)
         return y
 
-    def _forward_log_det_jacobian(self, x):
-        total = 0.0
+    @property
+    def _domain_event_ndim(self):
+        # walk backward from the last codomain, widening for any part
+        # that consumes more event dims than the running value (reference
+        # sums rightmost dims per part via _sum_rightmost; the chain's
+        # domain rank is the widest requirement propagated to the input)
+        event = self.transforms[-1]._codomain_event_ndim
+        for t in reversed(self.transforms):
+            event += t._domain_event_ndim - t._codomain_event_ndim
+            event = max(event, t._domain_event_ndim)
+        return event
+
+    @property
+    def _codomain_event_ndim(self):
+        event = self.transforms[0]._domain_event_ndim
         for t in self.transforms:
-            total = total + t._forward_log_det_jacobian(x)
+            event += t._codomain_event_ndim - t._domain_event_ndim
+            event = max(event, t._codomain_event_ndim)
+        return event
+
+    def _forward_log_det_jacobian(self, x):
+        # per-part log-dets live at different event ranks; reduce each to
+        # the chain's domain rank before accumulating (reference
+        # transform.py:566 _sum_rightmost)
+        total = 0.0
+        event = self._domain_event_ndim
+        for t in self.transforms:
+            ld = t._forward_log_det_jacobian(x)
+            total = total + _sum_rightmost(ld, event - t._domain_event_ndim)
+            event += t._codomain_event_ndim - t._domain_event_ndim
             x = t._forward(x)
         return total
 
@@ -211,6 +245,15 @@ class IndependentTransform(Transform):
             raise TypeError("base must be a Transform")
         self.base = base
         self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+
+    @property
+    def _domain_event_ndim(self):
+        return self.base._domain_event_ndim + self.reinterpreted_batch_ndims
+
+    @property
+    def _codomain_event_ndim(self):
+        return (self.base._codomain_event_ndim
+                + self.reinterpreted_batch_ndims)
 
     def _forward(self, x):
         return self.base._forward(x)
@@ -236,6 +279,8 @@ class ReshapeTransform(Transform):
     def __init__(self, in_event_shape, out_event_shape):
         self.in_event_shape = tuple(in_event_shape)
         self.out_event_shape = tuple(out_event_shape)
+        self._domain_event_ndim = len(self.in_event_shape)
+        self._codomain_event_ndim = len(self.out_event_shape)
         import numpy as _np
         if int(_np.prod(self.in_event_shape)) != int(
                 _np.prod(self.out_event_shape)):
@@ -273,6 +318,8 @@ class SoftmaxTransform(Transform):
     reference, not a bijection — no log-det)."""
 
     _is_injective = False
+    _domain_event_ndim = 1
+    _codomain_event_ndim = 1
 
     def _forward(self, x):
         return jax.nn.softmax(x, axis=-1)
@@ -295,6 +342,16 @@ class StackTransform(Transform):
         self.transforms = list(transforms)
         self.axis = int(axis)
 
+    @property
+    def _domain_event_ndim(self):
+        # the stack axis selects which transform applies — it is a batch
+        # dim, so the event rank is the widest component's
+        return max(t._domain_event_ndim for t in self.transforms)
+
+    @property
+    def _codomain_event_ndim(self):
+        return max(t._codomain_event_ndim for t in self.transforms)
+
     def _map(self, fn_name, v):
         parts = jnp.split(v, len(self.transforms), axis=self.axis)
         outs = [getattr(t, fn_name)(jnp.squeeze(p, self.axis))
@@ -314,6 +371,9 @@ class StackTransform(Transform):
 class StickBreakingTransform(Transform):
     """Unconstrained R^K -> (K+1)-simplex via stick breaking (reference
     ``:1179``)."""
+
+    _domain_event_ndim = 1
+    _codomain_event_ndim = 1
 
     def _forward(self, x):
         k = x.shape[-1]

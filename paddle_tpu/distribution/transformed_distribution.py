@@ -1,13 +1,15 @@
 """TransformedDistribution (reference
 ``python/paddle/distribution/transformed_distribution.py:24``): push a
 base distribution through a chain of Transforms; ``log_prob`` applies the
-change-of-variables formula with the inverse log-det Jacobian."""
+change-of-variables formula with the inverse log-det Jacobian, tracking
+per-transform event ranks and summing the rightmost dims at each hop the
+way the reference's ``_sum_rightmost`` does (``transform.py:566``)."""
 from __future__ import annotations
 
 import jax.numpy as jnp
 
 from .distributions import Distribution, Tensor, _t, _wrap
-from .transform import ChainTransform, Transform
+from .transform import ChainTransform, Transform, _sum_rightmost
 
 
 class TransformedDistribution(Distribution):
@@ -25,9 +27,21 @@ class TransformedDistribution(Distribution):
         self.base = base
         self.transforms = list(transforms)
         chain = ChainTransform(self.transforms)
-        shape = chain.forward_shape(
-            tuple(base.batch_shape) + tuple(base.event_shape))
-        super().__init__(batch_shape=shape, event_shape=())
+        base_shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        base_event_ndim = len(base.event_shape)
+        domain_ndim = chain._domain_event_ndim
+        if len(base_shape) < domain_ndim:
+            raise ValueError(
+                f"base distribution's shape {base_shape} has fewer dims "
+                f"than the transform's domain event rank {domain_ndim}")
+        fwd_shape = chain.forward_shape(base_shape)
+        # event rank of the result: what the chain emits, plus any base
+        # event dims the chain never consumed
+        event_ndim = (chain._codomain_event_ndim
+                      + max(base_event_ndim - domain_ndim, 0))
+        cut = len(fwd_shape) - event_ndim
+        super().__init__(batch_shape=fwd_shape[:cut],
+                         event_shape=fwd_shape[cut:])
         self._chain = chain
 
     def sample(self, shape=()):
@@ -41,11 +55,17 @@ class TransformedDistribution(Distribution):
     def log_prob(self, value):
         y = _t(value)
         lp = 0.0
+        event_ndim = len(self.event_shape)
         for t in reversed(self.transforms):
             x = t._inverse(y)
-            lp = lp - t._forward_log_det_jacobian(x)
+            event_ndim += t._domain_event_ndim - t._codomain_event_ndim
+            ld = t._forward_log_det_jacobian(x)
+            lp = lp - _sum_rightmost(
+                ld, event_ndim - t._domain_event_ndim)
             y = x
         base_lp = _t(self.base.log_prob(_wrap(y)))
+        base_lp = _sum_rightmost(
+            base_lp, event_ndim - len(self.base.event_shape))
         return _wrap(base_lp + lp)
 
     def prob(self, value):

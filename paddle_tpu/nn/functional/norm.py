@@ -233,9 +233,14 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
     weight by its largest singular value, estimated with ``power_iters``
     rounds of power iteration on W reshaped to [shape[dim], -1].
 
-    Deterministic u/v start vectors (unit-normalized ones) keep the op
-    functional — the reference keeps persistent U/V buffers; the layer
-    wrapper owns those here."""
+    Deterministic u/v start vectors keep the op functional — the
+    reference keeps persistent randomly-initialized U/V buffers; the
+    layer wrapper owns those here. The start vector is a fixed-key
+    Gaussian draw rather than all-ones: an all-ones start is exactly
+    orthogonal to any zero-sum left-singular vector (common in centered
+    weights), which would converge power iteration to a smaller singular
+    value and under-normalize."""
+    import jax
     import jax.numpy as jnp
 
     from ...core.dispatch import apply
@@ -245,7 +250,8 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
         perm = [d] + [i for i in range(w.ndim) if i != d]
         mat = jnp.transpose(w, perm).reshape(w.shape[d], -1)
         h, wdim = mat.shape
-        u = jnp.full((h,), 1.0 / jnp.sqrt(float(h)), jnp.float32)
+        u = jax.random.normal(jax.random.PRNGKey(0), (h,), jnp.float32)
+        u = u / (jnp.linalg.norm(u) + eps)
         v = None
         m = mat.astype(jnp.float32)
         for _ in range(max(1, int(power_iters))):
