@@ -59,6 +59,11 @@ define_flag("benchmark", False, "sync + time every op")
 define_flag("eager_delete_tensor_gb", 0.0, "GC threshold (no-op under XLA; kept for parity)")
 define_flag("use_stride_kernel", True, "allow view/stride ops to alias (jax always copies-on-write)")
 define_flag("log_level", 0, "framework VLOG level")
+define_flag("while_grad_max_trip_count", 256,
+            "trip bound for differentiable while_loop under jit capture "
+            "(lowered to a masked lax.scan; XLA has no reverse-mode "
+            "while). A loop still live after this many iterations warns "
+            "at runtime and returns the bound-truncated carry.")
 
 
 class _GradMode(threading.local):

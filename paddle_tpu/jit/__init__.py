@@ -313,8 +313,9 @@ def _make_rebuilder(out):
         return rebuild
     if isinstance(out, dict):
         def rebuild_d(ts, _out=out):
+            # sorted: must mirror _flatten_tensors' dict walk order
             res, i = {}, 0
-            for k in _out:
+            for k in sorted(_out):
                 if isinstance(_out[k], Tensor):
                     res[k] = ts[i]
                     i += 1
@@ -353,6 +354,27 @@ class StaticFunction:
         self._fallback_counts: dict[Any, int] = {}
         self._full_graph = full_graph
         self.__name__ = getattr(fn, "__name__", "static_fn")
+        self._conv_fn = None
+        self._conv_tried = False
+
+    def _converted(self):
+        """The dy2static AST-converted function (plain Python if/while/for
+        on tensor predicates lowered to cond/while_loop — see
+        ``jit/dy2static.py``), or the original when conversion found
+        nothing to do or declined. Converted lazily on first call so
+        closure cells are populated."""
+        if not self._conv_tried:
+            self._conv_tried = True
+            try:
+                from .dy2static import convert_function
+                self._conv_fn = convert_function(self.fn)
+            except Exception as e:
+                warnings.warn(
+                    f"to_static: dy2static conversion of {self.__name__} "
+                    f"failed ({type(e).__name__}: {e}); using the "
+                    "original function")
+                self._conv_fn = None
+        return self._conv_fn or self.fn
 
     def __get__(self, instance, owner):
         # bound-method support for @to_static on Layer methods
@@ -375,13 +397,13 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if tensor_mod._tracker is not None:
             # nested to_static: inline into the outer capture
-            return self.fn(*args, **kwargs)
+            return self._converted()(*args, **kwargs)
         try:
             key = self._cache_key(args, kwargs)
         except Exception:
-            return self.fn(*args, **kwargs)
+            return self._converted()(*args, **kwargs)
         if key in self._fallback_keys:
-            return self.fn(*args, **kwargs)
+            return self._converted()(*args, **kwargs)
         exe = self._cache.get(key)
         arg_tensors = _flatten_tensors((list(args), kwargs), [])
         if exe is not None:
@@ -389,10 +411,11 @@ class StaticFunction:
         return self._capture(key, args, kwargs, arg_tensors)
 
     def _capture(self, key, args, kwargs, arg_tensors):
+        fn = self._converted()
         d = _DiscoveryTracker()
         old = tensor_mod.set_tracker(d)
         try:
-            out = self.fn(*args, **kwargs)
+            out = fn(*args, **kwargs)
         finally:
             tensor_mod.set_tracker(old)
         # a grad owner whose grad is None at function exit was cleared
@@ -402,7 +425,7 @@ class StaticFunction:
         d.grad_owners = {k: t for k, t in d.grad_owners.items()
                          if t._grad is not None}
         ret_tensors = _flatten_tensors(out, [])
-        exe = _Executable(self.fn, d, _make_rebuilder(out),
+        exe = _Executable(fn, d, _make_rebuilder(out),
                           len(ret_tensors))
         try:
             exe.build(arg_tensors, args, kwargs)
@@ -532,7 +555,10 @@ def _encode_structure(out):
         if isinstance(o, (list, tuple)):
             return ("seq", type(o).__name__, [enc(x) for x in o])
         if isinstance(o, dict):
-            return ("d", {k: enc(v) for k, v in o.items()})
+            # tensor indices MUST follow _flatten_tensors' walk order,
+            # which visits dict keys sorted — insertion order here would
+            # silently swap values between keys
+            return ("d", {k: enc(o[k]) for k in sorted(o)})
         return ("c", o)
     return enc(out), counter[0]
 
@@ -669,6 +695,34 @@ def save(layer, path, input_spec=None, **config):
                                 for s in specs])
     out_struct, n_out = _encode_structure(out_example)
 
+    # output names for the inference Predictor (reference: fetch-var
+    # names in the saved program): explicit ``output_names=[...]`` wins,
+    # else dict keys / tensor .name along the flatten order, else out{i}
+    out_names = []
+
+    def _name_walk(o, path):
+        if isinstance(o, Tensor):
+            nm = getattr(o, "name", None)
+            out_names.append(nm if nm else
+                             (path or f"out{len(out_names)}"))
+        elif isinstance(o, (list, tuple)):
+            for i, v in enumerate(o):
+                _name_walk(v, f"{path}.{i}" if path else str(i))
+        elif isinstance(o, dict):
+            for k in sorted(o):
+                _name_walk(o[k], f"{path}.{k}" if path else str(k))
+
+    explicit = config.get("output_names")
+    if explicit:
+        out_names = [str(n) for n in explicit]
+    else:
+        _name_walk(out_example, "")
+        # all-positional fallback keeps the legacy out{i} names
+        if all(n.isdigit() for n in out_names):
+            out_names = [f"out{i}" for i in range(len(out_names))]
+    if len(out_names) != n_out:
+        out_names = [f"out{i}" for i in range(n_out)]
+
     meta = {
         "format": "pdtpu.jit.v1",
         "stablehlo": bytes(exported.serialize()),
@@ -676,6 +730,7 @@ def save(layer, path, input_spec=None, **config):
         "out_struct": out_struct,
         "n_out": n_out,
         "in_specs": [(s.shape, s.dtype, s.name) for s in specs],
+        "out_names": out_names,
     }
     d = os.path.dirname(path)
     if d:

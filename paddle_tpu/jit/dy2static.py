@@ -1,0 +1,780 @@
+"""Automatic dynamic-to-static conversion: rewrite *natural Python*
+control flow into the framework's compiled control-flow ops.
+
+Capability analog of the reference's dy2static transformer stack
+(``python/paddle/jit/dy2static/transformers/ifelse_transformer.py``,
+``.../loop_transformer.py``, orchestrated from
+``program_translator.py:780``) — TPU-shaped in mechanism: instead of
+rewriting into ConditionalBlock/While ops over a ProgramDesc, the AST
+pass rewrites ``if``/``while``/``for range(...)`` statements into calls
+to :func:`run_if` / :func:`run_while`, which dispatch per site at
+runtime:
+
+- predicate is a **Tensor under jit capture** -> lower onto
+  ``static.nn.cond`` / ``static.nn.while_loop`` (ultimately
+  ``lax.cond`` / ``lax.while_loop`` / masked ``lax.scan``), keeping the
+  branch *inside* the single compiled XLA program;
+- predicate is a plain Python value (or we're eager) -> run the plain
+  Python control flow, bit-for-bit the original semantics.
+
+That per-site dispatch is the fallback granularity: a site the rewriter
+cannot convert (``return``/``break`` inside the block, attribute or
+subscript stores whose side effects a traced branch could not replay)
+is simply left as plain Python — only *that* statement graph-breaks,
+not the whole function.
+
+State handoff uses the reference's get/set-args pattern
+(``ifelse_transformer.py`` ``create_get_args_node``/
+``create_set_args_node``): names assigned inside a converted block are
+hoisted through closure get/set helpers with ``nonlocal`` declarations,
+and names possibly unbound at entry are pre-bound to the UNDEF sentinel
+(the reference's ``UndefinedVar``).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+import types
+
+__all__ = ["convert_function", "run_if", "run_while", "not_", "and_",
+           "or_", "range_args", "range_cond", "UNDEF"]
+
+_HELPER = "__pdtpu_d2s__"
+
+
+# ==========================================================================
+# runtime helpers (the rewritten code calls these)
+# ==========================================================================
+
+class _Undef:
+    """Sentinel for names unbound at block entry (the reference's
+    ``UndefinedVar``). Any use other than rebinding raises."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined local (paddle_tpu dy2static)>"
+
+    def __bool__(self):
+        raise NameError(
+            "local variable used before assignment (it was only assigned "
+            "inside a converted control-flow block that did not run)")
+
+
+UNDEF = _Undef()
+
+
+def _is_tensor(v):
+    from ..core.tensor import Tensor
+    return isinstance(v, Tensor)
+
+
+def _under_capture():
+    from ..core import tensor as tensor_mod
+    return tensor_mod._tracker is not None
+
+
+def _truthy(v):
+    if v is UNDEF:
+        raise NameError("control-flow predicate uses an unbound local")
+    return bool(v)
+
+
+def run_if(pred, true_fn, false_fn, get, set_):
+    """Runtime dispatch for a rewritten ``if`` statement."""
+    if _is_tensor(pred) and _under_capture():
+        from ..static.control_flow import cond as static_cond
+        init = get()
+
+        # branch thunks restore the frame state they found: they re-run
+        # at every (re)trace — probe, lax trace, backward-time vjp — and
+        # their nonlocal writes must never outlive the trace (the final
+        # set_ below owns the real result)
+        def t():
+            cur = get()
+            try:
+                set_(init)
+                true_fn()
+                return get()
+            finally:
+                set_(cur)
+
+        def f():
+            cur = get()
+            try:
+                set_(init)
+                false_fn()
+                return get()
+            finally:
+                set_(cur)
+
+        out = static_cond(pred, t, f)
+        set_(tuple(out))
+        return
+    if _truthy(pred):
+        true_fn()
+    else:
+        false_fn()
+
+
+def run_while(cond_fn, body_fn, get, set_, max_trip_count=None):
+    """Runtime dispatch for a rewritten ``while`` (or ``for range``)."""
+    first = cond_fn()
+    if _is_tensor(first) and _under_capture():
+        from ..static.control_flow import while_loop as static_while
+        init = get()
+
+        def c(*vs):
+            cur = get()
+            try:
+                set_(tuple(vs))
+                return cond_fn()
+            finally:
+                set_(cur)
+
+        def b(*vs):
+            cur = get()
+            try:
+                set_(tuple(vs))
+                body_fn()
+                return get()
+            finally:
+                set_(cur)
+
+        out = static_while(c, b, list(init),
+                           max_trip_count=max_trip_count)
+        set_(tuple(out))
+        return
+    if not _truthy(first):
+        return
+    body_fn()
+    while _truthy(cond_fn()):
+        body_fn()
+
+
+def not_(v):
+    if _is_tensor(v):
+        from .. import ops
+        return ops.logical_not(v)
+    return not v
+
+
+def and_(a, b_thunk):
+    if _is_tensor(a):
+        from .. import ops
+        return ops.logical_and(a, b_thunk())
+    return a and b_thunk()
+
+
+def or_(a, b_thunk):
+    if _is_tensor(a):
+        from .. import ops
+        return ops.logical_or(a, b_thunk())
+    return a or b_thunk()
+
+
+_SKIP_ROOTS = {"paddle_tpu", "jax", "jaxlib", "numpy", "torch", "flax",
+               "optax", "orbax", "chex", "einops", "builtins", "math",
+               "functools", "itertools", "typing"}
+import weakref
+
+# code-object-keyed caches. Values pin the code object so its id cannot
+# be recycled; the per-function-object cache is weak so per-call-created
+# closures do not accumulate.
+_decline_codes: dict[int, object] = {}       # id(code) -> code
+_conv_fns: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _skip_function(fn):
+    mod = (getattr(fn, "__module__", "") or "")
+    if mod.split(".")[0] in _SKIP_ROOTS:
+        return True
+    f = getattr(fn.__code__, "co_filename", "")
+    return "site-packages" in f or "/lib/python" in f
+
+
+def _convert_cached(fn):
+    cid = id(fn.__code__)
+    if cid in _decline_codes:
+        return None
+    try:
+        conv = _conv_fns.get(fn)
+    except TypeError:
+        conv = None
+    if conv is not None:
+        return conv
+    conv = convert_function(fn)
+    if conv is None:
+        _decline_codes[cid] = fn.__code__
+        return None
+    try:
+        _conv_fns[fn] = conv
+    except TypeError:
+        pass
+    return conv
+
+
+def call(f):
+    """Call-site wrapper (the reference's ``convert_call``,
+    ``jit/dy2static/convert_call_func.py``): convert user callables
+    recursively so control flow inside callees (e.g. a Layer's
+    ``forward``) lowers too; framework/library functions pass through."""
+    try:
+        from ..nn import Layer
+        if isinstance(f, Layer):
+            fwd = getattr(type(f), "forward", None)
+            if isinstance(fwd, types.FunctionType) \
+                    and not _skip_function(fwd):
+                conv = _convert_cached(fwd)
+                if conv is not None:
+                    return _LayerCallProxy(f, types.MethodType(conv, f))
+            return f
+        tgt = f.__func__ if isinstance(f, types.MethodType) else f
+        if not isinstance(tgt, types.FunctionType) or _skip_function(tgt):
+            return f
+        conv = _convert_cached(tgt)
+        if conv is None:
+            return f
+        if isinstance(f, types.MethodType):
+            return types.MethodType(conv, f.__self__)
+        return conv
+    except Exception:
+        return f
+
+
+class _LayerCallProxy:
+    """Invoke a Layer through its real ``__call__`` (pre/post hooks run)
+    with the converted ``forward`` shadowed in the instance dict for the
+    duration of the call."""
+
+    __slots__ = ("_layer", "_fwd")
+
+    def __init__(self, layer, fwd):
+        self._layer = layer
+        self._fwd = fwd
+
+    def __call__(self, *args, **kwargs):
+        layer = self._layer
+        had = "forward" in layer.__dict__
+        prev = layer.__dict__.get("forward")
+        layer.__dict__["forward"] = self._fwd
+        try:
+            return layer(*args, **kwargs)
+        finally:
+            if had:
+                layer.__dict__["forward"] = prev
+            else:
+                layer.__dict__.pop("forward", None)
+
+
+def range_args(*a):
+    if len(a) == 1:
+        return (0, a[0], 1)
+    if len(a) == 2:
+        return (a[0], a[1], 1)
+    if len(a) == 3:
+        return tuple(a)
+    raise TypeError(f"range expected 1-3 arguments, got {len(a)}")
+
+
+def range_cond(i, stop, step):
+    if isinstance(step, (int, float)):
+        if step == 0:
+            raise ValueError("range() arg 3 must not be zero")
+        return i < stop if step > 0 else i > stop
+    from .. import ops
+    return ops.logical_or(ops.logical_and(step > 0, i < stop),
+                          ops.logical_and(step < 0, i > stop))
+
+
+# ==========================================================================
+# AST analysis
+# ==========================================================================
+
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef, ast.ListComp, ast.SetComp, ast.DictComp,
+                   ast.GeneratorExp)
+
+
+def _walk_in_scope(node):
+    """ast.walk that does not descend into nested scopes."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if not isinstance(child, _SCOPE_BARRIERS):
+                stack.append(child)
+
+
+def _target_names(t, out):
+    if isinstance(t, ast.Name):
+        out.add(t.id)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            _target_names(e, out)
+    elif isinstance(t, ast.Starred):
+        _target_names(t.value, out)
+    # Attribute/Subscript targets are object mutations, not name binds
+
+
+def _assigned_names(stmts):
+    """Names bound by the statements (this scope only, ordered)."""
+    names: set[str] = set()
+    for s in stmts:
+        for n in _walk_in_scope(s):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    _target_names(t, names)
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                _target_names(n.target, names)
+            elif isinstance(n, ast.For):
+                _target_names(n.target, names)
+            elif isinstance(n, ast.NamedExpr):
+                _target_names(n.target, names)
+            elif isinstance(n, ast.withitem) and n.optional_vars:
+                _target_names(n.optional_vars, names)
+            elif isinstance(n, ast.Import):
+                for al in n.names:
+                    names.add((al.asname or al.name).split(".")[0])
+            elif isinstance(n, ast.ImportFrom):
+                for al in n.names:
+                    names.add(al.asname or al.name)
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                names.add(n.name)
+    return sorted(names)
+
+
+def _has_escape(stmts, *, loop_ctx=False):
+    """True if converting these statements into a nested function would
+    change semantics: return/yield anywhere in this scope, or
+    break/continue that binds to a loop OUTSIDE the statements
+    (``loop_ctx``: the statements themselves are a loop body, so depth-0
+    break/continue escapes), or ``del`` of a name."""
+
+    def walk(ss, depth):
+        for s in ss:
+            if isinstance(s, (ast.Return, ast.Delete)):
+                return True
+            if isinstance(s, (ast.Break, ast.Continue)) and depth == 0:
+                return True
+            for child_list, d in _child_blocks(s, depth):
+                if walk(child_list, d):
+                    return True
+            for n in _walk_in_scope(s):
+                if isinstance(n, (ast.Yield, ast.YieldFrom, ast.Await)):
+                    return True
+        return False
+
+    def _child_blocks(s, depth):
+        if isinstance(s, (ast.For, ast.While, ast.AsyncFor)):
+            yield s.body, depth + 1
+            yield s.orelse, depth
+        elif isinstance(s, ast.If):
+            yield s.body, depth
+            yield s.orelse, depth
+        elif isinstance(s, (ast.With, ast.AsyncWith)):
+            yield s.body, depth
+        elif isinstance(s, ast.Try):
+            yield s.body, depth
+            yield s.orelse, depth
+            yield s.finalbody, depth
+            for h in s.handlers:
+                yield h.body, depth
+        # nested defs: new scope, their returns/breaks are fine
+
+    return walk(stmts, 0)
+
+
+def _has_mangled_names(tree):
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Attribute) and n.attr.startswith("__") \
+                and not n.attr.endswith("__"):
+            return True
+    return False
+
+
+class _DeclScanner(ast.NodeVisitor):
+    def __init__(self):
+        self.globals: set[str] = set()
+        self.nonlocals: set[str] = set()
+
+    def visit_Global(self, node):
+        self.globals.update(node.names)
+
+    def visit_Nonlocal(self, node):
+        self.nonlocals.update(node.names)
+
+
+# ==========================================================================
+# AST rewriting
+# ==========================================================================
+
+class _PredRewriter(ast.NodeTransformer):
+    """Convert ``not``/``and``/``or`` inside a predicate expression into
+    tensor-aware helpers (reference ``logical_transformer.py``). Lazy
+    evaluation of and/or tails is preserved via thunks."""
+
+    def visit_UnaryOp(self, node):
+        node = self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _call(_HELPER + ".not_", [node.operand])
+        return node
+
+    def visit_BoolOp(self, node):
+        node = self.generic_visit(node)
+        fn = ".and_" if isinstance(node.op, ast.And) else ".or_"
+        out = node.values[0]
+        for v in node.values[1:]:
+            out = _call(_HELPER + fn, [out, _thunk(v)])
+        return out
+
+    # do not descend into new scopes inside the predicate
+    def visit_Lambda(self, node):
+        return node
+
+
+def _call(dotted, args):
+    mod, attr = dotted.rsplit(".", 1)
+    return ast.Call(
+        func=ast.Attribute(value=ast.Name(id=mod, ctx=ast.Load()),
+                           attr=attr, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _thunk(expr):
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=expr)
+
+
+def _parse_stmts(src):
+    return ast.parse(textwrap.dedent(src)).body
+
+
+class _Rewriter(ast.NodeTransformer):
+    def __init__(self, declared_globals, declared_nonlocals):
+        self.globals = declared_globals
+        self.nonlocals = declared_nonlocals
+        self.n = 0
+        self.converted_sites = 0
+        self.wrapped_calls = 0
+
+    # ---- scope barriers: transform only the target function's scope
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+    # ---- recursive callee conversion (reference convert_call)
+    _CALL_SKIP = frozenset({
+        "range", "len", "print", "super", "isinstance", "issubclass",
+        "type", "int", "float", "bool", "str", "tuple", "list", "dict",
+        "set", "frozenset", "enumerate", "zip", "map", "filter", "getattr",
+        "setattr", "hasattr", "repr", "id", "abs", "min", "max", "sum",
+        "sorted", "reversed", "any", "all", "iter", "next", "vars",
+        "locals", "globals",
+    })
+
+    def visit_Call(self, node):
+        node = self.generic_visit(node)
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in self._CALL_SKIP:
+            return node
+        node.func = _call(_HELPER + ".call", [node.func])
+        self.wrapped_calls += 1
+        return node
+
+    # ---------------------------------------------------------------- util
+    def _decls(self, names):
+        """nonlocal/global declaration statements for generated fns."""
+        g = [n for n in names if n in self.globals]
+        nl = [n for n in names if n not in self.globals]
+        out = []
+        if nl:
+            out.append(ast.Nonlocal(names=nl))
+        if g:
+            out.append(ast.Global(names=g))
+        return out
+
+    def _mkfn(self, name, body, state_names, args=None):
+        body = self._decls(state_names) + (body or [ast.Pass()])
+        if not body:
+            body = [ast.Pass()]
+        return ast.FunctionDef(
+            name=name,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=a) for a in (args or [])],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=body, decorator_list=[], returns=None)
+
+    def _guards(self, names):
+        """try/except pre-binding for every state name (makes the name a
+        bound local so nonlocal chains resolve, and UNDEF-fills names
+        unbound at entry)."""
+        out = []
+        for n in names:
+            if n in self.globals:
+                continue  # guards would shadow the global with a local
+            out.extend(_parse_stmts(
+                f"try:\n    {n}\n"
+                f"except (NameError, UnboundLocalError):\n"
+                f"    {n} = {_HELPER}.UNDEF"))
+        return out
+
+    def _getset(self, idx, names):
+        tup = "(" + ", ".join(names) + ("," if names else "") + ")"
+        get = self._mkfn(f"__pt{idx}_get",
+                         _parse_stmts(f"return {tup}"), [])
+        set_body = (_parse_stmts(f"{tup} = __pt_vals") if names
+                    else [ast.Pass()])
+        set_ = self._mkfn(f"__pt{idx}_set", set_body, names,
+                          args=["__pt_vals"])
+        return get, set_
+
+    def _state_names(self, *stmt_lists):
+        names = set()
+        for ss in stmt_lists:
+            names.update(_assigned_names(ss))
+        # generated helper FUNCTIONS are always (re)defined before use in
+        # their own scope — never cross-branch state. Generated loop
+        # counters (__ptN_i) stay: they are genuine carry state.
+        import re
+        drop = re.compile(r"__pt\d+_(true|false|get|set|cond|body)$")
+        return sorted(n for n in names if not drop.match(n))
+
+    # ------------------------------------------------------------------ if
+    def visit_If(self, node):
+        node = self.generic_visit(node)
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            return node
+        idx = self.n
+        self.n += 1
+        names = self._state_names(node.body, node.orelse)
+        test = _PredRewriter().visit(node.test)
+        tf = self._mkfn(f"__pt{idx}_true", node.body, names)
+        ff = self._mkfn(f"__pt{idx}_false", node.orelse, names)
+        get, set_ = self._getset(idx, names)
+        call = ast.Expr(value=_call(_HELPER + ".run_if", [
+            test,
+            ast.Name(id=tf.name, ctx=ast.Load()),
+            ast.Name(id=ff.name, ctx=ast.Load()),
+            ast.Name(id=f"__pt{idx}_get", ctx=ast.Load()),
+            ast.Name(id=f"__pt{idx}_set", ctx=ast.Load()),
+        ]))
+        out = self._guards(names) + [tf, ff, get, set_, call]
+        for s in out:
+            ast.copy_location(s, node)
+        self.converted_sites += 1
+        return out
+
+    # --------------------------------------------------------------- while
+    def visit_While(self, node):
+        node = self.generic_visit(node)
+        return self._convert_while(node)
+
+    def _convert_while(self, node):
+        if node.orelse or _has_escape(node.body, loop_ctx=True):
+            return node
+        idx = self.n
+        self.n += 1
+        names = self._state_names(node.body)
+        test = _PredRewriter().visit(node.test)
+        cf = self._mkfn(f"__pt{idx}_cond",
+                        [ast.Return(value=test)], [])
+        bf = self._mkfn(f"__pt{idx}_body", node.body, names)
+        get, set_ = self._getset(idx, names)
+        call = ast.Expr(value=_call(_HELPER + ".run_while", [
+            ast.Name(id=cf.name, ctx=ast.Load()),
+            ast.Name(id=bf.name, ctx=ast.Load()),
+            ast.Name(id=f"__pt{idx}_get", ctx=ast.Load()),
+            ast.Name(id=f"__pt{idx}_set", ctx=ast.Load()),
+        ]))
+        out = self._guards(names) + [cf, bf, get, set_, call]
+        for s in out:
+            ast.copy_location(s, node)
+        self.converted_sites += 1
+        return out
+
+    # ----------------------------------------------------------------- for
+    def visit_For(self, node):
+        node = self.generic_visit(node)
+        if (node.orelse
+                or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or node.iter.keywords
+                or any(isinstance(a, ast.Starred)
+                       for a in node.iter.args)
+                or _has_escape(node.body, loop_ctx=True)):
+            return node
+        idx = self.n
+        self.n += 1
+        r, i = f"__pt{idx}_range", f"__pt{idx}_i"
+        # the loop target is pre-bound to start so it is never UNDEF in
+        # the carry (documented divergence from CPython: an empty range
+        # leaves the target bound to start instead of unbound)
+        setup = _parse_stmts(
+            f"{r} = {_HELPER}.range_args({{args}})\n{i} = {r}[0]\n"
+            f"{node.target.id} = {r}[0]")
+        # splice real arg expressions into the range_args call
+        setup[0].value.args = list(node.iter.args)
+        while_node = ast.While(
+            test=_call(_HELPER + ".range_cond", [
+                ast.Name(id=i, ctx=ast.Load()),
+                _sub(r, 1), _sub(r, 2)]),
+            body=([ast.Assign(targets=[node.target],
+                              value=ast.Name(id=i, ctx=ast.Load()))]
+                  + node.body
+                  + _parse_stmts(f"{i} = {i} + {r}[2]")),
+            orelse=[])
+        for s in setup + [while_node]:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        out = self._convert_while(while_node)
+        if out is while_node:  # inner conversion declined; keep plain for
+            return node
+        return setup + out
+
+
+def _sub(name, i):
+    return ast.Subscript(value=ast.Name(id=name, ctx=ast.Load()),
+                         slice=ast.Constant(value=i), ctx=ast.Load())
+
+
+# ==========================================================================
+# entry point
+# ==========================================================================
+
+# id(code) -> (code_exec, fndef_name, has_factory); pins the original
+# code object (key stability) AND the compiled artifact, so fresh
+# function objects sharing a code (per-call closures) skip the AST
+# pipeline and only re-exec + rebind cells
+_artifact_cache: dict[int, tuple] = {}
+
+
+def _instantiate(fn, code, fndef_name, has_factory, gns):
+    loc: dict = {}
+    exec(code, gns, loc)
+    if has_factory:
+        inner_code = None
+        for const in loc["__pt_factory"].__code__.co_consts:
+            if isinstance(const, types.CodeType) \
+                    and const.co_name == fndef_name:
+                inner_code = const
+                break
+        if inner_code is None:
+            return None
+        cellmap = dict(zip(fn.__code__.co_freevars, fn.__closure__ or ()))
+        try:
+            closure = tuple(cellmap[v] for v in inner_code.co_freevars)
+        except KeyError:
+            return None
+        new = types.FunctionType(inner_code, gns, fn.__name__,
+                                 fn.__defaults__, closure)
+    else:
+        new = loc[fndef_name]
+        new.__defaults__ = fn.__defaults__
+    new.__kwdefaults__ = fn.__kwdefaults__
+    new.__dict__.update(fn.__dict__)
+    new.__wrapped_original__ = fn
+    return new
+
+
+def convert_function(fn):
+    """AST-convert ``fn``; returns the converted function, or ``None``
+    when nothing was (or could be) converted (caller keeps the
+    original). Mirrors ``program_translator.py:780``'s convert-on-entry,
+    collapsed to one pass since our per-site dispatch happens at
+    runtime."""
+    if not isinstance(fn, types.FunctionType):
+        return None
+    import sys
+    cached = _artifact_cache.get(id(fn.__code__))
+    if cached is not None:
+        gns = fn.__globals__
+        gns.setdefault(_HELPER, sys.modules[__name__])
+        return _instantiate(fn, *cached[:3], gns)
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    if not tree.body or not isinstance(tree.body[0], ast.FunctionDef):
+        return None
+    fndef = tree.body[0]
+    if fndef.name != fn.__name__:
+        return None
+    if _has_mangled_names(fndef):
+        return None  # source-level name mangling won't survive re-exec
+    for dec in fndef.decorator_list:
+        # stripping an unknown decorator would change behavior (and a
+        # wrapping decorator means ``fn`` isn't this source anyway)
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        name = d.attr if isinstance(d, ast.Attribute) else \
+            d.id if isinstance(d, ast.Name) else None
+        if name != "to_static":
+            return None
+    decls = _DeclScanner()
+    decls.visit(fndef)
+    if decls.nonlocals:
+        return None  # re-exec'd nonlocal writes would not share cells
+
+    rw = _Rewriter(decls.globals, decls.nonlocals)
+    new_body = []
+    for s in fndef.body:
+        r = rw.visit(s)
+        new_body.extend(r if isinstance(r, list) else [r])
+    fndef.body = new_body
+    if not rw.converted_sites and not rw.wrapped_calls:
+        return None
+    fndef.decorator_list = []
+
+    freevars = fn.__code__.co_freevars
+    if freevars:
+        # wrap in a factory that pre-binds the freevar names so the inner
+        # def compiles them as free variables again; then rebuild the
+        # function around the ORIGINAL closure cells (late rebinding in
+        # the defining scope stays visible)
+        factory = ast.FunctionDef(
+            name="__pt_factory",
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=([ast.Assign(
+                targets=[ast.Name(id=v, ctx=ast.Store())
+                         for v in freevars],
+                value=ast.Constant(value=None))]
+                + [fndef,
+                   ast.Return(value=ast.Name(id=fndef.name,
+                                             ctx=ast.Load()))]),
+            decorator_list=[], returns=None)
+        mod = ast.Module(body=[factory], type_ignores=[])
+    else:
+        mod = ast.Module(body=[fndef], type_ignores=[])
+    ast.fix_missing_locations(mod)
+
+    filename = f"<dy2static {getattr(fn.__code__, 'co_filename', '?')}:" \
+               f"{fn.__code__.co_firstlineno}>"
+    code = compile(mod, filename, "exec")
+    # 4th slot pins the original code object so the cache key id cannot
+    # be recycled by a new code object at the same address
+    _artifact_cache[id(fn.__code__)] = (code, fndef.name, bool(freevars),
+                                        fn.__code__)
+
+    gns = fn.__globals__
+    gns.setdefault(_HELPER, sys.modules[__name__])
+    return _instantiate(fn, code, fndef.name, bool(freevars), gns)

@@ -28,11 +28,18 @@ XLA constraints (documented divergences from the PIR executor):
   tensors that exist outside the branch raise (a traced branch cannot
   mutate framework state; the same code still works eagerly). This includes
   the global RNG — use dropout outside branches or pass explicit seeds.
-- ``while_loop`` under capture compiles to ``lax.while_loop`` only when no
-  operand needs gradients (XLA has no reverse-mode while). When gradients
-  are required the Python loop runs instead — unrolled into the capture,
-  which then degrades to the to_static eager fallback on replay, where the
-  loop differentiates normally through the tape.
+- ``while_loop`` under capture compiles to ``lax.while_loop`` when no
+  operand needs gradients. When gradients ARE required (XLA has no
+  reverse-mode while) it lowers to a **bounded ``lax.scan`` with
+  early-exit masking**: the scan runs ``max_trip_count`` iterations
+  (default from ``FLAGS_while_grad_max_trip_count``), each step applies
+  the body only while the predicate still held (``jnp.where`` select on
+  every carry leaf), so the loop stays inside the compiled program and
+  differentiates through the selected iterations — the capability analog
+  of the reference's differentiable While op
+  (``python/paddle/static/nn/control_flow.py:687``). A loop still live
+  at the bound warns at runtime (``jax.debug.callback``) and returns the
+  truncated carry.
 """
 from __future__ import annotations
 
@@ -375,9 +382,14 @@ def case(pred_fn_pairs, default=None, name=None):
 # while_loop
 # --------------------------------------------------------------------------
 
-def while_loop(cond, body, loop_vars, is_test=False, name=None):
+def while_loop(cond, body, loop_vars, is_test=False, name=None,
+               max_trip_count=None):
     """Repeat ``body`` while ``cond`` holds (reference
-    ``static/nn/control_flow.py:687``)."""
+    ``static/nn/control_flow.py:687``).
+
+    ``max_trip_count`` (extension): trip bound used only for the
+    differentiable lowering under jit capture; defaults to
+    ``FLAGS_while_grad_max_trip_count``."""
     if not callable(cond) or not callable(body):
         raise TypeError("while_loop: cond and body must be callable")
     if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
@@ -419,15 +431,18 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
     read_ids = [id(t) for t in reads]
     n_carry = len(carry_leaves)
 
-    if _needs_grad(carry_ts + reads):
-        # lax.while_loop has no reverse-mode rule; run the Python loop.
-        # During discovery this unrolls into the capture; the replay pass
-        # then hits bool(tracer) and to_static falls back to eager, where
-        # the loop differentiates through the tape (see module docstring).
-        return run_python_loop()
+    needs_grad = _needs_grad(carry_ts + reads)
+    if needs_grad:
+        bound = int(max_trip_count
+                    if max_trip_count is not None
+                    else state.get_flag("while_grad_max_trip_count"))
+        if bound <= 0:
+            # explicit opt-out of the scan lowering: Python unroll during
+            # discovery -> to_static eager fallback on replay, where the
+            # loop differentiates through the tape
+            return run_python_loop()
 
-    def _while_impl(*vals):
-        init = tuple(vals[:n_carry])
+    def _make_cond_body(vals):
         inv = dict(zip(read_ids, vals[n_carry:]))
 
         def wrap_vars(carry):
@@ -455,9 +470,46 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
             leaves, _, _ = _run_branch(run, subs_for(carry))
             return tuple(leaves)
 
-        return jax.lax.while_loop(cond_w, body_w, init)
+        return cond_w, body_w
 
-    flat = apply("while_loop", _while_impl, *carry_ts, *reads)
+    def _while_impl(*vals):
+        cond_w, body_w = _make_cond_body(vals)
+        return jax.lax.while_loop(cond_w, body_w, tuple(vals[:n_carry]))
+
+    def _while_scan_impl(*vals):
+        # differentiable lowering: bounded scan, body masked off once the
+        # predicate first fails (reverse-mode flows through the selected
+        # iterations only; jnp.where's vjp routes zero cotangent to the
+        # unselected branch)
+        cond_w, body_w = _make_cond_body(vals)
+        init = tuple(vals[:n_carry])
+
+        def step(carry, _):
+            done, vars_ = carry
+            live = jnp.logical_and(jnp.logical_not(done), cond_w(vars_))
+            new_vars = body_w(vars_)
+            sel = tuple(jnp.where(live, n, o)
+                        for n, o in zip(new_vars, vars_))
+            return (jnp.logical_or(done, jnp.logical_not(live)), sel), None
+
+        (done, final), _ = jax.lax.scan(
+            step, (jnp.zeros((), bool), init), None, length=bound)
+        still_live = jnp.logical_and(jnp.logical_not(done), cond_w(final))
+
+        def _warn(live):
+            if bool(live):
+                import warnings
+                warnings.warn(
+                    "while_loop: differentiable scan lowering hit its "
+                    f"trip bound ({bound}) with the predicate still "
+                    "true; result is truncated. Raise max_trip_count or "
+                    "FLAGS_while_grad_max_trip_count.")
+        jax.debug.callback(_warn, still_live)
+        return final
+
+    flat = apply("while_loop",
+                 _while_scan_impl if needs_grad else _while_impl,
+                 *carry_ts, *reads)
     res = _rebuild_out(carry_tree, list(flat))
     return list(res) if isinstance(loop_vars, list) else res
 

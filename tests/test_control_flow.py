@@ -165,16 +165,72 @@ def test_while_loop_python_scalar_loop_var_compiles():
     assert not sf._fallback_keys and not sf._fallback_counts
 
 
-def test_while_loop_with_grads_falls_back_but_works():
-    """Grad-requiring while cannot lower to lax.while_loop; to_static must
-    degrade to eager (retry budget then pin) and stay CORRECT."""
+def test_while_loop_with_grads_compiles():
+    """Grad-requiring while lowers to the bounded masked lax.scan and
+    STAYS COMPILED (no eager fallback), with correct gradients through
+    the selected iterations."""
+    w = _t(np.array([1.0], np.float32), stop_gradient=False)
+
+    @paddle.jit.to_static
+    def fn(x):
+        w.clear_grad()
+        i, y = while_loop(lambda i, y: i < 3,
+                          lambda i, y: (i + 1, y * w),
+                          [_t(0), x], max_trip_count=8)
+        loss = y.sum()
+        loss.backward()
+        return loss
+
+    x = _t(np.array([2.0], np.float32))
+    out = fn(x)
+    np.testing.assert_allclose(out.numpy(), 2.0)
+    np.testing.assert_allclose(w.grad.numpy(), [6.0])  # d(w^3*2)/dw at w=1
+    out = fn(x)  # replay: must hit the compiled cache, not fall back
+    np.testing.assert_allclose(out.numpy(), 2.0)
+    np.testing.assert_allclose(w.grad.numpy(), [6.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys, "while_loop with grads fell back"
+    assert len(sf._cache) == 1
+
+
+def test_while_loop_grad_data_dependent_trip_count():
+    """The early-exit mask must zero contributions past the dynamic stop:
+    two inputs with different trip counts give different grads from the
+    SAME compiled program."""
+    w = _t(np.array([2.0], np.float32), stop_gradient=False)
+
+    @paddle.jit.to_static
+    def fn(x, n):
+        w.clear_grad()
+        i, y = while_loop(lambda i, y: i < n,
+                          lambda i, y: (i + 1, y * w),
+                          [_t(0), x], max_trip_count=8)
+        loss = y.sum()
+        loss.backward()
+        return loss
+
+    x = _t(np.array([1.0], np.float32))
+    out2 = fn(x, _t(2))      # y = w^2 -> dy/dw = 2w = 4
+    np.testing.assert_allclose(out2.numpy(), 4.0)
+    np.testing.assert_allclose(w.grad.numpy(), [4.0])
+    out3 = fn(x, _t(3))      # y = w^3 -> dy/dw = 3w^2 = 12
+    np.testing.assert_allclose(out3.numpy(), 8.0)
+    np.testing.assert_allclose(w.grad.numpy(), [12.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys
+    assert len(sf._cache) == 1
+
+
+def test_while_loop_grads_opt_out_falls_back():
+    """max_trip_count=0 opts out of the scan lowering: the Python loop
+    unrolls and to_static degrades to eager, staying correct."""
     w = _t(np.array([1.0], np.float32), stop_gradient=False)
 
     @paddle.jit.to_static
     def fn(x):
         i, y = while_loop(lambda i, y: i < 3,
                           lambda i, y: (i + 1, y * w),
-                          [_t(0), x])
+                          [_t(0), x], max_trip_count=0)
         loss = y.sum()
         loss.backward()
         return loss
@@ -183,7 +239,7 @@ def test_while_loop_with_grads_falls_back_but_works():
     with pytest.warns(UserWarning, match="to_static"):
         out = fn(x)
     np.testing.assert_allclose(out.numpy(), 2.0)
-    np.testing.assert_allclose(w.grad.numpy(), [6.0])  # d(w^3*2)/dw at w=1
+    np.testing.assert_allclose(w.grad.numpy(), [6.0])
 
 
 def test_branch_structure_mismatch_raises():

@@ -1,0 +1,256 @@
+"""Automatic dy2static conversion: plain Python ``if``/``while``/``for``
+on tensor predicates must compile into ONE program (no eager fallback),
+matching the reference's transformer stack
+(``python/paddle/jit/dy2static/transformers/ifelse_transformer.py``,
+``loop_transformer.py``)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _t(v, stop_gradient=True):
+    t = paddle.to_tensor(np.asarray(v, np.float32))
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def _sf(fn):
+    return fn if hasattr(fn, "_fallback_keys") else fn.__wrapped__
+
+
+def test_plain_if_on_tensor_compiles():
+    @paddle.jit.to_static
+    def fn(x):
+        if x.mean() > 0:
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y
+
+    x = _t([1.0, 2.0])
+    np.testing.assert_allclose(fn(x).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(fn(_t([-1.0, -2.0])).numpy(), [-2.0, -3.0])
+    np.testing.assert_allclose(fn(x).numpy(), [2.0, 4.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys, "plain if fell back to eager"
+    assert len(sf._cache) == 1  # one program serves both branches
+
+
+def test_plain_if_elif_else_chain():
+    @paddle.jit.to_static
+    def fn(x):
+        if x.sum() > 10:
+            y = x * 100.0
+        elif x.sum() > 0:
+            y = x * 10.0
+        else:
+            y = x
+        return y
+
+    np.testing.assert_allclose(fn(_t([6.0, 6.0])).numpy(), [600.0, 600.0])
+    np.testing.assert_allclose(fn(_t([1.0, 1.0])).numpy(), [10.0, 10.0])
+    np.testing.assert_allclose(fn(_t([-1.0, -1.0])).numpy(), [-1.0, -1.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys
+    assert len(sf._cache) == 1
+
+
+def test_plain_while_on_tensor_compiles():
+    @paddle.jit.to_static
+    def fn(x):
+        with paddle.no_grad():
+            i = paddle.to_tensor(np.float32(0.0))
+            while i < 4:
+                x = x * 2.0
+                i = i + 1.0
+        return x
+
+    np.testing.assert_allclose(fn(_t([1.5])).numpy(), [24.0])
+    np.testing.assert_allclose(fn(_t([1.0])).numpy(), [16.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys, "plain while fell back"
+    assert len(sf._cache) == 1
+
+
+def test_plain_for_range_tensor_bound():
+    @paddle.jit.to_static
+    def fn(x, n):
+        with paddle.no_grad():
+            for _ in range(n):
+                x = x + 1.0
+        return x
+
+    np.testing.assert_allclose(fn(_t([0.0]), _t(3)).numpy(), [3.0])
+    np.testing.assert_allclose(fn(_t([0.0]), _t(5)).numpy(), [5.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys, "for range(tensor) fell back"
+    assert len(sf._cache) == 1  # same program, different n
+
+
+def test_bool_ops_in_predicate():
+    @paddle.jit.to_static
+    def fn(x, y):
+        if x.sum() > 0 and y.sum() > 0:
+            out = x + y
+        else:
+            out = x - y
+        if not (x.sum() > 0):
+            out = out * 10.0
+        return out
+
+    a, b = _t([1.0]), _t([2.0])
+    np.testing.assert_allclose(fn(a, b).numpy(), [3.0])
+    np.testing.assert_allclose(fn(a, _t([-2.0])).numpy(), [3.0])
+    np.testing.assert_allclose(fn(_t([-1.0]), b).numpy(), [-30.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys
+    assert len(sf._cache) == 1
+
+
+def test_python_predicate_stays_python():
+    # plain-Python condition: per-site python path, still compiles (the
+    # branch is baked per cache key like before)
+    @paddle.jit.to_static
+    def fn(x, flag=True):
+        if flag:
+            return x * 2.0
+        return x
+
+    np.testing.assert_allclose(fn(_t([3.0])).numpy(), [6.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys
+
+
+def test_grads_flow_through_converted_if():
+    w = _t([2.0], stop_gradient=False)
+
+    @paddle.jit.to_static
+    def fn(x):
+        w.clear_grad()
+        if x.sum() > 0:
+            y = (w * x).sum()
+        else:
+            y = (w * w * x).sum()
+        y.backward()
+        return y
+
+    out = fn(_t([3.0]))
+    np.testing.assert_allclose(out.numpy(), 6.0)
+    np.testing.assert_allclose(w.grad.numpy(), [3.0])
+    out = fn(_t([-3.0]))
+    np.testing.assert_allclose(out.numpy(), -12.0)
+    np.testing.assert_allclose(w.grad.numpy(), [-12.0])  # d(w^2 x)/dw=2wx
+    sf = _sf(fn)
+    assert not sf._fallback_keys
+    assert len(sf._cache) == 1
+
+
+def test_model_with_natural_branching_compiles():
+    """The VERDICT acceptance shape: a model written with plain Python
+    branching + a data-dependent loop compiles to one program."""
+
+    class GatedNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 1)
+
+        def forward(self, x):
+            h = self.fc1(x)
+            if h.mean() > 0:
+                h = paddle.tanh(h)
+            else:
+                h = paddle.nn.functional.relu(h)
+            return self.fc2(h)
+
+    paddle.seed(0)
+    net = GatedNet()
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=net.parameters())
+
+    @paddle.jit.to_static
+    def step(x, y):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.default_rng(0)
+    losses = []
+    for _ in range(5):
+        x = _t(rng.normal(size=(8, 4)))
+        y = _t(rng.normal(size=(8, 1)))
+        losses.append(float(step(x, y)))
+    sf = _sf(step)
+    assert not sf._fallback_keys, "model with natural branching fell back"
+    assert len(sf._cache) == 1
+    assert losses[-1] < losses[0]
+
+
+def test_unconvertible_site_return_in_branch():
+    # return inside a branch: site is left as plain Python. With a
+    # python predicate everything still works end to end.
+    @paddle.jit.to_static
+    def fn(x, flag=True):
+        if flag:
+            return x + 1.0
+        while x.sum() < 100:  # convertible site still converts
+            x = x * 2.0
+        return x
+
+    np.testing.assert_allclose(fn(_t([1.0])).numpy(), [2.0])
+
+
+def test_nested_if_inside_while():
+    @paddle.jit.to_static
+    def fn(x):
+        with paddle.no_grad():
+            i = paddle.to_tensor(np.float32(0.0))
+            while i < 3:
+                if x.sum() > 0:
+                    x = x + 1.0
+                else:
+                    x = x - 1.0
+                i = i + 1.0
+        return x
+
+    np.testing.assert_allclose(fn(_t([1.0])).numpy(), [4.0])
+    np.testing.assert_allclose(fn(_t([-5.0])).numpy(), [-8.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys
+    assert len(sf._cache) == 1
+
+
+def test_eager_semantics_preserved():
+    # the converted function must behave identically OUTSIDE capture
+    from paddle_tpu.jit.dy2static import convert_function
+
+    def orig(x, lo):
+        total = 0.0
+        for i in range(3):
+            total = total + i
+        if x > lo:
+            y = "big"
+        else:
+            y = "small"
+        while total < 10:
+            total = total + 4
+        return y, total
+
+    conv = convert_function(orig)
+    assert conv is not None
+    assert conv(5, 1) == orig(5, 1) == ("big", 11.0)
+    assert conv(0, 1) == orig(0, 1)
+
+
+def test_convert_function_declines_gracefully():
+    from paddle_tpu.jit.dy2static import convert_function
+
+    def no_sites(x):
+        return x + 1
+
+    assert convert_function(no_sites) is None
+    assert convert_function(len) is None  # builtin: no source
