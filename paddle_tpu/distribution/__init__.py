@@ -18,6 +18,10 @@ from .transform import (  # noqa: F401
     SigmoidTransform, SoftmaxTransform, StackTransform,
     StickBreakingTransform, TanhTransform,
 )
+from .multivariate import (  # noqa: F401
+    ContinuousBernoulli, ExponentialFamily, Independent,
+    MultivariateNormal,
+)
 from .transformed_distribution import TransformedDistribution  # noqa: F401
 
 __all__ = [
@@ -25,6 +29,8 @@ __all__ = [
     "Beta", "Dirichlet", "Gamma", "Binomial", "Exponential", "Laplace", "LogNormal",
     "Gumbel", "Cauchy", "Geometric", "Poisson", "Multinomial",
     "kl_divergence", "register_kl",
+    "MultivariateNormal", "ContinuousBernoulli", "Independent",
+    "ExponentialFamily",
     "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
     "ExpTransform", "IndependentTransform", "PowerTransform",
     "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",

@@ -1,0 +1,338 @@
+"""MultivariateNormal, ContinuousBernoulli, Independent and the
+ExponentialFamily base (reference
+``python/paddle/distribution/multivariate_normal.py:22``,
+``continuous_bernoulli.py:21``, ``independent.py:18``,
+``exponential_family.py:20``) — compact jnp implementations.
+
+MultivariateNormal works internally on the Cholesky factor (scale_tril)
+whichever parameterization the user gives, like the reference; densities
+are closed-form jnp expressions so they jit-fuse and differentiate."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distributions import (Distribution, Tensor, _key, _t, _wrap,
+                            register_kl)
+
+__all__ = ["MultivariateNormal", "ContinuousBernoulli", "Independent",
+           "ExponentialFamily"]
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+def _sum_rightmost(x, n):
+    return jnp.sum(x, axis=tuple(range(-n, 0))) if n > 0 else x
+
+
+class MultivariateNormal(Distribution):
+    """Reference ``multivariate_normal.py:88``: exactly one of
+    ``covariance_matrix`` / ``precision_matrix`` / ``scale_tril``."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None):
+        self.loc = jnp.atleast_1d(_t(loc))
+        given = [covariance_matrix is not None, precision_matrix is not None,
+                 scale_tril is not None]
+        if sum(given) != 1:
+            raise ValueError(
+                "Exactly one of covariance_matrix or precision_matrix or "
+                "scale_tril may be specified.")
+        if scale_tril is not None:
+            L = _t(scale_tril)
+            if L.ndim < 2:
+                raise ValueError("scale_tril matrix must be at least "
+                                 "two-dimensional")
+            self.scale_tril = L
+        elif covariance_matrix is not None:
+            C = _t(covariance_matrix)
+            if C.ndim < 2:
+                raise ValueError("covariance_matrix must be at least "
+                                 "two-dimensional")
+            self.scale_tril = jnp.linalg.cholesky(C)
+        else:
+            P = _t(precision_matrix)
+            if P.ndim < 2:
+                raise ValueError("precision_matrix must be at least "
+                                 "two-dimensional")
+            # reference precision_to_scale_tril (:433): invert the
+            # Cholesky factor of the reversed precision
+            Lf = jnp.linalg.cholesky(jnp.flip(P, (-2, -1)))
+            Linv = jnp.swapaxes(jnp.flip(Lf, (-2, -1)), -2, -1)
+            eye = jnp.eye(P.shape[-1], dtype=P.dtype)
+            self.scale_tril = jax.scipy.linalg.solve_triangular(
+                Linv, jnp.broadcast_to(eye, Linv.shape), lower=True)
+        self.covariance_matrix = (
+            self.scale_tril @ jnp.swapaxes(self.scale_tril, -2, -1))
+        batch = jnp.broadcast_shapes(self.scale_tril.shape[:-2],
+                                     self.loc.shape[:-1])
+        event = self.loc.shape[-1:]
+        self.loc = jnp.broadcast_to(self.loc, batch + event)
+        self.scale_tril = jnp.broadcast_to(self.scale_tril,
+                                           batch + event + event)
+        super().__init__(batch, event)
+
+    @property
+    def mean(self):
+        return _wrap(self.loc)
+
+    @property
+    def variance(self):
+        return _wrap(jnp.square(self.scale_tril).sum(-1))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape + self.event_shape
+        eps = jax.random.normal(_key(), shp, self.loc.dtype)
+        return _wrap(self.loc + jnp.einsum("...ij,...j->...i",
+                                           self.scale_tril, eps))
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+
+        def impl(v):
+            diff = v - self.loc
+            # Mahalanobis via triangular solve (reference
+            # batch_mahalanobis, :452)
+            sol = jax.scipy.linalg.solve_triangular(
+                jnp.broadcast_to(self.scale_tril,
+                                 jnp.broadcast_shapes(
+                                     self.scale_tril.shape,
+                                     diff.shape[:-1]
+                                     + self.scale_tril.shape[-2:])),
+                diff[..., None], lower=True)[..., 0]
+            m = jnp.square(sol).sum(-1)
+            half_logdet = jnp.log(jnp.diagonal(
+                self.scale_tril, axis1=-2, axis2=-1)).sum(-1)
+            k = self.loc.shape[-1]
+            return -0.5 * (k * _LOG_2PI + m) - half_logdet
+        return apply("mvn_log_prob", impl, value)
+
+    def entropy(self):
+        half_logdet = jnp.log(jnp.diagonal(
+            self.scale_tril, axis1=-2, axis2=-1)).sum(-1)
+        k = self.loc.shape[-1]
+        return _wrap(0.5 * k * (1.0 + _LOG_2PI) + half_logdet)
+
+    def kl_divergence(self, other):
+        return kl_divergence_mvn(self, other)
+
+
+def kl_divergence_mvn(p: MultivariateNormal, q: MultivariateNormal):
+    """Closed-form MVN KL (reference ``multivariate_normal.py:375``)."""
+    k = p.loc.shape[-1]
+    q_half_logdet = jnp.log(jnp.diagonal(
+        q.scale_tril, axis1=-2, axis2=-1)).sum(-1)
+    p_half_logdet = jnp.log(jnp.diagonal(
+        p.scale_tril, axis1=-2, axis2=-1)).sum(-1)
+    # tr(Σq^-1 Σp) = ||Lq^-1 Lp||_F^2
+    M = jax.scipy.linalg.solve_triangular(q.scale_tril, p.scale_tril,
+                                          lower=True)
+    tr = jnp.square(M).sum((-2, -1))
+    diff = q.loc - p.loc
+    sol = jax.scipy.linalg.solve_triangular(
+        q.scale_tril, diff[..., None], lower=True)[..., 0]
+    m = jnp.square(sol).sum(-1)
+    return _wrap(q_half_logdet - p_half_logdet + 0.5 * (tr + m - k))
+
+
+class ContinuousBernoulli(Distribution):
+    """Reference ``continuous_bernoulli.py:100``: the [0,1]-supported
+    exponential-family relaxation of Bernoulli; ``lims`` bounds the
+    unstable region around probs=0.5 where the Taylor expansion of the
+    normalizer is used."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        p = _t(probs)
+        eps = jnp.finfo(p.dtype).eps
+        self.probs = jnp.clip(jnp.atleast_1d(p), eps, 1 - eps)
+        self.lims = (float(lims[0]), float(lims[1]))
+        super().__init__(self.probs.shape, ())
+
+    def _outside(self):
+        return (self.probs < self.lims[0]) | (self.probs > self.lims[1])
+
+    def _cut_probs(self):
+        # pin the unstable mid-region to the lower lim (reference :154)
+        return jnp.where(self._outside(), self.probs,
+                         jnp.full_like(self.probs, self.lims[0]))
+
+    def _log_constant(self):
+        """log C(p) with the reference's 2nd-order Taylor fallback near
+        p=0.5 (reference :177)."""
+        cut = self._cut_probs()
+        # exact: C(p) = 2*arctanh(1-2p)/(1-2p)
+        exact = jnp.log(jnp.abs(jnp.arctanh(1.0 - 2.0 * cut))) \
+            - jnp.log(jnp.abs(1.0 - 2.0 * cut)) + math.log(2.0)
+        taylor = math.log(2.0) + 4.0 / 3.0 * jnp.square(self.probs - 0.5) \
+            + 104.0 / 45.0 * jnp.power(self.probs - 0.5, 4)
+        return jnp.where(self._outside(), exact, taylor)
+
+    @property
+    def mean(self):
+        cut = self._cut_probs()
+        exact = cut / (2.0 * cut - 1.0) \
+            + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * cut))
+        taylor = 0.5 + (self.probs - 0.5) / 3.0 \
+            + 16.0 / 45.0 * jnp.power(self.probs - 0.5, 3)
+        return _wrap(jnp.where(self._outside(), exact, taylor))
+
+    @property
+    def variance(self):
+        cut = self._cut_probs()
+        exact = cut * (cut - 1.0) / jnp.square(1.0 - 2.0 * cut) \
+            + 1.0 / jnp.square(2.0 * jnp.arctanh(1.0 - 2.0 * cut))
+        taylor = 1.0 / 12.0 - jnp.square(self.probs - 0.5) / 15.0 \
+            - 128.0 / 945.0 * jnp.power(self.probs - 0.5, 4)
+        return _wrap(jnp.where(self._outside(), exact, taylor))
+
+    def sample(self, shape=()):
+        import jax.lax as lax
+        u = jax.random.uniform(
+            _key(), tuple(shape) + self.batch_shape, self.probs.dtype)
+        return _wrap(lax.stop_gradient(self._icdf(u)))
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(
+            _key(), tuple(shape) + self.batch_shape, self.probs.dtype)
+        return _wrap(self._icdf(u))
+
+    def _icdf(self, u):
+        cut = self._cut_probs()
+        ratio = jnp.log1p(-cut) - jnp.log(cut)
+        exact = (jnp.log1p(u * jnp.expm1(-ratio)) ) / (-ratio)
+        return jnp.where(self._outside(), exact, u)
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+
+        def impl(v):
+            return (v * jnp.log(self.probs)
+                    + (1.0 - v) * jnp.log1p(-self.probs)
+                    + self._log_constant())
+        return apply("continuous_bernoulli_log_prob", impl, value)
+
+    def cdf(self, value):
+        v = _t(value)
+        cut = self._cut_probs()
+        ratio = jnp.log1p(-cut) - jnp.log(cut)
+        exact = (jnp.expm1(-ratio * v)) / jnp.expm1(-ratio)
+        out = jnp.where(self._outside(), exact, v)
+        return _wrap(jnp.clip(out, 0.0, 1.0))
+
+    def entropy(self):
+        # E[-log p(X)] with closed-form mean (differential entropy)
+        mu = self.mean
+        mu_v = mu._read() if isinstance(mu, Tensor) else mu
+        return _wrap(-(mu_v * jnp.log(self.probs)
+                       + (1.0 - mu_v) * jnp.log1p(-self.probs)
+                       + self._log_constant()))
+
+    def kl_divergence(self, other):
+        return _kl_continuous_bernoulli(self, other)
+
+
+def _kl_continuous_bernoulli(p, q):
+    """KL(p||q) = E_p[log p - log q] (closed form via E_p[X] = p.mean)."""
+    mu = p.mean
+    mu_v = mu._read() if isinstance(mu, Tensor) else jnp.asarray(mu)
+    t = (mu_v * (jnp.log(p.probs) - jnp.log(q.probs))
+         + (1.0 - mu_v) * (jnp.log1p(-p.probs) - jnp.log1p(-q.probs)))
+    return _wrap(t + p._log_constant() - q._log_constant())
+
+
+class Independent(Distribution):
+    """Reinterpret ``reinterpreted_batch_rank`` rightmost batch dims of
+    ``base`` as event dims (reference ``independent.py:51``)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Distribution):
+            raise TypeError("base must be a Distribution")
+        n = int(reinterpreted_batch_rank)
+        if not 0 < n <= len(base.batch_shape):
+            raise ValueError(
+                f"reinterpreted_batch_rank must be in (0, "
+                f"{len(base.batch_shape)}], got {n}")
+        self.base = base
+        self.reinterpreted_batch_rank = n
+        shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        cut = len(base.batch_shape) - n
+        super().__init__(shape[:cut], shape[cut:])
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = _t(self.base.log_prob(value))
+        return _wrap(_sum_rightmost(lp, self.reinterpreted_batch_rank))
+
+    def prob(self, value):
+        return _wrap(jnp.exp(_t(self.log_prob(value))))
+
+    def entropy(self):
+        e = _t(self.base.entropy())
+        return _wrap(_sum_rightmost(e, self.reinterpreted_batch_rank))
+
+
+@register_kl(Independent, Independent)
+def _kl_independent(p, q):
+    from .distributions import kl_divergence
+    if p.reinterpreted_batch_rank != q.reinterpreted_batch_rank:
+        raise NotImplementedError(
+            "KL between Independents with different batch ranks")
+    kl = _t(kl_divergence(p.base, q.base))
+    return _wrap(_sum_rightmost(kl, p.reinterpreted_batch_rank))
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference
+    ``exponential_family.py:20``): subclasses provide
+    ``_natural_parameters`` and ``_log_normalizer``; ``entropy`` comes
+    from the Bregman-divergence identity, with log-normalizer gradients
+    taken by jax autodiff (the reference differentiates the static
+    graph)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        # H = -E[carrier] + A(eta) - sum_i eta_i * dA/deta_i. A is
+        # elementwise over the batch, so grad of A.sum() gives the
+        # per-element partials.
+        nat = [jnp.asarray(_t(p)) for p in self._natural_parameters]
+        grads = jax.grad(
+            lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+            argnums=tuple(range(len(nat))))(*nat)
+        ent = -jnp.asarray(self._mean_carrier_measure) \
+            + self._log_normalizer(*nat)
+        for p, g in zip(nat, grads):
+            ent = ent - p * g
+        return _wrap(ent)
+
+
+register_kl(MultivariateNormal, MultivariateNormal)(kl_divergence_mvn)
+register_kl(ContinuousBernoulli, ContinuousBernoulli)(
+    _kl_continuous_bernoulli)

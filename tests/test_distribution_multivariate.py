@@ -1,0 +1,161 @@
+"""MultivariateNormal / ContinuousBernoulli / Independent /
+ExponentialFamily (reference ``python/paddle/distribution/
+multivariate_normal.py``, ``continuous_bernoulli.py``,
+``independent.py``, ``exponential_family.py``)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (
+    ContinuousBernoulli, ExponentialFamily, Independent,
+    MultivariateNormal, Normal, kl_divergence,
+)
+
+
+def _mvn_ref_logpdf(x, loc, C):
+    k = len(loc)
+    d = x - loc
+    return float(-0.5 * (k * np.log(2 * np.pi)
+                         + np.log(np.linalg.det(C))
+                         + d @ np.linalg.solve(C, d)))
+
+
+@pytest.fixture
+def mvn_setup():
+    rng = np.random.default_rng(0)
+    A = rng.normal(size=(3, 3))
+    C = (A @ A.T + 3 * np.eye(3)).astype(np.float32)
+    loc = rng.normal(size=3).astype(np.float32)
+    x = rng.normal(size=3).astype(np.float32)
+    return loc, C, x
+
+
+def test_mvn_log_prob_three_parameterizations(mvn_setup):
+    loc, C, x = mvn_setup
+    ref = _mvn_ref_logpdf(x, loc, C)
+    L = np.linalg.cholesky(C).astype(np.float32)
+    P = np.linalg.inv(C).astype(np.float32)
+    for kw in (dict(covariance_matrix=paddle.to_tensor(C)),
+               dict(scale_tril=paddle.to_tensor(L)),
+               dict(precision_matrix=paddle.to_tensor(P))):
+        d = MultivariateNormal(paddle.to_tensor(loc), **kw)
+        lp = float(d.log_prob(paddle.to_tensor(x)).numpy())
+        np.testing.assert_allclose(lp, ref, rtol=5e-3)
+    with pytest.raises(ValueError, match="Exactly one"):
+        MultivariateNormal(paddle.to_tensor(loc))
+
+
+def test_mvn_entropy_and_moments(mvn_setup):
+    loc, C, _ = mvn_setup
+    d = MultivariateNormal(paddle.to_tensor(loc),
+                           covariance_matrix=paddle.to_tensor(C))
+    k = 3
+    ref_ent = 0.5 * (k * (1 + np.log(2 * np.pi))
+                     + np.log(np.linalg.det(C)))
+    np.testing.assert_allclose(float(d.entropy().numpy()), ref_ent,
+                               rtol=1e-4)
+    np.testing.assert_allclose(d.mean.numpy(), loc, rtol=1e-6)
+    np.testing.assert_allclose(d.variance.numpy(), np.diag(C), rtol=1e-4)
+    paddle.seed(0)
+    s = d.sample((5000,)).numpy()
+    assert s.shape == (5000, 3)
+    np.testing.assert_allclose(s.mean(0), loc, atol=0.15)
+
+
+def test_mvn_kl(mvn_setup):
+    loc, C, _ = mvn_setup
+    p = MultivariateNormal(paddle.to_tensor(loc),
+                           covariance_matrix=paddle.to_tensor(C))
+    q = MultivariateNormal(paddle.to_tensor(loc + 0.5),
+                           covariance_matrix=paddle.to_tensor(C * 1.5))
+    assert abs(float(kl_divergence(p, p).numpy())) < 1e-6
+    # closed form vs definition: for MVNs KL = 0.5*(tr + m - k + logdet)
+    d = 0.5 * np.ones(3, np.float32)
+    tr = np.trace(np.linalg.solve(1.5 * C, C))
+    m = d @ np.linalg.solve(1.5 * C, d)
+    logdet = np.log(np.linalg.det(1.5 * C) / np.linalg.det(C))
+    ref = 0.5 * (tr + m - 3 + logdet)
+    np.testing.assert_allclose(float(kl_divergence(p, q).numpy()), ref,
+                               rtol=1e-3)
+
+
+@pytest.mark.parametrize("pr", [0.2, 0.4999, 0.5, 0.77])
+def test_continuous_bernoulli_density_normalizes(pr):
+    cb = ContinuousBernoulli(paddle.to_tensor(np.float32(pr)))
+    xs = np.linspace(1e-6, 1 - 1e-6, 20001, dtype=np.float32)
+    pdf = np.exp(cb.log_prob(paddle.to_tensor(xs)).numpy())
+    Z = np.trapezoid(pdf, xs)
+    mean_num = np.trapezoid(pdf * xs, xs)
+    var_num = np.trapezoid(pdf * (xs - mean_num) ** 2, xs)
+    np.testing.assert_allclose(Z, 1.0, atol=1e-3)
+    np.testing.assert_allclose(float(cb.mean.numpy()[0]), mean_num,
+                               atol=1e-3)
+    np.testing.assert_allclose(float(cb.variance.numpy()[0]), var_num,
+                               atol=1e-3)
+
+
+def test_continuous_bernoulli_cdf_icdf_sample():
+    cb = ContinuousBernoulli(paddle.to_tensor(np.float32(0.3)))
+    u = np.array([0.1, 0.5, 0.9], np.float32)
+    x = cb._icdf(u)
+    np.testing.assert_allclose(
+        cb.cdf(paddle.to_tensor(np.asarray(x))).numpy(), u, atol=1e-4)
+    paddle.seed(0)
+    s = cb.sample((4000,)).numpy()
+    assert ((s >= 0) & (s <= 1)).all()
+    np.testing.assert_allclose(s.mean(), float(cb.mean.numpy()[0]),
+                               atol=0.02)
+    q = ContinuousBernoulli(paddle.to_tensor(np.float32(0.6)))
+    assert float(kl_divergence(cb, cb).numpy()[0]) == pytest.approx(
+        0.0, abs=1e-6)
+    assert float(kl_divergence(cb, q).numpy()[0]) > 0
+
+
+def test_independent_reinterprets_batch_dims():
+    base = Normal(paddle.to_tensor(np.zeros((2, 3), np.float32)),
+                  paddle.to_tensor(np.ones((2, 3), np.float32)))
+    ind = Independent(base, 1)
+    assert tuple(ind.batch_shape) == (2,)
+    assert tuple(ind.event_shape) == (3,)
+    v = np.random.default_rng(0).normal(size=(2, 3)).astype(np.float32)
+    lp = ind.log_prob(paddle.to_tensor(v)).numpy()
+    assert lp.shape == (2,)
+    np.testing.assert_allclose(
+        lp, base.log_prob(paddle.to_tensor(v)).numpy().sum(-1),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        ind.entropy().numpy(), base.entropy().numpy().sum(-1), rtol=1e-5)
+    base2 = Normal(paddle.to_tensor(np.ones((2, 3), np.float32)),
+                   paddle.to_tensor(np.ones((2, 3), np.float32)))
+    kl = kl_divergence(Independent(base, 1), Independent(base2, 1))
+    np.testing.assert_allclose(
+        kl.numpy(), kl_divergence(base, base2).numpy().sum(-1),
+        rtol=1e-5)
+    with pytest.raises(ValueError):
+        Independent(base, 3)
+
+
+def test_exponential_family_entropy_bregman():
+    # Exponential(rate): eta = -rate, A(eta) = -log(-eta), carrier = 0;
+    # H = 1 - log(rate) — check the generic Bregman entropy against it
+    import jax.numpy as jnp
+
+    class ExpFam(ExponentialFamily):
+        def __init__(self, rate):
+            self.rate = np.float32(rate)
+            super().__init__((), ())
+
+        @property
+        def _natural_parameters(self):
+            return (paddle.to_tensor(-self.rate),)
+
+        def _log_normalizer(self, eta):
+            return -jnp.log(-eta)
+
+        @property
+        def _mean_carrier_measure(self):
+            return 0.0
+
+    d = ExpFam(2.0)
+    np.testing.assert_allclose(float(d.entropy().numpy()),
+                               1.0 - np.log(2.0), rtol=1e-5)
