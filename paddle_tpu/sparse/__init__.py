@@ -191,35 +191,190 @@ def masked_matmul(x: Tensor, y: Tensor, mask: SparseCooTensor):
         mask._shape)
 
 
+def _preserve(x, m, data):
+    """Rebuild x's storage kind around new values on the same pattern."""
+    out = SparseCooTensor(jsparse.BCOO((data, m.indices), shape=m.shape),
+                          x._shape)
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
 def _unary(fn):
-    def op(x):
+    def op(x, name=None):
         m = _coo(x)
-        return SparseCooTensor(jsparse.BCOO((fn(m.data), m.indices),
-                                            shape=m.shape), x._shape)
+        return _preserve(x, m, fn(m.data))
     return op
 
 
+# zero-preserving elementwise set (reference ``sparse/unary.py`` — the op
+# list is exactly the f(0)=0 functions, so the sparsity pattern carries)
 relu = _unary(lambda v: jnp.maximum(v, 0))
 sin = _unary(jnp.sin)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
 tanh = _unary(jnp.tanh)
+square = _unary(jnp.square)
 sqrt = _unary(jnp.sqrt)
 abs = _unary(jnp.abs)  # noqa: A001
 neg = _unary(jnp.negative)
 log1p = _unary(jnp.log1p)
 expm1 = _unary(jnp.expm1)
+rad2deg = _unary(jnp.rad2deg)
+deg2rad = _unary(jnp.deg2rad)
+isnan = _unary(jnp.isnan)
 
 
-class nn:
-    """``paddle.sparse.nn`` activation layers."""
+def pow(x, factor, name=None):  # noqa: A001
+    """Reference ``unary.py:575``."""
+    m = _coo(x)
+    return _preserve(x, m, jnp.power(m.data, unwrap(factor)))
 
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
 
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """Reference ``unary.py:537``."""
+    from ..core.dtype import convert_dtype
+    m = _coo(x)
+    idx, vals = m.indices, m.data
+    if index_dtype is not None:
+        idx = idx.astype(convert_dtype(index_dtype))
+    if value_dtype is not None:
+        vals = vals.astype(convert_dtype(value_dtype))
+    if index_dtype is None:
+        return _preserve(x, m, vals)
+    out = SparseCooTensor(jsparse.BCOO((vals, idx), shape=m.shape),
+                          x._shape)
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def coalesce(x, name=None):
+    """Reference ``unary.py:675``: merge duplicate coordinates."""
+    if isinstance(x, SparseCooTensor):
+        return x.coalesce()
+    return x
+
+
+def transpose(x, perm, name=None):
+    """Reference ``unary.py:136``: permute dims by index-row shuffle —
+    no value movement."""
+    m = _coo(x)
+    perm = [int(p) for p in perm]
+    idx = m.indices[:, jnp.asarray(perm)]
+    shape = tuple(x._shape[p] for p in perm)
+    out = SparseCooTensor(jsparse.BCOO((m.data, idx), shape=shape),
+                          shape)
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def reshape(x, shape, name=None):
+    """Reference ``unary.py:812``: linearize coordinates, unravel into
+    the new shape."""
+    import numpy as _np
+    m = _coo(x).sum_duplicates(nse=_coo(x).nse)
+    old = x._shape
+    n = int(_np.prod(old))
+    shape = list(shape)
+    if -1 in shape:
+        known = int(_np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = n // known
+    shape = tuple(int(s) for s in shape)
+    if int(_np.prod(shape)) != n:
+        raise ValueError(f"cannot reshape {old} into {shape}")
+    lin = jnp.zeros(m.indices.shape[0], jnp.int32)
+    stride = 1
+    for d in range(len(old) - 1, -1, -1):
+        lin = lin + m.indices[:, d].astype(jnp.int32) * stride
+        stride *= old[d]
+    new_idx = []
+    for d in range(len(shape) - 1, -1, -1):
+        new_idx.append((lin % shape[d]).astype(jnp.int32))
+        lin = lin // shape[d]
+    idx = jnp.stack(list(reversed(new_idx)), axis=1)
+    return SparseCooTensor(jsparse.BCOO((m.data, idx), shape=shape),
+                           shape)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """Reference ``unary.py:170``. axis=None collapses to a dense
+    scalar; otherwise the axis is dropped from the coordinates and
+    duplicates merge."""
+    m = _coo(x)
+    if axis is None:
+        out = m.data.sum()
+        if dtype is not None:
+            from ..core.dtype import convert_dtype
+            out = out.astype(convert_dtype(dtype))
+        return Tensor(out)
+    ax = int(axis) if int(axis) >= 0 else len(x._shape) + int(axis)
+    keep = [d for d in range(len(x._shape)) if d != ax]
+    idx = m.indices[:, jnp.asarray(keep)]
+    if keepdim:
+        idx = jnp.insert(idx, ax, 0, axis=1)
+        shape = tuple(1 if d == ax else s
+                      for d, s in enumerate(x._shape))
+    else:
+        shape = tuple(x._shape[d] for d in keep)
+    vals = m.data if dtype is None else m.data.astype(dtype)
+    out = jsparse.BCOO((vals, idx), shape=shape)
+    return SparseCooTensor(out.sum_duplicates(nse=out.nse), shape)
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    """Reference ``unary.py:947``: crop coordinate ranges (eager-only —
+    the output nnz is data-dependent)."""
+    import numpy as _np
+    m = _coo(x)
+    idx = _np.asarray(m.indices)
+    vals = _np.asarray(m.data)
+    shape = list(x._shape)
+    mask = _np.ones(idx.shape[0], bool)
+    for ax, s, e in zip(axes, starts, ends):
+        ax = int(ax) if int(ax) >= 0 else len(shape) + int(ax)
+        s = int(s) if int(s) >= 0 else shape[ax] + int(s)
+        e = int(e) if int(e) >= 0 else shape[ax] + int(e)
+        s, e = max(s, 0), min(e, shape[ax])
+        mask &= (idx[:, ax] >= s) & (idx[:, ax] < e)
+        idx = idx.copy()
+        idx[:, ax] -= s
+        shape[ax] = max(e - s, 0)
+    idx, vals = idx[mask], vals[mask]
+    out = jsparse.BCOO((jnp.asarray(vals), jnp.asarray(idx)),
+                       shape=tuple(shape))
+    return SparseCooTensor(out, tuple(shape))
+
+
+def mv(x, vec, name=None):
+    """sparse matrix @ dense vector (reference ``binary.py:176``)."""
+    if not isinstance(x, SparseTensor):
+        raise TypeError("sparse.mv expects a sparse lhs")
+    v = unwrap(vec) if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor(_coo(x) @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x@y) (reference ``multiary.py:22``)."""
+    d = unwrap(input) if isinstance(input, Tensor) else jnp.asarray(input)
+    prod = unwrap(matmul(x, y))
+    return Tensor(beta * d + alpha * prod)
+
+
+def is_same_shape(x, y):
+    """Reference ``binary.py:425``."""
+    sx = x._shape if isinstance(x, SparseTensor) else tuple(x.shape)
+    sy = y._shape if isinstance(y, SparseTensor) else tuple(y.shape)
+    return tuple(sx) == tuple(sy)
+
+
+from . import nn  # noqa: E402,F401
 
 __all__ = [
     "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
     "sparse_csr_tensor", "to_sparse_coo", "to_sparse_csr", "add",
-    "subtract", "multiply", "divide", "matmul", "masked_matmul", "relu",
-    "sin", "tanh", "sqrt", "abs", "neg", "log1p", "expm1", "nn",
+    "subtract", "multiply", "divide", "matmul", "masked_matmul", "mv",
+    "addmm", "is_same_shape", "relu", "sin", "tan", "asin", "atan",
+    "sinh", "asinh", "atanh", "tanh", "square", "sqrt", "abs", "neg",
+    "log1p", "expm1", "rad2deg", "deg2rad", "isnan", "pow", "cast",
+    "coalesce", "transpose", "reshape", "sum", "slice", "nn",
 ]

@@ -1,0 +1,207 @@
+"""``paddle.sparse.nn`` — layers over sparse tensors.
+
+Capability analog of ``python/paddle/sparse/nn/layer/`` (conv.py:27
+_Conv3D/_Conv2D + Conv3D/Conv2D/SubmConv3D/SubmConv2D, pooling.py:20
+MaxPool3D, norm.py:24 BatchNorm, activation.py ReLU/ReLU6/LeakyReLU/
+Softmax). TPU-shaped where it matters, honest where it doesn't: the
+convolutions run the standard gather-GEMM-scatter rulebook (per-kernel-
+offset index matching in numpy, channel GEMMs in jnp — the MXU work),
+eager-only since the output nnz is data-dependent; activations and
+BatchNorm act on the value array and jit-fuse."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from .. import SparseCooTensor, SparseCsrTensor, _coo
+from . import functional  # noqa: F401
+from .functional import (conv2d, conv3d, max_pool3d, subm_conv2d,
+                         subm_conv3d)
+
+__all__ = ["Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D", "MaxPool3D",
+           "BatchNorm", "ReLU", "ReLU6", "LeakyReLU", "Softmax",
+           "functional"]
+
+
+class _ConvNd(Layer):
+    def __init__(self, ndim, in_channels, out_channels, kernel_size,
+                 stride=1, padding=0, dilation=1, groups=1,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format=None, subm=False):
+        super().__init__()
+        from ...nn import initializer as I
+        if groups != 1:
+            raise NotImplementedError("sparse conv: groups != 1")
+        self._ndim = ndim
+        self._subm = subm
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else [kernel_size] * ndim
+        self._kernel_size = [int(v) for v in k]
+        s = stride if isinstance(stride, (list, tuple)) \
+            else [stride] * ndim
+        self._stride = [int(v) for v in s]
+        p = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * ndim
+        self._padding = [int(v) for v in p]
+        d = dilation if isinstance(dilation, (list, tuple)) \
+            else [dilation] * ndim
+        self._dilation = [int(v) for v in d]
+        self.weight = self.create_parameter(
+            self._kernel_size + [in_channels, out_channels],
+            attr=weight_attr, default_initializer=I.XavierUniform())
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        fn = {(3, False): conv3d, (3, True): subm_conv3d,
+              (2, False): conv2d, (2, True): subm_conv2d}[
+                  (self._ndim, self._subm)]
+        return fn(x, self.weight, self.bias, stride=self._stride,
+                  padding=self._padding, dilation=self._dilation)
+
+
+class Conv3D(_ConvNd):
+    """Reference ``sparse/nn/layer/conv.py:239``: input is a 5-D
+    SparseCooTensor [N, D, H, W, C_in]."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(3, in_channels, out_channels, kernel_size,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format, subm=False)
+
+
+class SubmConv3D(_ConvNd):
+    """Reference ``conv.py:509``: submanifold conv — output sites are
+    exactly the input sites (no dilation of the active set)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(3, in_channels, out_channels, kernel_size,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format, subm=True)
+
+
+class Conv2D(_ConvNd):
+    """Reference ``conv.py:374``: 4-D SparseCooTensor [N, H, W, C]."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(2, in_channels, out_channels, kernel_size,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format, subm=False)
+
+
+class SubmConv2D(_ConvNd):
+    """Reference ``conv.py:649``."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__(2, in_channels, out_channels, kernel_size,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format, subm=True)
+
+
+class MaxPool3D(Layer):
+    """Reference ``sparse/nn/layer/pooling.py:20``."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        self._kernel_size = kernel_size
+        self._stride = stride if stride is not None else kernel_size
+        self._padding = padding
+
+    def forward(self, x):
+        return max_pool3d(x, self._kernel_size, self._stride,
+                          self._padding)
+
+
+class BatchNorm(Layer):
+    """Reference ``sparse/nn/layer/norm.py:24``: BatchNorm over the
+    channel (last) dim of the VALUES array — the active sites are the
+    batch."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ...nn import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr)
+
+    def forward(self, x):
+        m = _coo(x)
+        out = self._bn(Tensor(m.data))
+        data = out._read() if isinstance(out, Tensor) else out
+        return SparseCooTensor(
+            jsparse.BCOO((data, m.indices), shape=m.shape), x._shape)
+
+
+def _values_layer(fn_builder):
+    class _L(Layer):
+        def __init__(self, *a, **kw):
+            super().__init__()
+            self._fn = fn_builder(*a, **kw)
+
+        def forward(self, x):
+            m = _coo(x)
+            out = SparseCooTensor(
+                jsparse.BCOO((self._fn(m.data), m.indices),
+                             shape=m.shape), x._shape)
+            if isinstance(x, SparseCsrTensor):
+                return out.to_sparse_csr()
+            return out
+    return _L
+
+
+ReLU = _values_layer(lambda name=None: lambda v: jnp.maximum(v, 0))
+ReLU.__doc__ = "Reference ``sparse/nn/layer/activation.py:22``."
+ReLU.__name__ = "ReLU"
+ReLU6 = _values_layer(
+    lambda name=None: lambda v: jnp.clip(v, 0.0, 6.0))
+ReLU6.__name__ = "ReLU6"
+LeakyReLU = _values_layer(
+    lambda negative_slope=0.01, name=None:
+    lambda v: jnp.where(v >= 0, v, negative_slope * v))
+LeakyReLU.__name__ = "LeakyReLU"
+
+
+class Softmax(Layer):
+    """Reference ``activation.py:66``: softmax over the stored values of
+    each row (zeros act as -inf), axis=-1 of a 2-D csr/coo matrix."""
+
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        if axis != -1:
+            raise NotImplementedError("sparse Softmax: only axis=-1")
+
+    def forward(self, x):
+        csr = x if isinstance(x, SparseCsrTensor) else x.to_sparse_csr()
+        indptr = np.asarray(csr._mat.indptr)
+        vals = np.asarray(csr._mat.data, np.float64)
+        out = np.empty_like(vals)
+        for r in range(len(indptr) - 1):
+            s, e = indptr[r], indptr[r + 1]
+            if e > s:
+                v = vals[s:e]
+                v = np.exp(v - v.max())
+                out[s:e] = v / v.sum()
+        new = SparseCsrTensor(
+            jsparse.BCSR((jnp.asarray(out, csr._mat.data.dtype),
+                          csr._mat.indices, csr._mat.indptr),
+                         shape=csr._mat.shape), csr._shape)
+        return new if isinstance(x, SparseCsrTensor) \
+            else new.to_sparse_coo()

@@ -1,0 +1,189 @@
+"""``paddle.sparse.nn.functional`` — sparse conv/pool kernels.
+
+Reference ``python/paddle/sparse/nn/functional/conv.py`` (conv3d,
+subm_conv3d, conv2d, subm_conv2d) and ``pooling.py`` (max_pool3d); the
+reference lowers to the phi gpu rulebook kernels
+(``paddle/phi/kernels/sparse/gpu/conv_kernel.cu``). Here the rulebook
+(per-kernel-offset matching of input sites to output sites) is built in
+numpy — output nnz is data-dependent, so this is an eager-mode op family
+like the reference's dygraph-only sparse API — and the per-offset
+channel GEMMs + scatter-adds run in jnp, which is where the FLOPs are.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ...core.dispatch import unwrap
+from ...core.tensor import Tensor
+from .. import SparseCooTensor, _coo
+
+__all__ = ["conv3d", "subm_conv3d", "conv2d", "subm_conv2d",
+           "max_pool3d", "relu"]
+
+
+def _norm(v, n):
+    v = list(v) if isinstance(v, (list, tuple)) else [v] * n
+    return [int(x) for x in v]
+
+
+def _rulebook(in_idx, spatial_in, kernel, stride, padding, dilation,
+              subm):
+    """Match input sites to output sites per kernel offset.
+
+    Returns (out_idx [M, 1+nd], pairs: list over offsets of
+    (in_rows, out_rows)). Coordinates are [n, *spatial]."""
+    nd = len(kernel)
+    if subm:
+        # output sites == input sites; build a coord hash for lookup
+        out_idx = in_idx
+        key = {tuple(c): i for i, c in enumerate(map(tuple, in_idx))}
+        spatial_out = list(spatial_in)
+    else:
+        spatial_out = [
+            (spatial_in[d] + 2 * padding[d]
+             - dilation[d] * (kernel[d] - 1) - 1) // stride[d] + 1
+            for d in range(nd)]
+        key = None
+
+    offsets = np.stack(np.meshgrid(
+        *[np.arange(k) for k in kernel], indexing="ij"),
+        axis=-1).reshape(-1, nd)
+
+    all_out = []
+    raw_pairs = []
+    for off in offsets:
+        # out*stride = in + pad - off*dilation
+        num = in_idx[:, 1:] + np.asarray(padding) \
+            - off * np.asarray(dilation)
+        ok = np.ones(len(in_idx), bool)
+        for d in range(nd):
+            ok &= (num[:, d] % stride[d] == 0)
+        out_sp = num // np.asarray(stride)
+        for d in range(nd):
+            ok &= (out_sp[:, d] >= 0) & (out_sp[:, d]
+                                         < (spatial_out[d]))
+        rows = np.nonzero(ok)[0]
+        oc = np.concatenate([in_idx[rows, :1], out_sp[rows]], axis=1)
+        if subm:
+            hit = np.array([key.get(tuple(c), -1) for c in oc],
+                           np.int64)
+            keep = hit >= 0
+            raw_pairs.append((rows[keep], hit[keep]))
+        else:
+            raw_pairs.append((rows, oc))
+            all_out.append(oc)
+
+    if subm:
+        return in_idx, raw_pairs, spatial_out
+    if all_out:
+        cat = np.concatenate(all_out, axis=0)
+    else:
+        cat = np.zeros((0, 1 + nd), np.int64)
+    out_idx, inverse = np.unique(cat, axis=0, return_inverse=True)
+    pairs = []
+    pos = 0
+    for rows, oc in raw_pairs:
+        pairs.append((rows, inverse[pos:pos + len(rows)]))
+        pos += len(rows)
+    return out_idx, pairs, spatial_out
+
+
+def _conv_impl(x, weight, bias, stride, padding, dilation, subm, nd):
+    m = _coo(x).sum_duplicates(nse=_coo(x).nse)
+    if m.n_dense != 1:
+        raise ValueError(
+            "sparse conv expects a SparseCooTensor with dense channel "
+            "values: indices over [N, *spatial], values [nnz, C]")
+    in_idx = np.asarray(m.indices, np.int64)
+    vals = m.data                                   # (nnz, Cin) jnp
+    w = unwrap(weight) if isinstance(weight, Tensor) \
+        else jnp.asarray(weight)                    # (*K, Cin, Cout)
+    kernel = list(w.shape[:nd])
+    cin, cout = int(w.shape[nd]), int(w.shape[nd + 1])
+    spatial_in = list(x._shape[1:1 + nd])
+    stride = _norm(stride, nd)
+    padding = _norm(padding, nd)
+    dilation = _norm(dilation, nd)
+
+    out_idx, pairs, spatial_out = _rulebook(
+        in_idx, spatial_in, kernel, stride, padding, dilation, subm)
+
+    wflat = w.reshape(-1, cin, cout)
+    out_vals = jnp.zeros((len(out_idx), cout), vals.dtype)
+    for k, (in_rows, out_rows) in enumerate(pairs):
+        if len(in_rows) == 0:
+            continue
+        contrib = vals[jnp.asarray(in_rows)] @ wflat[k]   # GEMM on MXU
+        out_vals = out_vals.at[jnp.asarray(out_rows)].add(contrib)
+    if bias is not None:
+        b = unwrap(bias) if isinstance(bias, Tensor) \
+            else jnp.asarray(bias)
+        out_vals = out_vals + b
+
+    shape = (x._shape[0], *spatial_out, cout)
+    mat = jsparse.BCOO((out_vals, jnp.asarray(out_idx, jnp.int32)),
+                       shape=shape)
+    return SparseCooTensor(mat, shape)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC", name=None):
+    """Reference ``sparse/nn/functional/conv.py conv3d``."""
+    return _conv_impl(x, weight, bias, stride, padding, dilation,
+                      subm=False, nd=3)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Reference ``subm_conv3d``: output sites == input sites."""
+    return _conv_impl(x, weight, bias, stride, padding, dilation,
+                      subm=True, nd=3)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NHWC", name=None):
+    return _conv_impl(x, weight, bias, stride, padding, dilation,
+                      subm=False, nd=2)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    return _conv_impl(x, weight, bias, stride, padding, dilation,
+                      subm=True, nd=2)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """Reference ``sparse/nn/functional/pooling.py max_pool3d``: max
+    over the active sites in each window (inactive sites do not
+    contribute zeros, matching the reference kernel)."""
+    nd = 3
+    kernel = _norm(kernel_size, nd)
+    stride = _norm(stride if stride is not None else kernel_size, nd)
+    padding = _norm(padding, nd)
+
+    m = _coo(x).sum_duplicates(nse=_coo(x).nse)
+    if m.n_dense != 1:
+        raise ValueError("sparse max_pool3d expects values [nnz, C]")
+    in_idx = np.asarray(m.indices, np.int64)
+    vals = np.asarray(m.data)
+    out_idx, pairs, spatial_out = _rulebook(
+        in_idx, list(x._shape[1:1 + nd]), kernel, stride, padding,
+        [1] * nd, subm=False)
+
+    out_vals = np.full((len(out_idx), vals.shape[1]), -np.inf,
+                       vals.dtype)
+    for in_rows, out_rows in pairs:
+        if len(in_rows):
+            np.maximum.at(out_vals, out_rows, vals[in_rows])
+    shape = (x._shape[0], *spatial_out, vals.shape[1])
+    mat = jsparse.BCOO((jnp.asarray(out_vals),
+                        jnp.asarray(out_idx, jnp.int32)), shape=shape)
+    return SparseCooTensor(mat, shape)
+
+
+def relu(x, name=None):
+    from .. import relu as _relu
+    return _relu(x)

@@ -1,0 +1,183 @@
+"""Sparse op/nn breadth (reference ``python/paddle/sparse/unary.py``,
+``binary.py``, ``multiary.py``, ``nn/layer/conv.py``, ``pooling.py``,
+``norm.py``, ``activation.py``)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sp
+
+
+def _coo2x2():
+    return sp.to_sparse_coo(paddle.to_tensor(
+        np.array([[0., 2.], [3., 0.]], np.float32)))
+
+
+def test_unary_set_pattern_preserving():
+    t = _coo2x2()
+    for name in ["sin", "tan", "asin", "atan", "sinh", "asinh", "tanh",
+                 "square", "sqrt", "abs", "neg", "log1p", "expm1",
+                 "rad2deg", "deg2rad"]:
+        fn = getattr(sp, name)
+        out = fn(t)
+        assert out.nnz == t.nnz
+        ref = getattr(np, {"abs": "abs", "neg": "negative",
+                           "asin": "arcsin", "atan": "arctan",
+                           "asinh": "arcsinh"}.get(name, name))
+        np.testing.assert_allclose(
+            out.to_dense().numpy(),
+            ref(np.array([[0., 2.], [3., 0.]], np.float32)),
+            rtol=1e-5, atol=1e-6, equal_nan=True)
+
+
+def test_pow_cast_isnan():
+    t = _coo2x2()
+    np.testing.assert_allclose(sp.pow(t, 2.0).to_dense().numpy(),
+                               [[0., 4.], [9., 0.]])
+    c = sp.cast(t, value_dtype="float64")
+    assert "float" in str(c.dtype)
+    n = sp.isnan(t)
+    assert not bool(np.asarray(n.values().numpy()).any())
+
+
+def test_structural_ops():
+    t = _coo2x2()
+    assert float(sp.sum(t).numpy()) == 5.0
+    np.testing.assert_allclose(sp.sum(t, axis=0).to_dense().numpy(),
+                               [3., 2.])
+    np.testing.assert_allclose(
+        sp.sum(t, axis=1, keepdim=True).to_dense().numpy(),
+        [[2.], [3.]])
+    np.testing.assert_allclose(sp.transpose(t, [1, 0]).to_dense().numpy(),
+                               [[0., 3.], [2., 0.]])
+    np.testing.assert_allclose(sp.reshape(t, [4]).to_dense().numpy(),
+                               [0., 2., 3., 0.])
+    np.testing.assert_allclose(sp.reshape(t, [-1, 1]).to_dense().numpy(),
+                               [[0.], [2.], [3.], [0.]])
+    np.testing.assert_allclose(sp.slice(t, [0], [1], [2])
+                               .to_dense().numpy(), [[3., 0.]])
+
+
+def test_binary_multiary():
+    t = _coo2x2()
+    v = sp.mv(t, paddle.to_tensor(np.array([1., 1.], np.float32)))
+    np.testing.assert_allclose(v.numpy(), [2., 3.])
+    am = sp.addmm(paddle.to_tensor(np.ones((2, 2), np.float32)), t,
+                  paddle.to_tensor(np.eye(2, dtype=np.float32)),
+                  beta=0.5, alpha=2.0)
+    np.testing.assert_allclose(
+        am.numpy(), 0.5 + 2.0 * np.array([[0., 2.], [3., 0.]]))
+    assert sp.is_same_shape(t, sp.transpose(t, [1, 0]))
+
+
+@pytest.fixture
+def conv_setup():
+    rng = np.random.default_rng(0)
+    N, D, H, W, Cin, Cout = 2, 5, 6, 7, 3, 4
+    dense = np.zeros((N, D, H, W, Cin), np.float32)
+    nnz = 40
+    coords = np.stack([rng.integers(0, s, nnz)
+                       for s in (N, D, H, W)], axis=0)
+    vals = rng.normal(size=(nnz, Cin)).astype(np.float32)
+    for c, v in zip(coords.T, vals):
+        dense[tuple(c)] += v
+    x = sp.sparse_coo_tensor(coords, vals, shape=(N, D, H, W, Cin))
+    w = rng.normal(size=(3, 3, 3, Cin, Cout)).astype(np.float32)
+    b = rng.normal(size=(Cout,)).astype(np.float32)
+    return dense, x, w, b
+
+
+def test_conv3d_matches_dense_oracle(conv_setup):
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as TF
+    dense, x, w, b = conv_setup
+    out = sp.nn.functional.conv3d(x, paddle.to_tensor(w),
+                                  paddle.to_tensor(b), stride=2,
+                                  padding=1)
+    got = out.to_dense().numpy()
+    tw = torch.tensor(w).permute(4, 3, 0, 1, 2)
+    tx = torch.tensor(dense).permute(0, 4, 1, 2, 3)
+    ref = TF.conv3d(tx, tw, torch.tensor(b), stride=2, padding=1) \
+        .permute(0, 2, 3, 4, 1).numpy()
+    mask = np.abs(got).sum(-1) != 0  # sparse emits only active sites
+    assert mask.sum() > 0
+    np.testing.assert_allclose(got[mask], ref[mask], rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_subm_conv3d_keeps_sites(conv_setup):
+    _, x, w, _ = conv_setup
+    out = sp.nn.functional.subm_conv3d(x, paddle.to_tensor(w), None,
+                                       padding=1)
+    oi = np.asarray(out._mat.sum_duplicates(nse=out._mat.nse).indices)
+    ii = np.asarray(x._mat.sum_duplicates(nse=x._mat.nse).indices)
+    assert set(map(tuple, oi)) == set(map(tuple, ii))
+
+
+def test_conv2d_single_point():
+    # one active site, 1x1 kernel: exact closed form
+    x = sp.sparse_coo_tensor(np.array([[0], [1], [2]]),
+                             np.array([[2.0, 3.0]], np.float32),
+                             shape=(1, 4, 4, 2))
+    w = np.array([[[[1.0], [10.0]]]], np.float32)  # 1x1x2x1
+    out = sp.nn.functional.conv2d(x, paddle.to_tensor(w))
+    d = out.to_dense().numpy()
+    assert d.shape == (1, 4, 4, 1)
+    np.testing.assert_allclose(d[0, 1, 2, 0], 32.0)
+    assert np.abs(d).sum() == 32.0
+
+
+def test_max_pool3d_active_max(conv_setup):
+    _, x, _, _ = conv_setup
+    out = sp.nn.functional.max_pool3d(x, 2, stride=2)
+    # every output value is the max over its window's ACTIVE inputs
+    m = x._mat.sum_duplicates(nse=x._mat.nse)
+    in_idx = np.asarray(m.indices)
+    vals = np.asarray(m.data)
+    om = out._mat
+    oi, ov = np.asarray(om.indices), np.asarray(om.data)
+    for c, v in zip(oi, ov):
+        sel = (in_idx[:, 0] == c[0])
+        for d in range(3):
+            sel &= (in_idx[:, 1 + d] // 2 == c[1 + d])
+        assert sel.any()
+        np.testing.assert_allclose(v, vals[sel].max(0), rtol=1e-6)
+
+
+def test_sparse_layers():
+    rng = np.random.default_rng(1)
+    coords = np.stack([rng.integers(0, s, 20)
+                       for s in (2, 4, 4, 4)], axis=0)
+    vals = rng.normal(size=(20, 3)).astype(np.float32)
+    x = sp.sparse_coo_tensor(coords, vals, shape=(2, 4, 4, 4, 3))
+    paddle.seed(0)
+    conv = sp.nn.Conv3D(3, 5, 3, padding=1)
+    y = conv(x)
+    assert y._shape[-1] == 5
+    sub = sp.nn.SubmConv3D(3, 5, 3, padding=1)
+    y2 = sub(x)
+    assert y2._shape == (2, 4, 4, 4, 5)
+    bn = sp.nn.BatchNorm(3)
+    yb = bn(x)
+    assert yb._shape == x._shape
+    v = np.asarray(yb._mat.data)
+    np.testing.assert_allclose(v.mean(0), 0.0, atol=1e-4)
+    pool = sp.nn.MaxPool3D(2)
+    yp = pool(x)
+    assert yp._shape == (2, 2, 2, 2, 3)
+    assert (sp.nn.ReLU()(x).values().numpy() >= 0).all()
+    r6 = sp.nn.ReLU6()(x).values().numpy()
+    assert ((r6 >= 0) & (r6 <= 6)).all()
+    lr = sp.nn.LeakyReLU(0.1)(x).values().numpy()
+    np.testing.assert_allclose(lr, np.where(vals >= 0, vals, 0.1 * vals),
+                               rtol=1e-5)
+
+
+def test_sparse_softmax_rows():
+    mat = sp.to_sparse_csr(paddle.to_tensor(
+        np.array([[1., 2., 0.], [0., 3., 4.]], np.float32)))
+    s = sp.nn.Softmax()(mat)
+    v = s.values().numpy()
+    np.testing.assert_allclose(v[:2].sum(), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(v[2:].sum(), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(v[1] / v[0], np.e, rtol=1e-4)
