@@ -110,7 +110,10 @@ class Predictor:
             self._input_names = [f"x{i}" for i in range(n_in)]
         self._inputs = {n: _IOTensor() for n in self._input_names}
         self._outputs = []
-        self._output_names = []
+        # output names come from the export metadata (dict keys / tensor
+        # names recorded by jit.save); synthesized out{i} only when the
+        # export predates the out_names field
+        self._output_names = list(meta.get("out_names", []))
 
     def get_input_names(self):
         return list(self._input_names)
@@ -120,9 +123,21 @@ class Predictor:
 
     def _run_once(self, args):
         out = self._layer(*args)
-        outs = out if isinstance(out, (list, tuple)) else [out]
+        flat = []
+
+        def walk(o):  # same order as jit's _flatten_tensors
+            if isinstance(o, (list, tuple)):
+                for v in o:
+                    walk(v)
+            elif isinstance(o, dict):
+                for k in sorted(o):
+                    walk(o[k])
+            else:
+                flat.append(o)
+
+        walk(out)
         return [np.asarray(o._read() if isinstance(o, Tensor) else o)
-                for o in outs]
+                for o in flat]
 
     def run(self, inputs=None):
         if inputs is not None:  # list-of-arrays convenience form
@@ -135,7 +150,8 @@ class Predictor:
             h = _IOTensor()
             h.copy_from_cpu(o)
             self._outputs.append(h)
-        self._output_names = [f"out{i}" for i in range(len(res))]
+        if len(self._output_names) != len(res):
+            self._output_names = [f"out{i}" for i in range(len(res))]
         return [h.copy_to_cpu() for h in self._outputs]
 
     def run_batch(self, inputs, batch_size):
@@ -162,13 +178,17 @@ class Predictor:
             h = _IOTensor()
             h.copy_from_cpu(o)
             self._outputs.append(h)
-        self._output_names = [f"out{i}" for i in range(len(outs))]
+        if len(self._output_names) != len(outs):
+            self._output_names = [f"out{i}" for i in range(len(outs))]
         return outs
 
     def get_output_names(self):
         return list(self._output_names)
 
     def get_output_handle(self, name):
+        if name in self._output_names:
+            return self._outputs[self._output_names.index(name)]
+        # legacy synthesized names remain addressable pre-run
         return self._outputs[int(name.removeprefix("out"))]
 
 

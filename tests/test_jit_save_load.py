@@ -139,3 +139,41 @@ def test_translated_layer_train_raises(tmp_path):
     loaded = paddle.jit.load(path)
     with pytest.raises(RuntimeError):
         loaded.train()
+
+
+def test_predictor_output_names_from_export(tmp_path):
+    """Dict-returning model: Predictor output names come from the export
+    metadata keys, not synthesized out{i} (VERDICT r3 item 8)."""
+    from paddle_tpu.inference import Config, create_predictor
+
+    class DictNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            h = self.fc(x)
+            return {"logits": h, "probs": nn.functional.softmax(h)}
+
+    paddle.seed(0)
+    net = DictNet()
+    net.eval()
+    path = str(tmp_path / "dictnet")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 4])])
+    pred = create_predictor(Config(path))
+    x = np.ones((2, 4), np.float32)
+    pred.run([x])
+    assert pred.get_output_names() == ["logits", "probs"]
+
+
+def test_predictor_output_names_explicit(tmp_path):
+    paddle.seed(0)
+    net = SmallNet()
+    net.eval()
+    path = str(tmp_path / "named")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 16])],
+                    output_names=["scores"])
+    from paddle_tpu.inference import Config, create_predictor
+    pred = create_predictor(Config(path))
+    pred.run([np.ones((2, 16), np.float32)])
+    assert pred.get_output_names() == ["scores"]
