@@ -170,17 +170,41 @@ class PipelinedBlocks(Layer):
     def stacked_parameter(self, name: str):
         return self._parameters[self._mangle(name)]
 
-    def shard(self, mesh, pp_axis: str = "pp"):
-        """Pin Shard(0) over ``pp_axis`` on every stacked leaf."""
+    def shard(self, mesh, pp_axis: str = "pp", tp_axis=None,
+              tp_rules=None):
+        """Pin Shard(0) over ``pp_axis`` on every stacked leaf.
+
+        ``tp_axis``/``tp_rules`` add Megatron TP *inside* the pipeline
+        (the reference's pp x mp hybrid, ``topology.py`` +
+        ``semi_auto_parallel_simple_net_dp_mp_pp.py``): ``tp_rules`` maps
+        a parameter-name substring to the STACKED-array dim to shard over
+        ``tp_axis`` (e.g. ``{"qkv.weight": 2, "proj.weight": 1}``). The
+        pipeline's shard_map then leaves ``tp_axis`` to GSPMD
+        (``axis_names`` excludes it), so XLA inserts the TP collectives
+        inside each stage while ppermute rides the pp axis."""
         from ..auto_parallel.api import Replicate, Shard, shard_parameter
         self._mesh = mesh
         self.pp_axis = pp_axis
+        self._tp_axis = tp_axis if (tp_axis and tp_axis
+                                    in mesh.dim_names) else None
         dim = mesh.dim_names.index(pp_axis)
-        pl = [Replicate()] * mesh.ndim
-        pl[dim] = Shard(0)
         for n in self._names:
+            pl = [Replicate()] * mesh.ndim
+            pl[dim] = Shard(0)
+            if self._tp_axis and tp_rules:
+                for pat, tdim in tp_rules.items():
+                    if pat in n:
+                        pl[mesh.dim_names.index(tp_axis)] = Shard(tdim)
+                        break
             shard_parameter(self.stacked_parameter(n), mesh, pl)
         return self
+
+    def _manual_axes(self, jmesh):
+        """Mesh axes the pipeline shard_map handles manually — everything
+        except the TP axis, which stays under GSPMD."""
+        names = tuple(jmesh.axis_names)
+        tp = getattr(self, "_tp_axis", None)
+        return frozenset(n for n in names if n != tp)
 
     # -- the schedules -------------------------------------------------
     def forward(self, x, batch_axes=None):
@@ -255,7 +279,9 @@ class PipelinedBlocks(Layer):
             lspec = tuple(P(ax) for _ in leaves)
             out = jax.shard_map(local, mesh=jmesh,
                                 in_specs=(xspec,) + lspec,
-                                out_specs=xspec)(xm, *leaves)
+                                out_specs=xspec,
+                                axis_names=self._manual_axes(jmesh),
+                                )(xm, *leaves)
             return out.reshape((b,) + xv.shape[1:])
 
         return apply("pipelined_blocks", impl, x, *leaf_tensors)
@@ -352,7 +378,9 @@ class PipelinedBlocks(Layer):
             lspec = tuple(P(ax) for _ in leaves)
             out = jax.shard_map(local, mesh=jmesh,
                                 in_specs=(xspec,) + lspec,
-                                out_specs=xspec)(xm, *leaves)
+                                out_specs=xspec,
+                                axis_names=self._manual_axes(jmesh),
+                                )(xm, *leaves)
             return out.reshape((b,) + xv.shape[1:])
 
         return apply("pipelined_blocks_vpp", impl, x, *leaf_tensors)
