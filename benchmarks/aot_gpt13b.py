@@ -92,11 +92,10 @@ def _ln(x, w, b, jnp):
     return (x - m) * (1.0 / jnp.sqrt(v + 1e-5)) * w + b
 
 
-def make_train_step(cfg, mesh, use_flash=True):
+def make_train_step(cfg, use_flash=True):
     import jax
     import jax.numpy as jnp
     from jax import lax
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     H, nh, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
 
@@ -210,7 +209,7 @@ def lower_13b(n_devices=32, dp=4, mp=8, cfg=None, compile_=True):
     ids = sds((cfg.batch, cfg.seq_len), jnp.int32, P("dp", None))
     labels = sds((cfg.batch, cfg.seq_len), jnp.int32, P("dp", None))
 
-    step = make_train_step(cfg, mesh, use_flash=False)
+    step = make_train_step(cfg, use_flash=False)
     # donate params/opt state: the real executable updates them in place
     # (the jit _Executable donates state buffers the same way)
     lowered = jax.jit(step, donate_argnums=(0, 1, 2, 3)).lower(
@@ -250,8 +249,7 @@ def check_tiny_equivalence():
 
     cfg = Cfg(vocab_size=97, hidden_size=64, num_layers=2, num_heads=4,
               seq_len=16, batch=2)
-    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "mp"))
-    step = make_train_step(cfg, mesh, use_flash=False)
+    step = make_train_step(cfg, use_flash=False)
 
     blocks = model.gpt.blocks
     params = {
@@ -304,6 +302,7 @@ if __name__ == "__main__":
 
     got, ref = check_tiny_equivalence()
     print(f"tiny equivalence: harness={got:.4f} model={ref:.4f}")
+    print(f"13B params: {Cfg().n_params() / 1e9:.2f}B")
     assert abs(got - ref) < 0.05, "harness != framework model"
 
     compiled, resident = lower_13b()

@@ -185,8 +185,14 @@ class PipelinedBlocks(Layer):
         from ..auto_parallel.api import Replicate, Shard, shard_parameter
         self._mesh = mesh
         self.pp_axis = pp_axis
-        self._tp_axis = tp_axis if (tp_axis and tp_axis
-                                    in mesh.dim_names) else None
+        if tp_axis is not None and tp_axis not in mesh.dim_names:
+            raise ValueError(
+                f"tp_axis {tp_axis!r} is not a mesh dim "
+                f"{mesh.dim_names} — refusing to silently train "
+                "replicated")
+        if tp_rules and tp_axis is None:
+            raise ValueError("tp_rules given without tp_axis")
+        self._tp_axis = tp_axis
         dim = mesh.dim_names.index(pp_axis)
         for n in self._names:
             pl = [Replicate()] * mesh.ndim

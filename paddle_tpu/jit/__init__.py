@@ -192,6 +192,18 @@ class _Executable:
         self.arg_out_pos: list[int] = []
         self.trace_count = 0  # XLA (re)traces; guards retrace regressions
 
+    def state_split(self):
+        """(carry_idx, const_idx) into ``capt_state``: which captured
+        tensors the step WRITES (must thread through a scan carry) vs
+        reads only (scan constants). Shared by ``jit.multi_step`` and
+        the decode-window scan (``models/generation.py``)."""
+        pos = {id(t): i for i, t in enumerate(self.capt_state)}
+        carry_idx = [pos[id(t)] for t in self.state_out_tensors]
+        carry_set = set(carry_idx)
+        const_idx = [i for i in range(len(self.capt_state))
+                     if i not in carry_set]
+        return carry_idx, const_idx
+
     def build(self, arg_tensors, call_args, call_kwargs):
         d = self.discovery
         arg_pos = {id(t): i for i, t in enumerate(arg_tensors)}

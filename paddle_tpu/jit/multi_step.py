@@ -57,11 +57,8 @@ def multi_step(static_fn, arg_batches: Sequence[Sequence], donate=True):
     n_ret = exe.n_ret
     state_ts = exe.state_out_tensors
     capt = exe.capt_state
-    pos_in_capt = {id(t): i for i, t in enumerate(capt)}
     # carry = the written subset of captured state, by capt index
-    carry_idx = [pos_in_capt[id(t)] for t in state_ts]
-    carry_set = set(carry_idx)
-    const_idx = [i for i in range(len(capt)) if i not in carry_set]
+    carry_idx, const_idx = exe.state_split()
     pure = exe._pure
 
     cache = getattr(exe, "_multi_step_cache", None)

@@ -218,22 +218,34 @@ def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
 
     @primitive(name="top_p_sampling")
     def _tps(logits, p, key):
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        order = jnp.argsort(-probs, axis=-1)
-        sp = jnp.take_along_axis(probs, order, axis=-1)
-        cum = jnp.cumsum(sp, axis=-1)
-        keep = (cum - sp) < p.reshape(-1, 1)  # first bucket always kept
-        if threshold is not None:
-            keep = keep & (sp >= threshold)
-            keep = keep.at[:, 0].set(True)    # never drop every token
-        masked = jnp.where(keep, sp, 0.0)
-        masked = masked / jnp.sum(masked, axis=-1, keepdims=True)
-        idx = jr.categorical(jr.wrap_key_data(key), jnp.log(masked + 1e-30))
-        token = jnp.take_along_axis(order, idx[:, None], axis=-1)
-        score = jnp.take_along_axis(probs, token, axis=-1)
-        return score, token
+        return nucleus_sample_jnp(jr.wrap_key_data(key), logits, p,
+                                  threshold)
 
     return _tps(x, ps, key_data)
+
+
+def nucleus_sample_jnp(key, logits, p, threshold=None):
+    """Pure-jnp nucleus-sampling core, shared by the ``top_p_sampling``
+    op above and the scanned decode window
+    (``models/generation.py``): keeps the smallest sorted prefix with
+    cumulative probability >= p, samples within it. Returns
+    (scores [B, 1], tokens [B, 1])."""
+    import jax.random as jr
+
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    order = jnp.argsort(-probs, axis=-1)
+    sp = jnp.take_along_axis(probs, order, axis=-1)
+    cum = jnp.cumsum(sp, axis=-1)
+    keep = (cum - sp) < jnp.reshape(p, (-1, 1))  # first bucket always kept
+    if threshold is not None:
+        keep = keep & (sp >= threshold)
+        keep = keep.at[:, 0].set(True)           # never drop every token
+    masked = jnp.where(keep, sp, 0.0)
+    masked = masked / jnp.sum(masked, axis=-1, keepdims=True)
+    idx = jr.categorical(key, jnp.log(masked + 1e-30))
+    token = jnp.take_along_axis(order, idx[:, None], axis=-1)
+    score = jnp.take_along_axis(probs, token, axis=-1)
+    return score, token
 
 
 def frobenius_norm(x, axis=None, keepdim=False, name=None):
