@@ -3,11 +3,21 @@
 {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 
 Headline: GPT-124M (BASELINE.md config-4 class) training throughput on one
-chip — jit-compiled full train step (fwd + loss + bwd + AdamW), bf16 AMP O1,
-activation recompute. vs_baseline = achieved MFU / 0.40, the A100-parity
-north star of BASELINE.md (the reference publishes no absolute numbers, so
-parity-with-Paddle-CUDA is expressed as matching 40% model-FLOPs
-utilization on the local chip's peak).
+chip — jit-compiled full train step (fwd + loss + bwd + AdamW), bf16 AMP O2,
+activation recompute, executed as ONE dispatch per WINDOW_STEPS-step window
+(jit.WindowRunner: scanned steps, pre-staged inputs — per-step host work on
+a network-attached chip otherwise dominates). vs_baseline = achieved MFU /
+0.40, the A100-parity north star of BASELINE.md (the reference publishes no
+absolute numbers, so parity-with-Paddle-CUDA is expressed as matching 40%
+model-FLOPs utilization on the local chip's peak).
+
+Budget discipline (round-3 rc:124 postmortem): everything expensive that
+is NOT the headline — kernel-rate calibration, ResNet50/BERT north-star
+secondaries — is persisted in benchmarks/measured/ keyed by device kind +
+a content hash of the code that produced it, and only re-measured when
+that code changes. The flash-attention block autotune cache is likewise
+repo-persisted (PDTPU_CACHE_DIR below): a fresh environment re-tuning
+from scratch costs ~7 minutes of compiles.
 
 TPU rules (.claude/skills/verify/SKILL.md): everything through the jit
 path; no SIGKILL; single process owns the chip.
@@ -15,10 +25,20 @@ path; no SIGKILL; single process owns the chip.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
+_REPO = os.path.dirname(os.path.abspath(__file__))
+# flash-attention autotune winners persist inside the repo (committed);
+# ~/.cache is wiped between rounds and re-tuning costs minutes of compiles
+os.environ.setdefault(
+    "PDTPU_CACHE_DIR", os.path.join(_REPO, "benchmarks", "measured"))
+sys.path.insert(0, os.path.join(_REPO, "benchmarks"))
+
 import numpy as np
+
+import measured_cache as mc
 
 # bf16 peak FLOPs by device kind (per chip)
 _PEAK = {
@@ -30,6 +50,8 @@ _PEAK = {
     "TPU v6e": 918e12,
 }
 
+WINDOW_STEPS = 50  # steps per dispatch; see extra.host_overhead
+
 
 def _peak_flops(dev) -> float:
     kind = getattr(dev, "device_kind", "")
@@ -39,15 +61,43 @@ def _peak_flops(dev) -> float:
     return 197e12  # assume v5e-class when unknown
 
 
+def _cached(dev, name, files, fn):
+    """Measured-evidence gate: load from benchmarks/measured/ when the
+    producing code is unchanged, else measure now and persist."""
+    kind = str(getattr(dev, "device_kind", dev.platform))
+    ver = mc.code_version(*files)
+    val = mc.load(kind, name, ver)
+    if val is not None:
+        return dict(val, cached=True)
+    val = fn()
+    mc.store(kind, name, ver, val)
+    return val
+
+
+def _timed_window(step, example, batches, repeats=2):
+    """Compile a WindowRunner over ``batches``, then return the best-of-
+    ``repeats`` wall seconds for one window (inputs pre-staged; timed
+    region = one scan launch + one scalar loss readback)."""
+    import paddle_tpu as paddle
+
+    w = paddle.jit.WindowRunner(step, example, length=len(batches))
+    t0 = time.perf_counter()
+    stacks = w.stage(batches)
+    stage_s = time.perf_counter() - t0
+    float(w.run(*stacks, outputs="last"))  # compile the scanned window
+    dt, last = float("inf"), 0.0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        last = float(w.run(*stacks, outputs="last"))
+        dt = min(dt, time.perf_counter() - t0)
+    return dt, stage_s, w, last
+
+
 def _calibration(cfg, batch, seq):
     """Measured kernel rates at THIS model's GEMM/attention shapes via the
     dispatch-free scan-slope method (benchmarks/calibrate.py), plus the
     matmul+attention roofline they imply. The evidence behind the mfu
     number: achieved model-TF/s must sit below the roofline."""
-    import os
-    import sys
-    sys.path.insert(0, os.path.join(os.path.dirname(
-        os.path.abspath(__file__)), "benchmarks"))
     import calibrate as cal
 
     tokens = batch * seq
@@ -67,30 +117,17 @@ def _calibration(cfg, batch, seq):
     }
 
 
-def _window_time(train_step, batches, repeats=2, with_loss=False):
-    """Best-of-N timed multi_step windows (compile via a first throwaway
-    window); returns seconds per window (and the last loss if asked)."""
-    import time as _time
-
-    from paddle_tpu.jit import multi_step
-
-    losses = multi_step(train_step, batches)
-    last = float(losses[-1])  # compile + sync
-    best = float("inf")
-    for _ in range(repeats):
-        t0 = _time.perf_counter()
-        losses = multi_step(train_step, batches)
-        last = float(losses[-1])
-        best = min(best, _time.perf_counter() - t0)
-    return (best, last) if with_loss else best
-
-
 def _bench_resnet50(peak):
     """North star #1 (BASELINE.json): ResNet50 images/sec/chip, AMP O2."""
+    import gc
+
     import paddle_tpu as paddle
     import paddle_tpu.amp as amp
     from paddle_tpu.vision.models import resnet50
 
+    # batch 32 / window 6: batch 64 (and a longer window at 32) exceeds
+    # HBM — ResNet50 trains without remat, and the scanned window holds
+    # the stacked input batches alongside the step's activation peak
     batch, iters = 32, 6
     paddle.seed(0)
     model = resnet50(num_classes=1000)
@@ -119,28 +156,50 @@ def _bench_resnet50(peak):
     for _ in range(2):
         loss = step(*batch_fn())
     float(loss)
-    dt = _window_time(step, [batch_fn() for _ in range(iters)])
+    dt, _stage, w, _ = _timed_window(step, batch_fn(),
+                                     [batch_fn() for _ in range(iters)])
     img_s = batch * iters / dt
     # ResNet50 fwd = 4.089e9 MACs/img = 8.18e9 FLOPs (2 per MAC, the
     # same convention as the GPT/BERT 6N rows); train = fwd + ~2x bwd
     achieved = img_s * 3 * 2 * 4.089e9
+    del w, step, model, opt
+    gc.collect()
+    # conv roofline (scan-slope, both layouts, representative shapes):
+    # the measured ceiling evidence for why images/sec sits where it does
+    # (convs are ~6 ms of the step at b32 — the rest is BN/elementwise
+    # HBM traffic; NHWC ~= NCHW, XLA already lays out for the MXU)
+    import calibrate as cal
+    roof = cal.calibrate_resnet50(batch=batch, shapes=(
+        "conv1_7x7_s2", "s1_3x3", "s2_3x3", "s3_3x3", "s4_3x3",
+        "s3_expand_1x1"))
     return {"metric": "resnet50_train_images_per_sec_per_chip",
             "value": round(img_s, 1), "unit": "images/sec",
-            "extra": {"batch": batch,
-                      "step_time_ms": round(dt / iters * 1e3, 2),
-                      "amp": "O2-bf16-master",
-                      "model_tflops_per_sec": round(achieved / 1e12, 2),
-                      "mfu": round(achieved / peak, 4)}}
+            "batch": batch,
+            "step_time_ms": round(dt / iters * 1e3, 2),
+            "amp": "O2-bf16-master",
+            "model_tflops_per_sec": round(achieved / 1e12, 2),
+            "mfu": round(achieved / peak, 4),
+            "conv_roofline": roof["roofline"]}
 
 
 def _bench_bert(peak):
-    """North star #2: BERT-base pretraining tokens/sec/chip (MLM+NSP)."""
+    """North star #2: BERT-base pretraining tokens/sec/chip (MLM+NSP).
+
+    max_predictions=76 (the standard max_predictions_per_seq for seq 512
+    at 15% masking): the MLM head gathers the masked positions before
+    the vocab projection, so the [*, 30522] GEMM runs over ~15% of
+    positions. MFU counts the vocab-head FLOPs only for the positions
+    actually projected (honest accounting — see flops_method)."""
+    import gc
+
     import paddle_tpu as paddle
     import paddle_tpu.amp as amp
     from paddle_tpu.models.bert import BertConfig, BertForPretraining
 
-    batch, seq, iters = 16, 512, 6
-    cfg = BertConfig(recompute=True, recompute_policy="dots_saveable")
+    batch, seq, iters, maxpred = 16, 512, 8, 76
+    cfg = BertConfig(recompute=True,
+                     recompute_policy="dots_and_kernels_saveable",
+                     max_predictions=maxpred)
     paddle.seed(0)
     model = BertForPretraining(cfg)
     model.train()
@@ -163,27 +222,43 @@ def _bench_bert(peak):
     def batch_fn():
         ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
         seg = np.zeros((batch, seq), np.int32)
-        mlm = np.where(rng.uniform(size=(batch, seq)) < 0.15,
-                       rng.integers(0, cfg.vocab_size, (batch, seq)),
-                       -100).astype(np.int32)
+        # <= maxpred masked positions per row (the reference pipeline's
+        # max_predictions_per_seq contract)
+        mlm = np.full((batch, seq), -100, np.int32)
+        for b in range(batch):
+            pos = rng.choice(seq, size=maxpred, replace=False)
+            mlm[b, pos] = rng.integers(0, cfg.vocab_size, maxpred)
         nsp = rng.integers(0, 2, (batch,)).astype(np.int64)
         return tuple(paddle.to_tensor(v) for v in (ids, seg, mlm, nsp))
 
     for _ in range(2):
         loss = step(*batch_fn())
     float(loss)
-    dt = _window_time(step, [batch_fn() for _ in range(iters)])
+    dt, _stage, w, _ = _timed_window(step, batch_fn(),
+                                     [batch_fn() for _ in range(iters)])
     tok_s = batch * seq * iters / dt
     n = model.num_params()
-    achieved = tok_s * (6.0 * n + 12 * cfg.num_layers
-                        * cfg.hidden_size * seq)
+    h, v = cfg.hidden_size, cfg.vocab_size
+    # per-token model flops: 6*(N - vocab head) everywhere + the vocab
+    # head only on the maxpred/seq fraction actually projected
+    head = v * h
+    flops_tok = (6.0 * (n - head) + 6.0 * head * (maxpred / seq)
+                 + 12 * cfg.num_layers * h * seq)
+    achieved = tok_s * flops_tok
+    del w, step, model, opt
+    gc.collect()
     return {"metric": "bert_base_pretrain_tokens_per_sec_per_chip",
             "value": round(tok_s, 1), "unit": "tokens/sec",
-            "extra": {"batch": batch, "seq_len": seq,
-                      "step_time_ms": round(dt / iters * 1e3, 2),
-                      "params": n, "amp": "O2-bf16-master",
-                      "model_tflops_per_sec": round(achieved / 1e12, 2),
-                      "mfu": round(achieved / peak, 4)}}
+            "batch": batch, "seq_len": seq,
+            "max_predictions": maxpred,
+            "step_time_ms": round(dt / iters * 1e3, 2),
+            "params": n, "amp": "O2-bf16-master",
+            "model_tflops_per_sec": round(achieved / 1e12, 2),
+            "mfu": round(achieved / peak, 4),
+            "flops_method": ("6*(N - vocab_head) + 6*vocab_head*"
+                             "(max_predictions/seq) + 12*L*H*S per token; "
+                             "vocab-head flops counted only for projected "
+                             "positions")}
 
 
 def main():
@@ -197,14 +272,17 @@ def main():
     on_tpu = dev.platform == "tpu"
 
     if on_tpu:
-        # dots_saveable: remat recomputes elementwise only, keeping matmul
-        # outputs — measured +2% over full remat at this size (batch 16 and
-        # recompute=False both exceed HBM; XLA attention OOMs on the saved
-        # s^2 probs, so the Pallas flash path is also the memory enabler)
+        # dots_and_kernels_saveable: remat keeps matmul AND Pallas
+        # (flash-attention) outputs, recomputing only elementwise ops —
+        # measured 99.9 vs 104.2 ms/step over dots_saveable (the flash fwd
+        # re-run in backward costs ~4 ms/step). batch 16 and recompute=False
+        # both exceed HBM; XLA attention OOMs on the saved s^2 probs, so the
+        # Pallas flash path is also the memory enabler
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                         num_heads=12, max_seq_len=1024, dropout=0.0,
-                        recompute=True, recompute_policy="dots_saveable")
-        batch, seq, warmup, iters = 8, 1024, 2, 10
+                        recompute=True,
+                        recompute_policy="dots_and_kernels_saveable")
+        batch, seq, warmup, iters = 8, 1024, 2, WINDOW_STEPS
     else:  # CPU smoke (local testing only; driver runs on the real chip)
         cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
                         num_heads=4, max_seq_len=128, dropout=0.0,
@@ -224,9 +302,10 @@ def main():
                                   master_weight=True)
 
     if on_tpu:
-        # tune the flash-attention block sizes for this model's shapes
-        # (measured once per device+shape, persisted; the captured train
-        # step then picks the winner from the cache at trace time)
+        # flash-attention block sizes for this model's shapes come from
+        # the repo-persisted autotune cache (benchmarks/measured/); on a
+        # cache miss this probe re-measures once (slope-timed, backward-
+        # validated) and persists the winner
         import jax.numpy as jnp
 
         from paddle_tpu.incubate import autotune
@@ -258,15 +337,15 @@ def main():
         loss = train_step(*batch_fn())
     float(loss)  # sync
 
-    # timed window: ONE dispatch for all iters via the scanned multi-step
-    # program — per-step host dispatch (~13 ms/step over the axon tunnel,
-    # profiled) would otherwise be billed to the chip
+    # ONE dispatch per window of `iters` scanned steps, inputs pre-staged
+    # on device (jit.WindowRunner): per-step host work — stack/slice
+    # dispatches and the first-step launch — is hoisted out of the loop.
     # best of 3 windows: the axon tunnel adds +-10% run-to-run scheduling
-    # noise (device busy time is stable — profiled); best-of reports the
-    # chip's actual capability
-    dt, final_loss = _window_time(
-        train_step, [batch_fn() for _ in range(iters)], repeats=3,
-        with_loss=True)
+    # noise on top of stable device time (profiled)
+    dt, stage_s, w, final_loss = _timed_window(
+        train_step, batch_fn(), [batch_fn() for _ in range(iters)],
+        repeats=3)
+    stage_ms = stage_s * 1e3
 
     tokens_per_sec = batch * seq * iters / dt
     flops_per_token = model.flops_per_token(seq)
@@ -283,11 +362,18 @@ def main():
         "mfu": round(mfu, 4),
         "final_loss": round(final_loss, 4),
         "amp": "O2-bf16-master" if on_tpu else "O1-bf16", "recompute": True,
-        "dispatch": "multi_step window (1 dispatch / %d steps)" % iters,
+        "dispatch": "WindowRunner (1 dispatch / %d steps, inputs "
+                    "pre-staged on device)" % iters,
+        "host_overhead": {
+            "stage_upload_ms_per_window": round(stage_ms, 1),
+            "note": ("input staging happens once per window outside the "
+                     "step loop; the timed region is one scan launch + "
+                     "one scalar loss readback")},
         "flops_method": ("6*N_params + 12*L*H*S per token; backward "
                          "counted once, remat recompute NOT counted "
                          "(true-work MFU)"),
     }
+
     def emit():
         print(json.dumps({
             "metric": "gpt124m_train_tokens_per_sec_per_chip",
@@ -298,28 +384,45 @@ def main():
         }), flush=True)
 
     # kill-safety: the headline is measured — emit it NOW. The enriched
-    # line (calibration + north-star secondaries, ~20 extra minutes of
-    # compiles) re-emits the same metric afterwards; line-scanning
-    # parsers get a valid record whether they take the first or the
-    # last line, even if the process is killed mid-extras.
+    # re-emit below attaches calibration + north-star secondaries (cache
+    # hits in benchmarks/measured/ unless their producing code changed);
+    # line-scanning parsers get a valid record whether they take the
+    # first or the last line, even if the process dies mid-extras.
     if on_tpu:
         emit()
-        extra["calibration"] = _calibration(cfg, batch, seq)
+        import gc
+        try:
+            extra["calibration"] = _cached(
+                dev, "calibration_gpt124m_b8s1024",
+                ["bench.py", "benchmarks/calibrate.py",
+                 "paddle_tpu/ops/pallas/flash_attention.py"],
+                lambda: _calibration(cfg, batch, seq))
+        except Exception as e:
+            print(f"calibration failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
         # free the GPT params/moments/compiled programs BEFORE the
         # secondary models — leaving them resident OOMs ResNet50/BERT
-        import gc
-        del train_step, model, opt
+        del w, train_step, model, opt
         gc.collect()
-        import sys as _sys
-        for fn in (_bench_resnet50, _bench_bert):
+        for name, files, fn in (
+            ("secondary_resnet50",
+             ["bench.py", "benchmarks/calibrate.py",
+              "paddle_tpu/vision/models/resnet.py",
+              "paddle_tpu/nn/functional/conv.py"],
+             lambda: _bench_resnet50(peak)),
+            ("secondary_bert",
+             ["bench.py", "paddle_tpu/models/bert.py",
+              "paddle_tpu/ops/pallas/flash_attention.py",
+              "paddle_tpu/distributed/fleet/recompute.py"],
+             lambda: _bench_bert(peak)),
+        ):
             try:
-                row = fn(peak)
+                row = _cached(dev, name, files, fn)
                 extra.setdefault("secondary", {})[row["metric"]] = {
-                    "value": row["value"], "unit": row["unit"],
-                    **row["extra"]}
+                    k: v for k, v in row.items() if k != "metric"}
             except Exception as e:  # secondary must never kill the bench
                 print(f"secondary bench failed: {type(e).__name__}: {e}",
-                      file=_sys.stderr)
+                      file=sys.stderr)
             gc.collect()
 
     emit()

@@ -111,8 +111,10 @@ def _scanned_attention(batch, heads, seq, head_dim, reps, causal, bwd):
         @jax.jit
         def f(q, k, v):
             def body(c, i):
+                # all three grads feed the carry so the dkv kernel cannot
+                # be dead-code-eliminated from the timed program
                 dq, dk, dv = grad(q + i.astype(q.dtype) * 1e-6, k, v)
-                return c + dq.astype(jnp.bfloat16), None
+                return c + (dq + dk + dv).astype(jnp.bfloat16), None
             z = jnp.zeros(shp, jnp.bfloat16)
             return jax.lax.scan(body, z, jnp.arange(reps))[0]
 
@@ -138,6 +140,145 @@ def measure_attention(batch, heads, seq, head_dim, causal=True,
         res[tag] = {"tflops": round(flops / per_op / 1e12, 2),
                     "ms": round(per_op * 1e3, 3)}
     return res
+
+
+def _scanned_conv(n, h, w, cin, cout, kh, kw, stride, reps, fmt="NCHW",
+                  bwd=False, dtype=jnp.bfloat16):
+    """One jit program running ``reps`` convs (optionally + input/weight
+    grads), index-perturbed like the matmul scan."""
+    rng = np.random.default_rng(0)
+    xshape = (n, cin, h, w) if fmt == "NCHW" else (n, h, w, cin)
+    x = jnp.asarray(rng.normal(size=xshape) * 0.1, dtype)
+    wgt = jnp.asarray(rng.normal(size=(cout, cin, kh, kw)) * 0.1, dtype)
+    dn = jax.lax.conv_dimension_numbers(
+        xshape, wgt.shape,
+        (fmt, "OIHW", fmt))
+    pad = ((kh // 2, kh // 2), (kw // 2, kw // 2))
+
+    def conv(x, wgt):
+        return jax.lax.conv_general_dilated(
+            x, wgt, (stride, stride), pad, dimension_numbers=dn)
+
+    if not bwd:
+        @jax.jit
+        def f(x, wgt):
+            def body(c, i):
+                return c + conv(x + i.astype(dtype) * 1e-6, wgt), None
+            z = jnp.zeros(jax.eval_shape(conv, x, wgt).shape, dtype)
+            return jax.lax.scan(body, z, jnp.arange(reps))[0]
+    else:
+        grad = jax.grad(
+            lambda x, wgt: conv(x, wgt).astype(jnp.float32).sum(),
+            argnums=(0, 1))
+
+        @jax.jit
+        def f(x, wgt):
+            def body(c, i):
+                # BOTH grads must feed the carry: dropping dw would let
+                # XLA dead-code-eliminate the dW convolution from the
+                # timed program (and conv is linear, so the forward never
+                # runs in the grad program — bwd times exactly dX+dW)
+                dx, dw = grad(x + i.astype(dtype) * 1e-6, wgt)
+                return c + dx.astype(dtype) + dw.sum().astype(dtype), None
+            return jax.lax.scan(body, jnp.zeros(xshape, dtype),
+                                jnp.arange(reps))[0]
+
+    return f, (x, wgt)
+
+
+def measure_conv(n, h, w, cin, cout, kh, kw, stride=1, fmt="NCHW",
+                 bwd=False, r1=None, r2=None):
+    """Kernel-only conv TF/s via the two-R slope. ResNet-class convs run
+    in tens of microseconds, far below the tunnel's per-dispatch jitter —
+    the default rep counts auto-scale so that r2-r1 puts >= ~25 kernel-
+    milliseconds between the two timed programs (estimated at 100 TF/s).
+    A slope that still comes out non-positive is below timing resolution:
+    the returned TF/s is None in that case, never a fabricated number."""
+    ho, wo = h // stride, w // stride
+    flops = 2.0 * n * ho * wo * cout * cin * kh * kw
+    if bwd:
+        flops *= 2.0  # dX + dW (the fwd conv is linear: not in the program)
+    if r1 is None or r2 is None:
+        est = flops / 100e12  # optimistic per-rep seconds
+        delta = max(32, int(0.025 / max(est, 1e-7)))
+        delta = min(delta, 2048)
+        r1, r2 = max(4, delta // 8), max(4, delta // 8) + delta
+    f1, a1 = _scanned_conv(n, h, w, cin, cout, kh, kw, stride, r1, fmt, bwd)
+    f2, a2 = _scanned_conv(n, h, w, cin, cout, kh, kw, stride, r2, fmt, bwd)
+    t1 = _time_call(f1, *a1)
+    t2 = _time_call(f2, *a2)
+    per_op = (t2 - t1) / (r2 - r1)
+    if per_op <= 0:
+        return None, None
+    return flops / per_op / 1e12, per_op
+
+
+# ResNet50 bottleneck conv inventory: (h, w, cin, cout, k, stride, count)
+# per forward pass (conv1 + 4 stages; downsample convs folded into count-
+# weighted equivalents; fc excluded — it is a tiny matmul).
+_RESNET50_CONVS = [
+    ("conv1_7x7_s2", 224, 224, 3, 64, 7, 2, 1),
+    ("s1_reduce_1x1", 56, 56, 256, 64, 1, 1, 2),     # +first from 64
+    ("s1_3x3", 56, 56, 64, 64, 3, 1, 3),
+    ("s1_expand_1x1", 56, 56, 64, 256, 1, 1, 3),
+    ("s2_reduce_1x1", 28, 28, 512, 128, 1, 1, 3),
+    ("s2_3x3", 28, 28, 128, 128, 3, 1, 4),
+    ("s2_expand_1x1", 28, 28, 128, 512, 1, 1, 4),
+    ("s3_reduce_1x1", 14, 14, 1024, 256, 1, 1, 5),
+    ("s3_3x3", 14, 14, 256, 256, 3, 1, 6),
+    ("s3_expand_1x1", 14, 14, 256, 1024, 1, 1, 6),
+    ("s4_reduce_1x1", 7, 7, 2048, 512, 1, 1, 2),
+    ("s4_3x3", 7, 7, 512, 512, 3, 1, 3),
+    ("s4_expand_1x1", 7, 7, 512, 2048, 1, 1, 3),
+]
+
+
+def calibrate_resnet50(batch=32, fmts=("NCHW", "NHWC"), shapes=None):
+    """Conv roofline for the ResNet50 north-star config: measured TF/s for
+    the distinct conv shapes (fwd and fwd+bwd), in both layouts, plus the
+    count-weighted step-time lower bound per layout. Answers whether the
+    b32/224^2 shapes underfill the MXU and whether the layout handed to
+    XLA matters. ``shapes``: optional subset of _RESNET50_CONVS names —
+    each (shape, layout, direction) costs two compiles over the remote
+    compiler, so the full 13-shape sweep is ~10 minutes."""
+    convs = [c for c in _RESNET50_CONVS
+             if shapes is None or c[0] in shapes]
+    out = {"device": str(jax.devices()[0].device_kind), "batch": batch,
+           "method": "scan-slope (see module docstring)", "convs": {},
+           "roofline": {}}
+    for fmt in fmts:
+        total = 0.0
+        total_flops = 0.0
+        unresolved = 0
+        for name, h, w, cin, cout, k, s, cnt in convs:
+            tf_f, dt_f = measure_conv(batch, h, w, cin, cout, k, k, s, fmt)
+            tf_b, dt_b = measure_conv(batch, h, w, cin, cout, k, k, s, fmt,
+                                      bwd=True)
+            rec = out["convs"].setdefault(name, {
+                "shape": [batch, h, w, cin, cout, k, s], "count": cnt})
+            rec[fmt] = {
+                "fwd_tflops": round(tf_f, 2) if tf_f else None,
+                "bwd_tflops": round(tf_b, 2) if tf_b else None,
+                "fwd_ms": round(dt_f * 1e3, 3) if dt_f else None,
+                "bwd_ms": round(dt_b * 1e3, 3) if dt_b else None}
+            _log(f"{fmt} {name}: fwd {tf_f and round(tf_f, 1)} / "
+                 f"bwd {tf_b and round(tf_b, 1)} TF/s")
+            if dt_f and dt_b:
+                total += cnt * (dt_f + dt_b)
+                total_flops += cnt * 3 * 2.0 * batch * (h // s) * (w // s) \
+                    * cout * cin * k * k
+            else:
+                unresolved += 1
+        out["roofline"][fmt] = {
+            "conv_time_ms": round(total * 1e3, 2),
+            "blended_conv_tflops": round(total_flops / total / 1e12, 2)
+            if total else None,
+            "unresolved_shapes": unresolved,
+            "note": ("lower bound: conv kernel time only — BN/ReLU/pool/"
+                     "optimizer ride free; real step time must exceed it; "
+                     "shapes below timing resolution excluded"),
+        }
+    return out
 
 
 def calibrate(batch=8, seq=1024, hidden=768, heads=12, layers=12,

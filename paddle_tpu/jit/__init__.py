@@ -811,4 +811,4 @@ def load(path, **config):
     return TranslatedLayer(meta, params)
 
 
-from .multi_step import multi_step  # noqa: E402,F401
+from .multi_step import WindowRunner, multi_step  # noqa: E402,F401

@@ -5,12 +5,21 @@ autotune of ``paddle/phi/kernels/autotune/switch_autotune.h``,
 
 TPU shape: Pallas kernels have block-size free parameters; the autotuner
 times each candidate configuration on the real shapes the model runs
-(two calls per candidate — the first compiles, the second measures a
-host-synced median of repeats) and persists the winner per
-(device kind, op, shape signature) in a JSON cache so later processes
-skip the sweep. Disabled by default (the reference's autotune is also
-opt-in); enable with ``paddle_tpu.incubate.autotune.set_config(
-{"kernel": {"enable": True}})`` or ``PDTPU_AUTOTUNE=1``.
+and persists the winner per (device kind, op, shape signature) in a
+JSON cache so later processes skip the sweep.
+
+LIMITATION (measured, round 4): the sweep times candidates in an
+isolated chained program; the winner inside a REAL train step can
+differ by a few percent because XLA fuses/schedules the kernel
+differently in context (e.g. the GPT-124M step runs fastest with
+(256,512) although the isolated fwd+bwd chain ranks (512,1024) first).
+The cache stores VALUES, so an end-to-end-measured winner can be pinned
+by writing it into the cache file — bench.py ships pinned winners for
+its two model shapes in benchmarks/measured/autotune.json.
+
+Disabled by default (the reference's autotune is also opt-in); enable
+with ``paddle_tpu.incubate.autotune.set_config({"kernel": {"enable":
+True}})`` or ``PDTPU_AUTOTUNE=1``.
 """
 from __future__ import annotations
 
@@ -77,13 +86,23 @@ def _same_candidate(a, b):
 
 
 def autotune(op: str, signature: str, candidates: Sequence,
-             run: Callable, repeats: int = 3):
+             run: Callable, repeats: int = 3, measure: Callable = None,
+             validate: Callable = None):
     """Pick the fastest candidate for ``run(candidate)`` and cache it.
 
     ``run`` must execute the kernel to completion (host-synced) — it is
     called once per candidate for warmup/compile and ``repeats`` times
     for timing. Failing candidates (e.g. VMEM overflow) are skipped.
-    Returns the winning candidate (cached on later calls)."""
+    Returns the winning candidate (cached on later calls).
+
+    ``measure``: optional ``cand -> seconds`` that owns its own timing
+    (e.g. the dispatch-free scan-slope of benchmarks/calibrate.py —
+    wall-timing individual dispatches over a network-attached chip is
+    jitter-dominated and picks wrong winners). When given, ``run`` is
+    not used. ``validate``: optional ``cand -> None`` called on each
+    prospective winner in the caller's REAL execution context; if it
+    raises (e.g. scoped-vmem overflow that the measuring context did
+    not trigger), the candidate is discarded and the next-best wins."""
     key = f"{_device_kind()}|{op}|{signature}"
     cache = _load_cache()
     if key in cache:
@@ -94,20 +113,33 @@ def autotune(op: str, signature: str, candidates: Sequence,
         for cand in candidates:
             if _same_candidate(cand, cached):
                 return cand
-    best, best_t = None, float("inf")
+    scored = []
     for cand in candidates:
         try:
-            run(cand)  # compile + warm
-            ts = []
-            for _ in range(repeats):
-                t0 = time.perf_counter()
-                run(cand)
-                ts.append(time.perf_counter() - t0)
-            t = sorted(ts)[len(ts) // 2]
+            if measure is not None:
+                t = measure(cand)
+            else:
+                run(cand)  # compile + warm
+                ts = []
+                for _ in range(repeats):
+                    t0 = time.perf_counter()
+                    run(cand)
+                    ts.append(time.perf_counter() - t0)
+                t = sorted(ts)[len(ts) // 2]
         except Exception:
             continue
-        if t < best_t:
-            best, best_t = cand, t
+        if t != float("inf"):  # inf = below timing resolution, not a score
+            scored.append((t, cand))
+    scored.sort(key=lambda tc: tc[0])
+    best = None
+    for _, cand in scored:
+        if validate is not None:
+            try:
+                validate(cand)
+            except Exception:
+                continue
+        best = cand
+        break
     if best is None:
         raise RuntimeError(f"autotune: every candidate failed for {op} "
                            f"{signature}")

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 import math
+import time
 
 import jax
 import jax.numpy as jnp
@@ -426,22 +427,77 @@ def _autotuned_blocks(qt, kt, scale, causal):
         return None  # no timing possible mid-trace; use defaults
     runners = {}
 
-    def run(cand):
-        # chain several applications inside ONE jit so kernel-time
-        # differences dominate per-dispatch host latency
-        f = runners.get(cand)
+    def _timed(cand, reps):
+        # ``reps`` fwd+bwd applications scanned inside ONE jit (the q
+        # input is index-perturbed so XLA cannot CSE the iterations; the
+        # scan compiles each kernel once regardless of reps). The
+        # difference between two rep counts is pure kernel time
+        # (scan-slope — constant dispatch/tunnel latency cancels;
+        # per-call wall timing over a network-attached chip is
+        # jitter-dominated and picks wrong winners). Training is the
+        # tuner's consumer, so the BACKWARD kernels are timed too —
+        # fwd-only timing picks blocks whose bwd is slow.
+        f = runners.get((cand, reps))
         if f is None:
-            def chained(a, bb, cc, _cand=tuple(cand)):
-                y = a
-                for _ in range(8):
-                    y = _flash_bhsd(y, bb, cc, None, None, scale, causal,
-                                    False, _cand)
-                return y
-            f = runners[cand] = jax.jit(chained)
-        out = f(qt, kt, kt)
-        float(jax.device_get(out.ravel()[0]))  # true host sync
+            grad = jax.grad(
+                lambda a, bb, cc, _cand=tuple(cand): _flash_bhsd(
+                    a, bb, cc, None, None, scale, causal, False,
+                    _cand).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2))
 
-    return tuple(at.autotune("flash_attention", sig, cands, run))
+            def chained(a, bb, cc, _n=reps):
+                def body(c, i):
+                    # every grad output must feed the carry: an unused
+                    # dk/dv would let XLA dead-code-eliminate the dkv
+                    # kernel (the dominant backward cost) from the timed
+                    # program. dk/dv fold in as scalars so rectangular
+                    # attention (sq != sk) stays timeable.
+                    dq, dk, dv = grad(a + i.astype(a.dtype) * 1e-6, bb, cc)
+                    extra = (dk.sum() + dv.sum()).astype(a.dtype)
+                    return c + dq.astype(a.dtype) + extra, None
+                z = jnp.zeros(a.shape, a.dtype)
+                return jax.lax.scan(body, z, jnp.arange(_n))[0]
+
+            f = runners[(cand, reps)] = jax.jit(chained)
+        out = f(qt, kt, kt)
+        float(jax.device_get(out.ravel()[0]))  # compile/warm + sync
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = f(qt, kt, kt)
+            float(jax.device_get(out.ravel()[0]))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def measure(cand):
+        r1, r2 = 4, 24
+        slope = (_timed(cand, r2) - _timed(cand, r1)) / (r2 - r1)
+        if slope <= 0:
+            # below timing resolution (dispatch jitter swamped the
+            # 20-rep kernel delta): never let noise crown a winner
+            return float("inf")
+        return slope
+
+    def validate(cand):
+        # the measuring jit may fuse/lay out differently than the real
+        # call, and the backward kernels have the larger vmem footprint
+        # (dk/dv accumulators + the q loop). Compile+run fwd AND bwd in
+        # the caller's real eager context — a scoped-vmem overflow in
+        # either disqualifies the candidate and the next-best wins.
+        def f(a, bb, cc):
+            return _flash_bhsd(a, bb, cc, None, None, scale, causal,
+                               False, tuple(cand)).astype(jnp.float32).sum()
+        grads = jax.grad(f, argnums=(0, 1, 2))(qt, kt, kt)
+        float(jax.device_get(grads[0].ravel()[0]))  # force execution
+
+    try:
+        return tuple(at.autotune("flash_attention", sig, cands, None,
+                                 measure=measure, validate=validate))
+    except RuntimeError:
+        # every candidate failed or was below timing resolution: fall
+        # back to the measured defaults rather than crashing the call
+        # (nothing is cached, so a later quieter run can still tune)
+        return None
 
 
 def flash_attention(q, k, v, causal=False, scale=None, interpret=None,

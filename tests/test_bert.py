@@ -137,3 +137,31 @@ def test_sequence_classification():
                  labels=paddle.to_tensor(rng.integers(0, 3, (4,))
                                          .astype(np.int32)))
     assert float(loss) > 0
+
+
+def test_masked_gather_mlm_head_parity():
+    """cfg.max_predictions gathers the masked positions before the vocab
+    projection (the reference's max_predictions_per_seq contract); with
+    <= K masked per row the loss is identical to the dense head."""
+    rng = np.random.default_rng(3)
+    b, s, k = 3, 32, 8
+    paddle.seed(0)
+    dense = BertForPretraining(BertConfig(**CFG))
+    paddle.seed(0)
+    gathered = BertForPretraining(BertConfig(**CFG, max_predictions=k))
+
+    ids = rng.integers(0, 128, (b, s)).astype(np.int32)
+    tt = np.zeros((b, s), np.int32)
+    mlm = np.full((b, s), -100, np.int32)
+    for i in range(b):
+        pos = rng.choice(s, size=k - 2, replace=False)
+        mlm[i, pos] = rng.integers(0, 128, k - 2)
+    nsp = rng.integers(0, 2, (b,)).astype(np.int32)
+    args = [paddle.to_tensor(v) for v in (ids, tt, mlm, nsp)]
+    np.testing.assert_allclose(float(dense(*args)), float(gathered(*args)),
+                               rtol=1e-5)
+    # more masked than K: extras drop, loss stays finite (the reference
+    # data pipeline guarantees <= K; this is the out-of-contract guard)
+    over = np.where(rng.random((b, s)) < 0.9, ids, -100).astype(np.int32)
+    lv = float(gathered(args[0], args[1], paddle.to_tensor(over), args[3]))
+    assert np.isfinite(lv)

@@ -251,3 +251,51 @@ def test_multi_step_matches_sequential():
     for k, v in net.state_dict().items():
         np.testing.assert_allclose(np.asarray(v._read()), ref_params[k],
                                    atol=1e-6)
+
+
+def test_window_runner_matches_sequential():
+    """jit.WindowRunner: all K steps in ONE dispatch == K dispatches."""
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    lossf = nn.CrossEntropyLoss()
+    rng = np.random.default_rng(0)
+    warm, *batches = [
+        (pt.to_tensor(rng.normal(size=(4, 8)).astype("float32")),
+         pt.to_tensor(rng.integers(0, 2, (4,)).astype("int64")))
+        for _ in range(6)]
+    sd = {k: np.asarray(v._read()).copy()
+          for k, v in net.state_dict().items()}
+
+    def make_step():
+        optim = opt.Adam(learning_rate=1e-2,
+                         parameters=net.parameters())
+
+        @pt.jit.to_static
+        def step(x, y):
+            loss = lossf(net(x), y)
+            loss.backward()
+            optim.step()
+            optim.clear_grad()
+            return loss
+        return step
+
+    step = make_step()
+    step(*warm)
+    ref = [float(step(*b)) for b in batches]
+    ref_params = {k: np.asarray(v._read()).copy()
+                  for k, v in net.state_dict().items()}
+
+    for k, v in net.state_dict().items():
+        v._write(sd[k])
+    step2 = make_step()
+    step2(*warm)  # compile + the same warmup mutation as the ref run
+    w = pt.jit.WindowRunner(step2, batches[0], length=len(batches))
+    stacks = w.stage(batches)
+    outs = w.run(*stacks)
+    np.testing.assert_allclose([float(o) for o in outs], ref, rtol=1e-5)
+    for k, v in net.state_dict().items():
+        np.testing.assert_allclose(np.asarray(v._read()), ref_params[k],
+                                   atol=1e-6)
+    # outputs="last" on a fresh window continues from the updated state
+    last = w.run(*stacks, outputs="last")
+    assert float(last) < ref[0]

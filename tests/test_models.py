@@ -79,6 +79,33 @@ def test_recompute_grad_parity():
         np.testing.assert_allclose(g0[k], g1[k], rtol=1e-4, atol=1e-5)
 
 
+def test_recompute_policy_grad_parity():
+    """Every remat policy (incl. dots_and_kernels_saveable, which keeps
+    Pallas flash-attention outputs as residuals) produces the same loss
+    and grads — policies trade memory for recompute work, never math."""
+
+    def run(policy):
+        pt.seed(7)
+        cfg = _cfg(recompute=True, recompute_policy=policy)
+        m = GPTForCausalLM(cfg)
+        m.train()
+        ids, lab = _batch(cfg, seed=3)
+        loss = m(ids, lab)
+        loss.backward()
+        grads = {n: p.grad.numpy() for n, p in m.named_parameters()
+                 if p.grad is not None}
+        return float(loss), grads
+
+    ref_l, ref_g = run("full")
+    for policy in ("dots_saveable", "dots_and_kernels_saveable"):
+        l, g = run(policy)
+        assert abs(l - ref_l) < 1e-5, policy
+        assert g.keys() == ref_g.keys()
+        for k in g:
+            np.testing.assert_allclose(g[k], ref_g[k], rtol=1e-4,
+                                       atol=1e-5, err_msg=policy)
+
+
 def test_recompute_under_jit():
     pt.seed(0)
     cfg = _cfg(recompute=True)
