@@ -39,6 +39,7 @@ from . import distribution  # noqa: F401
 from . import profiler  # noqa: F401
 from . import device  # noqa: F401
 from .device import set_device, get_device  # noqa: F401
+from .device.custom import CustomPlace  # noqa: F401
 from . import quantization  # noqa: F401
 from . import text  # noqa: F401
 from . import audio  # noqa: F401

@@ -37,7 +37,9 @@ def get_all_device_type():
 
 
 def get_all_custom_device_type():
-    return [p for p in get_all_device_type() if p not in ("cpu",)]
+    from .custom import registered_types
+    native = [p for p in get_all_device_type() if p not in ("cpu",)]
+    return native + [t for t in registered_types() if t not in native]
 
 
 def get_available_device():
@@ -51,7 +53,9 @@ def get_available_custom_device():
 def device_count(device_type: Optional[str] = None) -> int:
     if device_type is None:
         return len(jax.devices())
-    return len([d for d in jax.devices() if d.platform == device_type])
+    from .custom import resolve_type
+    plat = resolve_type(device_type) or device_type
+    return len([d for d in jax.devices() if d.platform == plat])
 
 
 def set_device(device: str):
@@ -59,8 +63,17 @@ def set_device(device: str):
     placement for new tensors. Accepts "cpu", "tpu", "tpu:0", ...; the
     reference's "gpu:N" spelling maps to the accelerator backend."""
     global _current
-    name = device.replace("gpu", _accel_platform())
-    plat, _, idx = name.partition(":")
+    from .custom import resolve_type
+    plat, _, idx = device.partition(":")
+    if plat in ("gpu", "cuda", "xpu"):  # reference accelerator spellings
+        plat = _accel_platform()
+    resolved = resolve_type(plat)
+    if resolved is None and plat not in ("cpu", "tpu"):
+        raise ValueError(
+            f"set_device: unknown device type {plat!r} (live platforms: "
+            f"{get_all_device_type()}; custom types register via "
+            f"device.register_custom_device)")
+    plat = resolved or plat
     devs = [d for d in jax.devices() if d.platform == plat] or jax.devices()
     dev = devs[int(idx)] if idx else devs[0]
     jax.config.update("jax_default_device", dev)
@@ -83,10 +96,12 @@ def get_device() -> str:
 
 
 def _resolve(device=None):
+    from .custom import resolve_type
     if device is None:
         plat, _, idx = get_device().partition(":")
     else:
         plat, _, idx = str(device).partition(":")
+    plat = resolve_type(plat) or plat
     devs = [d for d in jax.devices() if d.platform == plat] or jax.devices()
     return devs[int(idx)] if idx else devs[0]
 
@@ -215,7 +230,12 @@ __all__ = [
     "set_device", "get_device", "get_all_device_type",
     "get_all_custom_device_type", "get_available_device",
     "get_available_custom_device", "device_count", "synchronize",
+    "register_custom_device", "is_compiled_with_custom_device",
+    "CustomPlace",
     "memory_allocated", "max_memory_allocated", "memory_reserved",
     "max_memory_reserved", "empty_cache", "Event", "Stream",
     "current_stream", "set_stream", "cuda",
 ]
+
+from .custom import (CustomPlace, is_compiled_with_custom_device,  # noqa: E402
+                     register_custom_device)

@@ -1,0 +1,131 @@
+"""Custom-device plugin registry (SURVEY C5).
+
+Reference: ``paddle/phi/backends/custom/custom_device.cc`` +
+``paddle/phi/backends/device_manager.cc`` load vendor ``.so`` plugins
+implementing the CustomDevice ABI and surface them through
+``python/paddle/device/__init__.py`` (``is_compiled_with_custom_device``,
+``core.CustomPlace``, ``set_device("npu:0")``).
+
+TPU-native shape: the plugin ABI of the jax/XLA world is **PJRT** — a
+vendor chip ships a PJRT plugin shared object, and jax can load it at
+runtime. This registry is the paddle-flavored front door:
+
+* ``register_custom_device(type, library_path=...)`` hands the plugin to
+  jax's PJRT plugin loader (the analog of DeviceManager::LoadCustomRuntimeLib);
+* ``register_custom_device(type, alias_of=...)`` names an
+  already-initialized jax platform as a paddle custom-device type (the
+  common case for backends that self-register via the ``jax_plugins``
+  entry-point namespace before we are imported);
+* ``CustomPlace("mychip", 0)``, ``paddle.device.set_device("mychip:0")``,
+  ``is_compiled_with_custom_device("mychip")`` then work against the
+  registered type exactly as the reference's surface does for ``npu``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..core.dtype import Place
+
+# device_type -> jax platform name it resolves to
+_registry: dict[str, str] = {}
+
+
+def register_custom_device(device_type: str, *,
+                           library_path: Optional[str] = None,
+                           alias_of: Optional[str] = None,
+                           options: Optional[dict] = None) -> None:
+    """Register ``device_type`` as a paddle custom device.
+
+    ``library_path``: path to a PJRT plugin shared object; it is handed
+    to jax's plugin loader and the platform it announces is bound to
+    ``device_type``. ``alias_of``: bind ``device_type`` to an existing
+    jax platform instead (no loading). Exactly one must be given.
+    """
+    if (library_path is None) == (alias_of is None):
+        raise ValueError(
+            "register_custom_device: pass exactly one of library_path "
+            "(load a PJRT plugin) or alias_of (bind an existing platform)")
+    if alias_of is not None:
+        plats = {d.platform for d in jax.devices()}
+        if alias_of not in plats:
+            raise ValueError(
+                f"register_custom_device: platform {alias_of!r} is not "
+                f"initialized (have {sorted(plats)})")
+        _registry[device_type.lower()] = alias_of
+        return
+    # PJRT plugin load path. jax's loader registers the plugin under the
+    # name we give it. jax caches its backend set on first use, so a
+    # plugin registered after device queries needs the cache dropped; if
+    # the platform still does not surface, fail loudly rather than let
+    # is_compiled_with_custom_device claim a chip that can never appear.
+    from jax._src import xla_bridge as xb
+    t = device_type.lower()
+    xb.register_plugin(t, library_path=library_path, options=options)
+    try:
+        jax.clear_backends()
+    except Exception:
+        pass
+    if not any(d.platform == t for d in jax.devices()):
+        raise RuntimeError(
+            f"register_custom_device: PJRT plugin {library_path!r} was "
+            f"registered but platform {t!r} did not initialize — "
+            f"register before first device use, or check the plugin's "
+            f"announced platform name")
+    _registry[t] = t
+
+
+def resolve_type(device_type: str) -> Optional[str]:
+    """The jax platform a (possibly custom) device type maps to, or None
+    when the type is neither registered nor a live platform."""
+    t = device_type.lower()
+    if t in _registry:
+        return _registry[t]
+    if any(d.platform == t for d in jax.devices()):
+        return t
+    return None
+
+
+def registered_types() -> list[str]:
+    return sorted(_registry)
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    """Reference ``device/__init__.py:62`` — whether ``device_type`` is
+    usable as a custom device in this process."""
+    return resolve_type(device_type) is not None
+
+
+class CustomPlace(Place):
+    """Reference ``core.CustomPlace(type, id)`` over a registered type."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        plat = resolve_type(device_type)
+        if plat is None:
+            raise ValueError(
+                f"CustomPlace: unknown custom device type "
+                f"{device_type!r}; register_custom_device first")
+        devs = [d for d in jax.devices() if d.platform == plat]
+        if not devs:
+            raise ValueError(f"CustomPlace: no devices for {device_type!r}")
+        if not 0 <= device_id < len(devs):
+            raise ValueError(
+                f"CustomPlace: device_id {device_id} out of range for "
+                f"{device_type!r} ({len(devs)} device(s))")
+        super().__init__(devs[device_id])
+        self._custom_type = device_type
+        self._custom_id = device_id
+
+    def get_device_type(self) -> str:
+        return self._custom_type
+
+    def get_device_id(self) -> int:
+        return self._custom_id
+
+    def __repr__(self):
+        return f"CustomPlace({self._custom_type}:{self._custom_id})"
+
+
+__all__ = ["register_custom_device", "is_compiled_with_custom_device",
+           "CustomPlace", "registered_types", "resolve_type"]

@@ -50,45 +50,71 @@ def _resolve_exe(static_fn, first):
     return exe, out0
 
 
-def _build_window(exe, donate):
+def _split(exe, per_step_idx=()):
+    """(carry_idx, const_idx, ps_idx) into ``exe.capt_state`` — the ONE
+    place the promoted per-step indices are removed from the constants,
+    shared by the window builder and the runner so their orderings can
+    never drift apart."""
+    carry_idx, const_idx = exe.state_split()
+    ps_idx = list(per_step_idx)
+    return carry_idx, [i for i in const_idx if i not in ps_idx], ps_idx
+
+
+def _build_window(exe, donate, per_step_idx=()):
     """The jitted K-step window program for ``exe``: scan the step's pure
     function over stacked inputs, threading the written captured state
-    through the (donated) carry and closing over the read-only state."""
+    through the (donated) carry and closing over the read-only state.
+
+    ``per_step_idx``: indices into ``exe.capt_state`` promoted from scan
+    constants to PER-STEP scanned inputs (leading [K] axis) — the
+    mechanism behind per-step learning rates inside a window (a captured
+    LR scalar is otherwise frozen for all K steps because its host-side
+    scheduler sync runs once per launch, not once per step)."""
     capt = exe.capt_state
     n_state = len(exe.state_out_tensors)
     n_ret = exe.n_ret
-    carry_idx, const_idx = exe.state_split()
+    carry_idx, const_idx, ps_idx = _split(exe, per_step_idx)
     pure = exe._pure
 
-    def window(carry_vals, const_vals, *stacks):
+    def window(carry_vals, const_vals, ps_stacks, *stacks):
         def body(carry, xs):
+            ps_vals, arg_vals = xs
             state = [None] * len(capt)
             for i, v in zip(carry_idx, carry):
                 state[i] = v
             for i, v in zip(const_idx, const_vals):
                 state[i] = v
-            outs = pure(*xs, *state)
+            for i, v in zip(ps_idx, ps_vals):
+                state[i] = v
+            outs = pure(*arg_vals, *state)
             return (list(outs[n_ret:n_ret + n_state]),
                     tuple(outs[:n_ret]))
 
-        carry, rets = jax.lax.scan(body, list(carry_vals), stacks)
+        carry, rets = jax.lax.scan(body, list(carry_vals),
+                                   (tuple(ps_stacks), tuple(stacks)))
         return carry, rets
 
     return jax.jit(window, donate_argnums=(0,) if donate else ())
 
 
-def _run_window(exe, runner, stacks):
+def _run_window(exe, runner, stacks, per_step_idx=(), per_step_vals=()):
     """Execute one window: read the captured state, launch, write the
     post-window state back. Returns the stacked per-step outputs."""
     capt = exe.capt_state
-    carry_idx, const_idx = exe.state_split()
+    carry_idx, const_idx, ps_idx = _split(exe, per_step_idx)
     for sync in exe.discovery.host_syncs:
         sync()
     carry_vals = [capt[i]._read() for i in carry_idx]
     const_vals = [capt[i]._read() for i in const_idx]
-    final_carry, rets = runner(carry_vals, const_vals, *stacks)
+    final_carry, rets = runner(carry_vals, const_vals,
+                               tuple(per_step_vals), *stacks)
     for i, v in zip(carry_idx, final_carry):
         capt[i]._data = v
+        capt[i]._node = None
+    # leave the promoted tensors holding their LAST per-step value, as
+    # if the host had fed each step individually
+    for i, v in zip(ps_idx, per_step_vals):
+        capt[i]._data = jnp.asarray(v)[-1]
         capt[i]._node = None
     return rets
 
@@ -118,7 +144,8 @@ class WindowRunner:
     once. Construct after warmup (the usual case) to avoid it.
     """
 
-    def __init__(self, static_fn, example_args, length, donate=True):
+    def __init__(self, static_fn, example_args, length, donate=True,
+                 per_step=None):
         if length < 1:
             raise ValueError("window length must be >= 1")
         self.length = length
@@ -126,7 +153,22 @@ class WindowRunner:
         exe, _ = _resolve_exe(static_fn, first)
         self._exe = exe
         self._n_args = len(first)
-        self._runner = _build_window(exe, donate)
+        self._ps_idx = []
+        if per_step:
+            pos = {id(t): i for i, t in enumerate(exe.capt_state)}
+            carry = set(exe.state_split()[0])
+            for t in per_step:
+                i = pos.get(id(t))
+                if i is None:
+                    raise ValueError(
+                        "per_step tensor is not captured state of this "
+                        "step (it must be read by the compiled function)")
+                if i in carry:
+                    raise ValueError(
+                        "per_step tensor is WRITTEN by the step — it "
+                        "already threads through the scan carry")
+                self._ps_idx.append(i)
+        self._runner = _build_window(exe, donate, tuple(self._ps_idx))
 
     def stage(self, arg_batches):
         """Stack a window of host batches into device arrays (one upload
@@ -145,7 +187,7 @@ class WindowRunner:
             cols.append(jnp.asarray(col))
         return tuple(cols)
 
-    def run(self, *stacks, outputs="all"):
+    def run(self, *stacks, outputs="all", per_step_vals=None):
         """One compiled K-step launch. Returns the per-step outputs as a
         list of ``length`` entries (device-resident until read); captured
         state (params, moments, RNG) holds the post-window values.
@@ -153,9 +195,25 @@ class WindowRunner:
         ``outputs``: "all" rebuilds every step's outputs (one device
         slice per step); "last" only the final step's (the common
         train-loop need — logging the latest loss — at one slice);
-        "stacked" returns the raw [K, ...] arrays with no slicing."""
+        "stacked" returns the raw [K, ...] arrays with no slicing.
+
+        ``per_step_vals``: one [length, ...] array per ``per_step``
+        tensor declared at construction — that tensor takes value
+        ``per_step_vals[j][k]`` during step k (e.g. a warmup LR ramp
+        inside the window)."""
         exe = self._exe
-        rets = _run_window(exe, self._runner, stacks)
+        if len(per_step_vals or ()) != len(self._ps_idx):
+            raise ValueError(
+                f"expected {len(self._ps_idx)} per_step_vals arrays, "
+                f"got {len(per_step_vals or ())}")
+        for v in per_step_vals or ():
+            n = jnp.asarray(v).shape[0] if jnp.asarray(v).ndim else -1
+            if n != self.length:
+                raise ValueError(
+                    f"per_step_vals arrays need leading dim "
+                    f"{self.length}, got {n}")
+        rets = _run_window(exe, self._runner, stacks, self._ps_idx,
+                           tuple(per_step_vals or ()))
         if outputs == "stacked":
             return rets
         if outputs == "last":

@@ -136,6 +136,36 @@ class Optimizer:
             return g32 + reg.coeff * jnp.sign(master)
         return g32
 
+    @property
+    def lr_var(self):
+        """The captured LR scalar the compiled step reads — pass it as a
+        ``jit.WindowRunner`` ``per_step`` tensor to feed a different LR
+        to every step of a scanned window."""
+        return self._lr_var
+
+    def lr_window(self, length: int):
+        """The next ``length`` scheduler LR values (current value first)
+        as a float32 [length] array for a WindowRunner per-step slot,
+        ADVANCING the scheduler by ``length`` steps — the window analog
+        of calling ``scheduler.step()`` once per batch. With a fixed
+        float LR the array is constant.
+
+        The advance happens NOW, not when the window runs: if the
+        subsequent ``run`` fails or is skipped, restore the scheduler
+        from a prior ``state_dict()`` snapshot before retrying, or the
+        schedule lands ``length`` steps ahead of the applied steps."""
+        import numpy as np
+        from .lr import LRScheduler
+        sched = self._learning_rate
+        if not isinstance(sched, LRScheduler):
+            return np.full((length,), float(self._learning_rate),
+                           np.float32)
+        vals = []
+        for _ in range(length):
+            vals.append(float(sched()))
+            sched.step()
+        return np.asarray(vals, np.float32)
+
     def _live_lr(self):
         """Current LR as a traceable value. Under capture, reads the
         persistent lr scalar (a real program input) and registers a host-side

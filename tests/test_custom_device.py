@@ -1,0 +1,68 @@
+"""Custom-device plugin registry (SURVEY C5): register a device type,
+surface it through the paddle.device API, place tensors on it.
+
+Reference surface: ``python/paddle/device/__init__.py``
+``is_compiled_with_custom_device`` (:62) / ``core.CustomPlace`` (:196) /
+``set_device("npu:0")`` (:191); plugin loading
+``paddle/phi/backends/device_manager.cc``. The TPU-native plugin ABI is
+PJRT — the test binds a custom type onto the live cpu platform (the
+``alias_of`` path); the ``library_path`` path hands a vendor PJRT .so to
+jax's plugin loader and cannot run here without one.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.device.custom import (CustomPlace,
+                                      is_compiled_with_custom_device,
+                                      register_custom_device,
+                                      registered_types)
+
+
+@pytest.fixture()
+def mychip():
+    register_custom_device("mychip", alias_of="cpu")
+    yield "mychip"
+    from paddle_tpu.device import custom
+    custom._registry.pop("mychip", None)
+
+
+def test_register_and_query(mychip):
+    assert is_compiled_with_custom_device("mychip")
+    assert not is_compiled_with_custom_device("notachip")
+    assert "mychip" in registered_types()
+    assert "mychip" in paddle.device.get_all_custom_device_type()
+    assert paddle.device.device_count("mychip") >= 1
+
+
+def test_custom_place_resolves(mychip):
+    p = CustomPlace("mychip", 0)
+    assert p.get_device_type() == "mychip"
+    assert p.get_device_id() == 0
+    assert p.device.platform == "cpu"  # the aliased platform
+    assert "mychip" in repr(p)
+
+
+def test_set_device_accepts_custom_type(mychip):
+    before = paddle.device.get_device()
+    got = paddle.device.set_device("mychip:0")
+    try:
+        assert got.startswith("cpu")  # resolved through the alias
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        assert float(x.sum()) == 4.0
+    finally:
+        paddle.device.set_device(before)
+
+
+def test_custom_place_unknown_type_raises():
+    with pytest.raises(ValueError, match="register_custom_device"):
+        CustomPlace("definitely_not_registered")
+
+
+def test_register_validates_arguments():
+    with pytest.raises(ValueError, match="exactly one"):
+        register_custom_device("x")
+    with pytest.raises(ValueError, match="exactly one"):
+        register_custom_device("x", alias_of="cpu", library_path="/y.so")
+    with pytest.raises(ValueError, match="not initialized"):
+        register_custom_device("x", alias_of="nonexistent_platform")

@@ -299,3 +299,59 @@ def test_window_runner_matches_sequential():
     # outputs="last" on a fresh window continues from the updated state
     last = w.run(*stacks, outputs="last")
     assert float(last) < ref[0]
+
+
+def test_window_runner_per_step_lr_matches_sequential():
+    """A scheduler-driven LR fed per-step into the scanned window
+    (WindowRunner per_step + optimizer.lr_window) reproduces sequential
+    training where scheduler.step() runs after every batch — the case a
+    per-launch host sync gets wrong (LR frozen across the window)."""
+    rng = np.random.default_rng(0)
+    warm, *batches = [
+        (pt.to_tensor(rng.normal(size=(4, 8)).astype("float32")),
+         pt.to_tensor(rng.integers(0, 2, (4,)).astype("int64")))
+        for _ in range(7)]
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    lossf = nn.CrossEntropyLoss()
+    sd = {k: np.asarray(v._read()).copy()
+          for k, v in net.state_dict().items()}
+
+    def make(sched_cls):
+        sched = sched_cls(learning_rate=0.05, warmup_steps=4,
+                          start_lr=0.001, end_lr=0.05)
+        optim = opt.SGD(learning_rate=sched, parameters=net.parameters())
+
+        @pt.jit.to_static
+        def step(x, y):
+            loss = lossf(net(x), y)
+            loss.backward()
+            optim.step()
+            optim.clear_grad()
+            return loss
+        return step, optim, sched
+
+    from paddle_tpu.optimizer.lr import LinearWarmup
+
+    # reference: one dispatch per step, scheduler.step() after each
+    step, optim, sched = make(LinearWarmup)
+    step(*warm); sched.step()
+    for b in batches:
+        step(*b)
+        sched.step()
+    ref = {k: np.asarray(v._read()).copy()
+           for k, v in net.state_dict().items()}
+
+    # windowed: same schedule fed per-step into one scanned launch
+    for k, v in net.state_dict().items():
+        v._write(sd[k])
+    step2, optim2, sched2 = make(LinearWarmup)
+    step2(*warm); sched2.step()
+    w = pt.jit.WindowRunner(step2, batches[0], length=len(batches),
+                            per_step=[optim2.lr_var])
+    lrs = optim2.lr_window(len(batches))
+    assert lrs[0] != lrs[-1], "warmup should vary inside the window"
+    w.run(*w.stage(batches), per_step_vals=[lrs], outputs="last")
+    for k, v in net.state_dict().items():
+        np.testing.assert_allclose(np.asarray(v._read()), ref[k],
+                                   atol=1e-6, err_msg=k)
