@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Attribute GPT-124M step time to components WITHOUT a device profiler.
+
+The axon environment exports no xprof device events (round 4), so this
+uses differential window timing: each variant changes exactly one
+component of the training step; K-step scanned windows (one dispatch,
+pre-staged inputs) give wall times whose DIFFERENCES isolate that
+component's cost. Variants:
+
+  full            the bench step (AdamW, CE loss, 12 layers, remat)
+  sgd             AdamW -> plain SGD        => optimizer update cost
+  mean_loss       CE -> logits.mean()       => CE + lm_head vjp cost
+  no_head         loss on hidden states     => + lm_head GEMM cost
+  layers_6        12 -> 6 layers            => per-layer encoder cost
+  fwd_only        no backward/optimizer     => backward multiple
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _window_time(step, batch_fn, K=30, repeats=3):
+    import paddle_tpu as paddle
+    for _ in range(2):
+        loss = step(*batch_fn())
+    float(loss)
+    w = paddle.jit.WindowRunner(step, batch_fn(), length=K)
+    stacks = w.stage([batch_fn() for _ in range(K)])
+    float(w.run(*stacks, outputs="last"))
+    dt = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(w.run(*stacks, outputs="last"))
+        dt = min(dt, time.perf_counter() - t0)
+    return dt / K
+
+
+def main():
+    import gc
+
+    import paddle_tpu as paddle
+    import paddle_tpu.amp as amp
+    from paddle_tpu.incubate import autotune
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    autotune.set_config({"kernel": {"enable": True}})
+    batch, seq = 8, 1024
+    results = {}
+
+    def build(num_layers=12, opt_kind="adamw"):
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768,
+                        num_layers=num_layers, num_heads=12,
+                        max_seq_len=1024, dropout=0.0, recompute=True,
+                        recompute_policy="dots_and_kernels_saveable")
+        paddle.seed(0)
+        model = GPTForCausalLM(cfg)
+        model.train()
+        if opt_kind == "adamw":
+            opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                         parameters=model.parameters())
+        else:
+            opt = paddle.optimizer.SGD(learning_rate=1e-4,
+                                       parameters=model.parameters())
+        model, opt = amp.decorate(models=model, optimizers=opt,
+                                  level="O2", dtype="bfloat16",
+                                  master_weight=True)
+        return cfg, model, opt
+
+    rng = np.random.default_rng(0)
+
+    def batch_fn():
+        ids = rng.integers(0, 50304, (batch, seq)).astype(np.int32)
+        lab = rng.integers(0, 50304, (batch, seq)).astype(np.int32)
+        return paddle.to_tensor(ids), paddle.to_tensor(lab)
+
+    def run(name, step):
+        ms = _window_time(step, batch_fn) * 1e3
+        results[name] = round(ms, 2)
+        print(f"{name}: {ms:.2f} ms/step", file=sys.stderr, flush=True)
+        gc.collect()
+
+    variants = sys.argv[1:] or ["full", "sgd", "mean_loss", "no_head",
+                                "layers_6", "fwd_only"]
+
+    if "full" in variants:
+        cfg, model, opt = build()
+
+        @paddle.jit.to_static
+        def full(ids, labels):
+            with amp.auto_cast(level="O2", dtype="bfloat16"):
+                loss = model(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        run("full", full)
+        del model, opt, full
+
+    if "sgd" in variants:
+        cfg, model, opt = build(opt_kind="sgd")
+
+        @paddle.jit.to_static
+        def sgd_step(ids, labels):
+            with amp.auto_cast(level="O2", dtype="bfloat16"):
+                loss = model(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        run("sgd", sgd_step)
+        del model, opt, sgd_step
+
+    if "mean_loss" in variants:
+        cfg, model, opt = build()
+
+        @paddle.jit.to_static
+        def mean_loss(ids, labels):
+            with amp.auto_cast(level="O2", dtype="bfloat16"):
+                logits = model(ids)          # [B, S, V]
+                loss = logits.astype("float32").mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        run("mean_loss", mean_loss)
+        del model, opt, mean_loss
+
+    if "no_head" in variants:
+        cfg, model, opt = build()
+        gpt_body = getattr(model, "gpt", None) or model._layers.gpt
+
+        @paddle.jit.to_static
+        def no_head(ids, labels):
+            with amp.auto_cast(level="O2", dtype="bfloat16"):
+                h = gpt_body(ids)            # hidden states only
+                loss = h.astype("float32").mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        run("no_head", no_head)
+        del model, opt, no_head, gpt_body
+
+    if "layers_6" in variants:
+        cfg, model, opt = build(num_layers=6)
+
+        @paddle.jit.to_static
+        def six(ids, labels):
+            with amp.auto_cast(level="O2", dtype="bfloat16"):
+                loss = model(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        run("layers_6", six)
+        del model, opt, six
+
+    if "fwd_only" in variants:
+        cfg, model, opt = build()
+        model.eval()
+
+        @paddle.jit.to_static
+        def fwd(ids, labels):
+            with amp.auto_cast(level="O2", dtype="bfloat16"):
+                loss = model(ids, labels)
+            return loss
+        run("fwd_only", fwd)
+        del model, opt, fwd
+
+    # derived attributions
+    d = {}
+    if "full" in results and "sgd" in results:
+        d["adamw_minus_sgd_ms"] = round(results["full"] - results["sgd"], 2)
+    if "full" in results and "mean_loss" in results:
+        d["ce_loss_ms"] = round(results["full"] - results["mean_loss"], 2)
+    if "mean_loss" in results and "no_head" in results:
+        d["lm_head_gemms_ms"] = round(
+            results["mean_loss"] - results["no_head"], 2)
+    if "full" in results and "layers_6" in results:
+        d["per_layer_ms"] = round(
+            (results["full"] - results["layers_6"]) / 6.0, 2)
+    if "full" in results and "fwd_only" in results:
+        d["bwd_plus_opt_ms"] = round(
+            results["full"] - results["fwd_only"], 2)
+    print(json.dumps({"variants_ms": results, "derived": d}, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -84,13 +84,16 @@ def device_count():
 
 
 def get_device():
-    import jax
-    d = jax.devices()[0]
-    return f"{d.platform}:{d.id}"
+    from .device import get_device as _gd
+    return _gd()
 
 
 def set_device(device):
-    return Place(device)
+    # route through device.set_device: it resolves registered custom
+    # device types and raises on unknown ones (a bare Place(str) would
+    # silently map them to cpu); reference returns the Place
+    from .device import set_device as _sd
+    return Place(_sd(device))
 
 
 def is_compiled_with_cuda():

@@ -134,6 +134,14 @@ def _platform(kind: str) -> str:
         return "tpu"
     if kind in ("gpu", "cuda"):
         return "gpu"
+    if kind not in ("cpu", ""):
+        try:  # registered custom device types resolve to their platform
+            from ..device.custom import resolve_type
+            r = resolve_type(kind)
+            if r is not None:
+                return r
+        except ImportError:
+            pass
     return "cpu"
 
 

@@ -56,23 +56,26 @@ def register_custom_device(device_type: str, *,
         _registry[device_type.lower()] = alias_of
         return
     # PJRT plugin load path. jax's loader registers the plugin under the
-    # name we give it. jax caches its backend set on first use, so a
-    # plugin registered after device queries needs the cache dropped; if
-    # the platform still does not surface, fail loudly rather than let
-    # is_compiled_with_custom_device claim a chip that can never appear.
+    # name we give it. jax caches its backend set on first use; when the
+    # plugin does not surface, the ONLY recovery is dropping that cache —
+    # which invalidates the device buffers of every already-created
+    # array. Rather than silently breaking live tensors, refuse in that
+    # case unless the caller opts in: register plugins BEFORE first
+    # device/tensor use (import time) and none of this applies.
     from jax._src import xla_bridge as xb
     t = device_type.lower()
     xb.register_plugin(t, library_path=library_path, options=options)
-    try:
-        jax.clear_backends()
-    except Exception:
-        pass
     if not any(d.platform == t for d in jax.devices()):
-        raise RuntimeError(
-            f"register_custom_device: PJRT plugin {library_path!r} was "
-            f"registered but platform {t!r} did not initialize — "
-            f"register before first device use, or check the plugin's "
-            f"announced platform name")
+        if (options or {}).get("reinitialize_backends"):
+            jax.clear_backends()
+        if not any(d.platform == t for d in jax.devices()):
+            raise RuntimeError(
+                f"register_custom_device: PJRT plugin {library_path!r} "
+                f"was registered but platform {t!r} did not initialize. "
+                f"Backends were already cached: register custom devices "
+                f"BEFORE first device/tensor use, or pass "
+                f"options={{'reinitialize_backends': True}} to force a "
+                f"backend reset (this INVALIDATES every live tensor)")
     _registry[t] = t
 
 

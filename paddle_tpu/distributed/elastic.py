@@ -11,14 +11,15 @@ aborts comms after ``pg_timeout``) — TPU-shaped:
   the master agent derives the alive set and publishes a new
   ``generation`` (member list + rank re-map) whenever it changes;
 
-  KNOWN LIMITATION (single point of failure): the store and the
-  membership scan live in the master agent (node rank 0) — if that node
-  dies the job cannot re-rendezvous, unlike the reference whose etcd
-  store survives its clients (``manager.py:126``). Mitigation path:
-  point every agent at an externally hosted TCPStore endpoint
-  (``--master`` on a machine outside the job) so agent death never takes
-  the store down, and elect a new scanning master from the surviving
-  agents (smallest alive node rank) on master-heartbeat loss;
+  KNOWN LIMITATION (partially mitigated): when node-rank-0's launcher
+  HOSTS the store, losing that node still ends rendezvous (the
+  reference's external etcd survives its clients, ``manager.py:126``) —
+  host the store externally (``--master`` on a machine outside the job)
+  to remove that leg. The SCAN is no longer a SPOF either way: the
+  scanning master heartbeats ``elastic/master_hb``; on loss, standby
+  agents elect the alive node first in registration order, which takes
+  over scanning and generation publishing (see ``_standby_loop``;
+  usurper demotion handles partition-healed double masters);
 * on a generation change every agent stops its workers and respawns them
   with the re-mapped ``PADDLE_TRAINER_ID`` / ``PADDLE_TRAINERS_NUM`` env
   (the launcher is the supervisor — on TPU the collectives live inside
@@ -45,6 +46,7 @@ _REG_KEY = "elastic/reg/{}"
 _HB_KEY = "elastic/hb/{}"
 _GEN_LATEST = "elastic/gen_latest"
 _MEMBERS_KEY = "elastic/members/{}"
+_MASTER_HB = "elastic/master_hb"
 
 
 def report_progress(step=None):
@@ -96,8 +98,14 @@ class ElasticManager:
         self.store.set(_REG_KEY.format(idx), self.node_id.encode())
         self._beat()
         threading.Thread(target=self._hb_loop, daemon=True).start()
-        if self.is_master:
-            threading.Thread(target=self._scan_loop, daemon=True).start()
+        # every agent runs the role loop: the designated master scans
+        # first, and on demotion (usurped by an earlier-registered
+        # scanner) falls back to STANDBY — watching the scanner's
+        # heartbeat and taking over (alive node first in registration
+        # order wins) when it goes silent. No agent ever stops
+        # monitoring, so the scan survives any single death as long as
+        # the store does (host it externally to cover that leg).
+        threading.Thread(target=self._role_loop, daemon=True).start()
         while True:
             gen, members = self.wait_generation(self._gen, timeout=None)
             if self.node_id in members:
@@ -105,6 +113,12 @@ class ElasticManager:
 
     def stop(self):
         self._stop.set()
+
+    def _role_loop(self):
+        if self.is_master:
+            self._scan_loop()
+            self.is_master = False
+        self._standby_loop()
 
     # ---------------------------------------------------------- heartbeat --
     def _beat(self):
@@ -139,26 +153,55 @@ class ElasticManager:
                 out.append(nid)
         return out
 
-    def _alive(self):
+    def _fresh_value(self, key, val):
+        """True while ``val`` is new or changed within ``hb_timeout`` on
+        OUR clock (remote clocks never enter the liveness decision);
+        observations are recorded under ``key`` in ``_hb_seen``."""
         now = time.time()
+        prev = self._hb_seen.get(key)
+        if prev is None or prev[0] != val:
+            self._hb_seen[key] = (val, now)
+            return True
+        return now - prev[1] <= self.hb_timeout
+
+    def _alive(self):
         alive = []
         for nid in self._registered():
             try:
                 val = self.store.get(_HB_KEY.format(nid), timeout=1.0)
             except Exception:
                 continue
-            prev = self._hb_seen.get(nid)
-            if prev is None or prev[0] != val:
-                self._hb_seen[nid] = (val, now)
-                alive.append(nid)
-            elif now - prev[1] <= self.hb_timeout:
+            if self._fresh_value(("hb", nid), val):
                 alive.append(nid)
         return alive
 
     def _scan_loop(self):
+        # a PROMOTED scanner inherits a world where the first rendezvous
+        # already happened: min_nodes applies only before any generation
+        # exists (else a failover below min_nodes waits forever), and
+        # ``current`` seeds from the latest published members so an
+        # unchanged membership does not trigger a gratuitous respawn
         current: list[str] = []
         published = False
+        try:
+            g = int(self.store.get(_GEN_LATEST, timeout=1.0).decode())
+            if g > 0:
+                published = True
+                current = pickle.loads(
+                    self.store.get(_MEMBERS_KEY.format(g), timeout=1.0))
+        except Exception:
+            pass
+        mseq = 0
         while not self._stop.is_set():
+            if self._usurped():
+                self.is_master = False  # a lower-index master is alive
+                return
+            mseq += 1
+            try:
+                self.store.set(_MASTER_HB,
+                               f"{self.node_id}:{mseq}".encode())
+            except OSError:
+                return  # store gone: the job is over
             try:
                 alive = self._alive()
             except ConnectionError:
@@ -175,6 +218,73 @@ class ElasticManager:
                                pickle.dumps(current))
                 self.store.set(_GEN_LATEST, str(gen).encode())
                 published = True
+            self._stop.wait(self.hb_interval)
+
+    # --------------------------------------------------- standby master --
+    def _master_hb_node(self):
+        """(node_id, raw_value) of the current master heartbeat, or
+        (None, None) when absent."""
+        try:
+            val = self.store.get(_MASTER_HB, timeout=1.0)
+        except Exception:
+            return None, None
+        try:
+            return val.decode().rsplit(":", 1)[0], val
+        except Exception:
+            return None, val
+
+    def _usurped(self):
+        """True when ANOTHER scanner earlier in registration order is
+        heartbeating — this master stands down (recovery from a network
+        partition that elected a second master)."""
+        nid, val = self._master_hb_node()
+        if nid is None or nid == self.node_id:
+            return False
+        if not self._fresh_value(("mhb", nid), val):
+            return False
+        reg = self._registered()
+        try:
+            return reg.index(nid) < reg.index(self.node_id)
+        except ValueError:
+            return False
+
+    def _standby_loop(self):
+        seen, seen_t = None, time.time()
+        while not self._stop.is_set():
+            _, val = self._master_hb_node()
+            now = time.time()
+            if val is not None and val != seen:
+                seen, seen_t = val, now
+            elif now - seen_t > 2 * self.hb_timeout:
+                # scanner is silent on OUR clock. The alive node first in
+                # registration order is the rightful successor.
+                try:
+                    alive = self._alive()
+                except Exception:
+                    return  # store gone with the master: unrecoverable
+                succ = next((n for n in self._registered() if n in alive),
+                            None)
+                if succ == self.node_id:
+                    # seed the usurper-check history with the DEAD
+                    # master's last heartbeat at its stale timestamp —
+                    # otherwise the new scanner's first _usurped() sees
+                    # that value as a fresh first observation and
+                    # immediately demotes itself
+                    if seen is not None:
+                        try:
+                            old = seen.decode().rsplit(":", 1)[0]
+                            self._hb_seen[("mhb", old)] = (seen, seen_t)
+                        except Exception:
+                            pass
+                    self.is_master = True
+                    self._scan_loop()        # runs until demoted/stopped
+                    self.is_master = False
+                    seen, seen_t = None, time.time()  # re-arm post-term
+                # on a FAILED promotion bid keep the staleness clock
+                # running: the first _alive() observation of the dead
+                # master counts it alive until hb_timeout passes on our
+                # clock — re-arming here would double the takeover
+                # latency by re-latching the same stale heartbeat
             self._stop.wait(self.hb_interval)
 
     # ------------------------------------------------------------- watch --

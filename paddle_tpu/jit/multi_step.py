@@ -114,7 +114,7 @@ def _run_window(exe, runner, stacks, per_step_idx=(), per_step_vals=()):
     # leave the promoted tensors holding their LAST per-step value, as
     # if the host had fed each step individually
     for i, v in zip(ps_idx, per_step_vals):
-        capt[i]._data = jnp.asarray(v)[-1]
+        capt[i]._data = v[-1]
         capt[i]._node = None
     return rets
 
@@ -206,14 +206,15 @@ class WindowRunner:
             raise ValueError(
                 f"expected {len(self._ps_idx)} per_step_vals arrays, "
                 f"got {len(per_step_vals or ())}")
-        for v in per_step_vals or ():
-            n = jnp.asarray(v).shape[0] if jnp.asarray(v).ndim else -1
+        ps_vals = tuple(jnp.asarray(v) for v in per_step_vals or ())
+        for v in ps_vals:
+            n = v.shape[0] if v.ndim else -1
             if n != self.length:
                 raise ValueError(
                     f"per_step_vals arrays need leading dim "
                     f"{self.length}, got {n}")
         rets = _run_window(exe, self._runner, stacks, self._ps_idx,
-                           tuple(per_step_vals or ()))
+                           ps_vals)
         if outputs == "stacked":
             return rets
         if outputs == "last":

@@ -163,3 +163,53 @@ def test_jit_step_reports_progress(tmp_path, monkeypatch):
     time.sleep(0.05)
     f(x)
     assert os.path.getmtime(path) >= t1
+
+
+def test_standby_master_takes_over_scan(tmp_path):
+    """With the store hosted OUTSIDE the agents (external-etcd analog),
+    killing the scanning master promotes the next registered alive agent,
+    which publishes the post-failure generation (reference elastic
+    re-rendezvous without a fixed master)."""
+    import threading
+
+    from paddle_tpu.distributed.elastic import ElasticManager
+    from paddle_tpu.distributed.store import TCPStore
+
+    port = _free_port()
+    host_store = TCPStore("127.0.0.1", port, is_master=True, world_size=1)
+    try:
+        def mk(nid, is_master):
+            st = TCPStore("127.0.0.1", port, is_master=False)
+            return ElasticManager(st, nid, is_master,
+                                  heartbeat_interval=0.2,
+                                  heartbeat_timeout=0.6, min_nodes=2)
+
+        a = mk("nodeA", True)
+        b = mk("nodeB", False)
+        ra = rb = None
+        ta = threading.Thread(target=lambda: a.start(), daemon=True)
+        results = {}
+
+        def run_b():
+            results["gen1"] = b.start()
+        tb = threading.Thread(target=run_b, daemon=True)
+        ta.start(); tb.start()
+        ta.join(30); tb.join(30)
+        assert not tb.is_alive(), "initial rendezvous never formed"
+        gen1, members1 = results["gen1"]
+        assert set(members1) == {"nodeA", "nodeB"}
+
+        a.stop()  # master dies: node heartbeat AND master_hb go silent
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            gen, members = b.wait_generation(gen1, timeout=1.0)
+            if gen > gen1 and members == ["nodeB"]:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("standby never published a new generation")
+        assert b.is_master, "standby should have promoted itself"
+        b.stop()
+    finally:
+        host_store.close()
