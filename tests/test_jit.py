@@ -355,3 +355,44 @@ def test_window_runner_per_step_lr_matches_sequential():
     for k, v in net.state_dict().items():
         np.testing.assert_allclose(np.asarray(v._read()), ref[k],
                                    atol=1e-6, err_msg=k)
+
+
+def test_window_runner_donate_false_reuses_carry():
+    """donate=False keeps the pre-window state buffers valid — the same
+    staged window can be re-run from a manually restored state."""
+    pt.seed(1)
+    net = nn.Linear(4, 2)
+    optim = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+
+    @pt.jit.to_static
+    def step(x, y):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+        return loss
+
+    rng = np.random.default_rng(1)
+    batches = [(pt.to_tensor(rng.normal(size=(4, 4)).astype("float32")),
+                pt.to_tensor(rng.normal(size=(4, 2)).astype("float32")))
+               for _ in range(4)]
+    step(*batches[0])  # compile/warm
+    # retain the PRE-WINDOW device arrays themselves (no host copy):
+    # with donation the window launch consumes these exact buffers and
+    # reusing them afterwards raises a deleted-buffer error; donate=False
+    # must keep them valid for restore-and-replay
+    snap = {k: v._read() for k, v in net.state_dict().items()}
+
+    w = pt.jit.WindowRunner(step, batches[0], length=4, donate=False)
+    stacks = w.stage(batches)
+    l1 = float(w.run(*stacks, outputs="last"))
+    after1 = {k: np.asarray(v._read()).copy()
+              for k, v in net.state_dict().items()}
+    for k, v in net.state_dict().items():
+        v._data = snap[k]
+        v._node = None
+    l2 = float(w.run(*stacks, outputs="last"))
+    np.testing.assert_allclose(l1, l2, rtol=1e-6)
+    for k, v in net.state_dict().items():
+        np.testing.assert_allclose(np.asarray(v._read()), after1[k],
+                                   rtol=1e-6)
