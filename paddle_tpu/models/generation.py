@@ -12,6 +12,7 @@ retrace after the first token).
 """
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
@@ -132,17 +133,20 @@ def rope_at(x, pos, theta=10000.0):
     return _apply_rope(x, cos, sin)
 
 
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _zero_pool(shape, count):
+    """``count`` zeroed arrays of ``shape`` in ONE device launch (jit's
+    static-arg cache keeps one compiled program per geometry): a
+    12-layer KV pool as 24 separate ``jnp.zeros`` dispatches pays 24
+    launches of per-request latency over a network-attached chip."""
+    return tuple(jnp.zeros(shape, jnp.float32) for _ in range(count))
+
+
 def _empty_caches(model, batch, max_len):
     cfg = model.cfg
     n_kv = getattr(cfg, "num_kv_heads", cfg.num_heads)
-    caches = []
-    for _ in range(cfg.num_layers):
-        kc = Tensor(jnp.zeros((batch, max_len, n_kv, cfg.head_dim),
-                              jnp.float32))
-        vc = Tensor(jnp.zeros((batch, max_len, n_kv, cfg.head_dim),
-                              jnp.float32))
-        caches.extend([kc, vc])
-    return caches
+    shape = (batch, max_len, n_kv, cfg.head_dim)
+    return [Tensor(a) for a in _zero_pool(shape, 2 * cfg.num_layers)]
 
 
 def _gpt_decode(model, ids_t, pos, caches, attend=cache_attention):
@@ -302,11 +306,8 @@ def _empty_paged_caches(model, batch, max_len, page_size):
     np_per_seq = -(-max_len // page_size)
     bt = np.arange(batch * np_per_seq, dtype=np.int32).reshape(
         batch, np_per_seq)
-    caches = []
-    for _ in range(cfg.num_layers):
-        shape = (n_kv, batch * np_per_seq, page_size, cfg.head_dim)
-        caches.extend([Tensor(jnp.zeros(shape, jnp.float32)),
-                       Tensor(jnp.zeros(shape, jnp.float32))])
+    shape = (n_kv, batch * np_per_seq, page_size, cfg.head_dim)
+    caches = [Tensor(a) for a in _zero_pool(shape, 2 * cfg.num_layers)]
     return caches, bt
 
 
