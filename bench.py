@@ -61,11 +61,22 @@ def _peak_flops(dev) -> float:
     return 197e12  # assume v5e-class when unknown
 
 
+# every _cached entry is timed through this shared harness — a change
+# here must invalidate all cached rows, or a regression in the timing
+# path would re-report stale numbers as current measured evidence
+_HARNESS_FILES = [
+    "bench.py",
+    "paddle_tpu/jit/multi_step.py",
+    "paddle_tpu/optimizer/optimizer.py",
+    "paddle_tpu/amp/__init__.py",
+]
+
+
 def _cached(dev, name, files, fn):
     """Measured-evidence gate: load from benchmarks/measured/ when the
     producing code is unchanged, else measure now and persist."""
     kind = str(getattr(dev, "device_kind", dev.platform))
-    ver = mc.code_version(*files)
+    ver = mc.code_version(*_HARNESS_FILES, *files)
     val = mc.load(kind, name, ver)
     if val is not None:
         return dict(val, cached=True)
@@ -374,22 +385,51 @@ def main():
                          "(true-work MFU)"),
     }
 
-    def emit():
-        print(json.dumps({
-            "metric": "gpt124m_train_tokens_per_sec_per_chip",
-            "value": round(tokens_per_sec, 1),
-            "unit": "tokens/sec",
-            "vs_baseline": round(mfu / 0.40, 4),
-            "extra": extra,
-        }), flush=True)
+    headline = {
+        "metric": "gpt124m_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }
 
-    # kill-safety: the headline is measured — emit it NOW. The enriched
-    # re-emit below attaches calibration + north-star secondaries (cache
-    # hits in benchmarks/measured/ unless their producing code changed);
-    # line-scanning parsers get a valid record whether they take the
-    # first or the last line, even if the process dies mid-extras.
+    def emit_enriched():
+        print(json.dumps(dict(headline, extra=extra)), flush=True)
+
+    def emit_compact():
+        """The LAST stdout line, kept well under 500 bytes: the driver
+        stores only the final 2000 BYTES of stdout and parses the last
+        line, so the ~2.4KB enriched record must never be last (round-4
+        postmortem: rc:0 but parsed:null — the line arrived beheaded).
+        The enriched evidence is printed above AND persisted to
+        benchmarks/measured/headline.json."""
+        brief = {"device": extra["device"],
+                 "step_time_ms": extra["step_time_ms"],
+                 "mfu": extra["mfu"]}
+        for key, short in (("resnet50_train_images_per_sec_per_chip",
+                            "resnet50"),
+                           ("bert_base_pretrain_tokens_per_sec_per_chip",
+                            "bert")):
+            row = extra.get("secondary", {}).get(key)
+            if row:
+                brief[short] = {"value": row["value"], "unit": row["unit"],
+                                "mfu": row["mfu"]}
+        line = json.dumps(dict(headline, extra=brief))
+        # never let the guard recreate the failure it prevents: drop
+        # optional entries (newest first) until the line fits
+        while len(line) > 500 and brief:
+            brief.pop(next(reversed(brief)))
+            line = json.dumps(dict(headline, extra=brief))
+        if len(line) > 500:
+            line = json.dumps(headline)
+        print(line, flush=True)
+
+    # kill-safety: the headline is measured — emit it NOW (compact, so
+    # it parses even if the process dies mid-extras). The enriched
+    # record below attaches calibration + north-star secondaries (cache
+    # hits in benchmarks/measured/ unless their producing code changed),
+    # then a compact line is re-emitted LAST.
     if on_tpu:
-        emit()
+        emit_compact()
         import gc
         try:
             extra["calibration"] = _cached(
@@ -425,7 +465,16 @@ def main():
                       file=sys.stderr)
             gc.collect()
 
-    emit()
+    # full evidence: to stdout (NOT last) and to a persisted file that
+    # survives regardless of how the driver captures stdout
+    emit_enriched()
+    try:
+        with open(os.path.join(_REPO, "benchmarks", "measured",
+                               "headline.json"), "w") as f:
+            json.dump(dict(headline, extra=extra), f, indent=1)
+    except OSError as e:
+        print(f"headline persist failed: {e}", file=sys.stderr)
+    emit_compact()
 
 
 if __name__ == "__main__":

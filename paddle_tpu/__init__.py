@@ -91,9 +91,16 @@ def get_device():
 def set_device(device):
     # route through device.set_device: it resolves registered custom
     # device types and raises on unknown ones (a bare Place(str) would
-    # silently map them to cpu); reference returns the Place
+    # silently map them to cpu); reference returns the Place — a
+    # CustomPlace (keeping the registered type name) for custom types
     from .device import set_device as _sd
-    return Place(_sd(device))
+    from .device.custom import CustomPlace, registered_types
+    resolved = _sd(device)
+    dtype_name = str(device).split(":", 1)[0].lower()
+    if dtype_name in registered_types():
+        idx = int(str(device).split(":", 1)[1]) if ":" in str(device) else 0
+        return CustomPlace(dtype_name, idx)
+    return Place(resolved)
 
 
 def is_compiled_with_cuda():

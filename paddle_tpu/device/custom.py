@@ -64,7 +64,11 @@ def register_custom_device(device_type: str, *,
     # device/tensor use (import time) and none of this applies.
     from jax._src import xla_bridge as xb
     t = device_type.lower()
-    xb.register_plugin(t, library_path=library_path, options=options)
+    # reinitialize_backends is OUR control flag, not a plugin create-
+    # option: strip it before the options dict reaches the PJRT plugin
+    plugin_options = {k: v for k, v in (options or {}).items()
+                      if k != "reinitialize_backends"} or None
+    xb.register_plugin(t, library_path=library_path, options=plugin_options)
     if not any(d.platform == t for d in jax.devices()):
         if (options or {}).get("reinitialize_backends"):
             jax.clear_backends()

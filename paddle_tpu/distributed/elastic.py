@@ -115,10 +115,19 @@ class ElasticManager:
         self._stop.set()
 
     def _role_loop(self):
-        if self.is_master:
-            self._scan_loop()
-            self.is_master = False
-        self._standby_loop()
+        # an uncaught error in either role must demote to standby, not
+        # kill the thread: a dead role thread with a live _hb_loop makes
+        # every standby defer to this node forever (advisor r4, medium)
+        while not self._stop.is_set():
+            try:
+                if self.is_master:
+                    self._scan_loop()
+                    self.is_master = False
+                self._standby_loop()
+                return  # clean exit: store gone or stopped
+            except Exception:
+                self.is_master = False
+                self._stop.wait(self.hb_interval)
 
     # ---------------------------------------------------------- heartbeat --
     def _beat(self):
@@ -212,12 +221,23 @@ class ElasticManager:
                 self._stop.wait(self.hb_interval)
                 continue
             if alive and alive != current:
-                current = alive
-                gen = self.store.add("elastic/gen", 1)
-                self.store.set(_MEMBERS_KEY.format(gen),
-                               pickle.dumps(current))
-                self.store.set(_GEN_LATEST, str(gen).encode())
-                published = True
+                # the publish is guarded like the _MASTER_HB set above: a
+                # transient store timeout must NOT kill the scanner (the
+                # node's _hb_loop keeps beating, so standbys would defer
+                # to a wedged master forever). ``current`` is only
+                # advanced on success so a failed publish retries.
+                try:
+                    gen = self.store.add("elastic/gen", 1)
+                    self.store.set(_MEMBERS_KEY.format(gen),
+                                   pickle.dumps(alive))
+                    self.store.set(_GEN_LATEST, str(gen).encode())
+                except ConnectionError:
+                    return  # store gone: the job is over
+                except OSError:
+                    pass    # transient (incl. TimeoutError): retry
+                else:
+                    current = alive
+                    published = True
             self._stop.wait(self.hb_interval)
 
     # --------------------------------------------------- standby master --
