@@ -86,6 +86,43 @@ def _truthy(v):
     return bool(v)
 
 
+def ret_value(v):
+    """Final-return helper for eliminated early returns when every path
+    provably returns: yields the flagged value (UNDEF can only mean the
+    value genuinely was ``return None``-less fall-through dead code)."""
+    return None if v is UNDEF else v
+
+
+def ret_final(flag, v):
+    """Final-return helper when fall-through is possible: the flag
+    decides between the flagged value and ``None``. A TRACED flag makes
+    the choice unrepresentable in one compiled program (tensor-vs-None);
+    ``bool(flag)`` then raises, which to_static's retry machinery turns
+    into an eager fallback — correct, per-call semantics (the reference
+    declines these with RETURN_NO_VALUE sentinel checks)."""
+    if flag is UNDEF or not flag:
+        return None
+    return None if v is UNDEF else v
+
+
+def is_tensor_seq(v):
+    """True when ``for x in v`` should iterate rows of a tensor (the
+    reference's ``loop_transformer`` tensor-iteration contract)."""
+    return _is_tensor(v) and len(getattr(v, "shape", ())) >= 1
+
+
+def loop_index():
+    """Row index for desugared tensor iteration: a traced int32 scalar
+    under capture (so the loop lowers to lax control flow with dynamic
+    row gathers), a plain int eagerly."""
+    if _under_capture():
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        return Tensor(jnp.asarray(0, jnp.int32))
+    return 0
+
+
 def run_if(pred, true_fn, false_fn, get, set_):
     """Runtime dispatch for a rewritten ``if`` statement."""
     if _is_tensor(pred) and _under_capture():
@@ -114,7 +151,7 @@ def run_if(pred, true_fn, false_fn, get, set_):
             finally:
                 set_(cur)
 
-        out = static_cond(pred, t, f)
+        out = static_cond(pred, t, f, _undef_fill=UNDEF)
         set_(tuple(out))
         return
     if _truthy(pred):
@@ -124,38 +161,44 @@ def run_if(pred, true_fn, false_fn, get, set_):
 
 
 def run_while(cond_fn, body_fn, get, set_, max_trip_count=None):
-    """Runtime dispatch for a rewritten ``while`` (or ``for range``)."""
-    first = cond_fn()
-    if _is_tensor(first) and _under_capture():
-        from ..static.control_flow import while_loop as static_while
-        init = get()
+    """Runtime dispatch for a rewritten ``while`` (or ``for range``).
 
-        def c(*vs):
-            cur = get()
-            try:
-                set_(tuple(vs))
-                return cond_fn()
-            finally:
-                set_(cur)
-
-        def b(*vs):
-            cur = get()
-            try:
-                set_(tuple(vs))
-                body_fn()
-                return get()
-            finally:
-                set_(cur)
-
-        out = static_while(c, b, list(init),
-                           max_trip_count=max_trip_count)
-        set_(tuple(out))
-        return
-    if not _truthy(first):
-        return
-    body_fn()
-    while _truthy(cond_fn()):
+    The predicate can TURN INTO a tensor mid-loop (a python-bound
+    ``for range`` whose break flag becomes traced on the first
+    iteration): iterations run eagerly (prefix-unrolled under capture)
+    until the predicate is a tensor, then the REST of the loop lowers
+    onto lax control flow with the current state as init."""
+    while True:
+        first = cond_fn()
+        if _is_tensor(first) and _under_capture():
+            break
+        if not _truthy(first):
+            return
         body_fn()
+    from ..static.control_flow import while_loop as static_while
+    init = get()
+
+    def c(*vs):
+        cur = get()
+        try:
+            set_(tuple(vs))
+            return cond_fn()
+        finally:
+            set_(cur)
+
+    def b(*vs):
+        cur = get()
+        try:
+            set_(tuple(vs))
+            body_fn()
+            return get()
+        finally:
+            set_(cur)
+
+    out = static_while(c, b, list(init),
+                       max_trip_count=max_trip_count,
+                       _undef_fill=UNDEF)
+    set_(tuple(out))
 
 
 def not_(v):
@@ -352,6 +395,26 @@ def _assigned_names(stmts):
     return sorted(names)
 
 
+def _child_blocks(s, depth):
+    """Child statement blocks of ``s`` with the loop depth they sit at
+    (+1 inside a loop body — break/continue there bind to that loop).
+    Nested defs are new scopes and are not yielded."""
+    if isinstance(s, (ast.For, ast.While, ast.AsyncFor)):
+        yield s.body, depth + 1
+        yield s.orelse, depth
+    elif isinstance(s, ast.If):
+        yield s.body, depth
+        yield s.orelse, depth
+    elif isinstance(s, (ast.With, ast.AsyncWith)):
+        yield s.body, depth
+    elif isinstance(s, ast.Try):
+        yield s.body, depth
+        yield s.orelse, depth
+        yield s.finalbody, depth
+        for h in s.handlers:
+            yield h.body, depth
+
+
 def _has_escape(stmts, *, loop_ctx=False):
     """True if converting these statements into a nested function would
     change semantics: return/yield anywhere in this scope, or
@@ -372,23 +435,6 @@ def _has_escape(stmts, *, loop_ctx=False):
                 if isinstance(n, (ast.Yield, ast.YieldFrom, ast.Await)):
                     return True
         return False
-
-    def _child_blocks(s, depth):
-        if isinstance(s, (ast.For, ast.While, ast.AsyncFor)):
-            yield s.body, depth + 1
-            yield s.orelse, depth
-        elif isinstance(s, ast.If):
-            yield s.body, depth
-            yield s.orelse, depth
-        elif isinstance(s, (ast.With, ast.AsyncWith)):
-            yield s.body, depth
-        elif isinstance(s, ast.Try):
-            yield s.body, depth
-            yield s.orelse, depth
-            yield s.finalbody, depth
-            for h in s.handlers:
-                yield h.body, depth
-        # nested defs: new scope, their returns/breaks are fine
 
     return walk(stmts, 0)
 
@@ -459,6 +505,428 @@ def _thunk(expr):
 
 def _parse_stmts(src):
     return ast.parse(textwrap.dedent(src)).body
+
+
+def _visit_body(transformer, fndef):
+    """Apply a scope-barriered NodeTransformer to ``fndef``'s body
+    statements (visiting the FunctionDef itself would hit the barrier)."""
+    new = []
+    for s in fndef.body:
+        r = transformer.visit(s)
+        new.extend(r if isinstance(r, list) else [r])
+    fndef.body = new
+
+
+def _is_range_for(node):
+    """A ``for NAME in range(...)`` loop the desugar pass can handle."""
+    return (not node.orelse
+            and isinstance(node.target, ast.Name)
+            and isinstance(node.iter, ast.Call)
+            and isinstance(node.iter.func, ast.Name)
+            and node.iter.func.id == "range"
+            and not node.iter.keywords
+            and not any(isinstance(a, ast.Starred)
+                        for a in node.iter.args))
+
+
+def _for_range_desugar(node, prefix):
+    """``for t in range(...)`` -> (setup stmts, equivalent While node).
+    The loop target is pre-bound to start so it is never UNDEF in the
+    carry (documented divergence from CPython: an empty range leaves the
+    target bound to start instead of unbound)."""
+    r, i = f"{prefix}_range", f"{prefix}_i"
+    setup = _parse_stmts(
+        f"{r} = {_HELPER}.range_args({{args}})\n{i} = {r}[0]\n"
+        f"{node.target.id} = {r}[0]")
+    # splice real arg expressions into the range_args call
+    setup[0].value.args = list(node.iter.args)
+    incr = _parse_stmts(f"{i} = {i} + {r}[2]")
+    # the increment must run even on `continue` (for-loop semantics):
+    # the tag keeps it out of _BreakContinueEliminator's guards
+    incr[0]._pdtpu_loop_incr = True
+    while_node = ast.While(
+        test=_call(_HELPER + ".range_cond", [
+            ast.Name(id=i, ctx=ast.Load()),
+            _sub(r, 1), _sub(r, 2)]),
+        body=([ast.Assign(targets=[node.target],
+                          value=ast.Name(id=i, ctx=ast.Load()))]
+              + node.body
+              + incr),
+        orelse=[])
+    for s in setup + [while_node]:
+        ast.copy_location(s, node)
+        ast.fix_missing_locations(s)
+    return setup, while_node
+
+
+# ==========================================================================
+# escape elimination: return/break/continue -> flag form, tensor for-each
+#
+# Capability analog of the reference's
+# ``jit/dy2static/transformers/return_transformer.py`` (early return ->
+# return-value/flag pair), ``break_continue_transformer.py`` (break ->
+# loop-condition flag + guards) and ``loop_transformer.py`` (iteration
+# over a tensor's rows). Runs BEFORE the main rewriter so the resulting
+# if/while sites are escape-free and convert normally; statements the
+# passes cannot handle (escapes inside try/with, returns nested in
+# python-iterable loops) are simply left as real escapes — the rewriter
+# then declines just those sites (mixed flag/real form is safe: a real
+# ``return`` still returns directly, flagged paths flow to the appended
+# final return).
+# ==========================================================================
+
+_RETF, _RETV = "__pt_retf", "__pt_retv"
+
+
+def _not_flags(names):
+    expr = ast.Name(id=names[0], ctx=ast.Load())
+    if len(names) > 1:
+        expr = ast.BoolOp(op=ast.Or(), values=[
+            ast.Name(id=n, ctx=ast.Load()) for n in names])
+    return ast.UnaryOp(op=ast.Not(), operand=expr)
+
+
+def _guard_if(flags, body):
+    return ast.If(test=_not_flags(flags), body=body or [ast.Pass()],
+                  orelse=[])
+
+
+def _scope_has_return(stmts):
+    def walk(ss, depth):
+        for s in ss:
+            if isinstance(s, ast.Return):
+                return True
+            for blk, d in _child_blocks(s, depth):
+                if walk(blk, d):
+                    return True
+        return False
+    return walk(stmts, 0)
+
+
+class _ForEachDesugar(ast.NodeTransformer):
+    """``for x in EXPR`` (non-range): runtime-dispatch between row
+    iteration over a tensor's leading axis (convertible; lowers with a
+    dynamic row gather under capture) and the original Python loop."""
+
+    def __init__(self):
+        self.n = 0
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse or not isinstance(node.target, ast.Name) \
+                or _is_range_for(node):
+            return node
+        # break/continue inside try/with: _BreakContinueEliminator will
+        # decline them as fragile, and a REAL continue in the generated
+        # while would skip the index increment (infinite loop) — keep
+        # the original for (Tensor.__iter__ handles tensors eagerly)
+        if _loop_escape_kinds(node.body)[2]:
+            return node
+        import copy
+        k = self.n
+        self.n += 1
+        seq, n_, i = (f"__ptfe{k}_seq", f"__ptfe{k}_n", f"__ptfe{k}_i")
+        stmts = _parse_stmts(
+            f"{seq} = None\n"
+            f"if {_HELPER}.is_tensor_seq({seq}):\n"
+            f"    {n_} = {seq}.shape[0]\n"
+            f"    {i} = {_HELPER}.loop_index()\n"
+            f"    while {i} < {n_}:\n"
+            f"        {node.target.id} = {seq}[{i}]\n"
+            f"        pass\n"
+            f"        {i} = {i} + 1\n"
+            f"else:\n"
+            f"    pass\n")
+        stmts[0].value = node.iter
+        ifn = stmts[1]
+        wl = ifn.body[2]
+        wl.body[-1]._pdtpu_loop_incr = True  # runs even on `continue`
+        wl.body[1:2] = node.body
+        ifn.orelse = [ast.For(target=node.target,
+                              iter=ast.Name(id=seq, ctx=ast.Load()),
+                              body=copy.deepcopy(node.body), orelse=[])]
+        for s in stmts:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return stmts
+
+
+def _eliminate_returns(fndef):
+    """Early returns -> ``__pt_retf``/``__pt_retv`` flag form. Only
+    returns reachable through convertible structure are transformed;
+    anything else stays a real return (safe in mixed form)."""
+    if not _scope_has_return(fndef.body):
+        return False
+    # nothing to do when every return already sits at function top level
+    if not any(_scope_has_return([s]) for s in fndef.body
+               if not isinstance(s, ast.Return)):
+        return False
+    changed = [0]
+    counter = [0]
+
+    def setret(s):
+        val = s.value if s.value is not None else ast.Constant(value=None)
+        a1 = ast.Assign(targets=[ast.Name(id=_RETV, ctx=ast.Store())],
+                        value=val)
+        out = [a1] + _parse_stmts(f"{_RETF} = True")
+        for n in out:
+            ast.copy_location(n, s)
+            ast.fix_missing_locations(n)
+        changed[0] += 1
+        return out
+
+    def xform(stmts):
+        """-> (new_stmts, may_return, always_returns)."""
+        out = []
+        for idx, s in enumerate(stmts):
+            rest_src = stmts[idx + 1:]
+            if isinstance(s, ast.Return):
+                out.extend(setret(s))
+                return out, True, True          # rest is dead
+            if isinstance(s, ast.If):
+                b, mb, ab = xform(s.body)
+                e, me, ae = xform(s.orelse)
+                if not (mb or me):
+                    out.append(s)
+                    continue
+                s.body, s.orelse = (b or [ast.Pass()]), e
+                rest, _, ar = (xform(rest_src) if rest_src
+                               else ([], False, False))
+                if ab and ae:
+                    out.append(s)               # both branches return
+                    return out, True, True
+                if ab and not s.orelse:
+                    # continuation folding: `if c: return a; REST` ->
+                    # `if c: <flags> else: REST` keeps retv bound on
+                    # both sides (no dummy fill needed)
+                    s.orelse = rest
+                    out.append(s)
+                    return out, True, ar
+                if ae and not ab:
+                    s.body = s.body + (rest if not mb
+                                       else [_guard_if([_RETF], rest)])
+                    out.append(s)
+                    return out, True, ar
+                out.append(s)
+                if rest:
+                    out.append(_guard_if([_RETF], rest))
+                return out, True, False
+            if isinstance(s, (ast.While, ast.For)) \
+                    and _scope_has_return([s]):
+                if isinstance(s, ast.For):
+                    if not _is_range_for(s):
+                        out.append(s)
+                        if not _breakify_for(s, changed):
+                            # only unguardable (deep, real) returns
+                            # inside: nothing flagged, so trailing
+                            # statements need no guard either
+                            continue
+                    else:
+                        k = counter[0]
+                        counter[0] += 1
+                        setup, wl = _for_range_desugar(s, f"__ptr{k}")
+                        nb, mb, _ = xform(wl.body[1:-1])
+                        wl.body[1:-1] = nb
+                        if mb:
+                            wl.test = ast.BoolOp(op=ast.And(), values=[
+                                _not_flags([_RETF]), wl.test])
+                            ast.fix_missing_locations(wl)
+                        out.extend(setup)
+                        out.append(wl)
+                else:
+                    nb, mb, _ = xform(s.body)
+                    if mb:
+                        s.body = nb
+                        s.test = ast.BoolOp(op=ast.And(), values=[
+                            _not_flags([_RETF]), s.test])
+                        ast.fix_missing_locations(s)
+                    out.append(s)
+                rest, _, _ = (xform(rest_src) if rest_src
+                              else ([], False, False))
+                if rest:
+                    out.append(_guard_if([_RETF], rest))
+                return out, True, False
+            # With/Try (and anything else): real returns inside stay real
+            out.append(s)
+        return out, False, False
+
+    body2, _may, always = xform(fndef.body)
+    if not changed[0]:
+        return False
+    prologue = _parse_stmts(
+        f"{_RETF} = False\n{_RETV} = {_HELPER}.UNDEF")
+    # fall-through possible -> the flag must decide value-vs-None (and a
+    # traced flag correctly forces the eager fallback); all paths return
+    # -> plain value extraction, stays compiled
+    epilogue = _parse_stmts(
+        f"return {_HELPER}.ret_value({_RETV})" if always else
+        f"return {_HELPER}.ret_final({_RETF}, {_RETV})")
+    for s in prologue + epilogue:
+        ast.copy_location(s, fndef.body[0] if fndef.body else fndef)
+        ast.fix_missing_locations(s)
+    fndef.body = prologue + body2 + epilogue
+    return True
+
+
+def _breakify_for(node, changed):
+    """Returns inside a python-iterable ``for``: flag + real ``break``
+    (the loop itself stays plain Python). Only depth-0 returns directly
+    in the body or under plain ``if`` are transformed; deeper ones stay
+    real returns. Returns the number of returns transformed (also added
+    to ``changed`` so the flag prologue/epilogue is guaranteed whenever
+    the tree was mutated)."""
+    n_repl = [0]
+
+    def walk(stmts, depth):
+        out = []
+        for s in stmts:
+            if isinstance(s, ast.Return) and depth == 0:
+                val = (s.value if s.value is not None
+                       else ast.Constant(value=None))
+                a1 = ast.Assign(
+                    targets=[ast.Name(id=_RETV, ctx=ast.Store())],
+                    value=val)
+                repl = [a1] + _parse_stmts(f"{_RETF} = True") \
+                    + [ast.Break()]
+                for n in repl:
+                    ast.copy_location(n, s)
+                    ast.fix_missing_locations(n)
+                out.extend(repl)
+                n_repl[0] += 1
+                return out                      # rest of block is dead
+            if isinstance(s, ast.If) and depth == 0:
+                s.body = walk(s.body, depth)
+                s.orelse = walk(s.orelse, depth)
+            out.append(s)
+        return out
+
+    node.body = walk(node.body, 0)
+    changed[0] += n_repl[0]
+    return n_repl[0]
+
+
+def _loop_escape_kinds(stmts):
+    """(has_break, has_continue) binding to the loop whose body is
+    ``stmts``; also True-third when any sits inside try/with (fragile —
+    guard insertion there is out of scope)."""
+    hb = hc = fragile = False
+
+    def walk(ss, depth, frag):
+        nonlocal hb, hc, fragile
+        for s in ss:
+            if isinstance(s, (ast.Break, ast.Continue)) and depth == 0:
+                if isinstance(s, ast.Break):
+                    hb = True
+                else:
+                    hc = True
+                fragile = fragile or frag
+            f2 = frag or isinstance(s, (ast.Try, ast.With, ast.AsyncWith))
+            for blk, d in _child_blocks(s, depth):
+                walk(blk, d, f2)
+
+    walk(stmts, 0, False)
+    return hb, hc, fragile
+
+
+def _guard_break_continue(stmts, brk, cont, flags):
+    """-> (new_stmts, may_escape): replace depth-0 break/continue with
+    flag sets and guard trailing statements."""
+    out = []
+    for idx, s in enumerate(stmts):
+        if isinstance(s, ast.Break):
+            out.extend(_parse_stmts(f"{brk} = True"))
+            return out, True                    # rest of block is dead
+        if isinstance(s, ast.Continue):
+            out.extend(_parse_stmts(f"{cont} = True"))
+            return out, True
+        if isinstance(s, ast.If):
+            b, mb = _guard_break_continue(s.body, brk, cont, flags)
+            e, me = _guard_break_continue(s.orelse, brk, cont, flags)
+            if mb or me:
+                s.body, s.orelse = (b or [ast.Pass()]), e
+                ast.fix_missing_locations(s)
+                out.append(s)
+                rest = stmts[idx + 1:]
+                if rest:
+                    r, _ = _guard_break_continue(rest, brk, cont, flags)
+                    g = _guard_if(flags, r)
+                    ast.copy_location(g, s)
+                    ast.fix_missing_locations(g)
+                    out.append(g)
+                return out, True
+        # nested loops own their break/continue; try/with pre-screened
+        out.append(s)
+    return out, False
+
+
+class _BreakContinueEliminator(ast.NodeTransformer):
+    """break/continue in while / for-range bodies -> loop-condition
+    flags + guards (innermost loops first)."""
+
+    def __init__(self):
+        self.n = 0
+        self.changed = 0
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+    def _loop(self, node):
+        """Transform one While node. Trailing statements tagged
+        ``_pdtpu_loop_incr`` (a desugared for's index increment) must
+        run even on continue, so they stay outside the guards."""
+        if node.orelse:
+            return [node]
+        n_tail = 0
+        while n_tail < len(node.body) and getattr(
+                node.body[-1 - n_tail], "_pdtpu_loop_incr", False):
+            n_tail += 1
+        cut = len(node.body) - n_tail
+        main = node.body[:cut]
+        hb, hc, fragile = _loop_escape_kinds(main)
+        if not (hb or hc) or fragile:
+            return [node]
+        k = self.n
+        self.n += 1
+        brk, cont = f"__ptbc{k}_brk", f"__ptbc{k}_cont"
+        flags = ([brk] if hb else []) + ([cont] if hc else [])
+        new_main, _ = _guard_break_continue(main, brk, cont, flags)
+        reset = _parse_stmts(f"{cont} = False") if hc else []
+        node.body = reset + new_main + node.body[cut:]
+        if hb:
+            node.test = ast.BoolOp(op=ast.And(), values=[
+                _not_flags([brk]), node.test])
+        pre = _parse_stmts(
+            "\n".join(f"{f} = False" for f in flags))
+        for s in pre + [node]:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        self.changed += 1
+        return pre + [node]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        return self._loop(node)
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if not _is_range_for(node):
+            return node                         # python-iterable: real
+        hb, hc, fragile = _loop_escape_kinds(node.body)
+        if not (hb or hc) or fragile:
+            return node
+        setup, wl = _for_range_desugar(node, f"__ptbc{self.n}f")
+        return setup + self._loop(wl)
 
 
 class _Rewriter(ast.NodeTransformer):
@@ -611,39 +1079,11 @@ class _Rewriter(ast.NodeTransformer):
     # ----------------------------------------------------------------- for
     def visit_For(self, node):
         node = self.generic_visit(node)
-        if (node.orelse
-                or not isinstance(node.target, ast.Name)
-                or not isinstance(node.iter, ast.Call)
-                or not isinstance(node.iter.func, ast.Name)
-                or node.iter.func.id != "range"
-                or node.iter.keywords
-                or any(isinstance(a, ast.Starred)
-                       for a in node.iter.args)
-                or _has_escape(node.body, loop_ctx=True)):
+        if not _is_range_for(node) or _has_escape(node.body, loop_ctx=True):
             return node
         idx = self.n
         self.n += 1
-        r, i = f"__pt{idx}_range", f"__pt{idx}_i"
-        # the loop target is pre-bound to start so it is never UNDEF in
-        # the carry (documented divergence from CPython: an empty range
-        # leaves the target bound to start instead of unbound)
-        setup = _parse_stmts(
-            f"{r} = {_HELPER}.range_args({{args}})\n{i} = {r}[0]\n"
-            f"{node.target.id} = {r}[0]")
-        # splice real arg expressions into the range_args call
-        setup[0].value.args = list(node.iter.args)
-        while_node = ast.While(
-            test=_call(_HELPER + ".range_cond", [
-                ast.Name(id=i, ctx=ast.Load()),
-                _sub(r, 1), _sub(r, 2)]),
-            body=([ast.Assign(targets=[node.target],
-                              value=ast.Name(id=i, ctx=ast.Load()))]
-                  + node.body
-                  + _parse_stmts(f"{i} = {i} + {r}[2]")),
-            orelse=[])
-        for s in setup + [while_node]:
-            ast.copy_location(s, node)
-            ast.fix_missing_locations(s)
+        setup, while_node = _for_range_desugar(node, f"__pt{idx}")
         out = self._convert_while(while_node)
         if out is while_node:  # inner conversion declined; keep plain for
             return node
@@ -732,6 +1172,17 @@ def convert_function(fn):
     decls.visit(fndef)
     if decls.nonlocals:
         return None  # re-exec'd nonlocal writes would not share cells
+
+    # escape elimination first (reference transformer ordering:
+    # loop_transformer's tensor iteration, return_transformer,
+    # break_continue_transformer) so the rewriter sees escape-free
+    # sites. The transformers barrier on nested defs, so they are
+    # applied to the target function's body statements, not the
+    # FunctionDef node itself.
+    _visit_body(_ForEachDesugar(), fndef)
+    _eliminate_returns(fndef)
+    _visit_body(_BreakContinueEliminator(), fndef)
+    ast.fix_missing_locations(fndef)
 
     rw = _Rewriter(decls.globals, decls.nonlocals)
     new_body = []

@@ -140,18 +140,114 @@ def _run_branch(fn: Callable, subs, record=False):
 def _hoist(fns):
     """Probe every branch once, collecting the ordered union of
     outer-tensor reads (weights and other closures) to hoist as explicit
-    operands. Returns (trees, reads)."""
+    operands. Returns (trees, reads, leaves-per-fn)."""
     reads: list[Tensor] = []
     read_ids: set[int] = set()
     trees = []
+    leaves_all = []
     for fn in fns:
-        _, tree, tr = _run_branch(fn, {}, record=True)
+        leaves, tree, tr = _run_branch(fn, {}, record=True)
         trees.append(tree)
+        leaves_all.append(leaves)
         for t in tr.reads:
             if id(t) not in read_ids:
                 read_ids.add(id(t))
                 reads.append(t)
-    return trees, reads
+    return trees, reads, leaves_all
+
+
+# --------------------------------------------------------------------------
+# undefined-slot unification (dy2static support)
+#
+# dy2static's escape elimination (early return / break / continue -> flag
+# form) can leave a state slot holding the UNDEF sentinel on one branch
+# while the other branch binds it to a tensor (the reference fills such
+# slots with RETURN_NO_VALUE / UndefinedVar dummies,
+# ``python/paddle/jit/dy2static/return_transformer.py``). When the caller
+# passes ``_undef_fill``, slots that are UNDEF on one side and a tensor on
+# the other are filled with typed zeros — semantically dead values, guarded
+# by the flag that accompanies them.
+# --------------------------------------------------------------------------
+
+def _tree_has(tree, sentinel):
+    kind = tree[0]
+    if kind == "c":
+        return tree[1] is sentinel
+    if kind in ("list", "tuple"):
+        return any(_tree_has(t, sentinel) for t in tree[1])
+    if kind == "dict":
+        return any(_tree_has(t, sentinel) for t in tree[1].values())
+    return False
+
+
+def _needs_unify(a, b, sentinel):
+    """True when the trees disagree at a position the fill can repair:
+    sentinel-vs-anything or plain-scalar-constant-vs-tensor."""
+    ka, kb = a[0], b[0]
+    if ka == "c" and (a[1] is sentinel
+                      or (kb == "T" and isinstance(a[1],
+                                                   (bool, int, float)))):
+        return True
+    if kb == "c" and (b[1] is sentinel
+                      or (ka == "T" and isinstance(b[1],
+                                                   (bool, int, float)))):
+        return True
+    if ka == kb == "c" and isinstance(a[1], (bool, int, float)) \
+            and isinstance(b[1], (bool, int, float)) and a[1] != b[1]:
+        return True
+    if ka == kb and ka in ("list", "tuple") and len(a[1]) == len(b[1]):
+        return any(_needs_unify(x, y, sentinel)
+                   for x, y in zip(a[1], b[1]))
+    if ka == kb == "dict":
+        return any(_needs_unify(a[1][k], b[1][k], sentinel)
+                   for k in a[1] if k in b[1])
+    return False
+
+
+def _sub_fill(obj, other_tree, other_leaves, sentinel):
+    """Replace ``sentinel`` leaves of ``obj`` with typed zeros (or the
+    matching constant) taken from the corresponding position of the
+    other branch's probe; promote plain scalar constants paired with a
+    tensor on the other side (a converted flag set like ``brk = True``
+    is a python constant in one branch and a carried tensor in the
+    other)."""
+    if obj is sentinel:
+        if other_tree[0] == "T":
+            ref = other_leaves[other_tree[1]]
+            return Tensor(jnp.zeros(jnp.shape(ref),
+                                    getattr(ref, "dtype", None)
+                                    or jnp.result_type(ref)))
+        if other_tree[0] == "c" and isinstance(other_tree[1],
+                                               (bool, int, float)):
+            return other_tree[1]
+        return obj
+    if isinstance(obj, (bool, int, float)) and other_tree[0] == "T":
+        ref = other_leaves[other_tree[1]]
+        return Tensor(jnp.asarray(obj, getattr(ref, "dtype", None)
+                                  or jnp.result_type(ref)))
+    if isinstance(obj, (bool, int, float)) and other_tree[0] == "c" \
+            and isinstance(other_tree[1], (bool, int, float)) \
+            and obj != other_tree[1]:
+        # branches bind the SAME name to DIFFERENT constants (cont=True
+        # in one arm, the False reset in the other): only a traced
+        # select can represent the merge
+        return Tensor(jnp.asarray(obj, jnp.result_type(obj,
+                                                       other_tree[1])))
+    if isinstance(obj, (list, tuple)) and other_tree[0] in ("list", "tuple") \
+            and len(other_tree[1]) == len(obj):
+        return type(obj)(_sub_fill(o, t, other_leaves, sentinel)
+                         for o, t in zip(obj, other_tree[1]))
+    if isinstance(obj, dict) and other_tree[0] == "dict":
+        return {k: (_sub_fill(v, other_tree[1][k], other_leaves, sentinel)
+                    if k in other_tree[1] else v)
+                for k, v in obj.items()}
+    return obj
+
+
+def _filled_fn(fn, other_tree, other_leaves, sentinel):
+    def wrapped():
+        return _sub_fill(fn(), other_tree, other_leaves, sentinel)
+    return wrapped
 
 
 # --------------------------------------------------------------------------
@@ -234,7 +330,8 @@ def _needs_grad(tensors):
 # cond
 # --------------------------------------------------------------------------
 
-def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None,
+         _undef_fill=None):
     """``true_fn()`` if ``pred`` else ``false_fn()`` (reference
     ``static/nn/control_flow.py:1444``). Works eagerly (runs one branch)
     and under jit capture (emits ``lax.cond``)."""
@@ -246,7 +343,14 @@ def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
     if tensor_mod._tracker is None:
         return true_fn() if bool(unwrap(pred)) else false_fn()
 
-    (tree_t, tree_f), reads = _hoist([true_fn, false_fn])
+    trees, reads, leaves = _hoist([true_fn, false_fn])
+    tree_t, tree_f = trees
+    if _undef_fill is not None and _needs_unify(tree_t, tree_f,
+                                                _undef_fill):
+        true_fn = _filled_fn(true_fn, tree_f, leaves[1], _undef_fill)
+        false_fn = _filled_fn(false_fn, tree_t, leaves[0], _undef_fill)
+        trees, reads, leaves = _hoist([true_fn, false_fn])
+        tree_t, tree_f = trees
     _check_same_structure([tree_t, tree_f], "cond")
 
     pred_t = pred if isinstance(pred, Tensor) else Tensor(jnp.asarray(pred))
@@ -306,7 +410,7 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
     fns = [fn for _, fn in pairs] + [default]
     keys = [k for k, _ in pairs]
-    trees, reads = _hoist(fns)
+    trees, reads, _ = _hoist(fns)
     _check_same_structure(trees, "switch_case")
 
     idx_t = (branch_index if isinstance(branch_index, Tensor)
@@ -352,7 +456,7 @@ def case(pred_fn_pairs, default=None, name=None):
         return default()
 
     all_fns = list(fns) + [default]
-    trees, reads = _hoist(all_fns)
+    trees, reads, _ = _hoist(all_fns)
     _check_same_structure(trees, "case")
 
     pred_ts = [p if isinstance(p, Tensor) else Tensor(jnp.asarray(p))
@@ -383,7 +487,7 @@ def case(pred_fn_pairs, default=None, name=None):
 # --------------------------------------------------------------------------
 
 def while_loop(cond, body, loop_vars, is_test=False, name=None,
-               max_trip_count=None):
+               max_trip_count=None, _undef_fill=None):
     """Repeat ``body`` while ``cond`` holds (reference
     ``static/nn/control_flow.py:687``).
 
@@ -425,7 +529,34 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None,
         out = body(*loop_vars)
         return tuple(out) if isinstance(out, (list, tuple)) else (out,)
 
-    (_, body_tree), reads = _hoist([lambda: cond(*loop_vars), probe_body])
+    (_, body_tree), reads, bleaves = _hoist([lambda: cond(*loop_vars),
+                                             probe_body])
+    if _undef_fill is not None and body_tree[0] in ("tuple", "list") \
+            and len(body_tree[1]) == len(loop_vars) \
+            and _needs_unify(carry_tree, body_tree, _undef_fill):
+        # two repairable disagreements between carry and body:
+        # - a slot UNDEF at entry that becomes a tensor inside the body
+        #   (__pt_retv before the first early return): seed the carry
+        #   with typed zeros from the body probe;
+        # - a slot that is a tensor in the carry but a python constant
+        #   in the body output (a flag reset like ``cont = False``):
+        #   promote the body's constant to the carry's tensor type.
+        loop_vars = type(loop_vars)(
+            _sub_fill(v, t, bleaves[1], _undef_fill)
+            for v, t in zip(loop_vars, body_tree[1]))
+        carry_leaves, carry_tree = _flatten_out(tuple(loop_vars))
+        carry_ts = list(_iter_tensors(loop_vars))
+        carry_ids = [id(t) for t in carry_ts]
+        orig_body, final_tree, final_leaves = body, carry_tree, carry_leaves
+
+        def body(*vs):
+            out = orig_body(*vs)
+            out = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+            return tuple(_sub_fill(o, t, final_leaves, _undef_fill)
+                         for o, t in zip(out, final_tree[1]))
+
+        (_, body_tree), reads, bleaves = _hoist([lambda: cond(*loop_vars),
+                                                 probe_body])
     _check_same_structure([carry_tree, body_tree], "while_loop")
     reads = [t for t in reads if id(t) not in set(carry_ids)]
     read_ids = [id(t) for t in reads]

@@ -254,3 +254,279 @@ def test_convert_function_declines_gracefully():
 
     assert convert_function(no_sites) is None
     assert convert_function(len) is None  # builtin: no source
+
+
+# ------------------------------------------------- escape conversion (r5) --
+
+def test_while_with_break_compiles():
+    # reference break_continue_transformer.py: break -> loop-condition
+    # flag; the loop must still compile to ONE program
+    @paddle.jit.to_static
+    def fn(x):
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < 10:
+            x = x + 1.0
+            i = i + 1.0
+            if i >= 3:
+                break
+        return x
+
+    np.testing.assert_allclose(fn(_t([0.0])).numpy(), [3.0])
+    np.testing.assert_allclose(fn(_t([5.0])).numpy(), [8.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys, "while with break fell back"
+    assert len(sf._cache) == 1
+
+
+def test_while_with_continue_compiles():
+    @paddle.jit.to_static
+    def fn(x):
+        total = x.sum() * 0.0
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < 5:
+            i = i + 1.0
+            if i == 2:
+                continue
+            total = total + i
+        return total
+
+    # 1 + 3 + 4 + 5 (2 skipped)
+    np.testing.assert_allclose(float(fn(_t([1.0]))), 13.0)
+    sf = _sf(fn)
+    assert not sf._fallback_keys, "while with continue fell back"
+
+
+def test_for_range_with_break_compiles():
+    @paddle.jit.to_static
+    def fn(x):
+        for i in range(10):
+            x = x + 1.0
+            if x.sum() > 4:
+                break
+        return x
+
+    np.testing.assert_allclose(fn(_t([0.0])).numpy(), [5.0])
+    np.testing.assert_allclose(fn(_t([100.0])).numpy(), [101.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys, "for-range with break fell back"
+
+
+def test_early_return_in_branch_compiles():
+    # reference return_transformer.py: early return -> retv/retf flags
+    @paddle.jit.to_static
+    def fn(x):
+        if x.sum() > 0:
+            return x * 2.0
+        return x - 1.0
+
+    np.testing.assert_allclose(fn(_t([1.0, 2.0])).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(fn(_t([-1.0, -2.0])).numpy(), [-2.0, -3.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys, "early return fell back"
+    assert len(sf._cache) == 1
+
+
+def test_return_inside_while_compiles():
+    @paddle.jit.to_static
+    def fn(x):
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < 100:
+            x = x * 2.0
+            i = i + 1.0
+            if x.sum() > 10:
+                return x + 100.0
+        return x
+
+    # 1 -> 2 -> 4 -> 8 -> 16 (>10) -> +100
+    np.testing.assert_allclose(fn(_t([1.0])).numpy(), [116.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys, "return inside while fell back"
+
+
+def test_python_pred_early_return_still_exact():
+    # the round-4 decline case now converts; python flag predicates
+    # must keep exact eager dispatch
+    @paddle.jit.to_static
+    def fn(x, flag=True):
+        if flag:
+            return x + 1.0
+        while x.sum() < 100:
+            x = x * 2.0
+        return x
+
+    np.testing.assert_allclose(fn(_t([1.0])).numpy(), [2.0])
+    np.testing.assert_allclose(fn(_t([3.0]), flag=False).numpy(), [192.0])
+    sf = _sf(fn)
+    assert not sf._fallback_keys
+
+
+def test_tensor_iteration_compiles():
+    # reference loop_transformer.py: `for x in tensor` iterates rows
+    @paddle.jit.to_static
+    def fn(x):
+        acc = x.sum() * 0.0
+        for row in x:
+            acc = acc + row.max()
+        return acc
+
+    v = np.array([[1.0, 2.0], [30.0, 4.0], [5.0, 6.0]], np.float32)
+    np.testing.assert_allclose(float(fn(paddle.to_tensor(v))), 38.0)
+    sf = _sf(fn)
+    assert not sf._fallback_keys, "tensor iteration fell back"
+
+
+def test_for_each_python_iterable_unchanged():
+    # the same syntax over a python list must stay plain python
+    @paddle.jit.to_static
+    def fn(x):
+        for mult in [1.0, 2.0, 3.0]:
+            x = x * mult
+        return x
+
+    np.testing.assert_allclose(fn(_t([1.0])).numpy(), [6.0])
+
+
+def test_eager_semantics_escape_forms():
+    # converted functions must behave bit-for-bit eagerly, including
+    # break/continue/early-return and loop-else-free mixes
+    from paddle_tpu.jit.dy2static import convert_function
+
+    def orig(n):
+        total = 0
+        for i in range(n):
+            if i == 2:
+                continue
+            if i == 5:
+                break
+            total = total + i
+        while total < 100:
+            if total > 50:
+                return ("mid", total)
+            total = total + 30
+        return ("end", total)
+
+    conv = convert_function(orig)
+    assert conv is not None
+    for n in (0, 1, 3, 8, 100):
+        assert conv(n) == orig(n), f"diverged at n={n}"
+
+
+def test_eager_empty_tensor_style_loop_and_bare_return():
+    from paddle_tpu.jit.dy2static import convert_function
+
+    def orig(x):
+        if x > 3:
+            return
+        return x * 2
+
+    conv = convert_function(orig)
+    assert conv is not None
+    assert conv(5) is None and orig(5) is None
+    assert conv(2) == orig(2) == 4
+
+
+def test_for_range_with_return_and_continue_terminates():
+    # code-review r5: the continue guard must not swallow the desugared
+    # index increment on the return-elimination path (hang regression)
+    from paddle_tpu.jit.dy2static import convert_function
+
+    def orig(n, cap):
+        total = 0
+        for i in range(n):
+            if i == 2:
+                continue
+            if total > cap:
+                return ("cap", total)
+            total = total + i
+        return ("end", total)
+
+    conv = convert_function(orig)
+    assert conv is not None
+    for n in (0, 3, 6, 10):
+        for cap in (2, 100):
+            assert conv(n, cap) == orig(n, cap)
+
+
+def test_tensor_foreach_with_continue_terminates():
+    @paddle.jit.to_static
+    def fn(x):
+        acc = x.sum() * 0.0
+        for row in x:
+            if row.max() > 10:
+                continue
+            acc = acc + row.max()
+        return acc
+
+    v = np.array([[1.0, 2.0], [30.0, 4.0], [5.0, 6.0]], np.float32)
+    np.testing.assert_allclose(float(fn(paddle.to_tensor(v))), 8.0)
+    sf = _sf(fn)
+    assert not sf._fallback_keys
+
+
+def test_fallthrough_under_traced_pred_returns_none():
+    # code-review r5: `if cond: return y` with NO other return must give
+    # None on the false path, not silently return zeros; the traced-pred
+    # case falls back to eager (correct semantics beats compiledness)
+    @paddle.jit.to_static
+    def fn(x):
+        if x.sum() > 0:
+            return x * 2.0
+
+    np.testing.assert_allclose(fn(_t([1.0])).numpy(), [2.0])
+    assert fn(_t([-1.0])) is None
+
+
+def test_tuple_target_for_with_return_stays_exact():
+    # code-review r5 pass 2: a return inside a tuple-target for must not
+    # be half-transformed (flags without prologue silently returned None)
+    from paddle_tpu.jit.dy2static import convert_function
+
+    def orig(items, base):
+        acc = base + 0
+        for k, v in items:
+            if v > 6:
+                return acc + v
+        return acc
+
+    conv = convert_function(orig)
+    if conv is not None:
+        assert conv([("a", 9)], 0) == orig([("a", 9)], 0) == 9
+        assert conv([("a", 1)], 5) == orig([("a", 1)], 5) == 5
+
+
+def test_return_in_try_inside_while_stays_exact():
+    from paddle_tpu.jit.dy2static import convert_function
+
+    def orig(n):
+        i = 0
+        while i < n:
+            try:
+                if i == 3:
+                    return "found"
+            except ValueError:
+                pass
+            i = i + 1
+        return "end"
+
+    conv = convert_function(orig)
+    if conv is not None:
+        assert conv(10) == orig(10) == "found"
+        assert conv(2) == orig(2) == "end"
+
+
+def test_tensor_foreach_with_continue_in_try_terminates():
+    # code-review r5 pass 2: fragile continue must keep the original
+    # python for (real continue + manual increment = infinite loop)
+    @paddle.jit.to_static
+    def fn(x):
+        acc = 0.0
+        for row in x:
+            try:
+                continue
+            except ValueError:
+                pass
+            acc = acc + 1.0
+        return paddle.to_tensor(np.float32(acc))
+
+    v = np.array([[1.0], [2.0]], np.float32)
+    assert float(fn(paddle.to_tensor(v))) == 0.0
