@@ -171,6 +171,73 @@ def main():
         run("fwd_only", fwd)
         del model, opt, fwd
 
+    if "relu" in variants:
+        # gelu(tanh) -> relu in the MLP: isolates the transcendental
+        # (VPU) cost of gelu fwd + bwd + remat recompute
+        from paddle_tpu.models import gpt as gpt_mod
+        import paddle_tpu.nn.functional as F
+        orig_fwd = gpt_mod.GPTMLP.forward
+        gpt_mod.GPTMLP.forward = \
+            lambda self, x: self.fc2(F.relu(self.fc1(x)))
+        try:
+            cfg, model, opt = build()
+
+            @paddle.jit.to_static
+            def relu_step(ids, labels):
+                with amp.auto_cast(level="O2", dtype="bfloat16"):
+                    loss = model(ids, labels)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss
+            run("relu", relu_step)
+            del model, opt, relu_step
+        finally:
+            gpt_mod.GPTMLP.forward = orig_fwd
+
+    if "xla_ln" in variants:
+        # LayerNorm via jnp instead of the Pallas kernel: the custom
+        # call is a fusion barrier; XLA may fuse the jnp form into the
+        # surrounding residual-add/cast chains and win in-context
+        import os
+        os.environ["PDTPU_NORM_BACKEND"] = "xla"
+        try:
+            cfg, model, opt = build()
+
+            @paddle.jit.to_static
+            def xla_ln_step(ids, labels):
+                with amp.auto_cast(level="O2", dtype="bfloat16"):
+                    loss = model(ids, labels)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss
+            run("xla_ln", xla_ln_step)
+            del model, opt, xla_ln_step
+        finally:
+            os.environ.pop("PDTPU_NORM_BACKEND", None)
+
+    if "ln_off" in variants:
+        # LayerNorm -> identity: upper bound on ALL norm-related cost
+        from paddle_tpu.nn import layers as nl
+        orig_ln = nl.LayerNorm.forward
+        nl.LayerNorm.forward = lambda self, x: x
+        try:
+            cfg, model, opt = build()
+
+            @paddle.jit.to_static
+            def ln_off_step(ids, labels):
+                with amp.auto_cast(level="O2", dtype="bfloat16"):
+                    loss = model(ids, labels)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss
+            run("ln_off", ln_off_step)
+            del model, opt, ln_off_step
+        finally:
+            nl.LayerNorm.forward = orig_ln
+
     # derived attributions
     d = {}
     if "full" in results and "sgd" in results:
@@ -186,6 +253,14 @@ def main():
     if "full" in results and "fwd_only" in results:
         d["bwd_plus_opt_ms"] = round(
             results["full"] - results["fwd_only"], 2)
+    if "full" in results and "relu" in results:
+        d["gelu_minus_relu_ms"] = round(
+            results["full"] - results["relu"], 2)
+    if "full" in results and "xla_ln" in results:
+        d["pallas_ln_minus_xla_ln_ms"] = round(
+            results["full"] - results["xla_ln"], 2)
+    if "full" in results and "ln_off" in results:
+        d["ln_total_ms"] = round(results["full"] - results["ln_off"], 2)
     print(json.dumps({"variants_ms": results, "derived": d}, indent=1))
 
 

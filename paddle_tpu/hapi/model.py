@@ -171,7 +171,16 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, window=1):
+        """``window=K`` (TPU extension over the reference fit signature,
+        ``hapi/model.py:1052``): dispatch K train steps as ONE compiled
+        scan launch (``jit.WindowRunner``) with inputs pre-staged on
+        device and per-step scheduler LRs threaded through the window
+        (``optimizer.lr_window``). Per-step host dispatch over a
+        network-attached chip otherwise dominates the step time; see
+        BASELINE.md. Callbacks and metrics observe every step, after
+        its window completes; epoch tails shorter than K (and
+        ``accumulate_grad_batches > 1`` runs) use the per-batch path."""
         assert self._optimizer is not None, "call prepare() before fit()"
         if accumulate_grad_batches != self._accumulate:
             self._accumulate = accumulate_grad_batches
@@ -186,23 +195,28 @@ class Model:
         self.stop_training = False
         cbks.on_train_begin()
         it = 0
+        wstate = {"runner": None}  # WindowRunner reused across epochs
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
             logs = {}
-            for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
-                inputs, labels = self._split_batch(batch)
-                update = ((step + 1) % self._accumulate == 0
-                          or (steps is not None and step + 1 == steps))
-                res = self.train_batch(inputs, labels, update=update)
-                logs = self._make_logs(res)
-                cbks.on_train_batch_end(step, logs)
-                it += 1
-                if num_iters is not None and it >= num_iters:
-                    self.stop_training = True
-                    break
+            if window > 1 and self._accumulate == 1:
+                logs, it = self._run_windowed_epoch(
+                    loader, cbks, window, it, num_iters, wstate)
+            else:
+                for step, batch in enumerate(loader):
+                    cbks.on_train_batch_begin(step)
+                    inputs, labels = self._split_batch(batch)
+                    update = ((step + 1) % self._accumulate == 0
+                              or (steps is not None and step + 1 == steps))
+                    res = self.train_batch(inputs, labels, update=update)
+                    logs = self._make_logs(res)
+                    cbks.on_train_batch_end(step, logs)
+                    it += 1
+                    if num_iters is not None and it >= num_iters:
+                        self.stop_training = True
+                        break
             cbks.on_epoch_end(epoch, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_data, batch_size=batch_size,
@@ -211,6 +225,144 @@ class Model:
             if self.stop_training:
                 break
         cbks.on_train_end(logs)
+
+    def _run_windowed_epoch(self, loader, cbks, window, it, num_iters,
+                            wstate):
+        """One epoch with K-step scanned windows (see ``fit(window=)``).
+        The first batch runs per-batch (it is also the compile trigger);
+        full windows then go through ONE WindowRunner launch each, with
+        the scheduler advanced via ``lr_window``. Epoch tails and any
+        fallback (step not compiled, LR slot not threadable) use the
+        per-batch path."""
+        from .. import jit
+
+        logs, step = {}, 0
+
+        def plain(inputs, labels):
+            nonlocal logs, step, it
+            cbks.on_train_batch_begin(step)
+            res = self.train_batch(inputs, labels)
+            logs = self._make_logs(res)
+            cbks.on_train_batch_end(step, logs)
+            step += 1
+            it += 1
+
+        def peek_lrs():
+            """Next K per-step LRs WITHOUT advancing the scheduler: the
+            auto-configured LRScheduler callback owns the advance (it
+            fires per batch-end below; lr_window would double-step).
+            With epoch-granular scheduling the in-window LR is constant."""
+            from ..optimizer.lr import LRScheduler as Sched
+            from .callbacks import LRScheduler as LRCb
+            sched = getattr(self._optimizer, "_learning_rate", None)
+            if not isinstance(sched, Sched):
+                return np.full((window,), float(sched), np.float32)
+            stepped = any(isinstance(c, LRCb) and c.by_step
+                          for c in getattr(cbks, "callbacks", []))
+            if not stepped:
+                return np.full((window,), float(sched()), np.float32)
+            snap = sched.state_dict()
+            vals = self._optimizer.lr_window(window)
+            sched.set_state_dict(snap)
+            return vals
+
+        def flush_window(buf):
+            nonlocal logs, step, it
+            runner = wstate["runner"]
+            batches = [tuple(_to_tensors(i) + _to_tensors(l))
+                       for i, l in buf]
+            label_lists = [_to_tensors(l) for _, l in buf]
+            self.network.train()
+            stacks = runner.stage(batches)
+            ps = [peek_lrs()] if wstate.get("lr_slot") else None
+            rets = runner.run(*stacks, outputs="stacked",
+                              per_step_vals=ps)
+            for k, (loss, outputs) in enumerate(
+                    runner.rebuild_host(rets)):
+                cbks.on_train_batch_begin(step)
+                metrics = self._update_metrics(outputs, label_lists[k])
+                logs = self._make_logs([float(loss)] + metrics)
+                cbks.on_train_batch_end(step, logs)
+                step += 1
+                it += 1
+
+        buf = []
+        for batch in loader:
+            if self.stop_training or (num_iters is not None
+                                      and it >= num_iters):
+                self.stop_training = True
+                break
+            inputs, labels = self._split_batch(batch)
+            if wstate["runner"] is None:
+                plain(inputs, labels)  # compile trigger + step 1
+                wstate["runner"] = self._make_window_runner(
+                    inputs, labels, window, wstate)
+                continue
+            if wstate["runner"] is False:
+                plain(inputs, labels)
+                continue
+            buf.append((inputs, labels))
+            room = (num_iters - it if num_iters is not None else None)
+            if room is not None and room < window:
+                # budget smaller than a window: finish per-batch (the
+                # top-of-loop check stops at num_iters exactly); without
+                # this the loop would buffer the whole remaining epoch
+                for i2, l2 in buf:
+                    if it >= num_iters:
+                        break
+                    plain(i2, l2)
+                buf = []
+                continue
+            if len(buf) == window:
+                flush_window(buf)
+                buf = []
+        for inputs, labels in buf:  # epoch tail (or num_iters remnant)
+            if num_iters is not None and it >= num_iters:
+                self.stop_training = True
+                break
+            plain(inputs, labels)
+        if num_iters is not None and it >= num_iters:
+            self.stop_training = True
+        return logs, it
+
+    def _make_window_runner(self, inputs, labels, window, wstate):
+        """Build the WindowRunner AFTER the first per-batch step proved
+        the step compiles. Returns the runner, or False for the
+        per-batch path. Never executes a training step itself: a
+        WindowRunner constructed against an uncompiled step would prime
+        by running one real step (extra optimizer updates on batch 1 —
+        silent trajectory corruption when construction then fails)."""
+        from .. import jit
+        from ..optimizer.lr import LRScheduler as Sched
+
+        sf = self._train_step
+        sf = sf if hasattr(sf, "_cache") else getattr(
+            sf, "__wrapped__", sf)
+        if getattr(sf, "_fallback_keys", None) or \
+                not getattr(sf, "_cache", None):
+            return False               # graph break: stay per-batch
+        ex = tuple(_to_tensors(inputs) + _to_tensors(labels))
+        try:
+            runner = jit.WindowRunner(
+                self._train_step, ex, length=window,
+                per_step=[self._optimizer.lr_var])
+            wstate["lr_slot"] = True
+            return runner
+        except Exception:
+            pass
+        if isinstance(getattr(self._optimizer, "_learning_rate", None),
+                      Sched):
+            # LR cannot thread per-step and a by-step scheduler is
+            # active: windowing would freeze the LR at window-start
+            # values — per-batch keeps the documented trajectory
+            return False
+        try:
+            runner = jit.WindowRunner(self._train_step, ex,
+                                      length=window)
+            wstate["lr_slot"] = False
+            return runner
+        except Exception:
+            return False
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None):

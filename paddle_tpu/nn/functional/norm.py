@@ -18,8 +18,15 @@ from ...core.tensor import Tensor
 
 def _pallas_norms():
     """Fused Pallas norm kernels, used on TPU (None elsewhere: the XLA
-    fallback below is faster than interpret mode on CPU)."""
-    if jax.default_backend() != "tpu":
+    fallback below is faster than interpret mode on CPU).
+    ``PDTPU_NORM_BACKEND=xla`` forces the XLA-native path even on TPU —
+    a Pallas custom call is a fusion BARRIER (its input and output must
+    materialize in HBM), so the jnp formulation can win in-context when
+    XLA fuses it into neighboring elementwise chains; the A/B lives in
+    benchmarks/step_anatomy.py."""
+    import os
+    if jax.default_backend() != "tpu" \
+            or os.environ.get("PDTPU_NORM_BACKEND") == "xla":
         return None
     try:
         from ...ops.pallas import norms

@@ -24,6 +24,28 @@ from ..nn.layer import Layer
 
 # --- weight-only ops -------------------------------------------------------
 
+def _pack_int4(q):
+    """[in, out] int8 nibbles in [-8, 7] -> [ceil(in/2), out] int8 with
+    row 2k in the low nibble and row 2k+1 in the high nibble (the
+    2-values-per-byte layout of the reference's weight-only int4 GEMMs,
+    ``paddle/phi/kernels/fusion/cutlass/``)."""
+    if q.shape[0] % 2:
+        q = jnp.pad(q, ((0, 1), (0, 0)))
+    lo, hi = q[0::2], q[1::2]
+    return (jnp.left_shift(hi, 4)
+            | jnp.bitwise_and(lo, jnp.int8(0xF))).astype(jnp.int8)
+
+
+def _unpack_int4(p, n_in):
+    """Inverse of :func:`_pack_int4`; arithmetic shifts sign-extend the
+    nibbles. XLA fuses this unpack + the scale multiply into the matmul
+    read, so int4 weights cost half the int8 HBM traffic."""
+    lo = jnp.right_shift(jnp.left_shift(p, 4), 4)
+    hi = jnp.right_shift(p, 4)
+    w = jnp.stack([lo, hi], axis=1).reshape(-1, p.shape[-1])
+    return w[:n_in]
+
+
 @primitive("weight_quantize")
 def _weight_quantize_impl(w, algo="weight_only_int8"):
     if algo not in ("weight_only_int8", "abs_max", "weight_only_int4"):
@@ -33,18 +55,28 @@ def _weight_quantize_impl(w, algo="weight_only_int8"):
     scale = jnp.max(jnp.abs(w), axis=0) / qmax  # per out-channel [out]
     scale = jnp.where(scale == 0, 1.0, scale)
     q = jnp.clip(jnp.round(w / scale), -qmax - 1, qmax).astype(jnp.int8)
+    if algo == "weight_only_int4":
+        q = _pack_int4(q)
     return q, scale.astype(jnp.float32)
 
 
 def weight_quantize(w, algo="weight_only_int8"):
-    """w: [in, out] float -> (int8 weights, [out] scales)."""
+    """w: [in, out] float -> (quantized weights, [out] scales). int8:
+    one int8 per value; int4: two nibbles per byte ([ceil(in/2), out]),
+    matching the reference ``weight_quantize(..., algo="weight_only_int4")``
+    (``python/paddle/nn/quant/quantized_linear.py``)."""
     return _weight_quantize_impl(w, algo=algo)
 
 
 @primitive("weight_dequantize")
 def weight_dequantize(qw, scale, algo="weight_only_int8",
-                      out_dtype="float32"):
+                      out_dtype="float32", in_features=None):
+    """``in_features`` (int4 only): unpadded input dim when the packed
+    rows carry a pad nibble (odd in_features)."""
     from ..core.dtype import convert_dtype
+    if algo == "weight_only_int4":
+        qw = _unpack_int4(qw, in_features
+                          if in_features is not None else 2 * qw.shape[0])
     return (qw.astype(jnp.float32) * scale).astype(
         convert_dtype(out_dtype) or jnp.float32)
 
@@ -52,9 +84,14 @@ def weight_dequantize(qw, scale, algo="weight_only_int8",
 @primitive("weight_only_linear")
 def weight_only_linear(x, qweight, scale, bias=None,
                        weight_dtype="int8"):
-    """y = x @ dequant(qweight) + bias; the dequant feeds the MXU matmul
-    directly (one fused HBM pass under XLA)."""
-    w = qweight.astype(x.dtype) * scale.astype(x.dtype)
+    """y = x @ dequant(qweight) + bias; the dequant (and for int4 the
+    nibble unpack) feeds the MXU matmul directly — one fused HBM pass
+    under XLA at the quantized byte width."""
+    if weight_dtype in ("int4", "weight_only_int4"):
+        w = _unpack_int4(qweight, x.shape[-1]).astype(x.dtype) \
+            * scale.astype(x.dtype)
+    else:
+        w = qweight.astype(x.dtype) * scale.astype(x.dtype)
     y = x @ w
     if bias is not None:
         y = y + bias

@@ -270,3 +270,98 @@ def test_resize_rounds_not_truncates():
     out = transforms.resize(img, (2, 2), "bilinear")
     assert out.dtype == np.uint8
     assert int(out.max()) >= 127  # truncation bias would pull everything down
+
+
+def test_fit_window_matches_per_batch_fit():
+    # fit(window=K) must produce the same training trajectory as the
+    # per-batch loop: same batches, same scheduler steps, one scanned
+    # launch per window (VERDICT r4 #4: WindowRunner shipped to users)
+    from paddle_tpu.io import Dataset as DS
+
+    class Reg(DS):
+        def __init__(self):
+            rng = np.random.default_rng(0)
+            self.x = rng.normal(size=(33, 4)).astype(np.float32)
+            w = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+            self.y = self.x @ w
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    def build():
+        paddle.seed(7)
+        net = nn.Linear(4, 1)
+        m = paddle.Model(net)
+        sched = paddle.optimizer.lr.StepDecay(
+            learning_rate=0.05, step_size=4, gamma=0.5)
+        m.prepare(paddle.optimizer.SGD(learning_rate=sched,
+                                       parameters=net.parameters()),
+                  paddle.nn.loss.MSELoss())
+        return m, net
+
+    losses_a, losses_b = [], []
+
+    class Rec(paddle.callbacks.Callback):
+        def __init__(self, sink):
+            self.sink = sink
+
+        def on_train_batch_end(self, step, logs=None):
+            self.sink.append(logs["loss"])
+
+    m1, n1 = build()
+    m1.fit(Reg(), epochs=2, batch_size=8, shuffle=False, verbose=0,
+           callbacks=[Rec(losses_a)])
+    m2, n2 = build()
+    from paddle_tpu.jit.multi_step import WindowRunner
+    runs = {"n": 0}
+    orig_run = WindowRunner.run
+
+    def counting_run(self, *a, **k):
+        runs["n"] += 1
+        return orig_run(self, *a, **k)
+
+    WindowRunner.run = counting_run
+    try:
+        m2.fit(Reg(), epochs=2, batch_size=8, shuffle=False, verbose=0,
+               window=3, callbacks=[Rec(losses_b)])
+    finally:
+        WindowRunner.run = orig_run
+
+    assert len(losses_a) == len(losses_b) == 10  # 5 batches x 2 epochs
+    np.testing.assert_allclose(losses_a, losses_b, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(n1.weight.numpy(), n2.weight.numpy(),
+                               rtol=2e-4, atol=1e-6)
+    # the windowed run really used windows: epoch1 = plain prime +
+    # window(3) + plain tail; epoch2 = window(3) + 2-step plain tail
+    assert runs["n"] == 2, runs
+
+
+def test_fit_window_respects_num_iters():
+    from paddle_tpu.io import Dataset as DS
+
+    class Reg(DS):
+        def __len__(self):
+            return 40
+
+        def __getitem__(self, i):
+            x = np.float32([i % 5, 1.0])
+            return x, np.float32([i % 3])
+
+    paddle.seed(0)
+    net = nn.Linear(2, 1)
+    m = paddle.Model(net)
+    m.prepare(paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=net.parameters()),
+              paddle.nn.loss.MSELoss())
+    seen = []
+
+    class Rec(paddle.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            seen.append(step)
+
+    m.fit(Reg(), epochs=5, batch_size=4, shuffle=False, verbose=0,
+          window=4, num_iters=7, callbacks=[Rec()])
+    assert len(seen) == 7

@@ -93,3 +93,39 @@ def test_ptq_observer_calibration():
     np.testing.assert_allclose(obs.scale(), 6.35 / 127, rtol=1e-5)
     p.convert(net)
     assert isinstance(net[0], nn.Linear)
+
+
+def test_weight_only_int4_pack_roundtrip():
+    # reference weight_quantize(algo="weight_only_int4"): 2 nibbles/byte
+    from paddle_tpu.quantization import (weight_dequantize, weight_quantize,
+                                         weight_only_linear)
+
+    rng = np.random.default_rng(0)
+    w = paddle.to_tensor(rng.normal(size=(16, 8)).astype(np.float32))
+    qw, scale = weight_quantize(w, algo="weight_only_int4")
+    assert list(qw.shape) == [8, 8]          # packed: in/2 rows
+    assert str(qw.dtype).endswith("int8")
+    deq = weight_dequantize(qw, scale, algo="weight_only_int4",
+                            in_features=16)
+    # int4 grid: max error is scale/2 per element
+    err = np.abs(deq.numpy() - w.numpy())
+    assert (err <= scale.numpy()[None, :] * 0.5 + 1e-6).all()
+
+    x = paddle.to_tensor(rng.normal(size=(4, 16)).astype(np.float32))
+    y = weight_only_linear(x, qw, scale, weight_dtype="int4")
+    ref = x.numpy() @ deq.numpy()
+    np.testing.assert_allclose(y.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_weight_only_int4_odd_in_features():
+    from paddle_tpu.quantization import weight_dequantize, weight_quantize
+
+    rng = np.random.default_rng(1)
+    w = paddle.to_tensor(rng.normal(size=(7, 5)).astype(np.float32))
+    qw, scale = weight_quantize(w, algo="weight_only_int4")
+    assert list(qw.shape) == [4, 5]          # ceil(7/2) packed rows
+    deq = weight_dequantize(qw, scale, algo="weight_only_int4",
+                            in_features=7)
+    assert list(deq.shape) == [7, 5]
+    err = np.abs(deq.numpy() - w.numpy())
+    assert (err <= scale.numpy()[None, :] * 0.5 + 1e-6).all()

@@ -181,3 +181,37 @@ def test_sparse_softmax_rows():
     np.testing.assert_allclose(v[:2].sum(), 1.0, rtol=1e-5)
     np.testing.assert_allclose(v[2:].sum(), 1.0, rtol=1e-5)
     np.testing.assert_allclose(v[1] / v[0], np.e, rtol=1e-4)
+
+
+def test_sparse_conv_rulebook_cached_across_calls():
+    # static sparsity: the host rulebook must be built once and reused
+    import paddle_tpu.sparse.nn.functional as SF
+
+    from paddle_tpu import sparse as sp
+    coords = np.array([[0, 0, 0], [0, 1, 3], [0, 2, 3], [0, 3, 3]])
+    vals = np.array([[1, 2], [3, 4], [5, 6]], np.float32)
+    x = sp.sparse_coo_tensor(coords, vals, shape=(1, 4, 4, 4, 2))
+    w = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(3, 3, 3, 2, 4))
+        .astype(np.float32))
+
+    SF._RB_CACHE.clear()
+    calls = {"n": 0}
+    orig = SF._rulebook
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    SF._rulebook = counting
+    try:
+        y1 = SF.conv3d(x, w)
+        y2 = SF.conv3d(x, w)          # same sites: cache hit
+        _ = SF.subm_conv3d(x, paddle.to_tensor(
+            np.random.default_rng(1).normal(size=(3, 3, 3, 2, 2))
+            .astype(np.float32)))     # different geometry: new entry
+    finally:
+        SF._rulebook = orig
+    assert calls["n"] == 2, calls
+    np.testing.assert_allclose(y1.to_dense().numpy(),
+                               y2.to_dense().numpy(), rtol=1e-6)
