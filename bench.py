@@ -63,20 +63,37 @@ def _peak_flops(dev) -> float:
 
 # every _cached entry is timed through this shared harness — a change
 # here must invalidate all cached rows, or a regression in the timing
-# path would re-report stale numbers as current measured evidence
+# path would re-report stale numbers as current measured evidence.
+# bench.py itself is hashed at FUNCTION granularity (the measurement
+# fns, passed per entry) so cosmetic bench edits — emit format, extra
+# wiring — cannot cold the whole cache and blow the driver's budget.
 _HARNESS_FILES = [
-    "bench.py",
     "paddle_tpu/jit/multi_step.py",
     "paddle_tpu/optimizer/optimizer.py",
     "paddle_tpu/amp/__init__.py",
+    "paddle_tpu/nn/functional/norm.py",
 ]
 
 
-def _cached(dev, name, files, fn):
+def _fn_version(*fns):
+    import hashlib
+    import inspect
+    h = hashlib.sha256()
+    for f in fns:
+        h.update(inspect.getsource(f).encode())
+    return h.hexdigest()[:16]
+
+
+def _cached(dev, name, files, fn, src_fns=()):
     """Measured-evidence gate: load from benchmarks/measured/ when the
-    producing code is unchanged, else measure now and persist."""
+    producing code is unchanged, else measure now and persist. The key
+    covers the shared timing harness, the per-entry measurement fns,
+    and the bench-module constants their math depends on."""
     kind = str(getattr(dev, "device_kind", dev.platform))
-    ver = mc.code_version(*_HARNESS_FILES, *files)
+    consts = repr((_PEAK, WINDOW_STEPS))
+    ver = mc.code_version(*_HARNESS_FILES, *files) \
+        + _fn_version(_timed_window, _peak_flops, *src_fns) \
+        + hashlib.sha256(consts.encode()).hexdigest()[:8]
     val = mc.load(kind, name, ver)
     if val is not None:
         return dict(val, cached=True)
@@ -434,9 +451,10 @@ def main():
         try:
             extra["calibration"] = _cached(
                 dev, "calibration_gpt124m_b8s1024",
-                ["bench.py", "benchmarks/calibrate.py",
+                ["benchmarks/calibrate.py",
                  "paddle_tpu/ops/pallas/flash_attention.py"],
-                lambda: _calibration(cfg, batch, seq))
+                lambda: _calibration(cfg, batch, seq),
+                src_fns=(_calibration,))
         except Exception as e:
             print(f"calibration failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
@@ -444,26 +462,40 @@ def main():
         # secondary models — leaving them resident OOMs ResNet50/BERT
         del w, train_step, model, opt
         gc.collect()
-        for name, files, fn in (
+        for name, files, fn, src in (
             ("secondary_resnet50",
-             ["bench.py", "benchmarks/calibrate.py",
+             ["benchmarks/calibrate.py",
               "paddle_tpu/vision/models/resnet.py",
               "paddle_tpu/nn/functional/conv.py"],
-             lambda: _bench_resnet50(peak)),
+             lambda: _bench_resnet50(peak), (_bench_resnet50,)),
             ("secondary_bert",
-             ["bench.py", "paddle_tpu/models/bert.py",
+             ["paddle_tpu/models/bert.py",
               "paddle_tpu/ops/pallas/flash_attention.py",
               "paddle_tpu/distributed/fleet/recompute.py"],
-             lambda: _bench_bert(peak)),
+             lambda: _bench_bert(peak), (_bench_bert,)),
         ):
             try:
-                row = _cached(dev, name, files, fn)
+                row = _cached(dev, name, files, fn, src_fns=src)
                 extra.setdefault("secondary", {})[row["metric"]] = {
                     k: v for k, v in row.items() if k != "metric"}
             except Exception as e:  # secondary must never kill the bench
                 print(f"secondary bench failed: {type(e).__name__}: {e}",
                       file=sys.stderr)
             gc.collect()
+        try:
+            # serving rows are measured separately (benchmarks/
+            # serving_bench.py, run on the chip outside the bench's
+            # time budget) and embedded from the cache here
+            import serving_bench
+            srows = serving_bench.cached_rows(dev)
+            if srows:
+                extra["serving"] = {
+                    k: {"ms_per_token": v["ms_per_token"],
+                        "tokens_per_sec": v["tokens_per_sec"],
+                        "kv_cache": v["kv_cache"], "batch": v["batch"]}
+                    for k, v in srows.items()}
+        except Exception as e:
+            print(f"serving rows unavailable: {e}", file=sys.stderr)
 
     # full evidence: to stdout (NOT last) and to a persisted file that
     # survives regardless of how the driver captures stdout
@@ -479,7 +511,17 @@ def main():
 
 if __name__ == "__main__":
     try:
-        main()
+        try:
+            main()
+        except Exception as e:
+            # the axon remote-compile tunnel drops long requests
+            # transiently ("response body closed before all bytes were
+            # read", observed twice in r5); one retry usually clears it
+            if "remote_compile" not in str(e):
+                raise
+            print(f"transient compile-tunnel failure, retrying: {e}",
+                  file=sys.stderr)
+            main()
     except Exception as e:  # still emit a parseable line on failure
         print(json.dumps({
             "metric": "gpt124m_train_tokens_per_sec_per_chip",

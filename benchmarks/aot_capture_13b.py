@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""AOT-lower the FRAMEWORK-CAPTURED GPT-13B train step on 32 virtual
+devices (VERDICT r4 item 9: prove the real capture path, not a twin).
+
+Unlike ``aot_gpt13b.py`` (a hand-written scan transformer over explicit
+param pytrees), this drives the REAL user path at 13B scale:
+
+    with paddle.LazyGuard():                 # abstract params, no RAM
+        model = GPTForCausalLM(cfg_13b)
+    shard_gpt(model, mesh, dp, mp)           # GSPMD annotations on SDS
+    amp.decorate(O2, master_weight=True)     # abstract retype to bf16
+    DygraphShardingOptimizer(AdamW, stage=1) # ZeRO-1 moments+master
+    jit.aot_lower(train_step, ids, labels)   # discovery capture, abstract
+
+What this proves that the twin cannot: the to_static discovery tracker,
+autograd tape, AMP decoration, shard_gpt annotations and the ZeRO
+in-trace constraints all survive 13B-scale tracing — no constant bloat
+(a single materialized weight would be 100+ MB in the HLO), no sharding
+loss (asserted on the lowered input avals), and the compiled step's
+per-device residency fits v5e HBM.
+
+Residency accounting note: optimizer moments / fp32 master weights are
+CREATED by this first-step program (zeros/cast inside the trace), so
+they are outputs, not donated inputs — same per-device residency as the
+steady state, where they alias as donated input/output pairs.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+V5E_HBM = 16 * 1024 ** 3
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.amp as amp
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet.sharding_optimizer import \
+        DygraphShardingOptimizer
+    from paddle_tpu.distributed.fleet.topology import \
+        HybridCommunicateGroup
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       shard_gpt)
+
+    n, dp, mp = 32, 4, 8
+    assert len(jax.devices()) >= n, "needs 32 virtual devices"
+    cfg = GPTConfig(vocab_size=50304, hidden_size=5120, num_layers=40,
+                    num_heads=40, max_seq_len=2048, dropout=0.0,
+                    recompute=True, use_flash_attention=False)
+    t0 = time.time()
+    with paddle.LazyGuard():
+        model = GPTForCausalLM(cfg)
+    t_build = time.time() - t0
+    mesh = dist.ProcessMesh(np.arange(n).reshape(dp, mp), ["dp", "mp"])
+    shard_gpt(model, mesh, dp_axis="dp", mp_axis="mp")
+    model.train()
+    opt_inner = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                       parameters=model.parameters())
+    model, opt_inner = amp.decorate(models=model, optimizers=opt_inner,
+                                    level="O2", dtype="bfloat16",
+                                    master_weight=True)
+    # ZeRO-1 over dp for moments + fp32 master (in-trace constraints);
+    # hcg device order (1,1,dp,1,mp) == ProcessMesh (dp, mp) row-major
+    hcg = HybridCommunicateGroup(dp_degree=1, pp_degree=1,
+                                 sharding_degree=dp, sep_degree=1,
+                                 mp_degree=mp)
+    # rename compose base: the ZeRO axis in hcg is "sharding"; params
+    # are annotated over ("dp","mp") — compose falls back to free dims
+    opt = DygraphShardingOptimizer(opt_inner, hcg, stage=1)
+
+    def train_step(ids, labels):
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            loss = model(ids, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    batch, seq = 32, cfg.max_seq_len
+    ids = dist.shard_tensor(
+        np.zeros((batch, seq), np.int32), mesh,
+        [dist.Shard(0), dist.Replicate()])
+    labels = dist.shard_tensor(
+        np.zeros((batch, seq), np.int32), mesh,
+        [dist.Shard(0), dist.Replicate()])
+
+    t0 = time.time()
+    lowered = paddle.jit.aot_lower(train_step, ids, labels)
+    t_lower = time.time() - t0
+
+    # sharding-loss check: TP'd weight inputs must still carry "mp"
+    mp_in = sum("mp" in str(getattr(getattr(a, "sharding", None),
+                                    "spec", ""))
+                for a in jax.tree_util.tree_leaves(lowered.in_avals))
+    assert mp_in >= 4 * cfg.num_layers, \
+        f"TP sharding lost in lowering: only {mp_in} mp-sharded inputs"
+
+    # constant-bloat check: no materialized weight in the HLO (a single
+    # fp32 5120x5120 constant is 100 MB of MLIR text)
+    text_len = len(lowered.as_text())
+    assert text_len < 200 * 1024 * 1024, \
+        f"suspicious HLO size {text_len} — constant bloat?"
+
+    t0 = time.time()
+    compiled = lowered.compile()
+    t_compile = time.time() - t0
+    mem = compiled.memory_analysis()
+    resident = None
+    if mem:
+        resident = mem.peak_memory_in_bytes + mem.argument_size_in_bytes
+    print(f"13B CAPTURE lowered+compiled: build {t_build:.1f}s, "
+          f"trace+lower {t_lower:.1f}s, compile {t_compile:.1f}s, "
+          f"hlo {text_len/1e6:.1f} MB, "
+          f"resident/device {resident/1024**3 if resident else -1:.2f} "
+          f"GiB (v5e HBM 16 GiB)", flush=True)
+    assert resident is not None and resident < V5E_HBM, \
+        f"captured 13B step does not fit v5e HBM: {resident}"
+    print("AOT CAPTURE 13B OK")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Serving measurements (VERDICT r4 items 3/8): ms/token for windowed
+decode with dense and paged KV caches, plus a multi-request
+batched-decode row over the page pools (the continuous-batching
+precursor). Reference bar: the fused serving kernels
+``paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu``
+and ``masked_multihead_attention_kernel.cu`` (SURVEY C12/C13).
+
+Results persist via benchmarks/measured_cache.py and surface as a
+compact ``serving`` entry in bench.py's enriched record and in
+BASELINE.md. Run standalone on the real chip:
+
+    PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/serving_bench.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault(
+    "PDTPU_CACHE_DIR", os.path.join(_REPO, "benchmarks", "measured"))
+
+
+def _build_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                    num_heads=12, max_seq_len=2048, dropout=0.0)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return cfg, model
+
+
+def measure():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.generation import generate
+
+    cfg, model = _build_model()
+    rng = np.random.default_rng(0)
+    rows = {}
+
+    def run(name, batch, prompt_len, new_tokens, kv, window):
+        ids = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size,
+                         (batch, prompt_len)).astype(np.int32))
+        kw = dict(max_new_tokens=new_tokens, temperature=0.0,
+                  kv_cache=kv, decode_window=window)
+        out = generate(model, ids, **kw)       # compile + warm
+        np.asarray(out._read())
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = generate(model, ids, **kw)
+            np.asarray(out._read())            # full sync readback
+            best = min(best, time.perf_counter() - t0)
+        ms_tok = best * 1e3 / new_tokens
+        rows[name] = {
+            "batch": batch, "prompt_len": prompt_len,
+            "new_tokens": new_tokens, "kv_cache": kv,
+            "decode_window": window,
+            "ms_per_token": round(ms_tok, 2),
+            "tokens_per_sec": round(batch * new_tokens / best, 1),
+            "wall_s": round(best, 3),
+        }
+        print(f"{name}: {ms_tok:.2f} ms/token "
+              f"({rows[name]['tokens_per_sec']} tok/s)",
+              file=sys.stderr, flush=True)
+
+    # single-request latency rows (the r4 commit's claimed measurement,
+    # now recorded): 128-token prompt, 64 new tokens, windowed decode
+    run("dense_b1", 1, 128, 64, "dense", 16)
+    run("paged_b1", 1, 128, 64, "paged", 16)
+    # multi-request batched decode over the page pools: 8 concurrent
+    # sequences through one compiled windowed-decode program — the
+    # static precursor of continuous batching (per-sequence block
+    # tables already admit ragged lengths)
+    run("paged_b8", 8, 128, 64, "paged", 16)
+    # long-context serving check: 1024-token prompt, paged
+    run("paged_b1_long", 1, 1024, 64, "paged", 16)
+    return rows
+
+
+FILES = ["benchmarks/serving_bench.py",
+         "paddle_tpu/models/generation.py",
+         "paddle_tpu/ops/pallas/paged_attention.py",
+         "paddle_tpu/ops/pallas/flash_attention.py"]
+
+
+def cached_rows(dev):
+    """Previously measured serving rows for this device kind, or None
+    (bench.py embeds these without re-measuring)."""
+    import measured_cache as mc
+    kind = str(getattr(dev, "device_kind", dev.platform))
+    return mc.load(kind, "serving", mc.code_version(*FILES))
+
+
+def main():
+    import jax
+
+    import measured_cache as mc
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        print("serving_bench: not on TPU; skipping", file=sys.stderr)
+        return 0
+    kind = str(getattr(dev, "device_kind", dev.platform))
+    ver = mc.code_version(*FILES)
+    rows = mc.load(kind, "serving", ver)
+    if rows is None:
+        rows = measure()
+        mc.store(kind, "serving", ver, rows)
+    print(json.dumps({"serving": rows}, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

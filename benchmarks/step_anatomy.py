@@ -238,8 +238,104 @@ def main():
         finally:
             nl.LayerNorm.forward = orig_ln
 
+    # ----------------------------------------------------- ResNet50 --
+    # VERDICT r5 item 2: conv is only ~5 ms of the 25 ms step (the r4
+    # calibration refuted the MXU-underfill excuse) — locate the other
+    # ~20 ms: BN? optimizer? data movement?
+    def build_resnet(opt_kind="momentum"):
+        from paddle_tpu.vision.models import resnet50
+        paddle.seed(0)
+        model = resnet50(num_classes=1000)
+        model.train()
+        if opt_kind == "momentum":
+            opt = paddle.optimizer.Momentum(
+                learning_rate=0.1, momentum=0.9,
+                parameters=model.parameters())
+        else:
+            opt = None
+        if opt is not None:
+            model, opt = amp.decorate(models=model, optimizers=opt,
+                                      level="O2", dtype="bfloat16",
+                                      master_weight=True)
+        else:
+            model = amp.decorate(models=model, level="O2",
+                                 dtype="bfloat16")
+        return model, opt
+
+    rbatch = 32
+
+    def rbatch_fn():
+        x = rng.normal(size=(rbatch, 3, 224, 224)).astype(np.float32)
+        y = rng.integers(0, 1000, (rbatch,)).astype(np.int64)
+        return paddle.to_tensor(x), paddle.to_tensor(y)
+
+    def resnet_step(model, opt):
+        @paddle.jit.to_static
+        def step(x, y):
+            with amp.auto_cast(level="O2", dtype="bfloat16"):
+                loss = paddle.nn.functional.cross_entropy(model(x), y)
+            loss.backward()
+            if opt is not None:
+                opt.step()
+                opt.clear_grad()
+            return loss
+        return step
+
+    def run_resnet(name, model, opt):
+        step = resnet_step(model, opt)
+        ms = _window_time(step, rbatch_fn, K=6) * 1e3
+        results[name] = round(ms, 2)
+        print(f"{name}: {ms:.2f} ms/step", file=sys.stderr, flush=True)
+        gc.collect()
+
+    if "resnet_full" in variants:
+        model, opt = build_resnet()
+        run_resnet("resnet_full", model, opt)
+        del model, opt
+
+    if "resnet_bn_off" in variants:
+        from paddle_tpu.nn import layers as nl
+        orig_bn = nl.BatchNorm2D.forward
+        nl.BatchNorm2D.forward = lambda self, x: x
+        try:
+            model, opt = build_resnet()
+            run_resnet("resnet_bn_off", model, opt)
+            del model, opt
+        finally:
+            nl.BatchNorm2D.forward = orig_bn
+
+    if "resnet_opt_off" in variants:
+        model, opt = build_resnet(opt_kind="none")
+        run_resnet("resnet_opt_off", model, opt)
+        del model, opt
+
+    if "resnet_fwd_only" in variants:
+        model, _ = build_resnet(opt_kind="none")
+        model.eval()
+
+        @paddle.jit.to_static
+        def fwd(x, y):
+            with amp.auto_cast(level="O2", dtype="bfloat16"):
+                loss = paddle.nn.functional.cross_entropy(model(x), y)
+            return loss
+        ms = _window_time(fwd, rbatch_fn, K=6) * 1e3
+        results["resnet_fwd_only"] = round(ms, 2)
+        print(f"resnet_fwd_only: {ms:.2f} ms/step", file=sys.stderr,
+              flush=True)
+        del model, fwd
+        gc.collect()
+
     # derived attributions
     d = {}
+    if "resnet_full" in results and "resnet_bn_off" in results:
+        d["resnet_bn_ms"] = round(
+            results["resnet_full"] - results["resnet_bn_off"], 2)
+    if "resnet_full" in results and "resnet_opt_off" in results:
+        d["resnet_momentum_ms"] = round(
+            results["resnet_full"] - results["resnet_opt_off"], 2)
+    if "resnet_full" in results and "resnet_fwd_only" in results:
+        d["resnet_bwd_plus_opt_ms"] = round(
+            results["resnet_full"] - results["resnet_fwd_only"], 2)
     if "full" in results and "sgd" in results:
         d["adamw_minus_sgd_ms"] = round(results["full"] - results["sgd"], 2)
     if "full" in results and "mean_loss" in results:

@@ -21,6 +21,7 @@ from .ops import creation as _creation
 
 # framework-level helpers (paddle.* parity)
 from .core.state import seed, get_flags, set_flags  # noqa: F401
+from .core.lazy import LazyGuard  # noqa: F401
 
 from . import ops  # noqa: F401
 from . import nn  # noqa: F401

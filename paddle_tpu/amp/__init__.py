@@ -141,7 +141,15 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
                     if p is None:
                         continue
                     v = p._read()
-                    if jnp.issubdtype(v.dtype, jnp.floating):
+                    if not jnp.issubdtype(v.dtype, jnp.floating):
+                        continue
+                    import jax
+                    if isinstance(v, jax.ShapeDtypeStruct):
+                        # lazy (LazyGuard) parameter: retype abstractly
+                        p._write(jax.ShapeDtypeStruct(
+                            v.shape, low,
+                            sharding=getattr(v, "sharding", None)))
+                    else:
                         p._write(v.astype(low))
     if optimizers is None:
         return models if single_model else model_list

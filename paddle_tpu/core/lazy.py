@@ -1,0 +1,53 @@
+"""LazyGuard — deferred (abstract) parameter initialization.
+
+Capability analog of ``paddle.LazyGuard`` (reference
+``python/paddle/nn/initializer/lazy_init.py``): layers built under the
+guard allocate NO real storage — parameters carry only shape/dtype (a
+``jax.ShapeDtypeStruct``), plus a sharding once annotated. TPU-native
+purpose: author a model whose full parameter set exceeds host memory,
+pin its GSPMD shardings (``shard_gpt`` etc.), and AOT-lower the real
+captured train step with :func:`paddle_tpu.jit.aot_lower` — abstract
+inputs, no execution — for scale validation and compile-cache priming.
+
+A lazy tensor cannot be computed with eagerly; any op on it raises when
+jax tries to treat the ShapeDtypeStruct as a value. That mirrors the
+reference, where lazy parameters hold no value until ``initialize``.
+"""
+from __future__ import annotations
+
+import weakref
+
+_active = False
+_registry: "weakref.WeakSet" = weakref.WeakSet()
+
+
+class LazyGuard:
+    """Context manager: parameters created inside are abstract."""
+
+    def __enter__(self):
+        global _active
+        self._prev = _active
+        _active = True
+        return self
+
+    def __exit__(self, *exc):
+        global _active
+        _active = self._prev
+        return False
+
+
+def in_lazy_mode() -> bool:
+    return _active
+
+
+def register(t) -> None:
+    """Track a lazily-created tensor (jit.aot_lower enumerates these to
+    turn them into abstract program inputs)."""
+    _registry.add(t)
+
+
+def lazy_tensors():
+    """Live lazily-created tensors whose data is still abstract."""
+    import jax
+    return [t for t in _registry
+            if isinstance(getattr(t, "_data", None), jax.ShapeDtypeStruct)]

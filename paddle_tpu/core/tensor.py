@@ -43,7 +43,15 @@ class Tensor:
         if isinstance(data, Tensor):
             data = data._read()
         dtype = convert_dtype(dtype)
-        if not isinstance(data, jax.Array) and not isinstance(
+        if isinstance(data, jax.ShapeDtypeStruct):
+            # lazy (LazyGuard) tensor: abstract shape/dtype, no storage
+            if dtype is not None and data.dtype != jnp.dtype(dtype):
+                data = jax.ShapeDtypeStruct(
+                    data.shape, jnp.dtype(dtype),
+                    sharding=getattr(data, "sharding", None))
+            from . import lazy as _lazy
+            _lazy.register(self)
+        elif not isinstance(data, jax.Array) and not isinstance(
                 data, jax.core.Tracer):
             if dtype is None and isinstance(data, (float, list)) :
                 arr = np.asarray(data)

@@ -302,7 +302,11 @@ def _as_dist_param(p: Tensor, mesh, placements) -> Parameter:
             placements = _normalize_placements(mesh, placements)
         spec = _to_partition_spec(mesh, placements)
     v = p._read()
-    if not isinstance(v, jax.core.Tracer):
+    if isinstance(v, jax.ShapeDtypeStruct):
+        # lazy (LazyGuard) parameter: annotate the abstract value
+        v = jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                 sharding=NamedSharding(jmesh, spec))
+    elif not isinstance(v, jax.core.Tracer):
         v = jax.device_put(v, NamedSharding(jmesh, spec))
     # mutate in place so optimizer param identity is preserved
     p._write(v)

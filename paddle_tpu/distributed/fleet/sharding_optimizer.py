@@ -12,7 +12,11 @@ reduce-scatter/all-gather pairs inside the compiled step — the
 ZeRO on TPU.
 
 Stage semantics:
-- stage 1: accumulators sharded (dim-0) over the sharding axis.
+- stage 1: accumulators sharded over the sharding axis (on the first
+  free divisible dim, COMPOSED with any sharding the state already
+  carries — a pipeline-stacked weight keeps its pp dim, TP weights
+  their mp dim). Under jit capture the sharding is applied as
+  ``with_sharding_constraint`` inside the compiled step.
 - stage 2: + gradients resharded before the update.
 - stage 3: + parameters stored sharded; all-gather happens inside forward
   (XLA inserts it where the full weight is consumed).
@@ -27,12 +31,72 @@ from ...core.tensor import Tensor
 from .topology import HybridCommunicateGroup
 
 
-def _shard0_spec(shape, axis_name, axis_size):
-    """Shard along dim 0 when divisible; replicate otherwise (the reference
-    likewise keeps non-divisible small params unsharded)."""
-    if len(shape) > 0 and shape[0] % axis_size == 0 and shape[0] >= axis_size:
-        return P(axis_name)
-    return P()
+def _spec_names(spec):
+    names = set()
+    for s in spec:
+        if s is None:
+            continue
+        names.update(s if isinstance(s, (tuple, list)) else (s,))
+    return names
+
+
+def _compose_parts(shape, cur, own_mesh, fallback_mesh, axis_name):
+    """Core of the compose: given an existing partial spec ``cur`` over
+    ``own_mesh``, pick the first free divisible dim for ``axis_name``.
+    None = leave as is."""
+    cur = tuple(cur) + (None,) * (len(shape) - len(cur))
+    names = _spec_names(cur)
+    if axis_name in names:
+        return None                       # already ZeRO-sharded
+    if names:
+        mesh = (own_mesh if own_mesh is not None
+                and axis_name in getattr(own_mesh, "axis_names", ())
+                else fallback_mesh)
+        if (axis_name not in mesh.axis_names
+                or not names <= set(mesh.axis_names)):
+            return None                   # cannot express the compose
+    else:
+        mesh = fallback_mesh
+        if axis_name not in mesh.axis_names:
+            return None
+    size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    if size <= 1:
+        return None
+    for d in range(len(shape)):
+        if cur[d] is None and shape[d] % size == 0 and shape[d] >= size:
+            new = list(cur)
+            new[d] = axis_name
+            return mesh, P(*new)
+    return None
+
+
+def _compose_target(v, fallback_mesh, axis_name):
+    """(mesh, spec) pinning ``v`` Shard over ``axis_name`` COMPOSED with
+    any sharding it already carries (a pipeline-stacked weight is
+    Shard('pp') on dim 0 and TP-sharded elsewhere — ZeRO must take a
+    remaining dim, not fight those axes). None = leave as is."""
+    sh = getattr(v, "sharding", None)
+    return _compose_parts(v.shape, getattr(sh, "spec", None) or (),
+                          getattr(sh, "mesh", None), fallback_mesh,
+                          axis_name)
+
+
+def _param_spec_parts(p):
+    """(spec, mesh) annotated on a parameter — readable even when its
+    value is a tracer (jit capture) via the ``_dist`` annotation."""
+    dist = getattr(p, "_dist", None) if p is not None else None
+    if not dist:
+        return (), None
+    mesh, placements = dist
+    try:
+        from ..auto_parallel.api import (ProcessMesh, _to_partition_spec)
+        jmesh = mesh.jmesh if isinstance(mesh, ProcessMesh) else mesh
+        if isinstance(placements, P):
+            return tuple(placements), jmesh
+        spec = _to_partition_spec(mesh, placements)
+        return tuple(spec), jmesh
+    except Exception:
+        return (), None
 
 
 class DygraphShardingOptimizer:
@@ -62,27 +126,67 @@ class DygraphShardingOptimizer:
                 continue
             v = g._read()
             if isinstance(v, jax.core.Tracer):
+                cur, own = _param_spec_parts(p)
+                tgt = _compose_parts(v.shape, cur, own, self._mesh,
+                                     self._axis)
+                if tgt is not None:
+                    mesh, spec = tgt
+                    g._write(jax.lax.with_sharding_constraint(
+                        v, NamedSharding(mesh, spec)))
                 continue
-            spec = _shard0_spec(v.shape, self._axis, self._n)
-            g._write(jax.device_put(v, NamedSharding(self._mesh, spec)))
+            tgt = _compose_target(v, self._mesh, self._axis)
+            if tgt is not None:
+                mesh, spec = tgt
+                g._write(jax.device_put(v, NamedSharding(mesh, spec)))
 
     def _shard_accumulators(self):
+        for _pid, acc in self._state_items():
+            v = acc._read()
+            if isinstance(v, jax.core.Tracer) or acc.is_dist():
+                continue
+            tgt = _compose_target(v, self._mesh, self._axis)
+            if tgt is not None:
+                mesh, spec = tgt
+                acc._write(jax.device_put(
+                    v, NamedSharding(mesh, spec)))
+                acc._dist = (mesh, spec)
+
+    def _state_items(self):
+        items = []
         for store in self._inner._accumulators.values():
-            for acc in store.values():
-                v = acc._read()
-                if isinstance(v, jax.core.Tracer) or acc.is_dist():
-                    continue
-                spec = _shard0_spec(v.shape, self._axis, self._n)
-                if spec != P():
-                    acc._write(jax.device_put(
-                        v, NamedSharding(self._mesh, spec)))
-                    acc._dist = (self._mesh, spec)
+            items.extend(store.items())
+        items.extend(getattr(self._inner, "_master_weights", {}).items())
+        return items
+
+    def _constrain_state_in_trace(self):
+        """Under jit capture the accumulators / master weights hold
+        tracers: apply ZeRO as ``with_sharding_constraint`` so the
+        sharding lives INSIDE the compiled step (the GSPMD
+        weight-update-sharding recipe). The compose base comes from the
+        owning parameter's ``_dist`` annotation (a tracer carries no
+        sharding to read)."""
+        by_id = {id(p): p for p in self._parameter_list}
+        for pid, acc in self._state_items():
+            v = acc._read()
+            if not isinstance(v, jax.core.Tracer):
+                continue
+            cur, own = _param_spec_parts(by_id.get(pid))
+            tgt = _compose_parts(v.shape, cur, own, self._mesh,
+                                 self._axis)
+            if tgt is not None:
+                mesh, spec = tgt
+                acc._write(jax.lax.with_sharding_constraint(
+                    v, NamedSharding(mesh, spec)))
 
     def step(self):
         if self._n > 1 and self.stage >= 2:
             self._reshard_grads()
         self._inner.step()
         if self._n > 1:
+            # discovery/eager values are real (device_put path); the
+            # replay and AOT traces see tracers (constraint path) —
+            # each helper skips the other's case
+            self._constrain_state_in_trace()
             self._shard_accumulators()
 
     def minimize(self, loss, *a, **k):
@@ -116,13 +220,13 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
     hcg = get_hybrid_communicate_group() or init()
     opt = DygraphShardingOptimizer(optimizer, hcg, stage=stage)
     if stage >= 3:
-        mesh, n = hcg.mesh, hcg.get_sharding_parallel_world_size()
         for p in model.parameters():
             v = p._read()
             if isinstance(v, jax.core.Tracer) or p.is_dist():
                 continue
-            spec = _shard0_spec(v.shape, "sharding", n)
-            if spec != P():
+            tgt = _compose_target(v, hcg.mesh, "sharding")
+            if tgt is not None:
+                mesh, spec = tgt
                 p._write(jax.device_put(v, NamedSharding(mesh, spec)))
                 p._dist = (mesh, spec)
     return model, opt, scaler

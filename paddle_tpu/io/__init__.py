@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import os
 import queue as _queue
 import threading
 
@@ -348,8 +349,12 @@ class DataLoader:
                  persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
+        self._user_collate = collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -386,8 +391,39 @@ class DataLoader:
         for idx_batch in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
+    def _mp_iter(self):
+        """Forked worker processes + shared-memory handoff (reference
+        ``io/reader.py:216`` / ``io/dataloader/worker.py``): GIL-free
+        AND crash-isolated — a worker dying in Dataset code raises in
+        the trainer instead of killing it. Workers are jax-free; numpy
+        nests come back and are wrapped into Tensors here."""
+        from .worker import MPBatchLoader, np_collate
+        collate = self._user_collate or np_collate
+        if self._iterable_mode:
+            src = MPBatchLoader(
+                self.dataset, collate, self.num_workers,
+                worker_init_fn=self.worker_init_fn, timeout=self.timeout,
+                iterable=True, batch_size=self.batch_size,
+                drop_last=self.drop_last).run_iterable()
+        elif self.batch_sampler is None:
+            src = MPBatchLoader(
+                self.dataset, lambda b: b[0], self.num_workers,
+                worker_init_fn=self.worker_init_fn,
+                timeout=self.timeout).run(
+                    [[i] for i in range(len(self.dataset))])
+        else:
+            src = MPBatchLoader(
+                self.dataset, collate, self.num_workers,
+                worker_init_fn=self.worker_init_fn,
+                timeout=self.timeout).run(list(self.batch_sampler))
+        to_tensor = self._user_collate is None
+        for item in src:
+            yield _wrap_np_nest(item) if to_tensor else item
+
     def __iter__(self):
         if self.num_workers and self.num_workers > 0:
+            if self.use_shared_memory and hasattr(os, "fork"):
+                return self._mp_iter()
             depth = max(2, self.prefetch_factor * self.num_workers)
             return iter(_Prefetcher(self._produce, depth))
         return self._produce()
@@ -400,5 +436,20 @@ class DataLoader:
         return len(self.batch_sampler)
 
 
+def _wrap_np_nest(obj):
+    """Worker-produced numpy nest -> the Tensor nest default_collate_fn
+    would have built in-process."""
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, dict):
+        return {k: _wrap_np_nest(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_wrap_np_nest(v) for v in obj]
+    return obj
+
+
 def get_worker_info():
-    return None
+    """Reference ``paddle.io.get_worker_info``: inside a forked
+    DataLoader worker, its (id, num_workers, dataset); else None."""
+    from .worker import get_worker_info as _g
+    return _g()

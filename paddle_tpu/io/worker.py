@@ -1,0 +1,335 @@
+"""Multiprocess DataLoader workers with shared-memory batch handoff.
+
+Capability analog of the reference's forked-worker DataLoader
+(``python/paddle/io/reader.py:216``, ``io/dataloader/worker.py``):
+``num_workers > 0`` with ``use_shared_memory=True`` forks worker
+processes that run ``Dataset.__getitem__`` + collate OUTSIDE the GIL
+and outside the trainer process (a crash in user data code cannot take
+down training — the loader raises instead), handing finished batches
+back through ``multiprocessing.shared_memory`` blocks (one tiny pipe
+message per batch; array bytes never pass through a pipe).
+
+TPU-specific rule (the analog of the reference's "no CUDA in forked
+workers"): workers must not touch jax — a forked child inheriting the
+process's TPU claim would wedge the chip. Batches are therefore
+collated with a NUMPY-ONLY collate in the worker and wrapped into
+framework Tensors on the trainer side. A custom ``collate_fn`` runs in
+the worker and must stay numpy-pure.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import pickle
+import queue as pyqueue
+import traceback
+from multiprocessing import shared_memory
+
+import numpy as np
+
+__all__ = ["WorkerInfo", "get_worker_info", "MPBatchLoader"]
+
+_worker_info = None
+
+
+class WorkerInfo:
+    """Reference ``get_worker_info()`` result: id / num_workers /
+    dataset as seen inside a worker process."""
+
+    def __init__(self, wid, num_workers, dataset):
+        self.id = wid
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+    def __repr__(self):
+        return f"WorkerInfo(id={self.id}, num_workers={self.num_workers})"
+
+
+def get_worker_info():
+    """Inside a worker: its WorkerInfo; in the trainer process: None."""
+    return _worker_info
+
+
+def np_collate(batch):
+    """Numpy-only mirror of default_collate_fn (jax-free: safe in
+    forked workers)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: np_collate([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return [np_collate(list(items)) for items in zip(*batch)]
+    # datasets returning framework Tensors worked through the threaded
+    # path and must keep working: pull the host value. (Creating
+    # tensors inside a worker is the user touching jax there — same
+    # standing as the reference's no-CUDA-in-workers rule.)
+    try:
+        from ..core.tensor import Tensor
+        if isinstance(sample, Tensor):
+            return np.stack([np.asarray(s._read()) for s in batch])
+    except ImportError:
+        pass
+    raise TypeError(
+        f"multiprocess DataLoader cannot collate {type(sample)} in a "
+        "worker (return numpy/scalars/Tensors from "
+        "Dataset.__getitem__, or use num_workers=0)")
+
+
+def _encode(obj):
+    """Batch nest -> picklable description; ndarray payloads move via
+    shared memory (worker side keeps no reference)."""
+    if isinstance(obj, np.ndarray) and obj.nbytes > 0:
+        shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        dst = np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)
+        dst[...] = obj
+        name = shm.name
+        shm.close()
+        # ownership transfers to the consumer (it unlinks after copy);
+        # drop the creator-side tracker registration or every segment
+        # is double-reported at worker exit
+        try:
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except Exception:
+            pass
+        return ("shm", name, obj.shape, str(obj.dtype))
+    if isinstance(obj, np.ndarray):
+        return ("np", obj)
+    if isinstance(obj, dict):
+        return ("dict", {k: _encode(v) for k, v in obj.items()})
+    if isinstance(obj, (list, tuple)):
+        return (type(obj).__name__, [_encode(v) for v in obj])
+    return ("c", obj)
+
+
+def _decode(desc):
+    kind = desc[0]
+    if kind == "shm":
+        _, name, shape, dtype = desc
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            arr = np.ndarray(shape, np.dtype(dtype),
+                             buffer=shm.buf).copy()
+        finally:
+            shm.close()
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+        return arr
+    if kind == "np":
+        return desc[1]
+    if kind == "dict":
+        return {k: _decode(v) for k, v in desc[1].items()}
+    if kind == "list":
+        return [_decode(v) for v in desc[1]]
+    if kind == "tuple":
+        return tuple(_decode(v) for v in desc[1])
+    return desc[1]
+
+
+def _worker_loop(dataset, collate, task_q, result_q, wid, num_workers,
+                 worker_init_fn):
+    global _worker_info
+    _worker_info = WorkerInfo(wid, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+    while True:
+        task = task_q.get()
+        if task is None:
+            return
+        seq, idxs = task
+        try:
+            batch = collate([dataset[i] for i in idxs])
+            result_q.put((seq, "ok", _encode(batch)))
+        except Exception:
+            result_q.put((seq, "err", traceback.format_exc()))
+
+
+def _iterable_worker_loop(dataset, collate, batch_size, drop_last,
+                          result_q, wid, num_workers, worker_init_fn):
+    """Iterable datasets: EVERY worker streams the full dataset unless
+    the dataset shards itself via ``get_worker_info()`` — the
+    reference/torch contract (a dataset that ignores worker info is
+    replicated num_workers times, exactly as there). The loader must
+    not also stride, or a sharding dataset would lose data."""
+    global _worker_info
+    _worker_info = WorkerInfo(wid, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+    try:
+        batch = []
+        for item in dataset:
+            if batch_size is None:
+                result_q.put((None, "ok", _encode(item)))
+                continue
+            batch.append(item)
+            if len(batch) == batch_size:
+                result_q.put((None, "ok", _encode(collate(batch))))
+                batch = []
+        if batch and not drop_last:
+            result_q.put((None, "ok", _encode(collate(batch))))
+        result_q.put((None, "done", wid))
+    except Exception:
+        result_q.put((None, "err", traceback.format_exc()))
+
+
+class MPBatchLoader:
+    """Forked worker pool streaming collated batches, in order for
+    map-style datasets (a reorder buffer keyed by sequence number) and
+    in arrival order for iterable ones."""
+
+    def __init__(self, dataset, collate_fn, num_workers,
+                 worker_init_fn=None, timeout=0, iterable=False,
+                 batch_size=None, drop_last=False):
+        self._ctx = mp.get_context("fork")
+        self._dataset = dataset
+        self._collate = collate_fn
+        self._n = int(num_workers)
+        self._init_fn = worker_init_fn
+        # 0/None = no deadline (reference semantics); dead workers are
+        # detected by liveness polling either way
+        self._timeout = timeout if timeout else None
+        self._iterable = iterable
+        self._batch_size = batch_size
+        self._drop_last = drop_last
+
+    # ---------------------------------------------------- map-style --
+    def run(self, index_batches):
+        task_q = self._ctx.SimpleQueue()
+        result_q = self._ctx.SimpleQueue()
+        workers = [
+            self._ctx.Process(
+                target=_worker_loop,
+                args=(self._dataset, self._collate, task_q, result_q,
+                      w, self._n, self._init_fn),
+                daemon=True)
+            for w in range(self._n)]
+        for w in workers:
+            w.start()
+        pending = {}
+        try:
+            # bounded in-flight window: enqueue interleaved with
+            # draining — enqueueing everything first deadlocks once the
+            # task/result pipes fill (workers block on put, main on put)
+            it = iter(index_batches)
+            in_flight, want, next_seq, exhausted = 0, 0, 0, False
+            while True:
+                while not exhausted and in_flight < 2 * self._n + 2:
+                    task = next(it, None)
+                    if task is None:
+                        exhausted = True
+                        for _ in workers:
+                            task_q.put(None)
+                        break
+                    task_q.put((next_seq, list(task)))
+                    next_seq += 1
+                    in_flight += 1
+                if exhausted and in_flight == 0:
+                    return
+                seq, status, payload = self._get(result_q, workers)
+                in_flight -= 1
+                if status == "err":
+                    raise RuntimeError(
+                        f"DataLoader worker failed:\n{payload}")
+                pending[seq] = payload
+                while want in pending:
+                    yield _decode(pending.pop(want))
+                    want += 1
+        finally:
+            self._teardown(workers, result_q, pending)
+
+    # ----------------------------------------------------- iterable --
+    def run_iterable(self):
+        result_q = self._ctx.SimpleQueue()
+        workers = [
+            self._ctx.Process(
+                target=_iterable_worker_loop,
+                args=(self._dataset, self._collate, self._batch_size,
+                      self._drop_last, result_q, w, self._n,
+                      self._init_fn),
+                daemon=True)
+            for w in range(self._n)]
+        for w in workers:
+            w.start()
+        try:
+            live = self._n
+            while live:
+                _, status, payload = self._get(result_q, workers)
+                if status == "done":
+                    live -= 1
+                    continue
+                if status == "err":
+                    raise RuntimeError(
+                        f"DataLoader worker failed:\n{payload}")
+                yield _decode(payload)
+        finally:
+            self._teardown(workers, result_q, {})
+
+    # ------------------------------------------------------ plumbing --
+    def _get(self, result_q, workers):
+        """SimpleQueue has no timeout: poll the underlying reader so a
+        dead worker (segfault / os._exit in user code) surfaces as an
+        error instead of a hang."""
+        import time
+        deadline = (time.monotonic() + self._timeout
+                    if self._timeout else None)
+        while True:
+            if result_q._reader.poll(0.2):
+                return result_q.get()
+            dead = [w for w in workers
+                    if not w.is_alive() and w.exitcode not in (0, None)]
+            if dead:
+                codes = [w.exitcode for w in dead]
+                raise RuntimeError(
+                    f"DataLoader worker(s) died with exit code(s) "
+                    f"{codes} (crash in Dataset code is isolated from "
+                    f"the trainer process)")
+            if deadline is not None and time.monotonic() > deadline:
+                raise RuntimeError("DataLoader worker timed out")
+
+    def _teardown(self, workers, result_q, pending):
+        """Kill workers AND unlink every undelivered shared-memory
+        segment (the creator side unregistered from the resource
+        tracker, so an early `break` would otherwise leak /dev/shm
+        blocks until it fills)."""
+        for payload in pending.values():
+            _unlink_desc(payload)
+        pending.clear()
+        try:
+            while result_q._reader.poll(0.1):
+                item = result_q.get()
+                if item[1] == "ok":
+                    _unlink_desc(item[2])
+        except Exception:
+            pass
+        for w in workers:
+            if w.is_alive():
+                w.terminate()
+        for w in workers:
+            w.join(timeout=5)
+
+
+def _unlink_desc(desc):
+    """Release the shared memory of an undelivered encoded batch."""
+    kind = desc[0]
+    if kind == "shm":
+        try:
+            shm = shared_memory.SharedMemory(name=desc[1])
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+    elif kind == "dict":
+        for v in desc[1].values():
+            _unlink_desc(v)
+    elif kind in ("list", "tuple"):
+        for v in desc[1]:
+            _unlink_desc(v)

@@ -474,6 +474,78 @@ class StaticFunction:
             return "<source unavailable>"
 
 
+def aot_lower(fn, *args, donate_state=True, **kwargs):
+    """Ahead-of-time lower ``fn``'s captured train-step program WITHOUT
+    executing it: the same discovery capture as ``to_static`` runs with
+    abstract values, so LazyGuard-built models lower at scales whose
+    real parameters exceed host memory (the 13B-on-32-virtual-devices
+    proof runs the REAL ``GPTForCausalLM`` + ``shard_gpt`` capture, not
+    a hand-written twin). Returns a ``jax.stages.Lowered``;
+    ``.compile().memory_analysis()`` gives the per-device picture.
+
+    Inputs = explicit ``args`` tensors + every live lazy tensor
+    (shardings from their annotations). With ``donate_state`` the lazy
+    state written by the step (parameters under an optimizer update) is
+    donated, matching the executable path's buffer reuse. Tensors
+    CREATED inside (optimizer moments on their first step) lower as
+    outputs — same residency, but not yet aliased inputs as in the
+    steady-state program."""
+    import jax as _jax
+
+    from ..core import lazy as _lazy
+
+    if isinstance(fn, StaticFunction):
+        fn = fn._converted()
+    arg_tensors = _flatten_tensors((list(args), kwargs), [])
+    arg_ids = {id(t) for t in arg_tensors}
+    lazies = [t for t in _lazy.lazy_tensors() if id(t) not in arg_ids]
+    tensors = list(arg_tensors) + lazies
+
+    def spec_of(t):
+        v = t._data
+        if isinstance(v, _jax.ShapeDtypeStruct):
+            return v
+        sh = getattr(v, "sharding", None)
+        from jax.sharding import NamedSharding
+        return _jax.ShapeDtypeStruct(
+            jnp.shape(v), v.dtype,
+            sharding=sh if isinstance(sh, NamedSharding) else None)
+
+    specs = [spec_of(t) for t in tensors]
+    holder = {}
+
+    def drive(*vals):
+        saved = [(t, t._data, t._grad, t._node) for t in tensors]
+        for t, v in zip(tensors, vals):
+            t._data = v
+        d = _DiscoveryTracker()
+        old = tensor_mod.set_tracker(d)
+        try:
+            out = fn(*args, **kwargs)
+            ret_vals = [t._data for t in _flatten_tensors(out, [])]
+            written = [t for t in d.written.values()]
+            state_vals = [t._data for t in written]
+            holder["written_ids"] = {id(t) for t in written}
+        finally:
+            tensor_mod.set_tracker(old)
+            _scrub_leaked_tracers(d)
+            for t, v, g, n in saved:
+                t._data = v
+                t._grad = g
+                t._node = n
+        return tuple(ret_vals) + tuple(state_vals)
+
+    if not donate_state:
+        return _jax.jit(drive).lower(*specs)
+    # trace once to learn which state the step writes, then lower with
+    # those inputs donated (the _Executable donates the same way)
+    _jax.eval_shape(drive, *specs)
+    donate = tuple(i for i, t in enumerate(tensors)
+                   if i >= len(arg_tensors)
+                   and id(t) in holder["written_ids"])
+    return _jax.jit(drive, donate_argnums=donate).lower(*specs)
+
+
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, full_graph=False, **kwargs):
     """``paddle.jit.to_static`` analog (reference ``jit/api.py:135``)."""

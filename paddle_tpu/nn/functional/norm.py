@@ -17,16 +17,18 @@ from ...core.tensor import Tensor
 
 
 def _pallas_norms():
-    """Fused Pallas norm kernels, used on TPU (None elsewhere: the XLA
-    fallback below is faster than interpret mode on CPU).
-    ``PDTPU_NORM_BACKEND=xla`` forces the XLA-native path even on TPU —
-    a Pallas custom call is a fusion BARRIER (its input and output must
-    materialize in HBM), so the jnp formulation can win in-context when
-    XLA fuses it into neighboring elementwise chains; the A/B lives in
-    benchmarks/step_anatomy.py."""
+    """Fused Pallas norm kernels — OPT-IN via
+    ``PDTPU_NORM_BACKEND=pallas``. Measured in-context (r5 step
+    anatomy, GPT-124M b8 x s1024): the Pallas LN custom call is a
+    fusion BARRIER — its input and output must materialize in HBM — and
+    costs ~6 ms/step over the jnp formulation, which XLA fuses into the
+    neighboring residual-add/cast chains (full step 100.4 ms with
+    Pallas LN, 94.4 ms with XLA LN, 87.1 ms with LN deleted). The same
+    isolated-vs-in-context trap as the flash-attention block autotune:
+    the kernel wins alone and loses inside the step."""
     import os
     if jax.default_backend() != "tpu" \
-            or os.environ.get("PDTPU_NORM_BACKEND") == "xla":
+            or os.environ.get("PDTPU_NORM_BACKEND") != "pallas":
         return None
     try:
         from ...ops.pallas import norms

@@ -79,7 +79,14 @@ class Layer:
             init = I.Constant(0.0) if is_bias else I._default_weight_init
         elif not isinstance(init, I.Initializer) and not callable(init):
             init = I.to_initializer(init)
-        data = init(tuple(int(s) for s in shape), jnp.dtype(dtype))
+        from ..core import lazy as _lazy
+        if _lazy.in_lazy_mode():
+            # LazyGuard: no storage — abstract shape/dtype only
+            import jax
+            data = jax.ShapeDtypeStruct(
+                tuple(int(s) for s in shape), jnp.dtype(dtype))
+        else:
+            data = init(tuple(int(s) for s in shape), jnp.dtype(dtype))
         p = Parameter(data, trainable=attr.trainable, name=attr.name)
         p.optimize_attr["learning_rate"] = attr.learning_rate
         p.regularizer = attr.regularizer

@@ -86,3 +86,4 @@ def test_batch_normalize_transform():
     assert out.shape == (4, 1, 8, 8) and out.dtype == np.float32
     with pytest.raises(ValueError):
         BatchNormalize([0.0], [1.0])(src.astype("float32"))
+
