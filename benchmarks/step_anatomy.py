@@ -51,11 +51,12 @@ def main():
     batch, seq = 8, 1024
     results = {}
 
-    def build(num_layers=12, opt_kind="adamw"):
+    def build(num_layers=12, opt_kind="adamw",
+              policy="dots_and_kernels_saveable"):
         cfg = GPTConfig(vocab_size=50304, hidden_size=768,
                         num_layers=num_layers, num_heads=12,
                         max_seq_len=1024, dropout=0.0, recompute=True,
-                        recompute_policy="dots_and_kernels_saveable")
+                        recompute_policy=policy)
         paddle.seed(0)
         model = GPTForCausalLM(cfg)
         model.train()
@@ -216,6 +217,21 @@ def main():
             del model, opt, xla_ln_step
         finally:
             os.environ.pop("PDTPU_NORM_BACKEND", None)
+
+    if "save_names" in variants:
+        # transformer_saveable: ln/gelu outputs saved across backward
+        cfg, model, opt = build(policy="transformer_saveable")
+
+        @paddle.jit.to_static
+        def save_names_step(ids, labels):
+            with amp.auto_cast(level="O2", dtype="bfloat16"):
+                loss = model(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        run("save_names", save_names_step)
+        del model, opt, save_names_step
 
     if "ln_off" in variants:
         # LayerNorm -> identity: upper bound on ALL norm-related cost

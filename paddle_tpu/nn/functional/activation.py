@@ -25,7 +25,12 @@ def relu6(x):
 
 @primitive(name="gelu")
 def _gelu_impl(x, approximate=False):
-    return jax.nn.gelu(x, approximate=bool(approximate))
+    # checkpoint_name: under recompute policies that list "act_out"
+    # (fleet/recompute.py "transformer_saveable") the activation is
+    # saved across backward instead of re-running the transcendental
+    from jax.ad_checkpoint import checkpoint_name
+    out = jax.nn.gelu(x, approximate=bool(approximate))
+    return checkpoint_name(out, "act_out")
 
 
 def gelu(x, approximate=False, name=None):

@@ -66,7 +66,10 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
             i += 1
         if bias is not None:
             out = out + wb[i]
-        return out
+        # saveable under "transformer_saveable" remat: keeps the normed
+        # activation as a residual instead of re-reducing in backward
+        from jax.ad_checkpoint import checkpoint_name
+        return checkpoint_name(out, "ln_out")
 
     args = [x] + [t for t in (weight, bias) if t is not None]
     return apply("layer_norm", impl, *args)

@@ -396,3 +396,40 @@ def test_window_runner_donate_false_reuses_carry():
     for k, v in net.state_dict().items():
         np.testing.assert_allclose(np.asarray(v._read()), after1[k],
                                    rtol=1e-6)
+
+
+def test_transformer_saveable_policy_grad_parity():
+    # named-activation remat (ln_out/act_out saved) must give the same
+    # loss and grads as full recompute
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.fleet.recompute import recompute
+
+    paddle.seed(0)
+    lin1 = paddle.nn.Linear(8, 16)
+    ln = paddle.nn.LayerNorm(16)
+    lin2 = paddle.nn.Linear(16, 8)
+
+    def block(x):
+        return lin2(F.gelu(ln(lin1(x)), approximate=True))
+
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32))
+
+    losses, grads = [], []
+    for policy in (None, "transformer_saveable"):
+        for p in [*lin1.parameters(), *ln.parameters(),
+                  *lin2.parameters()]:
+            p._grad = None
+
+        @paddle.jit.to_static
+        def step(v):
+            out = recompute(block, v, policy=policy)
+            loss = (out * out).mean()
+            loss.backward()
+            return loss
+
+        losses.append(float(step(x)))
+        grads.append(np.asarray(lin1.weight.grad._read()).copy())
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+    np.testing.assert_allclose(grads[0], grads[1], rtol=1e-4, atol=1e-6)
