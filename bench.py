@@ -92,6 +92,7 @@ def _cached(dev, name, files, fn, src_fns=()):
     producing code is unchanged, else measure now and persist. The key
     covers the shared timing harness, the per-entry measurement fns,
     and the bench-module constants their math depends on."""
+    import hashlib
     kind = str(getattr(dev, "device_kind", dev.platform))
     consts = repr((_PEAK, WINDOW_STEPS))
     ver = mc.code_version(*_HARNESS_FILES, *files) \

@@ -26,10 +26,14 @@ steady state, where they alias as donated input/output pairs.
 """
 from __future__ import annotations
 
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 V5E_HBM = 16 * 1024 ** 3
 
@@ -93,13 +97,6 @@ def main():
     lowered = paddle.jit.aot_lower(train_step, ids, labels)
     t_lower = time.time() - t0
 
-    # sharding-loss check: TP'd weight inputs must still carry "mp"
-    mp_in = sum("mp" in str(getattr(getattr(a, "sharding", None),
-                                    "spec", ""))
-                for a in jax.tree_util.tree_leaves(lowered.in_avals))
-    assert mp_in >= 4 * cfg.num_layers, \
-        f"TP sharding lost in lowering: only {mp_in} mp-sharded inputs"
-
     # constant-bloat check: no materialized weight in the HLO (a single
     # fp32 5120x5120 constant is 100 MB of MLIR text)
     text_len = len(lowered.as_text())
@@ -109,6 +106,12 @@ def main():
     t0 = time.time()
     compiled = lowered.compile()
     t_compile = time.time() - t0
+
+    # sharding-loss check: TP'd weight inputs must still carry "mp"
+    in_sh = jax.tree_util.tree_leaves(compiled.input_shardings[0])
+    mp_in = sum("mp" in str(getattr(s, "spec", "")) for s in in_sh)
+    assert mp_in >= 4 * cfg.num_layers, \
+        f"TP sharding lost in lowering: only {mp_in} mp-sharded inputs"
     mem = compiled.memory_analysis()
     resident = None
     if mem:
