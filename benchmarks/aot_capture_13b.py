@@ -16,8 +16,8 @@ What this proves that the twin cannot: the to_static discovery tracker,
 autograd tape, AMP decoration, shard_gpt annotations and the ZeRO
 in-trace constraints all survive 13B-scale tracing — no constant bloat
 (a single materialized weight would be 100+ MB in the HLO), no sharding
-loss (asserted on the lowered input avals), and the compiled step's
-per-device residency fits v5e HBM.
+loss (asserted on the compiled executable's input shardings), and the
+compiled step's per-device residency fits v5e HBM.
 
 Residency accounting note: optimizer moments / fp32 master weights are
 CREATED by this first-step program (zeros/cast inside the trace), so
@@ -34,6 +34,13 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the axon sitecustomize pins jax_platforms via jax.config, which
+    # IGNORES the env var — force the config before backends initialize
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 V5E_HBM = 16 * 1024 ** 3
 
@@ -107,11 +114,19 @@ def main():
     compiled = lowered.compile()
     t_compile = time.time() - t0
 
-    # sharding-loss check: TP'd weight inputs must still carry "mp"
+    # sharding-loss check: TP'd weight inputs must still carry "mp".
+    # str(s) covers NamedSharding AND GSPMD/HloSharding reprs; guard
+    # against a representation that names no axes at all (then this
+    # check proves nothing and must say so rather than pass or fail
+    # spuriously after the multi-minute compile)
     in_sh = jax.tree_util.tree_leaves(compiled.input_shardings[0])
-    mp_in = sum("mp" in str(getattr(s, "spec", "")) for s in in_sh)
-    assert mp_in >= 4 * cfg.num_layers, \
-        f"TP sharding lost in lowering: only {mp_in} mp-sharded inputs"
+    reprs = [str(getattr(s, "spec", None) or s) for s in in_sh]
+    named = sum("mp" in r for r in reprs)
+    devicey = sum("devices=" in r or "mp" in r or "dp" in r
+                  for r in reprs)
+    assert devicey, f"input shardings unreadable: {reprs[:3]}"
+    assert named >= 4 * cfg.num_layers, \
+        f"TP sharding lost in lowering: only {named} mp-sharded inputs"
     mem = compiled.memory_analysis()
     resident = None
     if mem:

@@ -83,6 +83,12 @@ class Model:
 
         accum = self._accumulate
 
+        # metrics need the per-step network outputs; without metrics the
+        # outputs slot returns the loss instead — a windowed run would
+        # otherwise stack K copies of the raw outputs on device (K x
+        # [B,S,V] logits for an LM is tens of GB)
+        has_metrics = bool(self._metrics)
+
         def make_train_step(update):
             def train_step(*batch_args):
                 n_label = len(_to_list(self._labels)) or 1
@@ -100,7 +106,7 @@ class Model:
                     # accum mode zeroes in place: grad buffers keep their
                     # identity so the compiled steps thread them as state
                     opt.clear_grad(set_to_zero=accum > 1)
-                return loss, outputs
+                return loss, (outputs if has_metrics else loss)
             return train_step
 
         def eval_step(*batch_args):

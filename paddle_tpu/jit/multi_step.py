@@ -171,20 +171,30 @@ class WindowRunner:
         self._runner = _build_window(exe, donate, tuple(self._ps_idx))
 
     def stage(self, arg_batches):
-        """Stack a window of host batches into device arrays (one upload
-        per argument position). Call outside the timed/steady-state path;
-        the result can be reused across ``run`` calls (e.g. benchmarking)
-        or double-buffered against the previous window's execution."""
+        """Stack a window of batches into device arrays (one upload per
+        argument position). Call outside the timed/steady-state path;
+        the result can be reused across ``run`` calls (e.g.
+        benchmarking) or double-buffered against the previous window's
+        execution.
+
+        Batches already resident on device (the common fit-loop case:
+        DataLoader collate built device tensors) are stacked ON DEVICE
+        — ``np.stack`` over device arrays would round-trip every batch
+        through the tunnel (~17 s/window measured for 50 GPT batches
+        vs milliseconds for the device-side stack)."""
         import numpy as np
         if len(arg_batches) != self.length:
             raise ValueError(
                 f"expected {self.length} batches, got {len(arg_batches)}")
         cols = []
         for i in range(self._n_args):
-            col = np.stack([
-                np.asarray(b[i]._read()) if isinstance(b[i], Tensor)
-                else np.asarray(b[i]) for b in arg_batches])
-            cols.append(jnp.asarray(col))
+            vals = [b[i]._read() if isinstance(b[i], Tensor) else b[i]
+                    for b in arg_batches]
+            if all(isinstance(v, jax.Array) for v in vals):
+                cols.append(jnp.stack(vals))
+            else:
+                cols.append(jnp.asarray(np.stack(
+                    [np.asarray(v) for v in vals])))
         return tuple(cols)
 
     def run(self, *stacks, outputs="all", per_step_vals=None):
