@@ -1,0 +1,50 @@
+"""``paddle_tpu.analysis`` — graph lint: two-front-end static analysis.
+
+A diagnostics engine with a registry of coded checks:
+
+- ``PDT1xx`` (AST front-end, ``ast_checks.py``): tracer-safety lint run
+  over a function's source before ``jit.to_static`` conversion — host
+  syncs, trace-time side effects, graph-break escape sites, host
+  entropy, unconvertible-function features.
+- ``PDT2xx`` (IR front-end, ``ir_checks.py``): checks over the traced
+  jaxpr — unintended f64, blocking host callbacks, undonated state
+  buffers, dead computation, weak-typed inputs — plus runtime-reported
+  codes (trip-bound truncation).
+
+Severities: note / warn / error. Reporting is gated by
+``PDTPU_ANALYSIS=off|warn|error`` (``FLAGS_analysis``): ``warn`` emits
+:class:`LintWarning`, ``error`` raises ``StaticAnalysisError`` on any
+warn-or-worse finding. Suppress per line with ``# pdtpu: noqa[PDT101]``
+(bare ``# pdtpu: noqa`` silences all codes on the line), per scope with
+``analysis.suppress("PDT101")`` as context manager or decorator.
+
+Wired into ``jit.to_static`` (AST lint before conversion, IR lint after
+capture), ``jit/dy2static.py`` (graph-break decline sites report
+PDT105/PDT107), and ``hapi.Model.prepare``. Standalone CLI::
+
+    python -m paddle_tpu.analysis paddle_tpu/ [--assume-jit] [--strict]
+"""
+from __future__ import annotations
+
+# FLAGS_analysis lives in core/state.py with the other core flags
+# (define_flag("analysis", ...); env override PDTPU_ANALYSIS).
+
+from .registry import (  # noqa: E402,F401
+    REGISTRY, CheckSpec, Diagnostic, Severity, pragma_suppressed,
+    register, register_runtime, spec, suppress)
+from . import ast_checks  # noqa: E402,F401  (registers PDT1xx)
+from . import ir_checks   # noqa: E402,F401  (registers PDT2xx)
+from .engine import (  # noqa: E402,F401
+    LintWarning, analyze_file, analyze_source, check_executable,
+    check_function, check_jaxpr, check_traced, collect, exercise,
+    lint_callable, lint_executable, mode, report, report_runtime,
+    reset_reported)
+
+__all__ = [
+    "REGISTRY", "CheckSpec", "Diagnostic", "Severity", "LintWarning",
+    "analyze_file", "analyze_source", "check_executable",
+    "check_function", "check_jaxpr", "check_traced", "collect",
+    "exercise", "lint_callable", "lint_executable", "mode",
+    "pragma_suppressed", "register", "register_runtime", "report",
+    "report_runtime", "reset_reported", "spec", "suppress",
+]

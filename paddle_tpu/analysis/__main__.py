@@ -1,0 +1,95 @@
+"""CLI: ``python -m paddle_tpu.analysis <paths...>`` — repo-wide graph
+lint over the AST front-end.
+
+Walks ``.py`` files, lints every ``to_static``-decorated function (every
+function under ``--assume-jit``), prints findings as
+``file:line:col: CODE [severity] message``, and exits non-zero when any
+finding reaches the gate severity (``error`` by default, ``warn`` under
+``--strict``). ``--list-codes`` prints the registry catalog.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .engine import analyze_file
+from .registry import REGISTRY, Severity
+
+
+def _iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        else:
+            print(f"warning: no such path: {p}", file=sys.stderr)
+
+
+def _list_codes() -> int:
+    for code in sorted(REGISTRY):
+        s = REGISTRY[code]
+        print(f"{code}  {s.name:<32} {str(s.severity):<5} [{s.frontend}]")
+        doc = " ".join(s.doc.split())
+        print(f"        {doc}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="paddle_tpu graph lint (AST front-end)")
+    ap.add_argument("paths", nargs="*", help=".py files or directories")
+    ap.add_argument("--assume-jit", action="store_true",
+                    help="lint every function, not only @to_static ones")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on warn-severity findings too")
+    ap.add_argument("--select", default="",
+                    help="comma-separated codes to restrict to")
+    ap.add_argument("--list-codes", action="store_true",
+                    help="print the diagnostic catalog and exit")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the per-finding lines (summary only)")
+    args = ap.parse_args(argv)
+
+    if args.list_codes:
+        return _list_codes()
+    if not args.paths:
+        ap.error("no paths given (or use --list-codes)")
+
+    select = {c.strip().upper() for c in args.select.split(",") if c.strip()}
+    gate = Severity.WARN if args.strict else Severity.ERROR
+    n_files = 0
+    counts = {Severity.NOTE: 0, Severity.WARN: 0, Severity.ERROR: 0}
+    gating = 0
+    for path in _iter_py_files(args.paths):
+        n_files += 1
+        try:
+            diags = analyze_file(path, force_jit=args.assume_jit)
+        except OSError as e:
+            print(f"warning: cannot read {path}: {e}", file=sys.stderr)
+            continue
+        for d in diags:
+            if select and d.code not in select:
+                continue
+            counts[d.severity] += 1
+            if d.severity >= gate:
+                gating += 1
+            if not args.quiet:
+                print(d.format())
+    total = sum(counts.values())
+    print(f"{total} finding(s) ({counts[Severity.ERROR]} error, "
+          f"{counts[Severity.WARN]} warn, {counts[Severity.NOTE]} note) "
+          f"in {n_files} file(s)")
+    return 1 if gating else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

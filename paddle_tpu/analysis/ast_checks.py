@@ -1,0 +1,365 @@
+"""AST front-end: tracer-safety checks (``PDT1xx``).
+
+These run over a function's source *before* ``jit.to_static`` conversion
+and flag the patterns the dy2static rewriter either silently falls back
+on (graph breaks) or that trace to something the author did not mean
+(host syncs baked into the compiled program, trace-time-only side
+effects, host randomness captured as a constant).
+
+A check is a generator ``check(fndef, ctx) -> (node, message)`` where
+``fndef`` is the (possibly nested) ``ast.FunctionDef`` being linted in a
+jit context and ``ctx`` carries filename/source. Severity and code come
+from the registry entry.
+"""
+from __future__ import annotations
+
+import ast
+import copy
+
+from .registry import Severity, decorator_name, register
+
+_HOST_SYNC_METHODS = {"numpy", "item", "tolist"}
+_MUTATORS = {"append", "extend", "insert", "remove", "clear", "update",
+             "add", "setdefault"}
+_HOST_ENTROPY_ROOTS = {"random", "time"}
+
+
+def _walk_fn(fndef):
+    """Walk the function's own scope only — nested defs are NOT
+    descended into: the engine lints every nested function as its own
+    jit scope, so a nested def's suppression (decorator tag, def-line
+    pragma) governs its own findings."""
+    stack = [fndef]
+    while stack:
+        node = stack.pop()
+        if node is not fndef and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _dotted(node) -> str | None:
+    """``a.b.c`` attribute chain -> ``"a.b.c"`` (None if not a chain)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@register(
+    "PDT101", "host-sync-in-jit", Severity.WARN, "ast",
+    example="""
+import paddle_tpu as paddle
+
+@paddle.jit.to_static
+def step(x):
+    y = x * 2
+    return y.numpy()
+""",
+    near_miss="""
+def step(x):
+    y = x * 2
+    return y.numpy()
+""")
+def check_host_sync(fndef, ctx):
+    """``.numpy()``/``.item()``/``.tolist()`` or ``float()``/``int()``/
+    ``bool()`` on a traced value inside a jit function blocks on a
+    device->host transfer and graph-breaks the capture — the single
+    costliest silent hazard on a network-attached TPU."""
+    for node in _walk_fn(fndef):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _HOST_SYNC_METHODS \
+                and not node.args and not node.keywords:
+            yield node, (f".{f.attr}() inside a jit function forces a "
+                         f"device->host sync (graph break); keep the "
+                         f"value on device or move the call outside "
+                         f"to_static")
+        elif isinstance(f, ast.Name) and f.id in ("float", "int", "bool") \
+                and len(node.args) == 1 and not node.keywords \
+                and isinstance(node.args[0], ast.Call) \
+                and isinstance(node.args[0].func, ast.Attribute):
+            # only the tensor-shaped pattern float(x.sum()): a bare
+            # float(name) is usually a plain Python scalar conversion
+            yield node, (f"{f.id}() on a tensor expression forces a "
+                         f"device->host sync inside a jit function; use "
+                         f"tensor ops (astype/comparison) instead")
+
+
+@register(
+    "PDT102", "print-in-traced-code", Severity.NOTE, "ast",
+    example="""
+from paddle_tpu.jit import to_static
+
+@to_static
+def step(x):
+    print(x)
+    return x * 2
+""",
+    near_miss="""
+from paddle_tpu.jit import to_static
+
+@to_static
+def step(x):
+    log(x)
+    return x * 2
+""")
+def check_print(fndef, ctx):
+    """``print`` inside traced code runs at trace time only: it fires
+    once per compile, not once per step, and printing a tensor shows a
+    tracer, not values. Use a host callback or move it out of jit."""
+    for node in _walk_fn(fndef):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "print":
+            yield node, ("print() in traced code runs once per compile, "
+                         "not per step; it will show tracers, not values")
+
+
+@register(
+    "PDT103", "global-write-in-jit", Severity.WARN, "ast",
+    example="""
+import paddle_tpu as paddle
+
+@paddle.jit.to_static
+def step(x):
+    global counter
+    counter = counter + 1
+    return x * 2
+""",
+    near_miss="""
+import paddle_tpu as paddle
+
+@paddle.jit.to_static
+def step(x):
+    counter = 1
+    return x * counter
+""")
+def check_global_write(fndef, ctx):
+    """Writing a ``global`` from a jit function is a trace-time side
+    effect: the write happens once per compile, and replaying the cached
+    program never updates it again."""
+    for node in _walk_fn(fndef):
+        if isinstance(node, ast.Global):
+            yield node, (f"global write ({', '.join(node.names)}) in a "
+                         f"jit function happens at trace time only; the "
+                         f"cached program will not repeat it")
+
+
+@register(
+    "PDT104", "mutation-in-converted-branch", Severity.NOTE, "ast",
+    example="""
+import paddle_tpu as paddle
+
+@paddle.jit.to_static
+def step(x, acc):
+    if x.mean() > 0:
+        acc.append(x)
+    return x * 2
+""",
+    near_miss="""
+import paddle_tpu as paddle
+
+@paddle.jit.to_static
+def step(x, acc):
+    acc.append(x)
+    if x.mean() > 0:
+        x = x + 1
+    return x * 2
+""")
+def check_branch_mutation(fndef, ctx):
+    """Container mutation (``.append``/``.update``/...) inside an
+    ``if``/``while`` body: if the predicate is a tensor, dy2static
+    traces BOTH branches, so the mutation runs even when its branch is
+    not taken — and runs once per trace, not per step."""
+
+    compound = (ast.If, ast.While, ast.For, ast.With, ast.Try,
+                ast.AsyncFor, ast.AsyncWith)
+
+    def scan(stmts, in_branch):
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            if in_branch and not isinstance(s, compound):
+                for node in ast.walk(s):
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Attribute) \
+                            and node.func.attr in _MUTATORS:
+                        yield (node,
+                               f".{node.func.attr}() inside a converted "
+                               f"branch replays at trace time for both "
+                               f"sides of the predicate")
+            branch_here = in_branch or isinstance(s, (ast.If, ast.While))
+            for blk in _stmt_blocks(s):
+                yield from scan(blk, branch_here)
+
+    yield from scan(fndef.body, False)
+
+
+def _stmt_blocks(s):
+    for attr in ("body", "orelse", "finalbody"):
+        blk = getattr(s, attr, None)
+        if isinstance(blk, list) and blk and isinstance(blk[0], ast.stmt):
+            yield blk
+    for h in getattr(s, "handlers", []) or []:
+        yield h.body
+
+
+@register(
+    "PDT105", "graph-break-escape", Severity.WARN, "ast",
+    example="""
+import paddle_tpu as paddle
+
+@paddle.jit.to_static
+def step(x):
+    if x.mean() > 0:
+        with open("/tmp/f") as f:
+            return x * 2
+    return x
+""",
+    near_miss="""
+import paddle_tpu as paddle
+
+@paddle.jit.to_static
+def step(x):
+    if x.mean() > 0:
+        return x * 2
+    return x
+""")
+def check_graph_break_escape(fndef, ctx):
+    """A control-flow site dy2static cannot convert (``return``/``break``
+    beyond what the escape-elimination passes handle, ``del``, ``yield``,
+    loop ``else``) is silently left as plain Python: a tensor predicate
+    there graph-breaks the whole capture. This check replays the real
+    dy2static transformer pipeline and flags the sites that survive it
+    unconverted."""
+    from ..jit.dy2static import (_BreakContinueEliminator, _ForEachDesugar,
+                                 _eliminate_returns, _has_escape,
+                                 _is_range_for, _visit_body,
+                                 _walk_in_scope)
+    fd = copy.deepcopy(fndef)
+    try:
+        _visit_body(_ForEachDesugar(), fd)
+        _eliminate_returns(fd)
+        _visit_body(_BreakContinueEliminator(), fd)
+        ast.fix_missing_locations(fd)
+    except Exception:
+        return  # conversion machinery declined outright; PDT107 covers it
+    seen = set()
+    for s in fd.body:
+        for node in _walk_in_scope(s):
+            broke = False
+            if isinstance(node, ast.If):
+                broke = _has_escape(node.body) or _has_escape(node.orelse)
+            elif isinstance(node, ast.While):
+                broke = bool(node.orelse) or _has_escape(node.body,
+                                                         loop_ctx=True)
+            elif isinstance(node, ast.For):
+                broke = _is_range_for(node) and _has_escape(node.body,
+                                                            loop_ctx=True)
+            if broke and (node.lineno, node.col_offset) not in seen:
+                seen.add((node.lineno, node.col_offset))
+                kind = type(node).__name__.lower()
+                yield node, (f"`{kind}` block contains an escape "
+                             f"(return/break/del/yield past what escape "
+                             f"elimination handles): dy2static leaves it "
+                             f"as plain Python — a tensor predicate here "
+                             f"graph-breaks the capture")
+
+
+@register(
+    "PDT106", "host-entropy-in-jit", Severity.WARN, "ast",
+    example="""
+import random
+import paddle_tpu as paddle
+
+@paddle.jit.to_static
+def step(x):
+    return x * random.random()
+""",
+    near_miss="""
+import random
+import paddle_tpu as paddle
+
+def make_noise():
+    return random.random()
+
+@paddle.jit.to_static
+def step(x):
+    return x * 2.0
+""")
+def check_host_entropy(fndef, ctx):
+    """``random.*`` / ``time.*`` / ``np.random.*`` in traced code is
+    evaluated once at trace time and baked into the compiled program as
+    a constant — every subsequent step reuses the same 'random' value.
+    Use ``paddle.seed`` + framework random ops instead."""
+    for node in _walk_fn(fndef):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if not dotted:
+            continue
+        parts = dotted.split(".")
+        hostile = (parts[0] in _HOST_ENTROPY_ROOTS and len(parts) > 1) or \
+            (parts[0] in ("np", "numpy") and len(parts) > 2
+             and parts[1] == "random")
+        if hostile:
+            yield node, (f"{dotted}() runs at trace time: the value is "
+                         f"baked into the compiled program as a constant "
+                         f"(same 'random' number every step)")
+
+
+@register(
+    "PDT107", "unconvertible-function", Severity.WARN, "ast",
+    example="""
+import paddle_tpu as paddle
+
+def outer():
+    k = 0
+
+    @paddle.jit.to_static
+    def step(x):
+        nonlocal k
+        k += 1
+        return x * 2
+    return step
+""",
+    near_miss="""
+import paddle_tpu as paddle
+
+def outer():
+    k = 2
+
+    @paddle.jit.to_static
+    def step(x):
+        return x * k
+    return step
+""")
+def check_unconvertible(fndef, ctx):
+    """Function-level features that make dy2static decline the WHOLE
+    function (``nonlocal`` writes, ``__name``-mangled attributes,
+    decorators it cannot strip): tensor control flow inside then always
+    falls back to eager with no conversion at all."""
+    from ..jit.dy2static import _has_mangled_names
+    for node in _walk_fn(fndef):
+        if isinstance(node, ast.Nonlocal):
+            yield node, (f"nonlocal ({', '.join(node.names)}) makes "
+                         f"dy2static decline the whole function (re-exec "
+                         f"cannot share closure cells for writes)")
+    if _has_mangled_names(fndef):
+        yield fndef, ("__name-mangled attribute access does not survive "
+                      "dy2static's re-exec; the function is left "
+                      "unconverted")
+    if ctx.decorated:
+        for dec in fndef.decorator_list:
+            name = decorator_name(dec)
+            if name not in ("to_static", "suppress"):
+                yield dec, (f"decorator @{name or '<expr>'} prevents "
+                            f"dy2static conversion (stripping it would "
+                            f"change behavior)")

@@ -1,0 +1,209 @@
+"""Diagnostic registry — the coded-check catalog of the graph lint.
+
+Every check the analyzer can emit is registered here as a
+:class:`CheckSpec` with a stable code, severity, front-end, docstring
+and a pair of golden snippets (one minimal *triggering* example and one
+non-triggering *near-miss*) that the registry self-test executes. Code
+ranges mirror the two front-ends:
+
+- ``PDT1xx`` — tracer-safety checks over the **Python AST** (run before
+  ``jit.to_static`` conversion; see ``ast_checks.py``),
+- ``PDT2xx`` — program-level checks over the **traced jaxpr / lowered
+  IR** (run after capture; see ``ir_checks.py``). A handful of PDT2xx
+  codes fire at *runtime* from inside compiled programs (frontend
+  ``"runtime"``) — same registry, different reporting site.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import enum
+import re
+from typing import Callable, Optional
+
+
+def decorator_name(dec) -> Optional[str]:
+    """Best-effort name of a decorator expression: ``"to_static"`` for
+    ``@to_static`` / ``@paddle.jit.to_static`` / ``@to_static(...)``;
+    ``None`` when the expression is not a (dotted) name. Single source
+    of truth for decorator matching across the engine, the AST checks
+    and dy2static."""
+    d = dec.func if isinstance(dec, ast.Call) else dec
+    if isinstance(d, ast.Attribute):
+        return d.attr
+    if isinstance(d, ast.Name):
+        return d.id
+    return None
+
+
+class Severity(enum.IntEnum):
+    """Finding severity; ordering is meaningful (gates compare >=)."""
+
+    NOTE = 0
+    WARN = 1
+    ERROR = 2
+
+    def __str__(self):
+        return self.name.lower()
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One finding: a coded, located, human-readable lint message."""
+
+    code: str
+    severity: Severity
+    message: str
+    file: str = "<unknown>"
+    line: int = 0
+    col: int = 0
+
+    def format(self) -> str:
+        return (f"{self.file}:{self.line}:{self.col}: {self.code} "
+                f"[{self.severity}] {self.message}")
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckSpec:
+    """Registry entry for one diagnostic code."""
+
+    code: str           # e.g. "PDT101"
+    name: str           # kebab-case slug, e.g. "host-sync-in-jit"
+    severity: Severity
+    frontend: str       # "ast" | "ir" | "runtime"
+    doc: str            # what the check flags and why it matters
+    example: str        # minimal source that triggers the code
+    near_miss: str      # minimal source that must NOT trigger it
+    func: Optional[Callable] = None  # the check (None for runtime codes)
+
+
+_CODE_RE = re.compile(r"^PDT[12]\d\d$")
+REGISTRY: dict[str, CheckSpec] = {}
+
+
+def register(code: str, name: str, severity: Severity, frontend: str, *,
+             example: str, near_miss: str):
+    """Decorator registering a check function under ``code``.
+
+    The function's docstring becomes the registry doc. AST checks take
+    ``(fndef, ctx)`` and yield ``(node, message)``; IR checks take
+    ``(closed_jaxpr, ctx)`` and yield ``(message, eqn_or_None)``.
+    """
+    if not _CODE_RE.match(code):
+        raise ValueError(f"diagnostic code {code!r} must match PDT[12]xx")
+    if frontend not in ("ast", "ir", "runtime"):
+        raise ValueError(f"unknown frontend {frontend!r}")
+    if (frontend == "ast") != code.startswith("PDT1"):
+        raise ValueError(f"{code}: PDT1xx codes are AST checks, "
+                         f"PDT2xx are IR/runtime checks")
+
+    def deco(fn):
+        if code in REGISTRY:
+            raise ValueError(f"duplicate diagnostic code {code}")
+        if not (fn.__doc__ or "").strip():
+            raise ValueError(f"{code}: check must carry a docstring")
+        REGISTRY[code] = CheckSpec(
+            code=code, name=name, severity=severity, frontend=frontend,
+            doc=fn.__doc__.strip(), example=example, near_miss=near_miss,
+            func=fn)
+        return fn
+    return deco
+
+
+def register_runtime(code: str, name: str, severity: Severity, doc: str, *,
+                     example: str, near_miss: str) -> CheckSpec:
+    """Register a runtime-reported code (no check function; producers
+    call ``engine.report_runtime`` with this code)."""
+    if code in REGISTRY:
+        raise ValueError(f"duplicate diagnostic code {code}")
+    if not _CODE_RE.match(code) or code.startswith("PDT1"):
+        raise ValueError(f"runtime codes live in the PDT2xx range")
+    spec = CheckSpec(code=code, name=name, severity=severity,
+                     frontend="runtime", doc=doc.strip(),
+                     example=example, near_miss=near_miss, func=None)
+    REGISTRY[code] = spec
+    return spec
+
+
+def spec(code: str) -> CheckSpec:
+    return REGISTRY[code]
+
+
+# --------------------------------------------------------------------------
+# suppression
+#
+# Three layers, all consulted at diagnostic-filter time:
+#   1. the ``# pdtpu: noqa`` / ``# pdtpu: noqa[PDT101,...]`` line pragma
+#      (checked against the source line a finding points at),
+#   2. the dynamic ``suppress(...)`` context manager (thread-local),
+#   3. the ``@suppress(...)`` decorator form, which TAGS the function
+#      (``__pdtpu_suppress__``) so lint run on it later — e.g. at
+#      to_static capture time — honors the codes without needing an
+#      active context.
+# --------------------------------------------------------------------------
+
+_PRAGMA_RE = re.compile(
+    r"#\s*pdtpu:\s*noqa(?:\[\s*([A-Za-z0-9_,\s]+?)\s*\])?")
+
+
+def pragma_suppressed(source_line: str, code: str) -> bool:
+    """True when ``source_line`` carries a noqa pragma covering ``code``."""
+    m = _PRAGMA_RE.search(source_line or "")
+    if not m:
+        return False
+    if m.group(1) is None:
+        return True  # bare ``# pdtpu: noqa`` silences everything
+    codes = {c.strip().upper() for c in m.group(1).split(",")}
+    return code.upper() in codes
+
+
+# Process-global, NOT thread-local: runtime diagnostics (PDT206) come
+# out of ``jax.debug.callback``, which async backends may run on a
+# runtime thread — a thread-local stack would make ``suppress`` (and
+# ``engine.collect``) silently miss those reports.
+class _SuppressState:
+    def __init__(self):
+        # (token, codes) frames; the token gives each entry an identity
+        # so exits remove exactly their own frame
+        self.stack: list[tuple[object, frozenset]] = []
+
+
+_suppress_state = _SuppressState()
+
+
+def active_suppressions() -> frozenset:
+    out: set[str] = set()
+    for _, s in _suppress_state.stack:
+        out |= s
+    return frozenset(out)
+
+
+class suppress:
+    """``with analysis.suppress("PDT101"): ...`` silences the codes for
+    the dynamic extent (process-wide — see ``_SuppressState``);
+    ``@analysis.suppress("PDT101")`` tags a function so any later lint
+    of it skips the codes. Bare ``suppress()`` silences every code."""
+
+    def __init__(self, *codes: str):
+        self.codes = frozenset(c.upper() for c in codes) or \
+            frozenset(REGISTRY)
+
+    def __enter__(self):
+        # a fresh token per entry so interleaved exits across threads
+        # (or re-entry of one instance) remove exactly their own frame
+        self._token = object()
+        _suppress_state.stack.append((self._token, self.codes))
+        return self
+
+    def __exit__(self, *exc):
+        stack = _suppress_state.stack
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] is self._token:
+                del stack[i]
+                break
+        return False
+
+    def __call__(self, fn):
+        prev = getattr(fn, "__pdtpu_suppress__", frozenset())
+        fn.__pdtpu_suppress__ = frozenset(prev) | self.codes
+        return fn

@@ -95,8 +95,10 @@ def _reraise_with_op_context(name, vals, e):
     # GraphBreak etc. steer the jit fallback machinery — never wrap
     if type(e).__name__ == "GraphBreak":
         raise
-    raise _errors.InvalidArgumentError(
-        _errors.op_error_context(name, vals, e)) from e
+    wrapped = _errors.InvalidArgumentError(
+        _errors.op_error_context(name, vals, e))
+    wrapped.op_name = name  # machine-readable op id alongside error_code
+    raise wrapped from e
 
 
 def apply(name: str, fn: Callable, *args, **kwargs):

@@ -14,51 +14,66 @@ from typing import Any, Sequence
 
 
 class EnforceNotMet(RuntimeError):
-    """Base of all framework errors (reference ``EnforceNotMet``)."""
+    """Base of all framework errors (reference ``EnforceNotMet``).
+
+    Every subclass carries a stable ``error_code`` (the analog of the
+    reference's ``phi::ErrorCode`` enum, ``paddle/phi/core/errors.h``)
+    so tooling and logs can match on code rather than message text.
+    """
+
+    error_code = "PDT-E000"  # LEGACY
 
 
 class InvalidArgumentError(EnforceNotMet, ValueError):
-    pass
+    error_code = "PDT-E001"
 
 
 class NotFoundError(EnforceNotMet, KeyError):
-    pass
+    error_code = "PDT-E002"
 
 
 class OutOfRangeError(EnforceNotMet, IndexError):
-    pass
+    error_code = "PDT-E003"
 
 
 class AlreadyExistsError(EnforceNotMet):
-    pass
+    error_code = "PDT-E004"
 
 
 class ResourceExhaustedError(EnforceNotMet, MemoryError):
-    pass
+    error_code = "PDT-E005"
 
 
 class PreconditionNotMetError(EnforceNotMet):
-    pass
+    error_code = "PDT-E006"
 
 
 class PermissionDeniedError(EnforceNotMet, PermissionError):
-    pass
+    error_code = "PDT-E007"
 
 
 class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
-    pass
+    error_code = "PDT-E008"
 
 
 class UnimplementedError(EnforceNotMet, NotImplementedError):
-    pass
+    error_code = "PDT-E009"
 
 
 class UnavailableError(EnforceNotMet):
-    pass
+    error_code = "PDT-E010"
 
 
 class FatalError(EnforceNotMet):
-    pass
+    error_code = "PDT-E011"
+
+
+class StaticAnalysisError(EnforceNotMet):
+    """Raised by the graph lint (``paddle_tpu.analysis``) when
+    ``PDTPU_ANALYSIS=error`` and a warn-or-worse finding survives
+    suppression."""
+
+    error_code = "PDT-E012"
 
 
 def enforce(cond: bool, msg: str, exc=InvalidArgumentError):
@@ -113,5 +128,9 @@ def op_error_context(name: str, vals: Sequence, err: Exception) -> str:
     """Build the operator-context message the dispatch funnel attaches
     (the enforce context stack of the reference)."""
     args = ", ".join(_describe(v) for v in vals)
+    # the original error's stable code when it has one, else the code of
+    # the InvalidArgumentError wrapper this message is built for
+    code = getattr(type(err), "error_code", None) or \
+        InvalidArgumentError.error_code
     return (f"Error raised by operator '{name}' with operands ({args}).\n"
-            f"  {type(err).__name__}: {err}")
+            f"  {type(err).__name__}: {err} [{code}]")

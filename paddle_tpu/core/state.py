@@ -59,6 +59,11 @@ define_flag("benchmark", False, "sync + time every op")
 define_flag("eager_delete_tensor_gb", 0.0, "GC threshold (no-op under XLA; kept for parity)")
 define_flag("use_stride_kernel", True, "allow view/stride ops to alias (jax always copies-on-write)")
 define_flag("log_level", 0, "framework VLOG level")
+define_flag("analysis", "warn",
+            "graph-lint mode (paddle_tpu.analysis): off = analyzers "
+            "skipped entirely; warn = findings surface as LintWarnings "
+            "(notes to the logger); error = any warn-or-worse finding "
+            "raises StaticAnalysisError. Env override PDTPU_ANALYSIS.")
 define_flag("while_grad_max_trip_count", 256,
             "trip bound for differentiable while_loop under jit capture "
             "(lowered to a masked lax.scan; XLA has no reverse-mode "

@@ -72,7 +72,23 @@ class Model:
         elif isinstance(amp_configs, dict):
             self._amp_level = amp_configs.get("level", "O1")
         self._build_steps()
+        self._lint_network()
         return self
+
+    def _lint_network(self):
+        """Pre-compile tracer-safety lint (graph lint, PDT1xx) over the
+        user network's ``forward`` — the code the compiled train/eval
+        steps will trace. Framework-provided layers are exempt; gated by
+        PDTPU_ANALYSIS (raises under =error, no-op under =off)."""
+        from .. import analysis
+        fwd = getattr(type(self.network), "forward", None)
+        if fwd is None:
+            return
+        mod = getattr(fwd, "__module__", "") or ""
+        if mod == "paddle_tpu" or mod.startswith("paddle_tpu."):
+            return
+        analysis.lint_callable(
+            fwd, where=f"{type(self.network).__name__}.forward")
 
     def _build_steps(self):
         from .. import amp as amp_mod

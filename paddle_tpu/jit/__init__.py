@@ -191,6 +191,9 @@ class _Executable:
         self.n_ret = n_ret
         self.arg_out_pos: list[int] = []
         self.trace_count = 0  # XLA (re)traces; guards retrace regressions
+        self.jaxpr = None            # ClosedJaxpr, kept for the IR lint
+        self.donate_idx: tuple = ()  # donated invar positions
+        self.n_explicit_args = 0     # leading caller-owned inputs
 
     def state_split(self):
         """(carry_idx, const_idx) into ``capt_state``: which captured
@@ -256,13 +259,17 @@ class _Executable:
                        if i >= n_args and id(t) in written_ids)
         self._pure = pure  # re-used by jit.multi_step's scanned window
         self.compiled = jax.jit(pure, donate_argnums=donate)
+        self.donate_idx = donate
+        self.n_explicit_args = n_args
         # force tracing now so failures surface at capture time. The replay
         # re-executes the function body, so host-side grad slots can be
         # clobbered (clear_grad() + backward() replaces a concrete step-0
         # grad with a tracer-backed Tensor): snapshot and restore them.
         saved_grads = [(t, t._grad) for t in grad_owners]
         try:
-            self.compiled.lower(*[t._data for t in ordered])
+            traced = self.compiled.trace(*[t._data for t in ordered])
+            self.jaxpr = traced.jaxpr
+            traced.lower()
         finally:
             _scrub_leaked_tracers(d)
             for t, g in saved_grads:
@@ -376,11 +383,25 @@ class StaticFunction:
         nothing to do or declined. Converted lazily on first call so
         closure cells are populated."""
         if not self._conv_tried:
+            # pre-conversion tracer-safety lint (PDT1xx); a no-op when
+            # PDTPU_ANALYSIS=off, raises StaticAnalysisError under
+            # =error. Runs BEFORE _conv_tried is set: a blocked call
+            # must not burn the one conversion attempt, so a later
+            # suppressed/fixed call still converts.
+            from .. import analysis as _analysis
+            _analysis.lint_callable(self.fn, where=self.__name__)
             self._conv_tried = True
             try:
                 from .dy2static import convert_function
                 self._conv_fn = convert_function(self.fn)
             except Exception as e:
+                from ..core.errors import StaticAnalysisError
+                if isinstance(e, StaticAnalysisError):
+                    # the conversion-decline gate (PDTPU_ANALYSIS=error)
+                    # must propagate, and the blocked call must not burn
+                    # the one conversion attempt
+                    self._conv_tried = False
+                    raise
                 warnings.warn(
                     f"to_static: dy2static conversion of {self.__name__} "
                     f"failed ({type(e).__name__}: {e}); using the "
@@ -459,6 +480,15 @@ class StaticFunction:
                     f"({type(e).__name__}: {e})")
             return out
         self._fallback_counts.pop(key, None)
+        # post-capture IR lint (PDT2xx) over the traced program. Runs
+        # BEFORE caching: under PDTPU_ANALYSIS=error a blocking finding
+        # leaves the key uncached, so every call re-captures and raises
+        # again until the finding is fixed or suppressed. The jaxpr is
+        # only needed here — release it so cached executables of large
+        # models don't pin the whole trace for the process lifetime.
+        from .. import analysis as _analysis
+        _analysis.lint_executable(exe, where=self.__name__, fn=self.fn)
+        exe.jaxpr = None
         self._cache[key] = exe
         return out  # discovery pass already produced step-0 results
 

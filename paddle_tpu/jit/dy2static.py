@@ -930,12 +930,20 @@ class _BreakContinueEliminator(ast.NodeTransformer):
 
 
 class _Rewriter(ast.NodeTransformer):
-    def __init__(self, declared_globals, declared_nonlocals):
+    def __init__(self, declared_globals, declared_nonlocals,
+                 on_decline=None):
         self.globals = declared_globals
         self.nonlocals = declared_nonlocals
         self.n = 0
         self.converted_sites = 0
         self.wrapped_calls = 0
+        # diagnostics hook: called with (node, reason) at every site the
+        # rewriter leaves as plain Python (the silent graph breaks)
+        self.on_decline = on_decline
+
+    def _declined(self, node, reason):
+        if self.on_decline is not None:
+            self.on_decline(node, reason)
 
     # ---- scope barriers: transform only the target function's scope
     def visit_FunctionDef(self, node):
@@ -1027,6 +1035,9 @@ class _Rewriter(ast.NodeTransformer):
     def visit_If(self, node):
         node = self.generic_visit(node)
         if _has_escape(node.body) or _has_escape(node.orelse):
+            self._declined(node, "`if` block contains an escape "
+                           "(return/break/del/yield) the elimination "
+                           "passes could not rewrite")
             return node
         idx = self.n
         self.n += 1
@@ -1055,6 +1066,9 @@ class _Rewriter(ast.NodeTransformer):
 
     def _convert_while(self, node):
         if node.orelse or _has_escape(node.body, loop_ctx=True):
+            self._declined(node, "`while` has an else clause or an "
+                           "escape (return/break/del/yield) the "
+                           "elimination passes could not rewrite")
             return node
         idx = self.n
         self.n += 1
@@ -1080,6 +1094,10 @@ class _Rewriter(ast.NodeTransformer):
     def visit_For(self, node):
         node = self.generic_visit(node)
         if not _is_range_for(node) or _has_escape(node.body, loop_ctx=True):
+            if _is_range_for(node):
+                self._declined(node, "`for range(...)` body contains an "
+                               "escape the elimination passes could not "
+                               "rewrite")
             return node
         idx = self.n
         self.n += 1
@@ -1098,6 +1116,44 @@ def _sub(name, i):
 # ==========================================================================
 # entry point
 # ==========================================================================
+
+def _emit_graph_break_diags(fn, items):
+    """Report conversion-decline sites ((code, rel_line, message) with
+    lines relative to the dedented source) through the analysis
+    registry — the graph breaks that used to degrade silently. Honors
+    the analysis mode flag, ``# pdtpu: noqa`` pragmas and
+    ``@analysis.suppress`` tags; a broken analysis import never breaks
+    conversion."""
+    if not items:
+        return
+    try:
+        from .. import analysis
+        from ..analysis.registry import active_suppressions
+        if analysis.mode() == "off":
+            return
+        sup = frozenset(getattr(fn, "__pdtpu_suppress__", ())) | \
+            active_suppressions()
+        try:
+            lines, start = inspect.getsourcelines(fn)
+        except (OSError, TypeError):
+            lines, start = [], fn.__code__.co_firstlineno
+        filename = getattr(fn.__code__, "co_filename", "<unknown>")
+        diags = []
+        for code, rel, msg in items:
+            spec = analysis.REGISTRY.get(code)
+            if spec is None or code in sup:
+                continue
+            src_line = lines[rel - 1] if 0 < rel <= len(lines) else ""
+            if analysis.pragma_suppressed(src_line, code):
+                continue
+            diags.append(analysis.Diagnostic(
+                code=code, severity=spec.severity, message=msg,
+                file=filename, line=start - 1 + rel))
+    except Exception:
+        return
+    # outside the guard: in error mode report() raises, and that must
+    # propagate to the caller rather than be swallowed
+    analysis.report(diags, where=getattr(fn, "__name__", ""))
 
 # id(code) -> (code_exec, fndef_name, has_factory); pins the original
 # code object (key stability) AND the compiled artifact, so fresh
@@ -1159,19 +1215,35 @@ def convert_function(fn):
     if fndef.name != fn.__name__:
         return None
     if _has_mangled_names(fndef):
-        return None  # source-level name mangling won't survive re-exec
+        # source-level name mangling won't survive re-exec
+        _emit_graph_break_diags(fn, [(
+            "PDT107", fndef.lineno,
+            "dy2static declined: __name-mangled attribute access does "
+            "not survive re-exec; tensor control flow stays eager")])
+        return None
+    from ..analysis.registry import decorator_name
     for dec in fndef.decorator_list:
         # stripping an unknown decorator would change behavior (and a
-        # wrapping decorator means ``fn`` isn't this source anyway)
-        d = dec.func if isinstance(dec, ast.Call) else dec
-        name = d.attr if isinstance(d, ast.Attribute) else \
-            d.id if isinstance(d, ast.Name) else None
-        if name != "to_static":
+        # wrapping decorator means ``fn`` isn't this source anyway);
+        # analysis.suppress only tags the function, so it is safe
+        name = decorator_name(dec)
+        if name not in ("to_static", "suppress"):
+            _emit_graph_break_diags(fn, [(
+                "PDT107", dec.lineno,
+                f"dy2static declined: decorator @{name or '<expr>'} "
+                f"cannot be stripped; tensor control flow stays eager")])
             return None
     decls = _DeclScanner()
     decls.visit(fndef)
     if decls.nonlocals:
-        return None  # re-exec'd nonlocal writes would not share cells
+        # re-exec'd nonlocal writes would not share cells
+        _emit_graph_break_diags(fn, [(
+            "PDT107", fndef.lineno,
+            f"dy2static declined: nonlocal "
+            f"({', '.join(sorted(decls.nonlocals))}) writes cannot share "
+            f"closure cells after re-exec; tensor control flow stays "
+            f"eager")])
+        return None
 
     # escape elimination first (reference transformer ordering:
     # loop_transformer's tensor iteration, return_transformer,
@@ -1184,12 +1256,19 @@ def convert_function(fn):
     _visit_body(_BreakContinueEliminator(), fndef)
     ast.fix_missing_locations(fndef)
 
-    rw = _Rewriter(decls.globals, decls.nonlocals)
+    declines: list[tuple] = []
+    rw = _Rewriter(decls.globals, decls.nonlocals,
+                   on_decline=lambda node, reason: declines.append(
+                       ("PDT105", node.lineno,
+                        f"graph break: {reason}; the site runs as plain "
+                        f"Python (a tensor predicate here breaks the "
+                        f"capture)")))
     new_body = []
     for s in fndef.body:
         r = rw.visit(s)
         new_body.extend(r if isinstance(r, list) else [r])
     fndef.body = new_body
+    _emit_graph_break_diags(fn, declines)
     if not rw.converted_sites and not rw.wrapped_calls:
         return None
     fndef.decorator_list = []

@@ -629,12 +629,16 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None,
 
         def _warn(live):
             if bool(live):
-                import warnings
-                warnings.warn(
+                # PDT206 through the graph-lint registry: honors the
+                # PDTPU_ANALYSIS mode flag and analysis.suppress()
+                from ..analysis import report_runtime
+                report_runtime(
+                    "PDT206",
                     "while_loop: differentiable scan lowering hit its "
                     f"trip bound ({bound}) with the predicate still "
                     "true; result is truncated. Raise max_trip_count or "
-                    "FLAGS_while_grad_max_trip_count.")
+                    "FLAGS_while_grad_max_trip_count.",
+                    file="<while_loop>")
         jax.debug.callback(_warn, still_live)
         return final
 

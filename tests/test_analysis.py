@@ -1,0 +1,557 @@
+"""Graph lint (``paddle_tpu.analysis``): registry golden tests, both
+front-ends, suppression (pragma / context / decorator), the mode flag
+(``PDTPU_ANALYSIS=off|warn|error``), the to_static + dy2static wiring,
+and the CLI."""
+import textwrap
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+from paddle_tpu.analysis import LintWarning, Severity
+from paddle_tpu.core import errors
+
+
+@pytest.fixture(autouse=True)
+def _fresh_dedup():
+    analysis.reset_reported()
+    yield
+    analysis.reset_reported()
+
+
+@pytest.fixture
+def _mode():
+    """Set the analysis mode flag for one test, restoring after."""
+    old = paddle.get_flags("analysis")["analysis"]
+
+    def set_mode(m):
+        paddle.set_flags({"analysis": m})
+
+    yield set_mode
+    paddle.set_flags({"analysis": old})
+
+
+# ==========================================================================
+# registry golden tests (satellite: parametrized over every code)
+# ==========================================================================
+
+def test_registry_catalog_shape():
+    assert len(analysis.REGISTRY) >= 8
+    names = set()
+    for code, spec in analysis.REGISTRY.items():
+        assert code == spec.code
+        assert code.startswith("PDT1") or code.startswith("PDT2")
+        assert (spec.frontend == "ast") == code.startswith("PDT1")
+        assert spec.frontend in ("ast", "ir", "runtime")
+        assert spec.doc.strip(), f"{code} has no docstring"
+        assert spec.example.strip(), f"{code} has no example"
+        assert spec.near_miss.strip(), f"{code} has no near-miss"
+        assert spec.severity in (Severity.NOTE, Severity.WARN,
+                                 Severity.ERROR)
+        assert spec.name and spec.name not in names, \
+            f"{code} name not unique"
+        names.add(spec.name)
+    # both front-ends are populated
+    assert sum(s.frontend == "ast" for s in analysis.REGISTRY.values()) >= 4
+    assert sum(s.frontend != "ast" for s in analysis.REGISTRY.values()) >= 4
+
+
+@pytest.mark.parametrize("code", sorted(analysis.REGISTRY))
+def test_registry_golden(code):
+    """Every code: the example triggers it, the near-miss does not, and
+    ``analysis.suppress(code)`` silences the example."""
+    spec = analysis.REGISTRY[code]
+    hits = analysis.exercise(spec, "example")
+    assert any(d.code == code for d in hits), \
+        f"{code} example did not trigger (got {[d.code for d in hits]})"
+    misses = analysis.exercise(spec, "near_miss")
+    assert not [d for d in misses if d.code == code], \
+        f"{code} near-miss triggered: {[d.format() for d in misses]}"
+    with analysis.suppress(code):
+        suppressed = analysis.exercise(spec, "example")
+    assert not [d for d in suppressed if d.code == code], \
+        f"{code} not suppressed by analysis.suppress"
+
+
+def test_register_rejects_bad_specs():
+    with pytest.raises(ValueError, match="PDT"):
+        analysis.register("XXX", "bad", Severity.WARN, "ast",
+                          example="x", near_miss="y")
+    with pytest.raises(ValueError, match="duplicate"):
+        @analysis.register("PDT101", "dup", Severity.WARN, "ast",
+                           example="x", near_miss="y")
+        def dup(fndef, ctx):
+            """Dup."""
+            return []
+    with pytest.raises(ValueError, match="AST"):
+        analysis.register("PDT131", "wrong-range", Severity.WARN, "ir",
+                          example="x", near_miss="y")
+
+
+# ==========================================================================
+# suppression: pragma, context, decorator
+# ==========================================================================
+
+_HOSTILE_SRC = """
+import paddle_tpu as paddle
+
+@paddle.jit.to_static
+def step(x):
+    return x.numpy()
+"""
+
+
+def test_pragma_line_suppression():
+    src = _HOSTILE_SRC.replace("x.numpy()",
+                               "x.numpy()  # pdtpu: noqa[PDT101]")
+    assert not analysis.analyze_source(src)
+    src_all = _HOSTILE_SRC.replace("x.numpy()", "x.numpy()  # pdtpu: noqa")
+    assert not analysis.analyze_source(src_all)
+    # unrelated code listed -> finding stays
+    src_other = _HOSTILE_SRC.replace("x.numpy()",
+                                     "x.numpy()  # pdtpu: noqa[PDT106]")
+    assert [d.code for d in analysis.analyze_source(src_other)] == ["PDT101"]
+
+
+def test_pragma_on_def_line_covers_function():
+    src = _HOSTILE_SRC.replace("def step(x):",
+                               "def step(x):  # pdtpu: noqa[PDT101]")
+    assert not analysis.analyze_source(src)
+
+
+def test_suppress_context_manager():
+    assert analysis.analyze_source(_HOSTILE_SRC)
+    with analysis.suppress("PDT101"):
+        assert not analysis.analyze_source(_HOSTILE_SRC)
+    with analysis.suppress():  # bare: all codes
+        assert not analysis.analyze_source(_HOSTILE_SRC)
+    assert analysis.analyze_source(_HOSTILE_SRC)  # restored on exit
+
+
+def test_suppress_decorator_tags_function():
+    @analysis.suppress("PDT101")
+    def step(x):
+        return x.numpy()
+
+    assert step.__pdtpu_suppress__ == frozenset({"PDT101"})
+    assert not [d for d in analysis.check_function(step)
+                if d.code == "PDT101"]
+
+    def step2(x):
+        return x.numpy()
+
+    assert [d.code for d in analysis.check_function(step2)] == ["PDT101"]
+
+
+def test_nested_functions_lint_as_own_scope():
+    """Inline helpers inside a jit function are traced too, so they are
+    linted — but as their own scope, with their own suppression."""
+    src = """
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+
+@paddle.jit.to_static
+def step(x):
+    def helper(v):
+        return v.numpy()
+    return helper(x)
+"""
+    diags = analysis.analyze_source(src)
+    assert [d.code for d in diags] == ["PDT101"]
+    # suppression on the NESTED def governs the nested finding
+    tagged = src.replace("def helper(v):",
+                         "@analysis.suppress(\"PDT101\")\n    "
+                         "def helper(v):")
+    assert not analysis.analyze_source(tagged)
+    # a def-line pragma on the helper works too
+    pragma = src.replace("def helper(v):",
+                         "def helper(v):  # pdtpu: noqa[PDT101]")
+    assert not analysis.analyze_source(pragma)
+
+
+def test_plain_scalar_casts_not_flagged():
+    """float()/int() on plain names are ordinary Python conversions,
+    not host syncs — only the tensor-shaped float(x.sum()) pattern
+    warns."""
+    src = """
+import paddle_tpu as paddle
+
+@paddle.jit.to_static
+def step(x, lr):
+    scale = float(lr)
+    n = int(3.5)
+    return x * scale * n
+"""
+    assert not analysis.analyze_source(src)
+    hostile = src.replace("float(lr)", "float(x.sum())")
+    assert [d.code for d in analysis.analyze_source(hostile)] == ["PDT101"]
+
+
+def test_suppress_decorator_visible_to_source_lint():
+    """The CLI (source-only) honors @analysis.suppress syntactically,
+    matching the runtime tag the decorator sets."""
+    src = """
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+
+@paddle.jit.to_static
+@analysis.suppress("PDT101")
+def step(x):
+    return x.numpy()
+"""
+    assert not [d for d in analysis.analyze_source(src)
+                if d.code == "PDT101"]
+    bare = src.replace('analysis.suppress("PDT101")', "analysis.suppress()")
+    assert not analysis.analyze_source(bare)
+    other = src.replace('"PDT101"', '"PDT106"')
+    assert [d.code for d in analysis.analyze_source(other)] == ["PDT101"]
+
+
+def test_check_function_reports_real_file_and_line():
+    def step(x):
+        return x.numpy()
+
+    diags = analysis.check_function(step)
+    assert len(diags) == 1
+    assert diags[0].file.endswith("test_analysis.py")
+    # the finding points at the `return x.numpy()` line of THIS file
+    import inspect
+    lines, start = inspect.getsourcelines(step)
+    assert diags[0].line == start + 1
+
+
+# ==========================================================================
+# mode flag: off | warn | error  (to_static wiring)
+# ==========================================================================
+
+def _entropy_fn():
+    # triggers PDT106 but still captures fine (constant gets baked)
+    import random
+
+    @paddle.jit.to_static
+    def step(x):
+        return x * random.random()
+    return step
+
+
+def test_mode_off_is_silent(_mode):
+    _mode("off")
+    step = _entropy_fn()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        step(paddle.to_tensor(np.ones(2, np.float32)))
+    assert not [x for x in w if isinstance(x.message, LintWarning)]
+
+
+def test_mode_warn_emits_lint_warning(_mode):
+    _mode("warn")
+    step = _entropy_fn()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        step(paddle.to_tensor(np.ones(2, np.float32)))
+    lint = [x for x in w if isinstance(x.message, LintWarning)]
+    assert any("PDT106" in str(x.message) for x in lint)
+    # dedup: the same site reports once per session
+    with warnings.catch_warnings(record=True) as w2:
+        warnings.simplefilter("always")
+        analysis.lint_callable(step.fn)
+    assert not [x for x in w2 if "PDT106" in str(x.message)]
+
+
+def test_mode_error_raises(_mode):
+    _mode("error")
+    step = _entropy_fn()
+    t = paddle.to_tensor(np.ones(2, np.float32))
+    with pytest.raises(errors.StaticAnalysisError, match="PDT106"):
+        step(t)
+    # the gate holds across calls (not a one-shot raise) ...
+    with pytest.raises(errors.StaticAnalysisError, match="PDT106"):
+        step(t)
+    # ... and the blocked calls did not burn the conversion attempt:
+    # once suppressed, the function still captures and runs
+    with analysis.suppress("PDT106"):
+        out = step(t)
+    assert out.shape == [2]
+
+
+def test_mode_error_respects_suppression(_mode):
+    _mode("error")
+
+    @analysis.suppress("PDT106")
+    def raw(x):
+        import random
+        return x * random.random()
+
+    step = paddle.jit.to_static(raw)
+    out = step(paddle.to_tensor(np.ones(2, np.float32)))
+    assert out.shape == [2]
+
+
+def test_warn_mode_dedup_does_not_disarm_error_gate(_mode):
+    """A site already surfaced as a warning must still raise once the
+    user escalates to error mode."""
+    _mode("warn")
+
+    def fn(x):
+        return x.numpy()
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        analysis.lint_callable(fn)
+    assert any("PDT101" in str(x.message) for x in w)
+    _mode("error")
+    with pytest.raises(errors.StaticAnalysisError, match="PDT101"):
+        analysis.lint_callable(fn)
+
+
+def test_mode_error_gates_dy2static_decline(_mode):
+    """A conversion-decline diagnostic (foreign decorator -> PDT107)
+    must surface through _converted's exception handling, repeatedly,
+    without burning the conversion attempt."""
+    _mode("error")
+
+    def deco(f):
+        return f
+
+    @deco
+    def fn(x):
+        if x.mean() > 0:
+            return x * 2
+        return x
+
+    step = paddle.jit.to_static(fn)
+    t = paddle.to_tensor(np.ones(2, np.float32))
+    with pytest.raises(errors.StaticAnalysisError, match="PDT107"):
+        step(t)
+    with pytest.raises(errors.StaticAnalysisError, match="PDT107"):
+        step(t)  # gate holds across calls
+    with analysis.suppress("PDT107"), warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # eager fallback chatter
+        out = step(t)
+    np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+
+
+# ==========================================================================
+# dy2static graph-break decline sites emit PDT1xx (satellite)
+# ==========================================================================
+
+def test_dy2static_decline_emits_pdt105():
+    @paddle.jit.to_static
+    def fn(x):
+        if x.sum() > 0:  # escape inside try blocks conversion of the if
+            try:
+                return x * 2
+            finally:
+                pass
+        return x
+
+    with analysis.collect() as diags:
+        fn(paddle.to_tensor(np.ones(2, np.float32)))
+    hits = [d for d in diags if d.code == "PDT105"]
+    assert hits, f"no PDT105 in {[d.format() for d in diags]}"
+    assert hits[0].file.endswith("test_analysis.py")
+    import inspect
+    lines, start = inspect.getsourcelines(fn.fn)
+    assert start < hits[0].line < start + len(lines)
+
+
+def test_dy2static_nonlocal_decline_emits_pdt107():
+    k = [0]
+
+    def outer():
+        n = 0
+
+        def fn(x):
+            nonlocal n
+            n += 1
+            return x * 2
+        return fn
+
+    step = paddle.jit.to_static(outer())
+    with analysis.collect() as diags:
+        step(paddle.to_tensor(np.ones(2, np.float32)))
+    assert any(d.code == "PDT107" for d in diags), \
+        [d.format() for d in diags]
+    assert k == [0]  # sanity: closure untouched
+
+
+def test_suppress_decorator_composes_with_to_static():
+    """@analysis.suppress must not block dy2static conversion (it tags,
+    it does not wrap): tensor control flow still compiles."""
+    @paddle.jit.to_static
+    @analysis.suppress("PDT106")
+    def fn(x):
+        if x.mean() > 0:
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y
+
+    t = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(fn(t).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(
+        fn(paddle.to_tensor(np.asarray([-1.0, -2.0], np.float32))).numpy(),
+        [-2.0, -3.0])
+    sf = fn if hasattr(fn, "_fallback_keys") else fn.__wrapped__
+    assert not sf._fallback_keys, "suppress decorator broke conversion"
+    assert len(sf._cache) == 1
+
+
+# ==========================================================================
+# IR front-end wiring: captured executables carry a jaxpr + lint hookup
+# ==========================================================================
+
+def test_capture_runs_ir_lint_then_releases_jaxpr():
+    w = paddle.to_tensor(np.ones(4, np.float32))
+    n = paddle.to_tensor(3)  # weak-typed python-int scalar state
+
+    @paddle.jit.to_static
+    def step2(x):
+        return x * 2 + w.sum() + n
+
+    t = paddle.to_tensor(np.ones(4, np.float32))
+    with analysis.collect() as diags:
+        out = step2(t)
+    np.testing.assert_allclose(out.numpy(), np.ones(4) * 2 + 4 + 3)
+    # the weak-typed capture input surfaced through the capture-time
+    # IR lint (PDT205 is note severity: visible to collect, not warned)
+    assert any(d.code == "PDT205" for d in diags), \
+        [d.format() for d in diags]
+    exe = step2.concrete_program(t)
+    assert exe is not None
+    assert exe.jaxpr is None  # released after the capture lint (memory)
+    assert exe.n_explicit_args == 1
+    assert analysis.check_executable(exe) == []  # released -> no-op
+
+
+def test_suppress_tag_covers_ir_findings():
+    n = paddle.to_tensor(3)
+
+    @paddle.jit.to_static
+    @analysis.suppress("PDT205")
+    def step(x):
+        return x + n
+
+    with analysis.collect() as diags:
+        step(paddle.to_tensor(np.ones(4, np.float32)))
+    assert not [d for d in diags if d.code == "PDT205"], \
+        [d.format() for d in diags]
+
+
+def test_report_runtime_each_occurrence_and_never_raises(_mode):
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        analysis.report_runtime("PDT206", "loop A truncated")
+        analysis.report_runtime("PDT206", "loop B truncated")
+    lint = [x for x in w if isinstance(x.message, LintWarning)]
+    assert len(lint) == 2  # runtime events are never deduped
+    _mode("error")
+    with warnings.catch_warnings(record=True) as w2:
+        warnings.simplefilter("always")
+        analysis.report_runtime("PDT206", "truncated mid-step")
+    # fires mid-execution (jax.debug.callback): degrade to a warning
+    # rather than aborting the compiled step with a corrupt result
+    assert any(isinstance(x.message, LintWarning) for x in w2)
+    # and even with the lint OFF, a warn-severity runtime event (wrong
+    # numerics) is not silenced
+    _mode("off")
+    with warnings.catch_warnings(record=True) as w3:
+        warnings.simplefilter("always")
+        analysis.report_runtime("PDT206", "truncated with lint off")
+    assert any(isinstance(x.message, LintWarning) for x in w3)
+
+
+def test_check_traced_flags_dead_code_and_weak_types():
+    import jax.numpy as jnp
+
+    def f(x):
+        unused = jnp.sin(x) * jnp.cos(x)
+        return x * 2
+
+    codes = {d.code for d in analysis.check_traced(
+        f, jnp.ones((4,), jnp.float32))}
+    assert "PDT204" in codes
+    codes2 = {d.code for d in analysis.check_traced(lambda x: x * 2.0, 3.0)}
+    assert "PDT205" in codes2
+
+
+# ==========================================================================
+# hapi wiring
+# ==========================================================================
+
+def test_hapi_prepare_lints_network(_mode):
+    from paddle_tpu import nn
+
+    class Hostile(nn.Layer):
+        def forward(self, x):  # linted with jit=True by prepare
+            import random
+            return x * random.random()
+
+    _mode("error")
+    m = paddle.Model(Hostile())
+    with pytest.raises(errors.StaticAnalysisError, match="PDT106"):
+        m.prepare(loss=nn.MSELoss())
+
+    _mode("off")
+    m2 = paddle.Model(Hostile())
+    m2.prepare(loss=nn.MSELoss())  # off: same network sails through
+
+
+# ==========================================================================
+# CLI
+# ==========================================================================
+
+def _write(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return p
+
+
+def test_cli_finds_and_gates(tmp_path, capsys):
+    from paddle_tpu.analysis.__main__ import main
+    _write(tmp_path, "bad.py", """
+        import paddle_tpu as paddle
+
+        @paddle.jit.to_static
+        def step(x):
+            return x.numpy()
+        """)
+    _write(tmp_path, "clean.py", """
+        def helper(x):
+            return x.numpy()  # not jit: fine
+        """)
+    rc = main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0  # warn severity does not gate by default
+    assert "PDT101" in out and "bad.py" in out and "clean.py" not in out
+    assert "(0 error, 1 warn, 0 note)" in out
+
+    rc = main([str(tmp_path), "--strict"])
+    capsys.readouterr()
+    assert rc == 1  # --strict gates on warn
+
+    rc = main([str(tmp_path), "--select", "PDT106", "--strict"])
+    capsys.readouterr()
+    assert rc == 0  # filtered out
+
+
+def test_cli_assume_jit(tmp_path, capsys):
+    from paddle_tpu.analysis.__main__ import main
+    _write(tmp_path, "plain.py", """
+        def helper(x):
+            return x.numpy()
+        """)
+    rc = main([str(tmp_path)])
+    assert "PDT101" not in capsys.readouterr().out and rc == 0
+    rc = main([str(tmp_path), "--assume-jit"])
+    assert "PDT101" in capsys.readouterr().out and rc == 0
+
+
+def test_cli_list_codes(capsys):
+    from paddle_tpu.analysis.__main__ import main
+    assert main(["--list-codes"]) == 0
+    out = capsys.readouterr().out
+    for code in analysis.REGISTRY:
+        assert code in out

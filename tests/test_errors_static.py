@@ -59,3 +59,68 @@ def test_save_load_inference_model_roundtrip(tmp_path):
     out = exe.run(prog, feed={feed_names[0]: x}, fetch_list=fetch_names)
     ref = np.asarray(net(paddle.to_tensor(x))._read())
     np.testing.assert_allclose(out[0], ref, atol=1e-5)
+
+
+def test_error_codes_stable_and_unique():
+    """Every EnforceNotMet subclass carries a stable, unique error_code
+    (the phi::ErrorCode analog)."""
+    import re
+
+    def subclasses(cls):
+        out = set()
+        for c in cls.__subclasses__():
+            out.add(c)
+            out |= subclasses(c)
+        return out
+
+    classes = {errors.EnforceNotMet} | subclasses(errors.EnforceNotMet)
+    codes = {}
+    for c in classes:
+        code = c.__dict__.get("error_code")
+        assert code, f"{c.__name__} has no own error_code"
+        assert re.match(r"^PDT-E\d{3}$", code), (c.__name__, code)
+        assert code not in codes, \
+            f"{c.__name__} shares {code} with {codes[code]}"
+        codes[code] = c.__name__
+    # the documented anchors stay put (stability contract)
+    assert errors.EnforceNotMet.error_code == "PDT-E000"
+    assert errors.InvalidArgumentError.error_code == "PDT-E001"
+    assert errors.StaticAnalysisError.error_code == "PDT-E012"
+
+
+def test_reraise_preserves_cause_and_traceback():
+    """_reraise_with_op_context must chain the original exception as
+    __cause__ with its traceback intact (the frames that actually
+    raised), and tag the wrapper with the op name + error code."""
+    import traceback
+
+    from paddle_tpu.core import dispatch
+
+    def kernel(x):
+        raise ZeroDivisionError("boom in kernel")
+
+    with pytest.raises(errors.InvalidArgumentError) as ei:
+        dispatch.apply("my_op", kernel, paddle.to_tensor(np.zeros(2)))
+    e = ei.value
+    assert isinstance(e.__cause__, ZeroDivisionError)
+    assert str(e.__cause__) == "boom in kernel"
+    tb = e.__cause__.__traceback__
+    assert tb is not None
+    frames = [f.name for f in traceback.extract_tb(tb)]
+    assert "kernel" in frames, frames  # the raising frame survived
+    assert e.op_name == "my_op"
+    assert "my_op" in str(e) and "[PDT-E001]" in str(e)
+
+
+def test_op_context_keeps_framework_error_codes():
+    """A framework-typed kernel error passes through unwrapped, code and
+    all (EnforceNotMet never gets double-wrapped)."""
+    from paddle_tpu.core import dispatch
+
+    def kernel(x):
+        raise errors.OutOfRangeError("index 9 out of range")
+
+    with pytest.raises(errors.OutOfRangeError) as ei:
+        dispatch.apply("gather", kernel, paddle.to_tensor(np.zeros(2)))
+    assert ei.value.error_code == "PDT-E003"
+    assert ei.value.__cause__ is None  # passed through, not wrapped
