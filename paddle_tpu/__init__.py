@@ -53,6 +53,7 @@ from . import version  # noqa: F401
 from . import vision  # noqa: F401
 from . import hapi  # noqa: F401
 from . import analysis  # noqa: F401
+from . import resilience  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .hapi import callbacks  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401

@@ -265,9 +265,20 @@ class GradScaler:
                 "use_dynamic_loss_scaling": self._dynamic}
 
     def set_state_dict(self, sd):
-        self._scale = sd.get("scale", self._scale)
-        self._good_steps = sd.get("good_steps", 0)
-        self._bad_steps = sd.get("bad_steps", 0)
+        # restore EVERY knob state_dict() saves — dropping the
+        # incr/decr policy here made a resumed fp16 run scale on the
+        # constructor defaults instead of the trained-with policy
+        self._scale = float(sd.get("scale", self._scale))
+        self._incr_ratio = float(sd.get("incr_ratio", self._incr_ratio))
+        self._decr_ratio = float(sd.get("decr_ratio", self._decr_ratio))
+        self._incr_every = int(sd.get("incr_every_n_steps",
+                                      self._incr_every))
+        self._decr_every = int(sd.get("decr_every_n_nan_or_inf",
+                                      self._decr_every))
+        self._dynamic = bool(sd.get("use_dynamic_loss_scaling",
+                                    self._dynamic))
+        self._good_steps = int(sd.get("good_steps", 0))
+        self._bad_steps = int(sd.get("bad_steps", 0))
 
 
 def is_bfloat16_supported(device=None):

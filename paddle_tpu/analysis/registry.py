@@ -187,18 +187,22 @@ class suppress:
     def __init__(self, *codes: str):
         self.codes = frozenset(c.upper() for c in codes) or \
             frozenset(REGISTRY)
+        self._tokens: list = []
 
     def __enter__(self):
-        # a fresh token per entry so interleaved exits across threads
-        # (or re-entry of one instance) remove exactly their own frame
-        self._token = object()
-        _suppress_state.stack.append((self._token, self.codes))
+        # a fresh token per entry, kept in a per-instance LIFO so
+        # nested re-entry of one instance pairs each exit with its own
+        # frame (a single slot would leak the outer frame forever)
+        token = object()
+        self._tokens.append(token)
+        _suppress_state.stack.append((token, self.codes))
         return self
 
     def __exit__(self, *exc):
+        token = self._tokens.pop() if self._tokens else None
         stack = _suppress_state.stack
         for i in range(len(stack) - 1, -1, -1):
-            if stack[i][0] is self._token:
+            if stack[i][0] is token:
                 del stack[i]
                 break
         return False

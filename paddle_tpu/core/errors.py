@@ -76,6 +76,32 @@ class StaticAnalysisError(EnforceNotMet):
     error_code = "PDT-E012"
 
 
+class NonFiniteStepError(EnforceNotMet, FloatingPointError):
+    """Raised by ``resilience.StepGuard`` when MORE than the budgeted
+    number of consecutive training steps produced non-finite loss or
+    gradients (each bad step inside the budget is skipped in-graph, so
+    parameters and optimizer state stay clean up to the raise)."""
+
+    error_code = "PDT-E013"
+
+
+class CheckpointCorruptError(EnforceNotMet):
+    """A checkpoint exists but fails validation — torn write, missing
+    shard/data files, or a manifest that doesn't match the files on
+    disk. The message lists the offending files/keys; versioned loads
+    (``resilience.CheckpointManager``) fall back to the previous
+    complete version instead of surfacing this."""
+
+    error_code = "PDT-E014"
+
+
+class CheckpointNotFoundError(EnforceNotMet, FileNotFoundError):
+    """No loadable checkpoint at the given location (no versions at
+    all, or every version failed validation)."""
+
+    error_code = "PDT-E015"
+
+
 def enforce(cond: bool, msg: str, exc=InvalidArgumentError):
     """PADDLE_ENFORCE: raise ``exc`` with ``msg`` unless ``cond``."""
     if not cond:

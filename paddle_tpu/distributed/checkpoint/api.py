@@ -23,6 +23,8 @@ import pickle
 import jax
 import numpy as np
 
+from ...core.errors import (CheckpointCorruptError,
+                            CheckpointNotFoundError, NotFoundError)
 from ...core.tensor import Tensor
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 
@@ -89,25 +91,47 @@ def save_state_dict(state_dict, path, process_group=None,
                 _data_file(rank)
             meta.global_shapes[key] = tuple(block.shape)
 
-    np.savez(os.path.join(path, _data_file(rank)), **arrays)
+    # atomic commits (resilience.atomic): a death mid-save leaves stray
+    # temp files, never a half-written .npz/manifest under the real name.
+    # The manifest lands LAST — a checkpoint with data but no manifest
+    # reads as absent, not corrupt.
+    from ...resilience.atomic import atomic_write
+
+    with atomic_write(os.path.join(path, _data_file(rank))) as f:
+        np.savez(f, **arrays)
     # every process writes its own manifest piece — addressable_shards is
     # per-process, so on a multi-host pod no single rank sees every shard;
     # load merges all pieces (the reference's merge_state_dict_metadata)
-    with open(os.path.join(path, _manifest_file(rank)), "wb") as f:
+    with atomic_write(os.path.join(path, _manifest_file(rank))) as f:
         pickle.dump(meta, f)
 
 
 def _read_manifest(path) -> Metadata:
     """Merge every rank's manifest piece (reference
     ``save_state_dict.py:50`` merge_state_dict_metadata)."""
-    pieces = sorted(f for f in os.listdir(path)
+    try:
+        entries = os.listdir(path)
+    except FileNotFoundError:
+        raise CheckpointNotFoundError(
+            f"no checkpoint directory at {path} "
+            f"[{CheckpointNotFoundError.error_code}]") from None
+    pieces = sorted(f for f in entries
                     if f == _MANIFEST or f.startswith(_MANIFEST + "."))
     if not pieces:
-        raise FileNotFoundError(f"no checkpoint manifest under {path}")
+        raise CheckpointNotFoundError(
+            f"no checkpoint manifest under {path} (torn save? a "
+            "complete checkpoint always has one) "
+            f"[{CheckpointNotFoundError.error_code}]")
     merged = Metadata()
     for fname in pieces:
-        with open(os.path.join(path, fname), "rb") as f:
-            meta = pickle.load(f)
+        try:
+            with open(os.path.join(path, fname), "rb") as f:
+                meta = pickle.load(f)
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"checkpoint manifest {os.path.join(path, fname)} is "
+                f"unreadable ({type(e).__name__}: {e}) — torn write? "
+                f"[{CheckpointCorruptError.error_code}]") from e
         for key, lms in meta.state_dict_metadata.items():
             have = merged.state_dict_metadata.setdefault(key, [])
             seen = {lm.global_offset for lm in have}
@@ -122,34 +146,100 @@ def _load_file(path, fname, cache):
     if fname not in cache:
         fp = os.path.join(path, fname)
         if not os.path.exists(fp):
-            raise FileNotFoundError(
-                f"checkpoint shard file {fp} missing (saved from more "
-                "processes than are loading? copy all shard files)")
-        cache[fname] = np.load(fp)
+            raise CheckpointCorruptError(
+                f"checkpoint shard file {fp} missing (torn save, or "
+                "saved from more processes than are loading? copy all "
+                f"shard files) [{CheckpointCorruptError.error_code}]")
+        try:
+            cache[fname] = np.load(fp)
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"checkpoint shard file {fp} is unreadable "
+                f"({type(e).__name__}: {e}) — torn write? "
+                f"[{CheckpointCorruptError.error_code}]") from e
     return cache[fname]
+
+
+def _shard_block(meta, path, key, lm, cache):
+    """One shard's block, with manifest-vs-file mismatches coded."""
+    fname = meta.storage_metadata[LocalTensorIndex(key, lm.global_offset)]
+    data = _load_file(path, fname, cache)
+    skey = _shard_key(key, lm.global_offset)
+    if skey not in data.files:
+        raise CheckpointCorruptError(
+            f"manifest mismatch: {fname} has no entry {skey!r} for "
+            f"tensor '{key}' (manifest and data file disagree) "
+            f"[{CheckpointCorruptError.error_code}]")
+    return data[skey]
 
 
 def _assemble(meta: Metadata, path, key, cache):
     """Gather every shard of ``key`` into the global ndarray."""
     if key not in meta.state_dict_metadata:
-        raise KeyError(f"checkpoint has no tensor '{key}'")
+        raise NotFoundError(
+            f"checkpoint has no tensor '{key}' "
+            f"[{NotFoundError.error_code}]")
     gshape = meta.global_shapes[key]
     shards = meta.state_dict_metadata[key]
     if len(shards) == 1 and tuple(shards[0].local_shape) == tuple(gshape):
-        fname = meta.storage_metadata[
-            LocalTensorIndex(key, shards[0].global_offset)]
-        return _load_file(path, fname, cache)[
-            _shard_key(key, shards[0].global_offset)]
+        return _shard_block(meta, path, key, shards[0], cache)
     out = np.empty(gshape, dtype=shards[0].dtype)
     for lm in shards:
-        fname = meta.storage_metadata[
-            LocalTensorIndex(key, lm.global_offset)]
-        block = _load_file(path, fname, cache)[
-            _shard_key(key, lm.global_offset)]
+        block = _shard_block(meta, path, key, lm, cache)
         sl = tuple(slice(o, o + s)
                    for o, s in zip(lm.global_offset, lm.local_shape))
         out[sl] = block
     return out
+
+
+def validate_checkpoint(path, keys=None):
+    """Validate the manifest and the presence of every shard file it
+    references (all keys, or just ``keys``). Returns the merged
+    manifest; raises ``CheckpointNotFoundError`` /
+    ``CheckpointCorruptError`` (listing EVERY offending key/file, not
+    just the first) on failure."""
+    meta = _read_manifest(path)
+    want = list(meta.state_dict_metadata) if keys is None else list(keys)
+    missing_keys = [k for k in want if k not in meta.state_dict_metadata]
+    if missing_keys:
+        raise NotFoundError(
+            f"checkpoint at {path} has no tensor(s) {missing_keys} "
+            f"(it holds {len(meta.state_dict_metadata)} tensors) "
+            f"[{NotFoundError.error_code}]")
+    # shard COVERAGE: a rank that died between its data write and its
+    # manifest write leaves a merged manifest that lists only the other
+    # ranks' shards — every file it names exists, but _assemble would
+    # fill the dead rank's regions of np.empty with garbage. Disjoint
+    # shards covering the global shape have volumes summing to it.
+    uncovered = []
+    for key in want:
+        gshape = meta.global_shapes.get(key)
+        vol = sum(int(np.prod(lm.local_shape))
+                  for lm in meta.state_dict_metadata[key])
+        if gshape is None or vol != int(np.prod(gshape)):
+            uncovered.append(key)
+    if uncovered:
+        raise CheckpointCorruptError(
+            f"checkpoint at {path}: shards of {uncovered} do not cover "
+            "their global shapes (a rank's manifest piece missing? "
+            "torn multi-host save — copy every rank's manifest) "
+            f"[{CheckpointCorruptError.error_code}]")
+    bad = {}  # file -> affected keys
+    for key in want:
+        for lm in meta.state_dict_metadata[key]:
+            idx = LocalTensorIndex(key, lm.global_offset)
+            fname = meta.storage_metadata.get(idx)
+            if fname is None:
+                bad.setdefault("<no storage entry>", set()).add(key)
+            elif not os.path.exists(os.path.join(path, fname)):
+                bad.setdefault(fname, set()).add(key)
+    if bad:
+        detail = "; ".join(
+            f"{f} (tensors: {sorted(ks)})" for f, ks in sorted(bad.items()))
+        raise CheckpointCorruptError(
+            f"checkpoint at {path} is missing shard data: {detail} "
+            f"[{CheckpointCorruptError.error_code}]")
+    return meta
 
 
 def load_state_dict(state_dict, path, process_group=None,
@@ -157,8 +247,10 @@ def load_state_dict(state_dict, path, process_group=None,
     """Reference ``load_state_dict.py:377``: fill ``state_dict``'s tensors
     in place, resharding each value onto the tensor's *current* placement
     (cross-topology restore). Keys in the checkpoint but not requested are
-    ignored (partial load, as the reference)."""
-    meta = _read_manifest(path)
+    ignored (partial load, as the reference). Validation runs up front:
+    missing tensors / shard files raise coded errors (PDT-E002 /
+    PDT-E014) listing every offender before anything is written."""
+    meta = validate_checkpoint(path, keys=state_dict.keys())
     cache = {}
     for key, t in state_dict.items():
         arr = _assemble(meta, path, key, cache)

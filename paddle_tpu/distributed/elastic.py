@@ -15,7 +15,12 @@ aborts comms after ``pg_timeout``) — TPU-shaped:
   HOSTS the store, losing that node still ends rendezvous (the
   reference's external etcd survives its clients, ``manager.py:126``) —
   host the store externally (``--master`` on a machine outside the job)
-  to remove that leg. The SCAN is no longer a SPOF either way: the
+  to remove that leg. Since the resilience layer landed
+  (``paddle_tpu.resilience``; README "Fault tolerance") a store-host
+  loss is no longer fatal to the JOB either way: client ops
+  retry/backoff through transient blips, and a hard loss is recovered
+  by relaunching with ``Model.fit(resume=True)``, which restores from
+  the newest COMPLETE versioned checkpoint. The SCAN is no longer a SPOF either way: the
   scanning master heartbeats ``elastic/master_hb``; on loss, standby
   agents elect the alive node first in registration order, which takes
   over scanning and generation publishing (see ``_standby_loop``;

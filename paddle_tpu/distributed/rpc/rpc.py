@@ -136,9 +136,27 @@ def get_current_worker_info():
     return _require_state()[3]
 
 
+def _connect(info, timeout):
+    """Connect to a peer agent, retrying transient refusals with
+    backoff (resilience.retry) — a worker mid-restart under the elastic
+    manager refuses connections for a moment. Only the CONNECT phase
+    retries: once the request is on the wire a retry could execute the
+    call twice, so send/recv failures propagate to the caller."""
+    from ...resilience import faults
+    from ...resilience.retry import retry_call
+
+    def attempt():
+        faults.maybe_raise("rpc_transient", info.name)
+        return socket.create_connection((info.ip, info.port),
+                                        timeout=timeout)
+
+    return retry_call(attempt, max_attempts=4, base_delay=0.05,
+                      retry_on=(ConnectionError,))
+
+
 def _invoke(to, fn, args, kwargs, timeout):
     info = get_worker_info(to)
-    conn = socket.create_connection((info.ip, info.port), timeout=timeout)
+    conn = _connect(info, timeout)
     if timeout and timeout > 0:
         conn.settimeout(timeout)
     try:

@@ -31,6 +31,7 @@ import threading
 import time
 
 _OP_SET, _OP_GET, _OP_ADD, _OP_DEL, _OP_CLOSE = 1, 2, 3, 4, 5
+_OP_NAMES = {1: "set", 2: "get", 3: "add", 4: "delete", 5: "close"}
 
 
 # --- generic pickle framing (rpc/ps protocols, NOT the store's) ----------
@@ -200,9 +201,63 @@ class TCPStore:
         from .native import NativeStoreServer
         return isinstance(self._server, NativeStoreServer)
 
+    def _reconnect(self):
+        """Best-effort fresh connection; a failure here surfaces on the
+        next request attempt (which the retry loop owns)."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        try:
+            self._sock = socket.create_connection(self._addr, timeout=5)
+            self._sock.settimeout(None)
+        except OSError:
+            pass
+
     def _call(self, op, key, payload=b""):
-        with self._lock:
-            return _store_request(self._sock, op, key, payload)
+        # Transient failures (peer restarting, connection reset) are
+        # retried with exponential backoff after a reconnect
+        # (resilience.retry). GET/SET/DEL are idempotent and retry
+        # unconditionally. ADD is NOT: a reply lost after the server
+        # applied the increment would double-count on resend — one
+        # barrier arrival counted twice releases the barrier early and
+        # desyncs every later generation — so in practice only
+        # injected (pre-send) faults retry for ADD; every error from
+        # the exchange itself is tagged in-flight and propagates to the
+        # caller. CLOSE never retries (the common failure is the server
+        # already being gone).
+        from ..resilience import faults
+        from ..resilience.retry import retry_call
+
+        # the lock covers one request/response exchange (and the
+        # reconnect that swaps the socket) but NOT the backoff sleeps —
+        # holding it across retries would stall every other thread's
+        # store op (e.g. the elastic heartbeat) behind one blip, turning
+        # the transient failure into the peer-death it was meant to
+        # ride out
+        def attempt():
+            with self._lock:
+                faults.maybe_raise("store_transient",
+                                   _OP_NAMES.get(op, str(op)))
+                try:
+                    return _store_request(self._sock, op, key, payload)
+                except (ConnectionError, OSError) as e:
+                    e._pdtpu_in_flight = True  # may have reached server
+                    raise
+
+        def non_retryable(e):
+            return op == _OP_ADD and getattr(e, "_pdtpu_in_flight",
+                                             False)
+
+        def recover(e, k):
+            with self._lock:
+                self._reconnect()
+
+        if op == _OP_CLOSE:
+            return attempt()
+        return retry_call(attempt, max_attempts=4, base_delay=0.05,
+                          retry_on=(ConnectionError, OSError),
+                          giveup=non_retryable, on_retry=recover)
 
     def set(self, key, value):
         if isinstance(value, str):

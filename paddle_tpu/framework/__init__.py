@@ -6,7 +6,6 @@ numpy on save and restored as device tensors on load.
 """
 from __future__ import annotations
 
-import os
 import pickle
 
 import jax.numpy as jnp
@@ -47,10 +46,11 @@ def _to_device(obj):
 
 
 def save(obj, path, protocol=4):
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
+    # atomic commit (resilience.atomic): a crash mid-save leaves the
+    # previous file intact instead of a torn pickle that loads garbage
+    from ..resilience.atomic import atomic_write
+
+    with atomic_write(path) as f:
         pickle.dump(_to_host(obj), f, protocol=protocol)
 
 

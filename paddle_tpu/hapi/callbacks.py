@@ -136,17 +136,53 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    """Periodic save (reference ``callbacks.py:517``)."""
+    """Periodic save (reference ``callbacks.py:517``). Saves go through
+    ``framework.save``, which commits atomically (temp + fsync +
+    rename) — a death mid-save can no longer leave a torn
+    ``<epoch>.pdparams`` that later loads as garbage. ``keep_last=K``
+    garbage-collects epoch saves beyond the newest K (the ``final`` /
+    ``best_model`` saves are never collected)."""
 
-    def __init__(self, save_freq=1, save_dir=None):
+    def __init__(self, save_freq=1, save_dir=None, keep_last=None):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.keep_last = None if keep_last is None else max(1, keep_last)
+        self._saved = []
+
+    def on_train_begin(self, logs=None):
+        # seed GC state from disk: after a preemption restart (or a
+        # second fit on this Model) the previous attempt's epoch saves
+        # must count toward keep_last, or the directory grows without
+        # bound across restarts
+        if not (self.save_dir and self.keep_last is not None):
+            return
+        try:
+            names = os.listdir(self.save_dir)
+        except OSError:
+            names = []
+        self._saved = sorted({
+            int(f.rsplit(".", 1)[0]) for f in names
+            if f.endswith((".pdparams", ".pdopt"))
+            and f.rsplit(".", 1)[0].isdigit()})
 
     def on_epoch_end(self, epoch, logs=None):
         if self.save_dir and (epoch + 1) % self.save_freq == 0:
             path = os.path.join(self.save_dir, str(epoch))
             self.model.save(path)
+            if self.keep_last is None:
+                return
+            if epoch in self._saved:  # resumed run re-saving an epoch
+                self._saved.remove(epoch)
+            self._saved.append(epoch)
+            while len(self._saved) > self.keep_last:
+                old = self._saved.pop(0)
+                for suffix in (".pdparams", ".pdopt"):
+                    try:
+                        os.remove(os.path.join(self.save_dir,
+                                               str(old) + suffix))
+                    except OSError:
+                        pass
 
     def on_train_end(self, logs=None):
         if self.save_dir:

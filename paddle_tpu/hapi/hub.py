@@ -11,10 +11,51 @@ import importlib.util
 import os
 import sys
 
-__all__ = ['list', 'help', 'load']
+__all__ = ['list', 'help', 'load', 'download']
 
 MODULE_HUBCONF = 'hubconf.py'
 VAR_DEPENDENCY = 'dependencies'
+
+
+def download(url, dst, fetcher=None, max_attempts=4):
+    """Fetch ``url`` into ``dst`` atomically, retrying transient
+    failures with exponential backoff (``resilience.retry``) — flaky
+    object stores are the rule, not the exception, at fleet scale.
+
+    ``fetcher(url) -> bytes`` defaults to urllib (this runtime has no
+    egress, so pass your own for air-gapped mirrors and in tests). The
+    write commits through ``resilience.atomic_write``: a crash
+    mid-download never leaves a half file under ``dst``.
+    """
+    from ..resilience import faults
+    from ..resilience.atomic import atomic_write
+    from ..resilience.retry import retry_call
+
+    if fetcher is None:
+        def fetcher(u):
+            from urllib.request import urlopen
+            with urlopen(u) as r:
+                return r.read()
+
+    def attempt():
+        faults.maybe_raise("download_transient", os.path.basename(dst))
+        return fetcher(url)
+
+    def permanent(e):
+        # urllib's HTTPError subclasses OSError; a 4xx is not transient
+        code = getattr(e, "code", None)
+        return code is not None and 400 <= int(code) < 500
+
+    # http.client.HTTPException covers mid-body drops (IncompleteRead,
+    # chunked-encoding errors) that are NOT OSError subclasses but are
+    # exactly the flaky-store failures worth retrying
+    import http.client
+    data = retry_call(attempt, max_attempts=max_attempts,
+                      retry_on=(OSError, http.client.HTTPException),
+                      giveup=permanent)
+    with atomic_write(dst) as f:
+        f.write(data)
+    return dst
 
 
 def _import_module(name, repo_dir):

@@ -11,6 +11,7 @@ default because eager dispatch over a TPU link is the slow path.
 from __future__ import annotations
 
 import os
+import signal
 
 import numpy as np
 
@@ -55,10 +56,18 @@ class Model:
         self._train_step_noupd = None
         self._eval_step = None
         self._accumulate = 1
+        self._step_guard = None
+        self._preempted = False
+        self._preempt_position = None
 
     # -- setup ---------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, step_guard=None):
+        """``step_guard`` (TPU extension): a ``resilience.StepGuard`` —
+        or ``True`` for the defaults — makes every non-finite train
+        step a bitwise no-op inside the compiled step and raises a
+        coded ``NonFiniteStepError`` only after the guard's
+        consecutive-bad-step budget is spent."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
@@ -66,6 +75,10 @@ class Model:
             if not isinstance(m, Metric):
                 raise TypeError(f"metrics must be paddle.metric.Metric, "
                                 f"got {type(m).__name__}")
+        if step_guard is True:
+            from ..resilience import StepGuard
+            step_guard = StepGuard()
+        self._step_guard = step_guard or None
         self._amp_level = None
         if isinstance(amp_configs, str):
             self._amp_level = amp_configs
@@ -96,6 +109,7 @@ class Model:
 
         net, loss_fn, opt = self.network, self._loss, self._optimizer
         level = self._amp_level
+        guard = self._step_guard
 
         accum = self._accumulate
 
@@ -118,7 +132,11 @@ class Model:
                     loss = loss_fn(outputs, *labels)
                 (loss / accum if accum > 1 else loss).backward()
                 if update:
-                    opt.step()
+                    if guard is not None:
+                        # in-graph non-finite skip (resilience.StepGuard)
+                        guard.guarded_step(opt, loss)
+                    else:
+                        opt.step()
                     # accum mode zeroes in place: grad buffers keep their
                     # identity so the compiled steps thread them as state
                     opt.clear_grad(set_to_zero=accum > 1)
@@ -154,8 +172,11 @@ class Model:
                 if not p.stop_gradient and p.grad is None:
                     p.grad = zeros_like(p)
         loss, outputs = step_fn(*args)
+        loss_val = float(loss)
+        if self._step_guard is not None and update:
+            self._step_guard.observe(loss_val)
         metrics = self._update_metrics(outputs, label_ts)
-        return [float(loss)] + metrics
+        return [loss_val] + metrics
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -193,7 +214,8 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None, window=1):
+            accumulate_grad_batches=1, num_iters=None, window=1,
+            resume=False, keep_last_k=3):
         """``window=K`` (TPU extension over the reference fit signature,
         ``hapi/model.py:1052``): dispatch K train steps as ONE compiled
         scan launch (``jit.WindowRunner``) with inputs pre-staged on
@@ -202,7 +224,22 @@ class Model:
         network-attached chip otherwise dominates the step time; see
         BASELINE.md. Callbacks and metrics observe every step, after
         its window completes; epoch tails shorter than K (and
-        ``accumulate_grad_batches > 1`` runs) use the per-batch path."""
+        ``accumulate_grad_batches > 1`` runs) use the per-batch path.
+
+        Resilience (TPU extension, ``paddle_tpu.resilience``): with a
+        ``save_dir``, fit keeps ``keep_last_k`` versioned checkpoints
+        (``save_dir/step_<N>``, atomic + COMPLETE-marked) — one per
+        ``save_freq`` epochs — alongside the reference-parity
+        ``<epoch>.pdparams`` saves (kept unbounded, as before; pass
+        ``ModelCheckpoint(..., keep_last=K)`` to bound those too), and
+        installs a SIGTERM/SIGINT handler
+        that checkpoints the exact position at the next step boundary
+        and exits the loops cleanly (preemption). ``resume=True``
+        restores model/optimizer/RNG from the newest COMPLETE version
+        (torn versions are skipped automatically) and continues from
+        the recorded epoch/step; with no checkpoint yet it trains from
+        scratch, so the same launch command works for attempt #1 and
+        every restart."""
         assert self._optimizer is not None, "call prepare() before fit()"
         if accumulate_grad_batches != self._accumulate:
             self._accumulate = accumulate_grad_batches
@@ -210,64 +247,167 @@ class Model:
         loader = self._loader(train_data, batch_size, shuffle, num_workers,
                               drop_last)
         steps = len(loader) if hasattr(loader, "__len__") else None
+        # NOTE: keep_last_k bounds only the resilience versions
+        # (step_<N> dirs); the reference-parity <epoch>.pdparams saves
+        # keep ALL epochs as before — deleting user checkpoints can't
+        # be a default. Opt in with callbacks=[ModelCheckpoint(
+        # save_freq, save_dir, keep_last=K)].
         cbks = config_callbacks(callbacks, self, epochs=epochs, steps=steps,
                                 verbose=verbose, log_freq=log_freq,
                                 save_freq=save_freq, save_dir=save_dir,
                                 metrics=self._metrics_name())
+        from ..resilience import preempt as _preempt
+        from ..resilience.checkpoint import CheckpointManager
+        mgr = (CheckpointManager(save_dir, keep_last_k=keep_last_k)
+               if save_dir else None)
+        start_epoch, skip_steps, it = 0, 0, 0
+        self._preempted = False
+        if resume:
+            if mgr is None:
+                raise ValueError("fit(resume=True) requires save_dir")
+            pos = self._restore_resilient(mgr)
+            if pos is not None:
+                start_epoch, skip_steps, it = pos
+                if skip_steps and shuffle and not isinstance(train_data,
+                                                             DataLoader):
+                    import warnings
+                    warnings.warn(
+                        "fit(resume=True) is fast-forwarding "
+                        f"{skip_steps} steps into an epoch, but "
+                        "shuffle=True rebuilds the batch order from "
+                        "scratch — the skipped prefix is not exactly "
+                        "the already-trained prefix (some samples "
+                        "repeat, others drop this epoch). Pass "
+                        "shuffle=False or a deterministically-ordered "
+                        "DataLoader for exact mid-epoch resume.",
+                        RuntimeWarning)
+        installed = False
+        if mgr is not None:
+            # only clear/uninstall state this fit OWNS: inside a user's
+            # own preempt.install() scope, a pending request stays
+            # pending (it is honored at the first step boundary) and
+            # the user's handler survives fit
+            installed = _preempt.install()
+            if installed:
+                _preempt.clear()
         self.stop_training = False
-        cbks.on_train_begin()
-        it = 0
-        wstate = {"runner": None}  # WindowRunner reused across epochs
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
+        try:
+            cbks.on_train_begin()
             logs = {}
-            if window > 1 and self._accumulate == 1:
-                logs, it = self._run_windowed_epoch(
-                    loader, cbks, window, it, num_iters, wstate)
-            else:
-                for step, batch in enumerate(loader):
-                    cbks.on_train_batch_begin(step)
-                    inputs, labels = self._split_batch(batch)
-                    update = ((step + 1) % self._accumulate == 0
-                              or (steps is not None and step + 1 == steps))
-                    res = self.train_batch(inputs, labels, update=update)
-                    logs = self._make_logs(res)
-                    cbks.on_train_batch_end(step, logs)
-                    it += 1
-                    if num_iters is not None and it >= num_iters:
-                        self.stop_training = True
-                        break
-            cbks.on_epoch_end(epoch, logs)
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size,
-                              num_workers=num_workers, verbose=0,
-                              callbacks=cbks)
-            if self.stop_training:
-                break
-        cbks.on_train_end(logs)
+            wstate = {"runner": None}  # WindowRunner reused across epochs
+            for epoch in range(start_epoch, epochs):
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                skip = skip_steps if epoch == start_epoch else 0
+                if window > 1 and self._accumulate == 1:
+                    logs, it = self._run_windowed_epoch(
+                        loader, cbks, window, it, num_iters, wstate,
+                        skip=skip, epoch=epoch, mgr=mgr)
+                else:
+                    for step, batch in enumerate(loader):
+                        if step < skip:
+                            continue  # fast-forward to the resume point
+                        cbks.on_train_batch_begin(step)
+                        inputs, labels = self._split_batch(batch)
+                        inputs = self._maybe_poison(inputs, it + 1)
+                        update = ((step + 1) % self._accumulate == 0
+                                  or (steps is not None
+                                      and step + 1 == steps))
+                        res = self.train_batch(inputs, labels,
+                                               update=update)
+                        logs = self._make_logs(res)
+                        cbks.on_train_batch_end(step, logs)
+                        it += 1
+                        if update:
+                            if self._maybe_preempt(mgr, epoch, step + 1,
+                                                   it, epoch_steps=steps):
+                                break
+                        else:
+                            # mid-accumulation: the partially summed
+                            # grads are not checkpointable, so only
+                            # deliver the synthetic signal here — the
+                            # request is honored (checkpoint + exit) at
+                            # the next update boundary
+                            self._fire_synthetic_preempt(mgr, it)
+                        if num_iters is not None and it >= num_iters:
+                            self.stop_training = True
+                            break
+                if self._preempted:
+                    # exit fast — the position is already checkpointed.
+                    # The epoch-boundary callbacks (ModelCheckpoint's
+                    # '<epoch>' save among them) only run if the epoch
+                    # actually completed; eval is always skipped — a
+                    # real preemption grace period doesn't fit an eval
+                    # pass
+                    if self._preempt_position[0] > epoch:
+                        cbks.on_epoch_end(epoch, logs)
+                    break
+                cbks.on_epoch_end(epoch, logs)
+                # no epoch-boundary save when the epoch was cut short
+                # (num_iters / a callback setting stop_training): its
+                # (epoch+1, 0) position would lie, and resume would
+                # silently skip the untrained remainder of the epoch.
+                # EarlyStopping is unaffected — it stops from the eval
+                # below, after the completed epoch's save.
+                if (mgr is not None and not self.stop_training
+                        and (epoch + 1) % save_freq == 0):
+                    self._resilient_save(mgr, epoch + 1, 0, it)
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    self.evaluate(eval_data, batch_size=batch_size,
+                                  num_workers=num_workers, verbose=0,
+                                  callbacks=cbks)
+                if self.stop_training:
+                    break
+            if not self._preempted:
+                # a preempted fit exits without the train-end callbacks:
+                # ModelCheckpoint.on_train_end would label half-trained
+                # weights 'final', and the extra save eats grace period
+                cbks.on_train_end(logs)
+        finally:
+            interrupted = False
+            if installed:
+                interrupted = (self._preempted and
+                               _preempt.last_signal() == signal.SIGINT)
+                _preempt.uninstall()
+                # fit owned the handler and honored (or outlived) the
+                # request — a stale sticky flag would make the next
+                # install()-scope in this process spuriously "preempt"
+                # with no signal delivered
+                _preempt.clear()
+        if interrupted:
+            # Ctrl-C keeps its abort semantics for existing callers:
+            # the position is checkpointed (resume-able), then the
+            # interrupt propagates instead of fit returning "success"
+            # into code that would treat the half-trained model as done
+            raise KeyboardInterrupt
 
     def _run_windowed_epoch(self, loader, cbks, window, it, num_iters,
-                            wstate):
+                            wstate, skip=0, epoch=0, mgr=None):
         """One epoch with K-step scanned windows (see ``fit(window=)``).
         The first batch runs per-batch (it is also the compile trigger);
         full windows then go through ONE WindowRunner launch each, with
         the scheduler advanced via ``lr_window``. Epoch tails and any
         fallback (step not compiled, LR slot not threadable) use the
-        per-batch path."""
+        per-batch path. ``skip`` resume-fast-forwards that many leading
+        batches; preemption is honored at step boundaries (window
+        flushes observe it after the window completes)."""
         from .. import jit
 
         logs, step = {}, 0
+        esteps = len(loader) if hasattr(loader, "__len__") else None
 
         def plain(inputs, labels):
             nonlocal logs, step, it
             cbks.on_train_batch_begin(step)
+            inputs = self._maybe_poison(inputs, it + 1)
             res = self.train_batch(inputs, labels)
             logs = self._make_logs(res)
             cbks.on_train_batch_end(step, logs)
             step += 1
             it += 1
+            self._maybe_preempt(mgr, epoch, step, it, epoch_steps=esteps)
 
         def peek_lrs():
             """Next K per-step LRs WITHOUT advancing the scheduler: the
@@ -291,9 +431,15 @@ class Model:
         def flush_window(buf):
             nonlocal logs, step, it
             runner = wstate["runner"]
+            # poison at EXECUTION time (step k of this window runs as
+            # global step it+k+1) so a fault-spec occurrence is counted
+            # exactly once per executed step, same as the per-batch
+            # path, and never consumed by a batch that gets discarded
+            poisoned = [(self._maybe_poison(i, it + k + 1), l)
+                        for k, (i, l) in enumerate(buf)]
             batches = [tuple(_to_tensors(i) + _to_tensors(l))
-                       for i, l in buf]
-            label_lists = [_to_tensors(l) for _, l in buf]
+                       for i, l in poisoned]
+            label_lists = [_to_tensors(l) for _, l in poisoned]
             self.network.train()
             stacks = runner.stage(batches)
             ps = [peek_lrs()] if wstate.get("lr_slot") else None
@@ -302,14 +448,30 @@ class Model:
             for k, (loss, outputs) in enumerate(
                     runner.rebuild_host(rets)):
                 cbks.on_train_batch_begin(step)
+                loss_val = float(loss)
+                if self._step_guard is not None:
+                    self._step_guard.observe(loss_val)
                 metrics = self._update_metrics(outputs, label_lists[k])
-                logs = self._make_logs([float(loss)] + metrics)
+                logs = self._make_logs([loss_val] + metrics)
                 cbks.on_train_batch_end(step, logs)
                 step += 1
                 it += 1
+                # synthetic preemption keyed on each step's number still
+                # fires, but the checkpoint waits for the window end:
+                # the whole window's updates are ALREADY applied on
+                # device, so a mid-window position would disagree with
+                # the saved weights and resume would replay applied
+                # steps
+                self._fire_synthetic_preempt(mgr, it)
+            self._maybe_preempt(mgr, epoch, step, it, epoch_steps=esteps,
+                                fire=False)
 
         buf = []
         for batch in loader:
+            if skip > 0:
+                skip -= 1  # resume fast-forward
+                step += 1
+                continue
             if self.stop_training or (num_iters is not None
                                       and it >= num_iters):
                 self.stop_training = True
@@ -330,7 +492,7 @@ class Model:
                 # top-of-loop check stops at num_iters exactly); without
                 # this the loop would buffer the whole remaining epoch
                 for i2, l2 in buf:
-                    if it >= num_iters:
+                    if self.stop_training or it >= num_iters:
                         break
                     plain(i2, l2)
                 buf = []
@@ -339,6 +501,8 @@ class Model:
                 flush_window(buf)
                 buf = []
         for inputs, labels in buf:  # epoch tail (or num_iters remnant)
+            if self.stop_training:
+                break  # preempted: the checkpoint position is final
             if num_iters is not None and it >= num_iters:
                 self.stop_training = True
                 break
@@ -385,6 +549,109 @@ class Model:
             return runner
         except Exception:
             return False
+
+    # -- resilience (preemption, resume, fault hooks) ------------------
+    @property
+    def preempted(self):
+        """True when the last ``fit`` exited early on a preemption
+        after checkpointing its position — distinguish it from a
+        completed run before e.g. exporting; continue with
+        ``fit(resume=True)``."""
+        return self._preempted
+
+    def _maybe_poison(self, inputs, step_no):
+        """Fault-injection hook (``resilience.faults`` site
+        ``nan_step``): poison this step's first floating input with NaN
+        so the full loss -> grads -> StepGuard path sees a genuine
+        non-finite step. Shapes/dtypes are preserved — no recompile."""
+        from ..resilience import faults
+        if not faults.check("nan_step", str(step_no)):
+            return inputs
+        out, poisoned = [], False
+        for b in _to_list(inputs):
+            arr = np.asarray(b.numpy() if isinstance(b, Tensor) else b)
+            if not poisoned and np.issubdtype(arr.dtype, np.floating):
+                arr = np.full_like(arr, np.nan)
+                poisoned = True
+            out.append(arr)
+        return out
+
+    def _fire_synthetic_preempt(self, mgr, global_step):
+        """Deliver a fault-harness preemption scheduled for this global
+        step through the REAL signal path."""
+        if mgr is None:
+            return
+        from ..resilience import faults
+        if faults.check("preempt", str(global_step)):
+            signal.raise_signal(signal.SIGTERM)
+
+    def _maybe_preempt(self, mgr, epoch, steps_done, global_step,
+                       epoch_steps=None, fire=True):
+        """Step-boundary preemption point: deliver any synthetic
+        preemption the fault harness scheduled, then honor a pending
+        request by checkpointing the exact position ONCE and stopping
+        the loops. A position at the end of an epoch is recorded as
+        (epoch + 1, 0) so the resumed run doesn't replay the epoch
+        boundary (on_epoch_end / evaluate / epoch saves). Returns True
+        when preempted."""
+        if mgr is None:
+            return False
+        if fire:
+            self._fire_synthetic_preempt(mgr, global_step)
+        if self._preempted:
+            return True  # already checkpointed this preemption
+        from ..resilience import preempt as _preempt
+        if not _preempt.requested():
+            return False
+        if epoch_steps is not None and steps_done >= epoch_steps:
+            epoch, steps_done = epoch + 1, 0
+        self._resilient_save(mgr, epoch, steps_done, global_step)
+        self.stop_training = True
+        self._preempted = True
+        # fit uses this to decide whether the epoch boundary was reached
+        self._preempt_position = (epoch, steps_done, global_step)
+        return True
+
+    def _resilient_save(self, mgr, epoch, steps_done, global_step):
+        """One versioned checkpoint (``resilience.CheckpointManager``):
+        model + optimizer + RNG key; meta records the position
+        ``fit(resume=True)`` restarts FROM (epoch, steps of that epoch
+        already done, global step)."""
+        from ..core import state as core_state
+        objs = {"model": self.network.state_dict()}
+        if self._optimizer is not None and hasattr(self._optimizer,
+                                                   "state_dict"):
+            objs["opt"] = self._optimizer.state_dict()
+        rng = core_state.default_rng
+        if rng._key_var is not None:
+            objs["rng"] = np.asarray(rng._key_var._read())
+        mgr.save(objs, global_step,
+                 meta={"epoch": int(epoch),
+                       "steps_done": int(steps_done),
+                       "global_step": int(global_step)})
+
+    def _restore_resilient(self, mgr):
+        """Restore from the newest COMPLETE version (torn ones are
+        skipped by the manager); None means no checkpoint yet — train
+        from scratch. Returns (epoch, steps_done, global_step)."""
+        from ..core import state as core_state
+        from ..core.errors import CheckpointNotFoundError
+        try:
+            _step, objs, meta = mgr.load()
+        except CheckpointNotFoundError:
+            return None
+        self.network.set_state_dict(objs["model"])
+        if "opt" in objs and self._optimizer is not None and hasattr(
+                self._optimizer, "set_state_dict"):
+            self._optimizer.set_state_dict(objs["opt"])
+        if "rng" in objs:
+            import jax.numpy as jnp
+            rng = core_state.default_rng
+            if rng._key_var is None:
+                rng.seed(0)
+            rng._key_var._write(jnp.asarray(objs["rng"]))
+        return (int(meta.get("epoch", 0)), int(meta.get("steps_done", 0)),
+                int(meta.get("global_step", 0)))
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None):

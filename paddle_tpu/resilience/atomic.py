@@ -1,0 +1,69 @@
+"""Atomic file writes: temp file + fsync + ``os.replace`` + dir fsync.
+
+A crash (or injected ``torn_write`` fault) at ANY point leaves either
+the previous file intact or a stray ``.<name>.tmp.<pid>`` — never a
+half-written file under the real name that would later load as garbage.
+``framework.save``, the distributed checkpoint writer and the
+``COMPLETE`` markers of ``resilience.CheckpointManager`` all commit
+through here.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+__all__ = ["atomic_write", "fsync_dir"]
+
+
+def fsync_dir(path):
+    """fsync a directory so a rename within it is durable (best effort —
+    some filesystems refuse directory fds)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+@contextlib.contextmanager
+def atomic_write(path, mode="wb"):
+    """Context manager yielding a file object; on clean exit the bytes
+    are fsynced and renamed over ``path`` in one atomic step.
+
+    On a handled ``Exception`` the temp file is removed and ``path`` is
+    untouched. On a crash (including the injected ``torn_write`` fault,
+    which truncates the temp file to half its bytes and raises
+    ``InjectedCrash``) the temp file is left behind — exactly what a
+    real power loss leaves — and ``path`` is still untouched.
+    """
+    from . import faults
+
+    path = os.fspath(path)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    f = open(tmp, mode)
+    try:
+        yield f
+        f.flush()
+        if faults.check("torn_write", path):
+            f.truncate(max(1, f.tell() // 2))
+            f.close()
+            raise faults.InjectedCrash(f"torn write: {path}")
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
+        fsync_dir(d)
+    except Exception:
+        if not f.closed:
+            f.close()
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

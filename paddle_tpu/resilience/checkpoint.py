@@ -1,0 +1,180 @@
+"""Versioned checkpoints: ``step_<N>`` dirs, COMPLETE markers, keep-K.
+
+The write path is crash-safe at two levels: every file inside a version
+commits through ``atomic_write`` (so no file is ever half-written under
+its real name), and the version itself only counts once its
+``COMPLETE`` marker — written LAST, after every data file is durably on
+disk — validates (file list + sizes). The load path walks versions
+newest-first and silently falls back past torn/invalid ones, so a run
+killed mid-checkpoint resumes from the previous complete version with
+no manual cleanup. Garbage collection keeps the newest ``keep_last_k``
+complete versions and sweeps older/incomplete debris.
+
+Capability analog of the reference checkpoint manifests (SURVEY D23)
+plus the save-then-commit discipline its elastic manager assumes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import time
+import warnings
+
+from .atomic import atomic_write
+
+__all__ = ["CheckpointManager"]
+
+_MARKER = "COMPLETE"
+_VERSION_RE = re.compile(r"^step_(\d+)$")
+
+
+class CheckpointManager:
+    """Atomic, versioned checkpoint store under one root directory.
+
+    ``save({'model': sd, 'opt': osd}, step=120, meta={...})`` writes
+    ``root/step_120/{model,opt}`` (``framework.save`` format) and then
+    the COMPLETE marker; ``load()`` returns the newest version that
+    validates. ``objs`` values are anything ``framework.save`` accepts.
+    """
+
+    def __init__(self, root, keep_last_k=3):
+        self.root = os.fspath(root)
+        self.keep_last_k = max(1, int(keep_last_k))
+
+    # ------------------------------------------------------------ paths --
+    def version_dir(self, step: int) -> str:
+        return os.path.join(self.root, f"step_{int(step)}")
+
+    def _scan(self):
+        """[(step, dir, marker|None)] sorted by step ascending — one
+        validation pass shared by load/latest_complete/gc (re-stating
+        every version's files per caller would multiply metadata I/O on
+        the networked filesystems checkpoints actually live on)."""
+        out = []
+        if not os.path.isdir(self.root):
+            return out
+        for name in os.listdir(self.root):
+            m = _VERSION_RE.match(name)
+            if not m:
+                continue
+            d = os.path.join(self.root, name)
+            if os.path.isdir(d):
+                out.append((int(m.group(1)), d, self._validate(d)))
+        out.sort(key=lambda t: t[0])
+        return out
+
+    def versions(self):
+        """[(step, dir, complete?)] sorted by step ascending. Complete
+        means the marker VALIDATES, not merely exists."""
+        return [(s, d, marker is not None) for s, d, marker
+                in self._scan()]
+
+    # ------------------------------------------------------------- write --
+    def save(self, objs: dict, step: int, meta: dict | None = None):
+        """Write one version. Returns its directory. Any crash before
+        the final marker commit leaves the version incomplete and
+        invisible to ``load``."""
+        from .. import framework as fw
+
+        d = self.version_dir(step)
+        if os.path.isdir(d):
+            # leftover torn attempt at the same step (we resumed and
+            # re-reached it): start the version over
+            shutil.rmtree(d)
+        os.makedirs(d)
+        files = {}
+        for name, obj in objs.items():
+            path = os.path.join(d, name)
+            fw.save(obj, path)
+            files[name] = os.path.getsize(path)
+        marker = {"step": int(step), "files": files, "meta": meta or {},
+                  "wall_time": time.time()}
+        with atomic_write(os.path.join(d, _MARKER), "w") as f:
+            json.dump(marker, f)
+        self.gc()
+        return d
+
+    # -------------------------------------------------------------- read --
+    def _validate(self, d):
+        """Marker dict when the version is complete and consistent
+        (marker parses, every listed file exists with the recorded
+        size), else None."""
+        try:
+            with open(os.path.join(d, _MARKER)) as f:
+                marker = json.load(f)
+            files = marker["files"]
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+        for name, size in files.items():
+            p = os.path.join(d, name)
+            if not os.path.isfile(p) or os.path.getsize(p) != int(size):
+                return None
+        return marker
+
+    def latest_complete(self):
+        """(step, marker) of the newest valid version, or None."""
+        for step, _d, marker in reversed(self._scan()):
+            if marker is not None:
+                return step, marker
+        return None
+
+    def load(self, step=None, return_numpy=False):
+        """Load a version: ``(step, objs, meta)``.
+
+        With ``step=None``, walks newest-first and falls back past any
+        version that fails validation (warning once per skip) — the
+        auto-recovery path after a death mid-checkpoint. With an
+        explicit ``step``, a validation failure is an error instead.
+        Raises ``CheckpointNotFoundError`` when nothing loadable
+        exists.
+        """
+        from ..core.errors import (CheckpointCorruptError,
+                                   CheckpointNotFoundError)
+        from .. import framework as fw
+
+        vs = self._scan()
+        if step is not None:
+            vs = [(s, d, m) for s, d, m in vs if s == int(step)]
+            if not vs:
+                raise CheckpointNotFoundError(
+                    f"no checkpoint version step_{step} under "
+                    f"{self.root} [{CheckpointNotFoundError.error_code}]")
+        for s, d, marker in reversed(vs):
+            if marker is None:
+                if step is not None:
+                    raise CheckpointCorruptError(
+                        f"checkpoint {d} is incomplete or torn (no "
+                        f"valid {_MARKER} marker) "
+                        f"[{CheckpointCorruptError.error_code}]")
+                warnings.warn(
+                    f"checkpoint {d} incomplete/torn; falling back to "
+                    "the previous complete version", RuntimeWarning)
+                continue
+            objs = {name: fw.load(os.path.join(d, name),
+                                  return_numpy=return_numpy)
+                    for name in marker["files"]}
+            return s, objs, marker.get("meta", {})
+        raise CheckpointNotFoundError(
+            f"no complete checkpoint under {self.root} "
+            f"[{CheckpointNotFoundError.error_code}]")
+
+    # ---------------------------------------------------------------- gc --
+    def gc(self):
+        """Keep the newest ``keep_last_k`` complete versions; delete
+        older complete ones and any incomplete version at or below the
+        newest complete step (torn attempts a resumed run has already
+        moved past). An incomplete version NEWER than every complete
+        one is left alone — it may be another process mid-write; it
+        gets swept once a newer complete version lands."""
+        vs = self._scan()
+        complete = [s for s, _d, m in vs if m is not None]
+        if not complete:
+            return
+        keep = set(complete[-self.keep_last_k:])
+        newest = complete[-1]
+        for s, d, m in vs:
+            if (m is not None and s not in keep) or (m is None
+                                                     and s <= newest):
+                shutil.rmtree(d, ignore_errors=True)

@@ -1,0 +1,179 @@
+"""Deterministic fault injection — ``PDTPU_FAULTS`` or programmatic.
+
+The durability-critical paths consult this harness so tests (and chaos
+drills) can prove every recovery path with a single env var and zero
+sleeps or randomness:
+
+* ``torn_write``         — ``resilience.atomic.atomic_write`` commit:
+  the temp file is truncated to half its bytes and ``InjectedCrash``
+  (a ``BaseException``) propagates, simulating a process dying
+  mid-checkpoint. The destination file is never touched. Key = the
+  destination file's path.
+* ``store_transient``    — TCPStore client ops (``distributed/
+  store.py``) raise ``InjectedConnectionError`` before sending.
+  Key = op name (``set``/``get``/``add``/``delete``).
+* ``rpc_transient``      — rpc connect phase (``distributed/rpc``).
+  Key = target worker name.
+* ``download_transient`` — ``hapi.hub.download`` fetch. Key = the
+  destination basename.
+* ``nan_step``           — the hapi fit loop poisons the step's first
+  floating batch input with NaN. Key = 1-based GLOBAL step number.
+* ``preempt``            — the hapi fit loop raises a synthetic
+  SIGTERM through the real signal path. Key = global step number.
+
+Spec grammar (``;``-separated rules)::
+
+    PDTPU_FAULTS="site[:match][*times][@at][;...]"
+
+    site   injection point (table above)
+    match  fnmatch glob the site key must match (default ``*``); for
+           step-indexed sites the key is the step number, so
+           ``nan_step:6`` means "global step 6"
+    times  how many matching occurrences fire (default 1; 0 = every)
+    at     1-based matching-occurrence index of the first firing
+           (default 1)
+
+Examples::
+
+    PDTPU_FAULTS="store_transient:get*2"    # first two gets fail
+    PDTPU_FAULTS="torn_write:*step_8*"      # kill that ckpt mid-file
+    PDTPU_FAULTS="nan_step:6;preempt:10"    # NaN step 6, SIGTERM @10
+
+Counting is per-rule and purely occurrence-based, so a given spec
+replays identically on every run — the property the recovery tests
+(``tests/test_resilience.py``) rely on.
+"""
+from __future__ import annotations
+
+import fnmatch
+import os
+import threading
+
+__all__ = [
+    "InjectedCrash", "InjectedConnectionError", "Rule", "inject",
+    "check", "maybe_raise", "clear", "reset", "active", "parse",
+]
+
+
+class InjectedCrash(BaseException):
+    """A simulated process death (torn write). Deliberately NOT an
+    ``Exception``: cleanup handlers that swallow ``Exception`` must not
+    'survive' a crash the harness asked for."""
+
+
+class InjectedConnectionError(ConnectionError):
+    """A simulated transient network failure — a real ``ConnectionError``
+    (so retry/backoff treats it exactly like one) that tests can also
+    match on specifically."""
+
+
+class Rule:
+    """One injection rule: fire ``times`` times starting at the
+    ``at``-th occurrence whose key matches ``match``."""
+
+    def __init__(self, site, match="*", times=1, at=1):
+        self.site = str(site)
+        self.match = match or "*"
+        self.times = int(times)
+        self.at = max(1, int(at))
+        self.seen = 0   # matching occurrences observed
+        self.fired = 0  # occurrences that fired
+
+    def __repr__(self):
+        return (f"Rule({self.site}:{self.match}*{self.times}"
+                f"@{self.at} seen={self.seen} fired={self.fired})")
+
+
+_lock = threading.Lock()
+_rules: list[Rule] = []
+_env_loaded = False
+
+
+def parse(spec: str) -> list[Rule]:
+    """Parse a ``PDTPU_FAULTS`` spec string into rules."""
+    rules = []
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        at = 1
+        if "@" in part:
+            part, at_s = part.rsplit("@", 1)
+            at = int(at_s)
+        times = 1
+        # trailing *N is a count; a bare * inside match stays a glob
+        head, star, tail = part.rpartition("*")
+        if star and tail.isdigit():
+            part, times = head, int(tail)
+        site, sep, match = part.partition(":")
+        rules.append(Rule(site.strip(), match.strip() if sep else "*",
+                          times, at))
+    return rules
+
+
+def _load_env(force=False):
+    global _env_loaded
+    if _env_loaded and not force:
+        return
+    _env_loaded = True
+    spec = os.environ.get("PDTPU_FAULTS", "")
+    if spec:
+        _rules.extend(parse(spec))
+
+
+def inject(site, match="*", times=1, at=1) -> Rule:
+    """Programmatically arm a rule; returns it (inspect ``.fired``)."""
+    rule = Rule(site, match, times, at)
+    with _lock:
+        _rules.append(rule)
+    return rule
+
+
+def clear():
+    """Drop every rule (env rules included; they do NOT re-arm until
+    ``reset``)."""
+    global _env_loaded
+    with _lock:
+        _rules.clear()
+        _env_loaded = True
+
+
+def reset():
+    """Drop every rule and re-parse ``PDTPU_FAULTS`` from scratch."""
+    global _env_loaded
+    with _lock:
+        _rules.clear()
+        _env_loaded = False
+        _load_env()
+
+
+def active() -> list[Rule]:
+    with _lock:
+        _load_env()
+        return list(_rules)
+
+
+def check(site: str, key: str = "") -> bool:
+    """True when an armed rule matches this occurrence (consumes one
+    firing). Sites call this at their injection point and raise/act
+    themselves — the harness only decides."""
+    with _lock:
+        _load_env()
+        for rule in _rules:
+            if rule.site != site:
+                continue
+            if not fnmatch.fnmatch(str(key), rule.match):
+                continue
+            rule.seen += 1
+            if rule.seen >= rule.at and (rule.times == 0
+                                         or rule.fired < rule.times):
+                rule.fired += 1
+                return True
+    return False
+
+
+def maybe_raise(site: str, key: str, exc_type=InjectedConnectionError):
+    """Raise ``exc_type`` when a rule fires — the one-liner for
+    transient-failure sites."""
+    if check(site, key):
+        raise exc_type(f"injected {site} fault (key={key!r})")

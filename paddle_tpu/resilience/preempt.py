@@ -1,0 +1,90 @@
+"""Preemption handling: SIGTERM/SIGINT -> checkpoint-and-exit flag.
+
+TPU fleets are preemptible by design; the eviction notice is a signal.
+``install()`` swaps in a handler that only sets a flag — the training
+loop polls ``requested()`` at step boundaries, writes a final
+checkpoint through its ``CheckpointManager`` and exits cleanly, after
+which ``Model.fit(resume=True)`` picks the run back up. A second
+signal while the first is still being honored restores the previous
+disposition and re-raises it, so a stuck checkpoint can still be killed
+the ordinary way.
+
+``hapi.Model.fit`` installs/uninstalls this automatically whenever it
+has a ``save_dir`` to checkpoint into; custom loops call it directly.
+The synthetic ``preempt`` fault (``resilience.faults``) goes through
+``signal.raise_signal``, i.e. through this exact path.
+"""
+from __future__ import annotations
+
+import signal
+import threading
+
+__all__ = ["install", "uninstall", "requested", "clear",
+           "DEFAULT_SIGNALS"]
+
+DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+_lock = threading.Lock()
+_requested = False
+_last_signal = None
+_prev: dict = {}
+
+
+def _handler(signum, frame):
+    global _requested, _last_signal
+    if _requested:
+        # second notice: restore the old disposition and re-deliver
+        prev = _prev.get(signum, signal.SIG_DFL)
+        signal.signal(signum, prev)
+        signal.raise_signal(signum)
+        return
+    _last_signal = signum
+    _requested = True
+
+
+def install(signals=DEFAULT_SIGNALS) -> bool:
+    """Install the flag-setting handler. Returns True when THIS call
+    installed it — callers must only uninstall/clear state they own
+    (``Model.fit`` inside a user's own install leaves the user's
+    handler and any pending request untouched). No-op (False) when
+    already installed or off the main thread, where CPython forbids
+    ``signal.signal``."""
+    with _lock:
+        if _prev:
+            return False
+        for s in signals:
+            try:
+                _prev[s] = signal.signal(s, _handler)
+            except ValueError:  # not the main thread
+                _prev.clear()
+                return False
+        return True
+
+
+def uninstall():
+    """Restore the previous signal dispositions."""
+    with _lock:
+        for s, h in _prev.items():
+            try:
+                signal.signal(s, h)
+            except ValueError:
+                pass
+        _prev.clear()
+
+
+def requested() -> bool:
+    """True once a preemption signal arrived (sticky until ``clear``)."""
+    return _requested
+
+
+def last_signal():
+    """The signal number that set ``requested`` (None until one did) —
+    lets a loop distinguish an eviction (SIGTERM: exit cleanly) from a
+    user abort (SIGINT: checkpoint, then re-raise the interrupt)."""
+    return _last_signal
+
+
+def clear():
+    global _requested, _last_signal
+    _requested = False
+    _last_signal = None

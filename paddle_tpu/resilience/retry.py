@@ -1,0 +1,77 @@
+"""Bounded retry with exponential backoff + jitter.
+
+``retry`` is the decorator form, ``retry_call`` the one-shot form. Only
+exception types in ``retry_on`` are retried — anything else (including
+``faults.InjectedCrash``, a ``BaseException``) propagates immediately.
+Jitter comes from a module-seeded PRNG so backoff sequences are
+reproducible within a process; tests that want zero wall time pass
+``sleep=lambda s: None``.
+
+Wired into the TCPStore client ops (``distributed/store.py``), the rpc
+connect phase (``distributed/rpc/rpc.py``) and ``hapi.hub.download`` —
+the paths a flaky network or a restarting peer makes transiently fail.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import random
+import time
+
+__all__ = ["retry", "retry_call"]
+
+# pid-seeded: jitter MUST differ across the ranks of a job — correlated
+# failures (the store host restarting under every worker at once) are
+# exactly when the herd needs desynchronizing — while staying
+# reproducible within one process
+_rng = random.Random(0x7E57ab1e ^ os.getpid())
+
+
+def retry_call(fn, *, max_attempts=4, base_delay=0.05, max_delay=2.0,
+               backoff=2.0, jitter=0.25, retry_on=(ConnectionError,),
+               giveup=None, sleep=None, on_retry=None):
+    """Call ``fn()`` with up to ``max_attempts`` tries.
+
+    Delay before retry ``k`` (1-based) is
+    ``min(max_delay, base_delay * backoff**(k-1)) * (1 + jitter*u)``
+    with ``u`` uniform in [0, 1).
+
+    ``giveup(exc) -> bool`` short-circuits retrying for errors that are
+    formally in ``retry_on`` but known permanent. ``on_retry(exc, k)``
+    runs before the sleep — the hook reconnect-style recovery lives in
+    (it must not raise; failures should surface on the next attempt).
+    """
+    if max_attempts < 1:
+        raise ValueError("max_attempts must be >= 1")
+    slp = time.sleep if sleep is None else sleep
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            attempt += 1
+            if attempt >= max_attempts or (giveup is not None
+                                           and giveup(e)):
+                raise
+            delay = min(max_delay, base_delay * backoff ** (attempt - 1))
+            if jitter:
+                delay *= 1.0 + jitter * _rng.random()
+            if on_retry is not None:
+                on_retry(e, attempt)
+            slp(delay)
+
+
+def retry(**cfg):
+    """Decorator form of ``retry_call``::
+
+        @retry(max_attempts=5, retry_on=(ConnectionError, TimeoutError))
+        def fetch(): ...
+    """
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return retry_call(functools.partial(fn, *args, **kwargs),
+                              **cfg)
+        return wrapper
+    return deco

@@ -130,6 +130,17 @@ def test_suppress_context_manager():
     assert analysis.analyze_source(_HOSTILE_SRC)  # restored on exit
 
 
+def test_suppress_instance_reentry_does_not_leak():
+    """Nested re-entry of ONE suppress instance must unwind cleanly —
+    a leaked frame would silence its codes process-wide forever."""
+    s = analysis.suppress("PDT101")
+    with s:
+        with s:
+            assert not analysis.analyze_source(_HOSTILE_SRC)
+        assert not analysis.analyze_source(_HOSTILE_SRC)  # outer holds
+    assert analysis.analyze_source(_HOSTILE_SRC)  # fully restored
+
+
 def test_suppress_decorator_tags_function():
     @analysis.suppress("PDT101")
     def step(x):
