@@ -196,4 +196,8 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+from .engine import CompletedRequest  # noqa: E402
+from .engine import ContinuousBatchingEngine  # noqa: E402
+
+__all__ = ["Config", "Predictor", "create_predictor",
+           "ContinuousBatchingEngine", "CompletedRequest"]

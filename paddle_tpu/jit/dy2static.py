@@ -167,7 +167,14 @@ def run_while(cond_fn, body_fn, get, set_, max_trip_count=None):
     ``for range`` whose break flag becomes traced on the first
     iteration): iterations run eagerly (prefix-unrolled under capture)
     until the predicate is a tensor, then the REST of the loop lowers
-    onto lax control flow with the current state as init."""
+    onto lax control flow with the current state as init.  Eager
+    iterations count against ``max_trip_count``: the lowered remainder
+    gets the leftover budget (ADVICE r5: the bound is a whole-loop
+    bound, not a post-prefix one), floored at 1 — static_while treats
+    a bound <= 0 as an explicit OPT-OUT of the scan lowering, so
+    flooring at 0 would UNBOUND exactly the loop that exhausted its
+    budget."""
+    eager_trips = 0
     while True:
         first = cond_fn()
         if _is_tensor(first) and _under_capture():
@@ -175,7 +182,21 @@ def run_while(cond_fn, body_fn, get, set_, max_trip_count=None):
         if not _truthy(first):
             return
         body_fn()
+        eager_trips += 1
     from ..static.control_flow import while_loop as static_while
+    if max_trip_count is None:
+        # the implicit budget is the flag static_while would read; pull
+        # it here so eager trips count against THAT bound too
+        from ..core import state as _state
+        try:
+            max_trip_count = int(
+                _state.get_flag("while_grad_max_trip_count"))
+        except Exception:
+            max_trip_count = None
+    if max_trip_count is not None:
+        mtc = int(max_trip_count)
+        if mtc > 0:  # <= 0 stays as-is: the documented scan opt-out
+            max_trip_count = max(mtc - eager_trips, 1)
     init = get()
 
     def c(*vs):
@@ -741,9 +762,23 @@ def _eliminate_returns(fndef):
                         out.extend(setup)
                         out.append(wl)
                 else:
-                    nb, mb, _ = xform(s.body)
+                    # trailing ``_pdtpu_loop_incr``-tagged statements (a
+                    # desugared for-each's index increment) must STAY the
+                    # loop tail: folding them into a return-If's orelse
+                    # would hide the tag from _BreakContinueEliminator's
+                    # tail scan, which then wraps the increment in the
+                    # continue guard — the index stops advancing on
+                    # continue iterations (ADVICE r5 high: infinite loop
+                    # on continue + later return)
+                    n_tail = 0
+                    while n_tail < len(s.body) and getattr(
+                            s.body[-1 - n_tail], "_pdtpu_loop_incr",
+                            False):
+                        n_tail += 1
+                    cut = len(s.body) - n_tail
+                    nb, mb, _ = xform(s.body[:cut])
                     if mb:
-                        s.body = nb
+                        s.body = nb + s.body[cut:]
                         s.test = ast.BoolOp(op=ast.And(), values=[
                             _not_flags([_RETF]), s.test])
                         ast.fix_missing_locations(s)

@@ -85,6 +85,74 @@ def paged_cache_attention(q, k_new, v_new, k_pages, v_pages, pos,
 
 
 @primitive
+def paged_slot_attention(q, k_new, v_new, k_pages, v_pages, positions,
+                         block_tables, scale=None, pages_per_block=None):
+    """One decode step against a paged KV cache with PER-SLOT state —
+    the continuous-batching variant of :func:`paged_cache_attention`.
+
+    Unlike the static-attribute form, ``positions`` [B] (each slot's
+    current token index) and ``block_tables`` [B, NP] are TRACED
+    tensors: the serving engine admits/retires requests by changing
+    their VALUES between dispatches, never recompiling.  Writes each
+    slot's new K/V at its own (page, slot) and attends through the
+    ragged Pallas kernel with per-slot lengths.
+    """
+    from ..ops.pallas.paged_attention import paged_decode_attention
+
+    p = positions.reshape(-1).astype(jnp.int32)             # [B]
+    bt = block_tables.astype(jnp.int32)
+    b = q.shape[0]
+    ps = k_pages.shape[2]
+    page = bt[jnp.arange(b), jnp.minimum(p // ps, bt.shape[1] - 1)]
+    slot = p % ps
+    kn = jnp.swapaxes(k_new[:, 0], 0, 1).astype(k_pages.dtype)
+    vn = jnp.swapaxes(v_new[:, 0], 0, 1).astype(v_pages.dtype)
+    k_pages = k_pages.at[:, page, slot].set(kn)
+    v_pages = v_pages.at[:, page, slot].set(vn)
+    out = paged_decode_attention(q[:, 0], k_pages, v_pages, bt, p + 1,
+                                 scale=scale,
+                                 pages_per_block=pages_per_block)
+    return out[:, None].astype(q.dtype), k_pages, v_pages
+
+
+@primitive
+def ragged_paged_step(q, k_new, v_new, k_pages, v_pages, tok_pos,
+                      tok_slot, tok_valid, kv_lens, q_lens, block_tables,
+                      scale=None, q_block=8, pages_per_block=None):
+    """Attention for ONE continuously-batched step over packed tokens.
+
+    q/k_new/v_new: [T, H(q|kv), D] — tokens of all sequences packed in
+    slot order (each slot's segment padded to a ``q_block`` multiple);
+    tok_pos/tok_slot/tok_valid: [T] per-token absolute position, owning
+    slot, and validity (padding tokens route their K/V write to the
+    engine's reserved null page 0); kv_lens/q_lens: [B] per-slot totals
+    (kv INCLUDING this step's tokens).  Prefill chunks and single-token
+    decodes share this one call — the kernel's per-sequence causal
+    offset handles both.
+    """
+    from ..ops.pallas.paged_attention import ragged_paged_attention
+
+    bt = block_tables.astype(jnp.int32)
+    ps = k_pages.shape[2]
+    pos = tok_pos.astype(jnp.int32)
+    sl = tok_slot.astype(jnp.int32)
+    ok = tok_valid.astype(jnp.bool_)
+    page = jnp.where(
+        ok, bt[sl, jnp.minimum(pos // ps, bt.shape[1] - 1)], 0)
+    wslot = jnp.where(ok, pos % ps, 0)
+    kn = jnp.swapaxes(k_new, 0, 1).astype(k_pages.dtype)    # [Hk, T, D]
+    vn = jnp.swapaxes(v_new, 0, 1).astype(v_pages.dtype)
+    k_pages = k_pages.at[:, page, wslot].set(kn)
+    v_pages = v_pages.at[:, page, wslot].set(vn)
+    out = ragged_paged_attention(q, k_pages, v_pages, bt,
+                                 kv_lens.astype(jnp.int32),
+                                 q_lens.astype(jnp.int32),
+                                 q_block=q_block, scale=scale,
+                                 pages_per_block=pages_per_block)
+    return out.astype(q.dtype), k_pages, v_pages
+
+
+@primitive
 def cache_prefill(k_new, v_new, k_cache, v_cache):
     """Write the WHOLE prompt's K/V [B, S, Hkv, D] into cache[:, :S] in
     one shot (batched prefill — the serving-path complement of the
@@ -125,11 +193,29 @@ def _apply_rope(x, cos, sin):
 
 @primitive
 def rope_at(x, pos, theta=10000.0):
-    """Half-rotation rope for ONE position (decode): x [B, 1, H, D],
-    pos [1] traced. Convention comes from llama.rope_angles (single
-    home — training and decode paths cannot drift)."""
+    """Half-rotation rope at explicit positions (decode / serving).
+    Convention comes from llama.rope_angles (single home — training and
+    decode paths cannot drift).  Three position shapes:
+
+    * pos [1] (classic decode): one traced position for the whole batch;
+    * pos [B] matching x [B, 1, H, D]: per-slot positions (the
+      continuous-batching decode step — every slot is at its own depth);
+    * pos [T] matching x [1, T, H, D]: per-token positions (the packed
+      ragged prefill+decode step).
+    """
     from .llama import rope_angles
-    cos, sin = rope_angles(pos.reshape(()), x.shape[-1], theta)
+    p = pos.reshape(-1)
+    n = p.shape[0]
+    cos, sin = rope_angles(p, x.shape[-1], theta)        # [n, D]
+    if n == 1:
+        cos, sin = cos.reshape(-1), sin.reshape(-1)      # broadcast all
+    elif n == x.shape[0]:
+        cos, sin = cos[:, None, None, :], sin[:, None, None, :]
+    elif n == x.shape[1]:
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    else:
+        raise ValueError(
+            f"rope_at: {n} positions do not match x {x.shape}")
     return _apply_rope(x, cos, sin)
 
 
@@ -151,10 +237,12 @@ def _empty_caches(model, batch, max_len):
 
 def _gpt_decode(model, ids_t, pos, caches, attend=cache_attention):
     """One-token logits for GPTForCausalLM given flat [k0,v0,k1,v1,...]
-    caches; returns (logits [B, V], new caches)."""
+    caches; returns (logits [B, V], new caches). ``pos`` may be [1]
+    (one shared position) or [B] (per-slot positions — the serving
+    engine's continuously-batched decode)."""
     from .. import ops
     gpt = model.gpt
-    x = gpt.wte(ids_t) + gpt.wpe(ops.reshape(pos, [1]))
+    x = gpt.wte(ids_t) + gpt.wpe(ops.reshape(pos, [-1, 1]))
     new = []
     for li, blk in enumerate(gpt.blocks):
         kc, vc = caches[2 * li], caches[2 * li + 1]
@@ -203,6 +291,89 @@ def _llama_decode(model, ids_t, pos, caches, attend=cache_attention):
     else:
         logits = ops.matmul(h, lm.embed_tokens.weight, transpose_y=True)
     return ops.reshape(logits, [logits.shape[0], -1]), new
+
+
+def _gpt_ragged_forward(model, ids_t, tok_pos, tok_slot, tok_valid,
+                        kv_lens, q_lens, bt, caches, q_block,
+                        pages_per_block=None):
+    """Packed-token forward for a continuously-batched serving step:
+    ``ids_t`` [1, T] carries prefill chunks AND single decode tokens of
+    all slots (segments in slot order, ``q_block``-padded); per-token
+    position/slot/validity vectors drive the page writes and the ragged
+    attention.  Returns ([T, V] logits — padding rows garbage — and the
+    new page pools)."""
+    from .. import ops
+    gpt = model.gpt
+    t = ids_t.shape[1]
+    x = gpt.wte(ids_t) + gpt.wpe(ops.reshape(tok_pos, [1, -1]))
+    new = []
+    for li, blk in enumerate(gpt.blocks):
+        kc, vc = caches[2 * li], caches[2 * li + 1]
+        h = blk.ln1(x)
+        hd, nh = blk.attn.head_dim, blk.attn.num_heads
+        qkv = ops.reshape(blk.attn.qkv(h), [t, 3, nh, hd])
+        q, k, v = ops.unbind(qkv, axis=1)                  # [T, nh, hd]
+        att, kc, vc = ragged_paged_step(
+            q, k, v, kc, vc, tok_pos, tok_slot, tok_valid, kv_lens,
+            q_lens, bt, q_block=q_block,
+            pages_per_block=pages_per_block)
+        x = x + blk.attn.proj(ops.reshape(att, [1, t, nh * hd]))
+        x = x + blk.mlp(blk.ln2(x))
+        new.extend([kc, vc])
+    h = gpt.ln_f(x)
+    if model.lm_head is not None:
+        logits = model.lm_head(h)
+    else:
+        logits = ops.matmul(h, gpt.wte.weight, transpose_y=True)
+    return ops.reshape(logits, [t, -1]), new
+
+
+def _llama_ragged_forward(model, ids_t, tok_pos, tok_slot, tok_valid,
+                          kv_lens, q_lens, bt, caches, q_block,
+                          pages_per_block=None):
+    from .. import ops
+    lm = model.llama
+    t = ids_t.shape[1]
+    x = lm.embed_tokens(ids_t)
+    new = []
+    for li, layer in enumerate(lm.layers):
+        kc, vc = caches[2 * li], caches[2 * li + 1]
+        att_in = layer.input_norm(x)
+        a = layer.attn
+        q = ops.reshape(a.q_proj(att_in), [1, t, a.num_heads, a.head_dim])
+        k = ops.reshape(a.k_proj(att_in),
+                        [1, t, a.num_kv_heads, a.head_dim])
+        v = ops.reshape(a.v_proj(att_in),
+                        [1, t, a.num_kv_heads, a.head_dim])
+        q = rope_at(q, tok_pos, theta=a.rope_theta)
+        k = rope_at(k, tok_pos, theta=a.rope_theta)
+        att, kc, vc = ragged_paged_step(
+            ops.reshape(q, [t, a.num_heads, a.head_dim]),
+            ops.reshape(k, [t, a.num_kv_heads, a.head_dim]),
+            ops.reshape(v, [t, a.num_kv_heads, a.head_dim]),
+            kc, vc, tok_pos, tok_slot, tok_valid, kv_lens, q_lens, bt,
+            q_block=q_block, pages_per_block=pages_per_block)
+        x = x + a.o_proj(ops.reshape(att, [1, t, -1]))
+        x = x + layer.mlp(layer.post_norm(x))
+        new.extend([kc, vc])
+    h = lm.norm(x)
+    if model.lm_head is not None:
+        logits = model.lm_head(h)
+    else:
+        logits = ops.matmul(h, lm.embed_tokens.weight, transpose_y=True)
+    return ops.reshape(logits, [t, -1]), new
+
+
+def _ragged_fn(model):
+    """Family dispatch for the packed continuous-batching forward."""
+    from .gpt import GPTForCausalLM
+    from .llama import LlamaForCausalLM
+    if isinstance(model, GPTForCausalLM):
+        return _gpt_ragged_forward
+    if isinstance(model, LlamaForCausalLM):
+        return _llama_ragged_forward
+    raise TypeError(
+        f"serving engine: unsupported model {type(model).__name__}")
 
 
 @primitive

@@ -8,6 +8,13 @@ times each candidate configuration on the real shapes the model runs
 and persists the winner per (device kind, op, shape signature) in a
 JSON cache so later processes skip the sweep.
 
+Tuned entries: ``flash_attention`` (block_q, block_k — see
+flash_attention._autotuned_blocks) and ``paged_attention_ppb``
+(pages_per_block of the ragged paged-KV serving kernel — see
+paged_attention.pick_pages_per_block; candidates are powers of two
+bounded by the block-table width and a VMEM cap, cache hits apply under
+a trace, sweeps run on synthetic decode shapes when enabled).
+
 LIMITATION (measured, round 4): the sweep times candidates in an
 isolated chained program; the winner inside a REAL train step can
 differ by a few percent because XLA fuses/schedules the kernel

@@ -530,3 +530,82 @@ def test_tensor_foreach_with_continue_in_try_terminates():
 
     v = np.array([[1.0], [2.0]], np.float32)
     assert float(fn(paddle.to_tensor(v))) == 0.0
+
+
+# ------------------------------------------------- ADVICE r5 regressions --
+def test_foreach_continue_with_later_return_terminates():
+    """ADVICE r5 high: a tensor for-each whose body has BOTH `continue`
+    and a later `return` hung — _eliminate_returns' continuation folding
+    moved the desugared index increment inside the continue guard, so
+    the index stopped advancing on continue iterations."""
+    from paddle_tpu.jit.dy2static import convert_function
+
+    def orig(xs):
+        acc = paddle.to_tensor(0.0)
+        for x in xs:
+            if x.sum() < 0:
+                continue
+            acc = acc + x.sum()
+            if acc > 100:
+                return acc * 2
+        return acc
+
+    conv = convert_function(orig)
+    assert conv is not None
+    # continue fires on row 1, loop must still advance to row 2
+    xs = paddle.to_tensor(np.array([[1., 2.], [-5., 1.], [3., 4.]],
+                                   np.float32))
+    np.testing.assert_allclose(conv(xs).numpy(), orig(xs).numpy())
+    # the early-return path (and continue before it) stays correct
+    xs2 = paddle.to_tensor(np.array([[60., 0.], [-1., -1.], [50., 0.]],
+                                    np.float32))
+    np.testing.assert_allclose(conv(xs2).numpy(), orig(xs2).numpy())
+    # under capture too (the ADVICE repro hung both ways)
+    st = paddle.jit.to_static(orig)
+    np.testing.assert_allclose(st(xs).numpy(), orig(xs).numpy())
+
+
+def test_run_while_counts_eager_prefix_against_trip_budget(monkeypatch):
+    """ADVICE r5 low: iterations run eagerly before the predicate turns
+    traced must be charged against max_trip_count — the lowered
+    remainder gets the LEFTOVER budget, floored at 0."""
+    from paddle_tpu.core import state
+    from paddle_tpu.jit import dy2static as d2s
+    from paddle_tpu.static import control_flow as cf
+
+    seen = {}
+
+    def fake_while(c, b, init, max_trip_count=None, _undef_fill=None):
+        seen["mtc"] = max_trip_count
+        return list(init)
+
+    monkeypatch.setattr(cf, "while_loop", fake_while)
+    monkeypatch.setattr(d2s, "_under_capture", lambda: True)
+
+    def drive(mtc):
+        st = {"i": 0}
+
+        def cond():
+            if st["i"] < 3:
+                return True
+            return paddle.to_tensor(True)     # predicate turns traced
+
+        def body():
+            st["i"] += 1
+
+        d2s.run_while(cond, body, lambda: (st["i"],), lambda v: None,
+                      max_trip_count=mtc)
+        return st["i"]
+
+    assert drive(10) == 3
+    assert seen["mtc"] == 7                   # 10 - 3 eager trips
+    assert drive(2) == 3                      # eager prefix still runs
+    # floored at 1, NOT 0: static_while reads <= 0 as the scan-lowering
+    # opt-out, which would UNBOUND the loop that exhausted its budget
+    assert seen["mtc"] == 1
+    drive(-1)
+    assert seen["mtc"] == -1                  # explicit opt-out intact
+    # implicit budget (the flag) is charged the same way
+    drive(None)
+    flag = int(state.get_flag("while_grad_max_trip_count"))
+    assert seen["mtc"] == flag - 3

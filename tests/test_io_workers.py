@@ -146,3 +146,42 @@ def test_mp_workers_large_dataset_no_deadlock():
                             num_workers=2, timeout=120):
         n += 1
     assert n == 500
+
+
+def test_consumer_shm_attach_untracked(monkeypatch):
+    """ADVICE r5 low: attaching (create=False) registers the segment
+    with the CONSUMER's resource_tracker on CPython <= 3.12; since
+    _decode immediately unlinks, that registration must be dropped or
+    the tracker reports 'leaked shared_memory' at shutdown.  The
+    register/unregister calls seen by this process must balance."""
+    from multiprocessing import resource_tracker
+
+    from paddle_tpu.io import worker as w
+
+    calls = {"register": [], "unregister": []}
+    orig_reg = resource_tracker.register
+    orig_unreg = resource_tracker.unregister
+
+    def reg(name, rtype):
+        if rtype == "shared_memory":
+            calls["register"].append(name)
+        return orig_reg(name, rtype)
+
+    def unreg(name, rtype):
+        if rtype == "shared_memory":
+            calls["unregister"].append(name)
+        return orig_unreg(name, rtype)
+
+    monkeypatch.setattr(resource_tracker, "register", reg)
+    monkeypatch.setattr(resource_tracker, "unregister", unreg)
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    # in-process round trip exercises BOTH sides' tracker bookkeeping:
+    # _encode (creator) and _decode (consumer attach + unlink)
+    desc = w._encode({"x": arr, "n": 3})
+    out = w._decode(desc)
+    np.testing.assert_array_equal(out["x"], arr)
+    assert sorted(calls["register"]) == sorted(calls["unregister"])
+    # the abandoned-batch path unlinks AND untracks too
+    desc2 = w._encode([arr])
+    w._unlink_desc(desc2)
+    assert sorted(calls["register"]) == sorted(calls["unregister"])

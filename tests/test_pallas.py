@@ -336,3 +336,169 @@ def test_rope_fwd_bwd():
 
     np.testing.assert_allclose(jax.grad(lp)(x), jax.grad(lr)(x),
                                atol=2e-5, rtol=2e-5)
+
+
+# --------------------------------------------------------------------------
+# ragged paged attention (ISSUE 3: multi-page compacted-grid serving kernel)
+# --------------------------------------------------------------------------
+from paddle_tpu.ops.pallas import paged_attention as pga
+
+
+def _paged_gather(pool, bt, b, length, ps):
+    """[L, Hk, D] kv of sequence ``b`` out of the page pool."""
+    return np.stack([np.asarray(pool)[:, bt[b, t // ps], t % ps]
+                     for t in range(length)], 0)
+
+
+def _ref_causal_offset(q, k, v, kv_len, q_len):
+    """Dense reference with the ragged causal rule: q token i attends
+    kv positions <= kv_len - q_len + i.  q [q_len, Hq, D]; k/v
+    [kv_len, Hk, D]."""
+    hq, hk = q.shape[1], k.shape[1]
+    kt = np.repeat(k, hq // hk, axis=1)
+    vt = np.repeat(v, hq // hk, axis=1)
+    s = np.einsum("qhd,lhd->hql", q, kt) / np.sqrt(q.shape[-1])
+    qpos = kv_len - q_len + np.arange(q_len)
+    mask = np.arange(kv_len)[None, :] <= qpos[:, None]
+    s = np.where(mask[None], s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("hql,lhd->qhd", p, vt)
+
+
+def _paged_setup(rng, lens, hk, ps, d, extra_pages=3):
+    """Page pools with SHUFFLED page assignment (block-table indirection
+    must matter) + block tables; page 0 left unassigned (null page)."""
+    B = len(lens)
+    NP = -(-max(lens) // ps) + 1
+    total = B * NP + extra_pages
+    pk = rng.normal(size=(hk, total, ps, d)).astype(np.float32)
+    pv = rng.normal(size=(hk, total, ps, d)).astype(np.float32)
+    ids = np.arange(1, total)
+    rng.shuffle(ids)
+    bt = np.zeros((B, NP), np.int32)
+    n = 0
+    for b in range(B):
+        need = -(-lens[b] // ps)
+        bt[b, :need] = ids[n:n + need]
+        n += need
+    return pk, pv, bt
+
+
+@pytest.mark.parametrize("hq,hk,ps,lens,ppb", [
+    (4, 4, 8, [5, 16, 23], 1),     # rep 1, non-aligned lengths
+    (8, 2, 16, [1, 30, 17], 2),    # GQA rep 4, len < page, multi-page
+    (6, 3, 8, [9, 40], 4),         # GQA rep 2, ppb > pages of some seq
+    (8, 8, 16, [33], 3),           # ppb not dividing the page count
+])
+def test_paged_decode_matches_dense(hq, hk, ps, lens, ppb):
+    rng = np.random.default_rng(0)
+    d = 32
+    B = len(lens)
+    pk, pv, bt = _paged_setup(rng, lens, hk, ps, d)
+    q = rng.normal(size=(B, hq, d)).astype(np.float32)
+    out = np.asarray(pga.paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(pk), jnp.asarray(pv),
+        jnp.asarray(bt), jnp.asarray(lens, dtype=jnp.int32),
+        interpret=True, pages_per_block=ppb))
+    for b in range(B):
+        ref = _ref_causal_offset(
+            q[b][None], _paged_gather(pk, bt, b, lens[b], ps),
+            _paged_gather(pv, bt, b, lens[b], ps), lens[b], 1)[0]
+        np.testing.assert_allclose(out[b], ref, atol=2e-5, rtol=2e-5)
+
+
+def test_paged_decode_traced_lengths_no_recompile():
+    """seq_lens/block_tables ride the scalar-prefetch channel: one
+    compiled program serves CHANGING lengths and re-pointed tables (the
+    serving engine's admission/retirement contract)."""
+    rng = np.random.default_rng(1)
+    hq = hk = 2
+    ps, d, B, NP = 8, 16, 2, 3
+    pk, pv, bt = _paged_setup(rng, [20, 11], hk, ps, d)
+    q = rng.normal(size=(B, hq, d)).astype(np.float32)
+
+    traces = []
+
+    @jax.jit
+    def step(q, pk, pv, bt, lens):
+        traces.append(1)
+        return pga.paged_decode_attention(q, pk, pv, bt, lens,
+                                          interpret=True,
+                                          pages_per_block=2)
+
+    for lens in ([20, 11], [7, 23], [1, 1]):
+        out = np.asarray(step(jnp.asarray(q), jnp.asarray(pk),
+                              jnp.asarray(pv), jnp.asarray(bt),
+                              jnp.asarray(lens, dtype=jnp.int32)))
+        for b in range(B):
+            ref = _ref_causal_offset(
+                q[b][None], _paged_gather(pk, bt, b, lens[b], ps),
+                _paged_gather(pv, bt, b, lens[b], ps), lens[b], 1)[0]
+            np.testing.assert_allclose(out[b], ref, atol=2e-5,
+                                       rtol=2e-5)
+    assert len(traces) == 1  # lengths are data, not shape
+
+
+@pytest.mark.parametrize("qb", [2, 4])
+def test_ragged_mixed_prefill_decode(qb):
+    """One kernel call serving a continuously-batched step: prefill
+    chunks (q_len > 1) and decodes (q_len 1) with non-page-aligned
+    lengths, causal offsets per sequence."""
+    rng = np.random.default_rng(2)
+    hq, hk, ps, d, ppb = 4, 2, 8, 16, 2
+    kv_lens = [13, 6, 21, 1]
+    q_lens = [5, 1, 9, 1]          # mixed prefill + decode
+    B = len(kv_lens)
+    pk, pv, bt = _paged_setup(rng, kv_lens, hk, ps, d)
+    segs = [-(-ql // qb) * qb for ql in q_lens]
+    starts = np.cumsum([0] + segs[:-1])
+    T = sum(segs)
+    q = np.zeros((T, hq, d), np.float32)
+    for b in range(B):
+        q[starts[b]:starts[b] + q_lens[b]] = rng.normal(
+            size=(q_lens[b], hq, d))
+    out = np.asarray(pga.ragged_paged_attention(
+        jnp.asarray(q), jnp.asarray(pk), jnp.asarray(pv),
+        jnp.asarray(bt), jnp.asarray(kv_lens, dtype=jnp.int32),
+        jnp.asarray(q_lens, dtype=jnp.int32), q_block=qb,
+        pages_per_block=ppb, interpret=True))
+    for b in range(B):
+        ref = _ref_causal_offset(
+            q[starts[b]:starts[b] + q_lens[b]],
+            _paged_gather(pk, bt, b, kv_lens[b], ps),
+            _paged_gather(pv, bt, b, kv_lens[b], ps),
+            kv_lens[b], q_lens[b])
+        np.testing.assert_allclose(out[starts[b]:starts[b] + q_lens[b]],
+                                   ref, atol=2e-5, rtol=2e-5)
+
+
+def test_ragged_zero_qlen_sits_out():
+    """q_len 0 (a slot sitting a step out) contributes no work items and
+    corrupts nothing."""
+    rng = np.random.default_rng(3)
+    hq = hk = 2
+    ps, d, qb = 8, 16, 2
+    kv_lens = [10, 9]
+    q_lens = [2, 0]
+    pk, pv, bt = _paged_setup(rng, kv_lens, hk, ps, d)
+    q = np.zeros((2, hq, d), np.float32)
+    q[:2] = rng.normal(size=(2, hq, d))
+    out = np.asarray(pga.ragged_paged_attention(
+        jnp.asarray(q), jnp.asarray(pk), jnp.asarray(pv),
+        jnp.asarray(bt), jnp.asarray(kv_lens, dtype=jnp.int32),
+        jnp.asarray(q_lens, dtype=jnp.int32), q_block=qb,
+        pages_per_block=2, interpret=True))
+    ref = _ref_causal_offset(q[:2], _paged_gather(pk, bt, 0, 10, ps),
+                             _paged_gather(pv, bt, 0, 10, ps), 10, 2)
+    np.testing.assert_allclose(out[:2], ref, atol=2e-5, rtol=2e-5)
+
+
+def test_pages_per_block_heuristic_and_candidates():
+    from paddle_tpu.ops.pallas.paged_attention import (
+        _tune_candidates, default_pages_per_block)
+    assert default_pages_per_block(16, 128, 64) == 32   # 512-token target
+    assert default_pages_per_block(16, 2, 64) == 2      # capped by table
+    cands = _tune_candidates(16, 128, 64)
+    assert cands[0] == 1 and all(b == a * 2 for a, b in
+                                 zip(cands, cands[1:]))
