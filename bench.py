@@ -73,6 +73,10 @@ def _peak_flops(dev) -> float:
 _HARNESS_FILES = [
     "paddle_tpu/jit/multi_step.py",
     "paddle_tpu/optimizer/optimizer.py",
+    # the fused multi-tensor optimizer path runs inside every training
+    # row's compiled step: its code must cold the training caches
+    "paddle_tpu/optimizer/flat.py",
+    "paddle_tpu/ops/pallas/fused_optimizer.py",
     "paddle_tpu/amp/__init__.py",
     "paddle_tpu/nn/functional/norm.py",
 ]
@@ -297,6 +301,14 @@ def _bench_bert(peak):
                              "positions")}
 
 
+def _bench_optimizer():
+    """Training-secondary row: fused vs per-param optimizer update at
+    BERT-base and ResNet50 param sets (benchmarks/optimizer_bench.py —
+    HLO update-op counts + eager update time + dispatch counts)."""
+    import optimizer_bench
+    return optimizer_bench.bench_row(small=False)
+
+
 def main():
     import jax
 
@@ -481,6 +493,9 @@ def main():
               "paddle_tpu/ops/pallas/flash_attention.py",
               "paddle_tpu/distributed/fleet/recompute.py"],
              lambda: _bench_bert(peak), (_bench_bert,)),
+            ("secondary_optimizer",
+             ["benchmarks/optimizer_bench.py"],
+             _bench_optimizer, (_bench_optimizer,)),
         ):
             try:
                 row = _cached(dev, name, files, fn, src_fns=src)

@@ -1,0 +1,207 @@
+"""Fused vs per-param optimizer micro-bench.
+
+Measures, at BERT-base and ResNet50 parameter-set shapes:
+
+- traced-step HLO op counts (total + arithmetic "update ops") of a
+  captured optimizer-only step under the fused flat-bucket path vs the
+  per-param path — the acceptance bar is >= 10x fewer update ops at
+  BERT-base scale;
+- eager update wall time per step (fused vs per-param) and the number
+  of fused-kernel dispatches per step (O(buckets), not O(params)).
+
+Run standalone (`python benchmarks/optimizer_bench.py [--small]`) for a
+JSON report, or through bench.py which embeds a cached row
+(``secondary_optimizer``). ``--small`` shrinks hidden sizes (op counts
+are size-independent; only timings change) so the report runs in
+seconds on CPU — the structural op-count ratio is what the tests pin.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ARITH = {
+    "add", "sub", "mul", "div", "sqrt", "rsqrt", "max", "min", "pow",
+    "integer_pow", "neg", "sign", "abs", "square",
+}
+
+
+def bert_base_shapes(hidden=768, layers=12, vocab=30522, seq=512):
+    """The BERT-base parameter set (structurally exact: one entry per
+    parameter tensor, ~200 tensors)."""
+    h, i4 = hidden, 4 * hidden
+    shapes = [(vocab, h), (seq, h), (2, h), (h,), (h,)]  # embeddings + LN
+    for _ in range(layers):
+        shapes += [(h, h), (h,)] * 4          # q/k/v/out
+        shapes += [(h,), (h,)]                # attn LN
+        shapes += [(h, i4), (i4,), (i4, h), (h,)]  # ffn
+        shapes += [(h,), (h,)]                # ffn LN
+    shapes += [(h, h), (h,), (h,), (h,), (h, 2), (2,)]  # pooler/heads
+    return shapes
+
+
+def resnet50_shapes(width=64):
+    """ResNet50 parameter set (conv/bn/fc tensor structure)."""
+    w = width
+    shapes = [(w, 3, 7, 7), (w,), (w,)]
+    cfg = [(3, w, w * 4), (4, w * 2, w * 8), (6, w * 4, w * 16),
+           (3, w * 8, w * 32)]
+    inp = w
+    for blocks, mid, out in cfg:
+        for b in range(blocks):
+            shapes += [(mid, inp, 1, 1), (mid,), (mid,)]
+            shapes += [(mid, mid, 3, 3), (mid,), (mid,)]
+            shapes += [(out, mid, 1, 1), (out,), (out,)]
+            if b == 0:
+                shapes += [(out, inp, 1, 1), (out,), (out,)]
+            inp = out
+    shapes += [(inp, 1000), (1000,)]
+    return shapes
+
+
+def _make_opt(shapes, kind, fused, seed=0):
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.core import state as st
+    st.set_flags({"fused_opt": fused})
+    rng = np.random.default_rng(seed)
+    params = [pt.Parameter(rng.normal(size=s).astype("float32") * 0.02)
+              for s in shapes]
+    grads = [rng.integers(-2, 3, s).astype("float32") for s in shapes]
+    cls = {"adamw": opt.AdamW, "adam": opt.Adam, "sgd": opt.SGD,
+           "momentum": opt.Momentum}[kind]
+    o = cls(learning_rate=1e-3, parameters=params)
+    return params, grads, o
+
+
+def _set_grads(params, grads):
+    import paddle_tpu as pt
+    for p, g in zip(params, grads):
+        p.grad = pt.to_tensor(g)
+
+
+def _count(jaxpr):
+    total = arith = 0
+    stack = [jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr]
+    while stack:
+        j = stack.pop()
+        for eqn in j.eqns:
+            total += 1
+            if eqn.primitive.name in ARITH:
+                arith += 1
+            for v in eqn.params.values():
+                vs = v if isinstance(v, (list, tuple)) else (v,)
+                for x in vs:
+                    inner = getattr(x, "jaxpr", None)
+                    if inner is not None:
+                        stack.append(inner)
+    return total, arith
+
+
+def hlo_op_counts(shapes, kind="adamw", fused=True):
+    """(total_eqns, arith_eqns) of the captured optimizer-only step."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.core import state as st
+    entry_flag = st.get_flag("fused_opt")
+    try:
+        params, grads, o = _make_opt(shapes, kind, fused)
+        _set_grads(params, grads)
+
+        @pt.jit.to_static
+        def upd():
+            o.step()
+            o.clear_grad(set_to_zero=True)
+            return params[0]
+
+        upd()
+        exe = list(upd._cache.values())[0]
+        vals = [t._read() for t in exe.capt_state]
+        jaxpr = jax.make_jaxpr(exe._pure)(*vals)
+        return _count(jaxpr)
+    finally:
+        st.set_flags({"fused_opt": entry_flag})
+
+
+def eager_step_time(shapes, kind="adamw", fused=True, iters=10):
+    """(seconds per eager optimizer.step, fused-kernel calls per step,
+    bucket count)."""
+    import jax
+
+    from paddle_tpu.core import state as st
+    from paddle_tpu.ops.pallas import fused_optimizer as fo
+    entry_flag = st.get_flag("fused_opt")
+    calls = [0]
+    orig = fo.fused_update
+
+    def counting(*a, **k):
+        calls[0] += 1
+        return orig(*a, **k)
+    fo.fused_update = counting
+    try:
+        params, grads, o = _make_opt(shapes, kind, fused)
+        for _ in range(2):  # warm (bucket build + op compile caches)
+            _set_grads(params, grads)
+            o.step()
+            o.clear_grad()
+        jax.block_until_ready(params[0]._read())
+        calls[0] = 0
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            _set_grads(params, grads)
+            o.step()
+            o.clear_grad()
+        jax.block_until_ready(params[0]._read())
+        dt = (time.perf_counter() - t0) / iters
+    finally:
+        fo.fused_update = orig
+        st.set_flags({"fused_opt": entry_flag})
+    buckets = len(o._flat or ())
+    return dt, calls[0] // iters, buckets
+
+
+def bench_row(small=False, kind="adamw"):
+    sets = {
+        "bert_base": bert_base_shapes(hidden=64 if small else 768,
+                                      vocab=512 if small else 30522,
+                                      seq=64 if small else 512),
+        "resnet50": resnet50_shapes(width=8 if small else 64),
+    }
+    out = {"metric": "optimizer_fused_update", "optimizer": kind,
+           "small": bool(small)}
+    for name, shapes in sets.items():
+        tot_f, ar_f = hlo_op_counts(shapes, kind, fused=True)
+        tot_p, ar_p = hlo_op_counts(shapes, kind, fused=False)
+        dt_f, calls, buckets = eager_step_time(shapes, kind, fused=True)
+        dt_p, _, _ = eager_step_time(shapes, kind, fused=False)
+        out[name] = {
+            "params": len(shapes),
+            "elements": int(sum(int(np.prod(s)) for s in shapes)),
+            "hlo_ops_per_param": tot_p, "hlo_ops_fused": tot_f,
+            "update_ops_per_param": ar_p, "update_ops_fused": ar_f,
+            "update_op_reduction_x": round(ar_p / max(ar_f, 1), 1),
+            "eager_step_ms_per_param": round(dt_p * 1e3, 3),
+            "eager_step_ms_fused": round(dt_f * 1e3, 3),
+            "eager_speedup_x": round(dt_p / max(dt_f, 1e-9), 2),
+            "fused_kernel_calls_per_step": calls,
+            "buckets": buckets,
+        }
+    return out
+
+
+def main():
+    small = "--small" in sys.argv or \
+        __import__("jax").default_backend() != "tpu"
+    print(json.dumps(bench_row(small=small), indent=1))
+
+
+if __name__ == "__main__":
+    main()

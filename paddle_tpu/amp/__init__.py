@@ -206,8 +206,15 @@ class GradScaler:
             return
         inv = 1.0 / self._scale
         found = False
+        # fused optimizer: unscale + inf-check over the flat grad
+        # buckets — one multiply and one reduction per bucket instead of
+        # a per-param chain; leftovers fall through to the loop below
+        handled = set()
+        flat_unscale = getattr(optimizer, "_flat_unscale", None)
+        if flat_unscale is not None:
+            found, handled = flat_unscale(inv)
         for p in optimizer._parameters:
-            if p.grad is None:
+            if p.grad is None or id(p) in handled:
                 continue
             g = p.grad._read().astype(jnp.float32) * inv
             if not bool(jnp.all(jnp.isfinite(g))):

@@ -363,3 +363,64 @@ def check_unconvertible(fndef, ctx):
                 yield dec, (f"decorator @{name or '<expr>'} prevents "
                             f"dy2static conversion (stripping it would "
                             f"change behavior)")
+
+
+@register(
+    "PDT108", "eager-optimizer-loop", Severity.NOTE, "ast", scope="eager",
+    example="""
+import paddle_tpu as paddle
+
+def train(model, opt, batches):
+    for x, y in batches:
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+""",
+    near_miss="""
+import paddle_tpu as paddle
+
+@paddle.jit.to_static
+def train_step(model, opt, x, y):
+    loss = ((model(x) - y) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return loss
+""")
+def check_eager_optimizer_loop(fndef, ctx):
+    """A training loop (``backward()`` + ``.step()`` in the same loop
+    body) in a function NOT under ``jit.to_static``: every iteration
+    dispatches the whole step eagerly — the optimizer update alone is
+    O(params) host dispatches on the per-param path and still O(buckets)
+    on the fused path, vs ZERO once the step is captured (and one
+    launch per K steps with ``Model.fit(window=K)`` / ``WindowRunner``).
+    Note-level advice, not an error."""
+    for node in _walk_fn(fndef):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        has_backward = False
+        step_node = None
+        # own-scope walk of the loop body: nested defs are linted as
+        # their own scope (same contract as _walk_fn), so a closure
+        # merely DEFINED in the loop doesn't flag the outer function
+        stack = [node]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(sub))
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute):
+                if sub.func.attr == "backward":
+                    has_backward = True
+                elif sub.func.attr in ("step", "minimize") and \
+                        step_node is None:
+                    step_node = sub
+        if has_backward and step_node is not None:
+            yield step_node, (
+                "optimizer step inside an eager Python loop: every "
+                "batch pays per-step host dispatch — wrap the train "
+                "step in @paddle.jit.to_static (or use "
+                "Model.fit(window=K)) so the loop body compiles to one "
+                "program")

@@ -104,20 +104,21 @@ def _is_to_static_decorator(dec) -> bool:
     return decorator_name(dec) == "to_static"
 
 
-def _iter_jit_functions(tree, force_jit):
-    """(fndef, decorated) for every function in a jit context: decorated
-    with ``to_static``, forced, or NESTED inside a jit function (inline
-    helpers are traced too). Each nested def is yielded as its own scope
-    — the AST checks do not descend into nested defs — so per-function
-    suppression binds to the right function."""
+def _iter_functions(tree, force_jit):
+    """(fndef, decorated, in_jit) for EVERY function: in_jit when
+    decorated with ``to_static``, forced, or NESTED inside a jit
+    function (inline helpers are traced too). Each nested def is
+    yielded as its own scope — the AST checks do not descend into
+    nested defs — so per-function suppression binds to the right
+    function. Checks with scope "jit" run on in-jit functions, scope
+    "eager" on the rest."""
     def visit(node, in_jit):
         for child in ast.iter_child_nodes(node):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 decorated = any(_is_to_static_decorator(d)
                                 for d in child.decorator_list)
                 jit = decorated or force_jit or in_jit
-                if jit:
-                    yield child, decorated
+                yield child, decorated, jit
                 yield from visit(child, jit)
             else:
                 yield from visit(child, in_jit)
@@ -160,7 +161,7 @@ def analyze_source(source: str, filename: str = "<string>", *,
     suppressed = active_suppressions() | extra_suppress
     out: list[Diagnostic] = []
     seen: set[tuple] = set()
-    for fndef, decorated in _iter_jit_functions(tree, force_jit):
+    for fndef, decorated, in_jit in _iter_functions(tree, force_jit):
         ctx = _AstCtx(filename=filename, lines=lines,
                       line_offset=line_offset, decorated=decorated)
         def_line = lines[fndef.lineno - 1] if fndef.lineno <= len(lines) \
@@ -170,6 +171,8 @@ def analyze_source(source: str, filename: str = "<string>", *,
             continue  # bare @suppress(): whole function opted out
         for spec in REGISTRY.values():
             if spec.frontend != "ast" or spec.func is None:
+                continue
+            if (spec.scope == "jit") != in_jit:
                 continue
             if spec.code in suppressed or spec.code in dec_sup:
                 continue

@@ -75,6 +75,9 @@ class CheckSpec:
     example: str        # minimal source that triggers the code
     near_miss: str      # minimal source that must NOT trigger it
     func: Optional[Callable] = None  # the check (None for runtime codes)
+    scope: str = "jit"  # "jit": functions in a jit context (the default)
+    #                     "eager": functions NOT in a jit context (e.g.
+    #                     PDT108's eager train-loop advice)
 
 
 _CODE_RE = re.compile(r"^PDT[12]\d\d$")
@@ -82,12 +85,14 @@ REGISTRY: dict[str, CheckSpec] = {}
 
 
 def register(code: str, name: str, severity: Severity, frontend: str, *,
-             example: str, near_miss: str):
+             example: str, near_miss: str, scope: str = "jit"):
     """Decorator registering a check function under ``code``.
 
     The function's docstring becomes the registry doc. AST checks take
     ``(fndef, ctx)`` and yield ``(node, message)``; IR checks take
     ``(closed_jaxpr, ctx)`` and yield ``(message, eqn_or_None)``.
+    ``scope`` (AST checks only): "jit" runs over functions in a jit
+    context, "eager" over functions outside one.
     """
     if not _CODE_RE.match(code):
         raise ValueError(f"diagnostic code {code!r} must match PDT[12]xx")
@@ -96,6 +101,8 @@ def register(code: str, name: str, severity: Severity, frontend: str, *,
     if (frontend == "ast") != code.startswith("PDT1"):
         raise ValueError(f"{code}: PDT1xx codes are AST checks, "
                          f"PDT2xx are IR/runtime checks")
+    if scope not in ("jit", "eager"):
+        raise ValueError(f"unknown scope {scope!r}")
 
     def deco(fn):
         if code in REGISTRY:
@@ -105,7 +112,7 @@ def register(code: str, name: str, severity: Severity, frontend: str, *,
         REGISTRY[code] = CheckSpec(
             code=code, name=name, severity=severity, frontend=frontend,
             doc=fn.__doc__.strip(), example=example, near_miss=near_miss,
-            func=fn)
+            func=fn, scope=scope)
         return fn
     return deco
 

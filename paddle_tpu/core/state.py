@@ -64,6 +64,14 @@ define_flag("analysis", "warn",
             "skipped entirely; warn = findings surface as LintWarnings "
             "(notes to the logger); error = any warn-or-worse finding "
             "raises StaticAnalysisError. Env override PDTPU_ANALYSIS.")
+define_flag("fused_opt", True,
+            "flat-buffer multi-tensor optimizer path (optimizer/flat.py "
+            "+ ops/pallas/fused_optimizer.py): dtype-bucketed flat "
+            "params/grads/moments updated by one fused kernel per "
+            "bucket. PDTPU_FUSED_OPT=off force-disables (per-param "
+            "fallback). Exotic cases (per-param LR/clip/regularizer, "
+            "sharded or lazy params, unsupported optimizers/clips) fall "
+            "back automatically.")
 define_flag("while_grad_max_trip_count", 256,
             "trip bound for differentiable while_loop under jit capture "
             "(lowered to a masked lax.scan; XLA has no reverse-mode "

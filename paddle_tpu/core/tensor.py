@@ -36,7 +36,8 @@ def set_tracker(tr):
 
 class Tensor:
     __slots__ = ("_data", "_stop_gradient", "_grad", "_node", "_hooks",
-                 "_retain_grad", "name", "_dist", "__weakref__")
+                 "_retain_grad", "name", "_dist", "_flat_view",
+                 "_flat_src", "__weakref__")
 
     def __init__(self, data, dtype=None, place=None, stop_gradient=True,
                  name=None):
@@ -70,17 +71,29 @@ class Tensor:
         self._retain_grad = False
         self.name = name
         self._dist = None  # (ProcessMesh, placements) when distributed
+        # (FlatStore, slot) when this tensor is a view into a flat
+        # optimizer bucket (optimizer/flat.py); _flat_src anchors the
+        # lazily-materialized cache to the flat array it was sliced from
+        self._flat_view = None
+        self._flat_src = None
         if _tracker is not None:
             _tracker.on_create(self)
 
     # --- raw data access (all ops funnel through here; the jit capture
     # tracker hooks these, cf. SOT's eval-frame interception, SURVEY L9) ---
     def _read(self):
+        fv = self._flat_view
+        if fv is not None:
+            return fv[0].member_read(self, fv[1])
         if _tracker is not None:
             return _tracker.on_read(self)
         return self._data
 
     def _write(self, val):
+        fv = self._flat_view
+        if fv is not None:
+            fv[0].member_write(self, fv[1], val)
+            return
         if _tracker is not None:
             _tracker.on_write(self, val)
             return
@@ -105,6 +118,8 @@ class Tensor:
             ghost._retain_grad = False
             ghost.name = None
             ghost._dist = None
+            ghost._flat_view = None
+            ghost._flat_src = None
             if self._node is not None:
                 try:
                     i = self._node.out_ids.index(id(self))
@@ -207,10 +222,9 @@ class Tensor:
         if set_to_zero and self._grad is not None:
             import jax.numpy as jnp
             z = jnp.zeros_like(self._grad._read())
-            if _tracker is not None:
-                _tracker.on_write(self._grad, z)
-            else:
-                self._grad._data = z
+            # through the write funnel: a grad that is a flat-bucket view
+            # (fused optimizer) must record the local override
+            self._grad._write(z)
             self._grad._node = None
         else:
             self._grad = None
@@ -240,10 +254,7 @@ class Tensor:
                     ) from e
                 raise
             acc = base + g
-            if _tracker is not None:
-                _tracker.on_write(self._grad, acc)
-            else:
-                self._grad._data = acc
+            self._grad._write(acc)
             self._grad._node = None
         if _tracker is not None:
             _tracker.on_grad_write(self)

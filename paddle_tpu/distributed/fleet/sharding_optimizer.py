@@ -105,6 +105,12 @@ class DygraphShardingOptimizer:
     def __init__(self, optimizer, hcg: HybridCommunicateGroup = None,
                  stage: int = 1):
         self._inner = optimizer
+        # ZeRO shards per-param state over the sharding axis via GSPMD
+        # constraint propagation; the fused flat-bucket path would fold
+        # the moments into one unsharded buffer and defeat the sharding
+        # — pin the inner optimizer to the per-param path
+        if hasattr(optimizer, "_fused_off"):
+            optimizer._fused_off = True
         if hcg is None:
             from .fleet import get_hybrid_communicate_group, init
             hcg = get_hybrid_communicate_group() or init()

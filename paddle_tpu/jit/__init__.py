@@ -81,6 +81,29 @@ class GraphBreak(Exception):
     pass
 
 
+def _flat_member(t, touched):
+    """True for per-param views into a NON-grad flat optimizer bucket
+    whose storage participates in this capture — their state lives in
+    the bucket storage, so the program must not thread them. A view
+    whose bucket the program never touched (e.g. params still bound to
+    an old optimizer's bucket while a new one runs per-param) is acting
+    as a plain tensor and stays threaded."""
+    fv = t._flat_view
+    return (fv is not None and fv[1] >= 0 and fv[0].kind != "grad"
+            and id(fv[0].storage) in touched)
+
+
+def _state_write(t, val):
+    """Post-execution state write-back: direct for plain tensors, via
+    the funnel for flat-bucket views (records the local override so
+    later reads see the new value instead of a stale bucket slice)."""
+    if t._flat_view is not None:
+        t._write(val)
+    else:
+        t._data = val
+    t._node = None
+
+
 def _scrub_leaked_tracers(discovery):
     """Replay re-executes the function, so the tape may assign tracer-backed
     grad Tensors onto real (pre-existing) tensors. Drop any such leftovers —
@@ -98,6 +121,8 @@ def _scrub_leaked_tracers(discovery):
 class _DiscoveryTracker:
     """Concrete-value pass: classifies tensors into inputs/state/fresh while
     the function executes for real (step 0)."""
+
+    is_discovery = True  # flat-bucket host state may mutate (flat.py)
 
     def __init__(self):
         self.inputs: list[Tensor] = []      # pre-existing, read
@@ -140,6 +165,8 @@ class _DiscoveryTracker:
 
 class _ReplayTracker:
     """Tracing pass: substitutes jax tracers for the discovered inputs."""
+
+    is_discovery = False  # flat-bucket host state frozen (flat.py)
 
     def __init__(self, input_ids_to_pos, vals):
         self.pos = input_ids_to_pos
@@ -210,13 +237,24 @@ class _Executable:
     def build(self, arg_tensors, call_args, call_kwargs):
         d = self.discovery
         arg_pos = {id(t): i for i, t in enumerate(arg_tensors)}
-        self.capt_state = [t for t in d.inputs if id(t) not in arg_pos]
+        # tensors that became flat-bucket member views during discovery
+        # (the fused optimizer binding params/moments at its first step)
+        # are dropped: the flat storage is the program input/output and
+        # their traced reads route there. GRAD views stay — under a
+        # tracker they read/write as plain tensors (optimizer/flat.py),
+        # so gradient accumulation threads per-param exactly as before.
+        touched = {id(t) for t in d.inputs}
+        touched.update(d.written)
+        self.capt_state = [t for t in d.inputs
+                           if id(t) not in arg_pos
+                           and not _flat_member(t, touched)]
         ordered = list(arg_tensors) + self.capt_state
         pos = {id(t): i for i, t in enumerate(ordered)}
 
         # mutated explicit-arg tensors are written back BY POSITION to the
         # tensors of the *current* call, not the step-0 objects
-        written = [t for t in d.written.values() if id(t) not in arg_pos]
+        written = [t for t in d.written.values() if id(t) not in arg_pos
+                   and not _flat_member(t, touched)]
         self.arg_out_pos = [arg_pos[id(t)] for t in d.written.values()
                             if id(t) in arg_pos]
         written_args = [t for t in d.written.values() if id(t) in arg_pos]
@@ -290,19 +328,17 @@ class _Executable:
         arg_vals = outs[n_ret + n_state:n_ret + n_state + n_arg_out]
         grad_vals = outs[n_ret + n_state + n_arg_out:]
         for t, v in zip(self.state_out_tensors, state_vals):
-            t._data = v
-            t._node = None
+            _state_write(t, v)
         # mutated explicit-arg tensors: write back positionally onto the
         # tensors of THIS call (not the step-0 objects)
         for pos, v in zip(self.arg_out_pos, arg_vals):
-            arg_tensors[pos]._data = v
-            arg_tensors[pos]._node = None
+            _state_write(arg_tensors[pos], v)
         for t, v in zip(self.grad_out_owners, grad_vals):
             if t._grad is not None:
                 # mutate in place so the object identity the trace captured
-                # stays valid across XLA retraces (sharding changes)
-                t._grad._data = v
-                t._grad._node = None
+                # stays valid across XLA retraces (sharding changes);
+                # funnel for flat-bucket grad views
+                _state_write(t._grad, v)
             else:
                 t._grad = Tensor(v, stop_gradient=True)
         if "PADDLE_PROGRESS_FILE" in os.environ:

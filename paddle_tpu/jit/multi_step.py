@@ -16,6 +16,12 @@ Constraints: every step must hit the SAME compiled specialization (same
 shapes/dtypes/modes), and host-side hooks that normally run between steps
 (LR-scheduler sync) apply once for the window — `.step()` the scheduler
 K times afterwards, as the training loop already does per batch.
+
+With the fused multi-tensor optimizer (``optimizer/flat.py``) the scan
+carry holds a handful of flat dtype buckets (params, master weights,
+moments, grads) instead of hundreds of per-param arrays: the capture
+filters bucket member views out of its state (``jit/__init__.py``), so
+the window program's carry — and its donation set — is O(buckets).
 """
 from __future__ import annotations
 
@@ -104,18 +110,17 @@ def _run_window(exe, runner, stacks, per_step_idx=(), per_step_vals=()):
     carry_idx, const_idx, ps_idx = _split(exe, per_step_idx)
     for sync in exe.discovery.host_syncs:
         sync()
+    from . import _state_write
     carry_vals = [capt[i]._read() for i in carry_idx]
     const_vals = [capt[i]._read() for i in const_idx]
     final_carry, rets = runner(carry_vals, const_vals,
                                tuple(per_step_vals), *stacks)
     for i, v in zip(carry_idx, final_carry):
-        capt[i]._data = v
-        capt[i]._node = None
+        _state_write(capt[i], v)
     # leave the promoted tensors holding their LAST per-step value, as
     # if the host had fed each step individually
     for i, v in zip(ps_idx, per_step_vals):
-        capt[i]._data = v[-1]
-        capt[i]._node = None
+        _state_write(capt[i], v[-1])
     return rets
 
 

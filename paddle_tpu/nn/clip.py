@@ -59,17 +59,19 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.clip_norm = float(clip_norm)
         self.group_name = group_name
 
-    def _dygraph_clip(self, params_grads):
-        sq = []
-        for p, g in params_grads:
-            if g is None or getattr(p, "need_clip", True) is False:
-                continue
-            v = g._read()
-            sq.append(jnp.sum(jnp.square(v.astype(jnp.float32))))
-        if not sq:
-            return params_grads
-        global_norm = jnp.sqrt(sum(sq))
-        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+    def _flat_scale(self, sq_terms):
+        """Fused-path twin of ``_dygraph_clip``'s scale: the same
+        formula over precomputed sum-of-squares terms (one per flat
+        bucket + one per leftover grad) — a SINGLE global reduction tree
+        instead of the per-param chain below."""
+        global_norm = jnp.sqrt(sum(sq_terms))
+        return self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+
+    @staticmethod
+    def _apply_scale(params_grads, scale):
+        """Scale each clippable grad (new tensors, originals untouched);
+        shared by the per-param path below and the fused path's
+        leftover-grad handling so the two can never drift apart."""
         out = []
         for p, g in params_grads:
             if g is None or getattr(p, "need_clip", True) is False:
@@ -79,3 +81,14 @@ class ClipGradByGlobalNorm(ClipGradBase):
             out.append((p, Tensor((v.astype(jnp.float32) * scale)
                                   .astype(v.dtype))))
         return out
+
+    def _dygraph_clip(self, params_grads):
+        sq = []
+        for p, g in params_grads:
+            if g is None or getattr(p, "need_clip", True) is False:
+                continue
+            v = g._read()
+            sq.append(jnp.sum(jnp.square(v.astype(jnp.float32))))
+        if not sq:
+            return params_grads
+        return self._apply_scale(params_grads, self._flat_scale(sq))

@@ -23,7 +23,9 @@ def use_interpret() -> bool:
 
 
 from . import flash_attention  # noqa: E402
+from . import fused_optimizer  # noqa: E402
 from . import norms  # noqa: E402
 from . import rope  # noqa: E402
 
-__all__ = ["flash_attention", "norms", "rope", "use_interpret"]
+__all__ = ["flash_attention", "fused_optimizer", "norms", "rope",
+           "use_interpret"]

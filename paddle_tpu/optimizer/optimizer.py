@@ -12,11 +12,17 @@ subclasses (adam.py, adamw.py, momentum.py, ...). TPU-native details:
 """
 from __future__ import annotations
 
+import warnings
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import state as _state
+from ..core import tensor as _tm
 from ..core.tensor import Parameter, Tensor
-from ..nn.clip import ClipGradBase
+from ..nn.clip import ClipGradBase, ClipGradByGlobalNorm
+from . import flat as _flat
 from .lr import LRScheduler
 
 
@@ -63,6 +69,12 @@ class Optimizer:
         self._master_weights: dict[int, Tensor] = {}
         self._step_count = 0
         self._aux_state: dict = {}
+        # fused multi-tensor path (optimizer/flat.py): dtype buckets of
+        # flat param/grad/moment buffers, built lazily at first step()
+        self._flat: list[_flat.FlatGroup] | None = None
+        self._fused_off = False
+        self._defuse_count = 0
+        self._flat_created_log: list | None = None  # StepGuard hook
         # 0-d device scalar holding the current LR: under jit capture it is
         # threaded as an input (synced from the scheduler host-side before
         # each compiled invocation), so LR changes don't retrigger tracing.
@@ -181,9 +193,24 @@ class Optimizer:
     def step(self):
         self._step_count += 1
         pairs = self._collect()
+        if self._fused_enabled():
+            try:
+                if self._fused_step(pairs):
+                    return
+            except _flat.FlatMismatch as e:
+                self._defuse(str(e))
+        elif self._flat is not None:
+            # eligibility changed after fused steps ran (flag flipped,
+            # clip swapped): fold bucket state — notably the per-bucket
+            # beta-pow scalars — back into per-param accumulators before
+            # the per-param path lazily re-creates them at 1.0
+            self._defuse("fused path disabled", count=False)
         if self._grad_clip is not None:
             pairs = self._grad_clip(pairs)
-        lr = self._live_lr()
+        self._apply_pairs(pairs, self._live_lr())
+
+    def _apply_pairs(self, pairs, lr):
+        """The per-param update loop (grads already clipped)."""
         for p, g in pairs:
             lr_p = lr * p.optimize_attr.get("learning_rate", 1.0) \
                 if hasattr(p, "optimize_attr") else lr
@@ -199,6 +226,394 @@ class Optimizer:
                 new_v = self._update(p, v.astype(jnp.float32), g32, lr_p)
                 p._write(new_v.astype(v.dtype))
 
+    # --- fused multi-tensor path (flat dtype buckets) --------------------
+    def _fused_kind(self):
+        """Fused-kernel kind for this optimizer, or None when the
+        per-param path must run (subclasses override)."""
+        return None
+
+    _FUSED_MOMENTS = {"sgd": (), "momentum": ("velocity",),
+                      "adam": ("moment1", "moment2"),
+                      "adamw": ("moment1", "moment2")}
+
+    def _fused_enabled(self):
+        if self._fused_off or not _state.get_flag("fused_opt"):
+            return False
+        if self._fused_kind() is None:
+            return False
+        gc = self._grad_clip
+        if gc is not None and not isinstance(gc, ClipGradByGlobalNorm):
+            return False
+        return True
+
+    @staticmethod
+    def _fusable_param(p, v, clip_active):
+        if isinstance(v, (jax.core.Tracer, jax.ShapeDtypeStruct)) or \
+                not hasattr(v, "dtype"):
+            return False  # lazy / abstract (aot) values
+        if not jnp.issubdtype(v.dtype, jnp.floating):
+            return False
+        if p._dist is not None:
+            return False
+        sh = getattr(v, "sharding", None)
+        if sh is not None and len(getattr(sh, "device_set", ())) > 1 \
+                and not sh.is_fully_replicated:
+            return False  # keep sharded state sharded (fleet/mp)
+        if hasattr(p, "optimize_attr") and \
+                p.optimize_attr.get("learning_rate", 1.0) != 1.0:
+            return False
+        if getattr(p, "regularizer", None) is not None:
+            return False
+        if clip_active and getattr(p, "need_clip", True) is False:
+            return False
+        return True
+
+    def _build_flat(self, pairs):
+        """Group fusable params into dtype buckets and build the flat
+        stores. Returns the group list or None (structural no-fuse).
+        Every validation runs BEFORE any view is bound, so a no-fuse
+        return leaves the optimizer's tensors untouched."""
+        kind = self._fused_kind()
+        clip_active = isinstance(self._grad_clip, ClipGradByGlobalNorm)
+        by_dtype: dict = {}
+        for p, _g in pairs:
+            v = p._read()
+            if not self._fusable_param(p, v, clip_active):
+                continue
+            dt = jnp.dtype(v.dtype)
+            if dt != jnp.float32 and self._FUSED_MOMENTS[kind] and not (
+                    self._multi_precision and
+                    dt in (jnp.bfloat16, jnp.float16)):
+                # the flat moment stores are f32 but the per-param path
+                # keeps accumulators in the param dtype when no master
+                # weight applies — fusing would break bitwise parity
+                # (and fuse-or-not would depend on accumulator history)
+                continue
+            by_dtype.setdefault(dt, []).append((p, v))
+        # ---- validation pass (no mutation) ----
+        betas = {}
+        for dt, pv in by_dtype.items():
+            members = [p for p, _ in pv]
+            if kind in ("adam", "adamw"):
+                got = self._uniform_beta_pows(members)
+                if got is None:
+                    return None
+                betas[dt] = got
+            if self._multi_precision and dt in (jnp.bfloat16, jnp.float16):
+                for p, v in pv:
+                    t = self._master_weights.get(id(p))
+                    if t is None:
+                        continue
+                    tv = t._read()
+                    if tv.dtype != jnp.float32 or \
+                            tuple(tv.shape) != tuple(v.shape):
+                        return None
+            for name in self._FUSED_MOMENTS[kind]:
+                store = self._accumulators.get(name, {})
+                for p, v in pv:
+                    t = store.get(id(p))
+                    if t is None:
+                        continue
+                    tv = t._read()
+                    if tv.dtype != jnp.float32 or \
+                            tuple(tv.shape) != tuple(v.shape):
+                        return None
+        # ---- build pass ----
+        groups = []
+        log = self._flat_created_log
+        for dt, pv in by_dtype.items():
+            members = [p for p, _ in pv]
+            values = [v for _, v in pv]
+            use_master = self._multi_precision and dt in (
+                jnp.bfloat16, jnp.float16)
+            grp = _flat.FlatGroup(members, values, use_master)
+            # beta powers collapse to one scalar per bucket; a prior
+            # per-param history must be uniform for that to be exact
+            b1v, b2v = betas.get(dt, (1.0, 1.0))
+            pf = grp.flatten(values)
+            grp.param_store = _flat.FlatStore(grp, "param", pf)
+            if log is not None:
+                log.append((grp.param_store.storage, pf))
+            for i, p in enumerate(members):
+                grp.param_store.bind(i, p)
+            if use_master:
+                if any(id(p) in self._master_weights for p in members):
+                    mvals = []
+                    for p, v in pv:
+                        t = self._master_weights.get(id(p))
+                        mvals.append(v.astype(jnp.float32) if t is None
+                                     else t._read())
+                    mf = grp.flatten(mvals, jnp.float32)
+                else:
+                    mf = pf.astype(jnp.float32)
+                grp.master_store = _flat.FlatStore(grp, "master", mf)
+                if log is not None:
+                    log.append((grp.master_store.storage, mf))
+                st = grp.master_store
+                for i, p in enumerate(members):
+                    t = self._master_weights.get(id(p))
+                    if t is None:
+                        t = Tensor(st._slice(mf, i))
+                        self._master_weights[id(p)] = t
+                    st.bind(i, t)
+            for name in self._FUSED_MOMENTS[kind]:
+                store = self._accumulators.setdefault(name, {})
+                avals = []
+                for p, v in pv:
+                    t = store.get(id(p))
+                    avals.append(jnp.zeros(v.shape, jnp.float32)
+                                 if t is None else t._read())
+                af = grp.flatten(avals, jnp.float32)
+                st = _flat.FlatStore(grp, "moment", af)
+                grp.moment_stores[name] = st
+                if log is not None:
+                    log.append((st.storage, af))
+                for i, p in enumerate(members):
+                    t = store.get(id(p))
+                    if t is None:
+                        t = Tensor(avals[i])
+                        store[id(p)] = t
+                    st.bind(i, t)
+            if kind in ("adam", "adamw"):
+                grp.b1p = Tensor(jnp.float32(b1v))
+                grp.b2p = Tensor(jnp.float32(b2v))
+                if log is not None:
+                    log.append((grp.b1p, grp.b1p._read()))
+                    log.append((grp.b2p, grp.b2p._read()))
+            groups.append(grp)
+        return groups or None
+
+    def _uniform_beta_pows(self, members):
+        """(b1, b2) when every member's saved beta-pow history agrees
+        (the normal case: all params step together); None when mixed."""
+        out = []
+        for name in ("beta1_pow", "beta2_pow"):
+            store = self._accumulators.get(name, {})
+            ts = [store.get(id(p)) for p in members]
+            if all(t is None for t in ts):
+                out.append(1.0)
+                continue
+            if any(t is None for t in ts):
+                return None
+            first = None
+            for t in ts:
+                a = np.asarray(t._read()).ravel()
+                if a.size == 0:
+                    return None
+                if first is None:
+                    first = a.flat[0]
+                if not np.all(a == first):
+                    return None
+            out.append(float(first))
+        return out[0], out[1]
+
+    def _make_spec(self, grp, has_clip):
+        from ..ops.pallas.fused_optimizer import UpdateSpec
+        kind = self._fused_kind()
+        reg = self._regularization
+        reg_kind, reg_coeff = None, 0.0
+        if isinstance(reg, L2Decay) and reg.coeff:
+            reg_kind, reg_coeff = "l2", reg.coeff
+        elif isinstance(reg, L1Decay) and reg.coeff:
+            reg_kind, reg_coeff = "l1", reg.coeff
+        return UpdateSpec(
+            kind=kind, beta1=getattr(self, "_beta1", 0.9),
+            beta2=getattr(self, "_beta2", 0.999),
+            eps=getattr(self, "_epsilon", 1e-8),
+            momentum=getattr(self, "_momentum", 0.0),
+            nesterov=getattr(self, "_nesterov", False),
+            rescale=getattr(self, "_rescale", 1.0),
+            decay=(self._coeff if kind == "adamw" else 0.0),
+            reg=reg_kind, reg_coeff=reg_coeff,
+            use_master=grp.use_master, has_clip=has_clip)
+
+    def _gather_grads(self, grp, gmap):
+        """Member grads -> the group's flat grad buffer (ONE concat),
+        binding the grad tensors as views of it."""
+        st = grp.grad_store
+        gts = [gmap[id(p)] for p in grp.params]
+        # the short-circuit (flat buffer already authoritative) is an
+        # EAGER-only optimization: under capture the gather must always
+        # run — discovery has to read the member grads so replay (whose
+        # host flags are frozen post-discovery and which always takes
+        # the gather branch) sees the same reads, and skipping it would
+        # bake a program that ignores in-step grad accumulation
+        if st is not None and not st._dirty and _tm._tracker is None \
+                and all(st.owns(g, i) for i, g in enumerate(gts)):
+            return
+        vals = [g._read() for g in gts]
+        flat = grp.flatten(vals, vals[0].dtype)
+        if st is None:
+            st = grp.grad_store = _flat.FlatStore(grp, "grad", flat)
+        else:
+            st.set_flat(flat)
+        if _flat._replaying():
+            # replay re-executes with temporary tracer grads: only the
+            # value flow above is real, bindings must not mutate
+            return
+        anchor = st.storage._data
+        concrete = _flat._concrete(anchor)
+        for i, g in enumerate(gts):
+            if not st.owns(g, i):
+                st.bind(i, g)
+            else:
+                st.local[i] = False
+            g._flat_src = anchor if concrete else None
+        st._dirty = False
+
+    def _fused_step(self, pairs):
+        from ..ops.pallas import fused_optimizer as fo
+        if not pairs:
+            return False  # nothing to do; keep buckets/eligibility intact
+        fl = self._flat
+        if fl is None:
+            fl = self._build_flat(pairs)
+            if fl is None:
+                self._fused_off = True  # structural: stop probing
+                return False
+            self._flat = fl
+        gmap = {id(p): g for p, g in pairs}
+        clip_active = isinstance(self._grad_clip, ClipGradByGlobalNorm)
+        for grp in fl:
+            for i, p in enumerate(grp.params):
+                if id(p) not in gmap:
+                    raise _flat.FlatMismatch(
+                        "bucketed parameter has no gradient this step")
+                if not grp.param_store.owns(p, i):
+                    raise _flat.FlatMismatch(
+                        "parameter re-bound outside its bucket")
+                if getattr(p, "regularizer", None) is not None or \
+                        (hasattr(p, "optimize_attr") and
+                         p.optimize_attr.get("learning_rate", 1.0) != 1.0) \
+                        or (clip_active and
+                            getattr(p, "need_clip", True) is False):
+                    raise _flat.FlatMismatch(
+                        "per-param attribute changed after bucket build")
+        bucketed = set()
+        for grp in fl:
+            bucketed.update(grp.pids)
+        leftover = [(p, g) for p, g in pairs if id(p) not in bucketed]
+        # fold any local view overrides (per-param fallback steps, user
+        # writes) back into the flat buffers, then gather grads
+        for grp in fl:
+            for st in grp.stores():
+                st.sync()
+            self._gather_grads(grp, gmap)
+        lr = self._live_lr()
+        clip_scale = None
+        if clip_active:
+            sq = [jnp.sum(jnp.square(
+                grp.grad_store.storage._read().astype(jnp.float32)))
+                for grp in fl]
+            for p, g in leftover:
+                if g is None or getattr(p, "need_clip", True) is False:
+                    continue
+                sq.append(jnp.sum(jnp.square(
+                    g._read().astype(jnp.float32))))
+            if sq:
+                clip_scale = self._grad_clip._flat_scale(sq)
+        if clip_scale is not None and leftover:
+            leftover = ClipGradByGlobalNorm._apply_scale(leftover,
+                                                         clip_scale)
+        for grp in fl:
+            spec = self._make_spec(grp, clip_scale is not None)
+            kw = {}
+            names = self._FUSED_MOMENTS[spec.kind]
+            if names:
+                kw["m"] = grp.moment_stores[names[0]].flat_value()
+            if len(names) > 1:
+                kw["v"] = grp.moment_stores[names[1]].flat_value()
+            if spec.use_master:
+                kw["master"] = grp.master_store.flat_value()
+            if grp.b1p is not None:
+                kw["b1p"] = grp.b1p._read()
+                kw["b2p"] = grp.b2p._read()
+            new_w, new_master, nm, nv, nb1, nb2 = fo.fused_update(
+                spec, w=grp.param_store.flat_value(),
+                g=grp.grad_store.storage._read(), lr=lr,
+                clip_scale=clip_scale, **kw)
+            grp.param_store.set_flat(new_w)
+            if new_master is not None:
+                grp.master_store.set_flat(new_master)
+            if nm is not None:
+                grp.moment_stores[names[0]].set_flat(nm)
+            if nv is not None:
+                grp.moment_stores[names[1]].set_flat(nv)
+            if nb1 is not None:
+                grp.b1p._write(nb1)
+                grp.b2p._write(nb2)
+        if leftover:
+            self._apply_pairs(leftover, lr)
+        return True
+
+    def _defuse(self, reason, warn=True, count=True):
+        """Dissolve the flat buckets back into per-param tensors."""
+        fl = self._flat
+        if fl is None:
+            return
+        if _tm._tracker is not None:
+            raise _flat.FlatMismatch(
+                f"flat-bucket defuse required under jit capture ({reason})"
+                " — defuse eagerly before capturing the step")
+        for grp in fl:
+            if grp.b1p is not None:
+                for i, p in enumerate(grp.params):
+                    for name, t in (("beta1_pow", grp.b1p),
+                                    ("beta2_pow", grp.b2p)):
+                        self._accumulators.setdefault(name, {})[id(p)] = \
+                            Tensor(jnp.full(grp.shapes[i], t._read(),
+                                            jnp.float32))
+            for st in grp.stores():
+                st.unbind_all()
+            if grp.grad_store is not None:
+                grp.grad_store.unbind_all()
+        self._flat = None
+        if count:
+            self._defuse_count += 1
+            if self._defuse_count >= 2:
+                self._fused_off = True
+        if warn:
+            warnings.warn(
+                f"fused optimizer path defused: {reason} "
+                f"(per-param fallback)")
+
+    def _flat_unscale(self, inv):
+        """Bucketed unscale + inf-check for ``amp.GradScaler``: one
+        multiply and one isfinite reduction per flat bucket instead of
+        per-param chains. Returns (found_inf, handled param ids)."""
+        fl = self._flat
+        if not fl:
+            return False, set()
+        gmap = {id(p): g for p, g in self._collect()}
+        found = False
+        handled: set[int] = set()
+        for grp in fl:
+            if any(id(p) not in gmap for p in grp.params):
+                continue
+            try:
+                self._gather_grads(grp, gmap)
+            except _flat.FlatMismatch:
+                continue
+            g32 = grp.grad_store.storage._read().astype(jnp.float32) * inv
+            if not bool(jnp.all(jnp.isfinite(g32))):
+                found = True
+            grp.grad_store.set_flat(g32)
+            handled.update(grp.pids)
+        return found, handled
+
+    def _fused_guard_slots(self):
+        """Every flat storage the fused update writes — the slots
+        ``resilience.StepGuard`` snapshots/blends instead of the
+        per-param views (O(buckets) selects, not O(params))."""
+        out = []
+        for grp in (self._flat or ()):
+            for st in grp.stores():
+                st.sync()
+                out.append(st.storage)
+            if grp.b1p is not None:
+                out.extend((grp.b1p, grp.b2p))
+        return out
+
     minimize = None  # set below
 
     def _update(self, p, w, g, lr):
@@ -208,7 +623,24 @@ class Optimizer:
         # NOTE: the reference defaults set_to_zero=True (zero in place);
         # we default to dropping the buffer — zeroing is opt-in for
         # jit-captured gradient accumulation (hapi accumulate_grad_batches).
+        handled: set[int] = set()
+        if set_to_zero and self._flat is not None:
+            # fused path: ONE zeros op per flat grad bucket; the
+            # per-param grad views observe the zeros lazily
+            for grp in self._flat:
+                st = grp.grad_store
+                if st is None:
+                    continue
+                if any(p._grad is None or not st.owns(p._grad, i)
+                       for i, p in enumerate(grp.params)):
+                    continue  # partially re-bound: per-param fallback
+                st.fill_zeros()
+                for p in grp.params:
+                    p._grad._node = None
+                    handled.add(id(p))
         for p in self._parameters:
+            if id(p) in handled:
+                continue
             p.clear_grad(set_to_zero=set_to_zero)
 
     clear_gradients = clear_grad
@@ -225,12 +657,28 @@ class Optimizer:
         for pid, val in self._master_weights.items():
             if pid in names:
                 sd[f"{names[pid]}.master_weight"] = Tensor(val._read())
+        # fused buckets keep ONE beta-pow scalar per bucket; emit it per
+        # param so the per-param path (and older checkpoints) round-trip
+        for grp in (self._flat or ()):
+            if grp.b1p is None:
+                continue
+            for p in grp.params:
+                nm = names.get(id(p))
+                if nm is None:
+                    continue
+                sd[f"{nm}.beta1_pow"] = Tensor(grp.b1p._read())
+                sd[f"{nm}.beta2_pow"] = Tensor(grp.b2p._read())
         if isinstance(self._learning_rate, LRScheduler):
             sd["LR_Scheduler"] = self._learning_rate.state_dict()
         sd["@step"] = self._step_count
         return sd
 
     def set_state_dict(self, sd):
+        if self._flat is not None:
+            # dissolve the buckets first: loading replaces the per-param
+            # accumulator tensors wholesale; the buckets rebuild from the
+            # loaded values at the next step()
+            self._defuse("set_state_dict", warn=False, count=False)
         names = {(p.name or f"param_{i}"): p
                  for i, p in enumerate(self._parameters)}
         self._step_count = int(sd.get("@step", 0))
@@ -274,6 +722,9 @@ class SGD(Optimizer):
     def _update(self, p, w, g, lr):
         return w - lr * g
 
+    def _fused_kind(self):
+        return "sgd"
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
@@ -295,6 +746,9 @@ class Momentum(Optimizer):
             return w - lr * (g + self._momentum * vel)
         return w - lr * vel
 
+    def _fused_kind(self):
+        return "momentum"
+
 
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
@@ -307,6 +761,9 @@ class Adam(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
         self._amsgrad = amsgrad
+
+    def _fused_kind(self):
+        return None if self._amsgrad else "adam"
 
     def _beta_pows(self, p):
         b1p = self._acc("beta1_pow", p, init=1.0, dtype=jnp.float32)
@@ -352,6 +809,12 @@ class AdamW(Adam):
             weight_decay, (L1Decay, L2Decay)) else weight_decay.coeff
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
+
+    def _fused_kind(self):
+        if self._amsgrad or self._lr_ratio is not None or \
+                self._apply_decay_param_fun is not None:
+            return None
+        return "adamw"
 
     def _update(self, p, w, g, lr):
         if self._lr_ratio is not None:

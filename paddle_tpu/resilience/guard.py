@@ -83,16 +83,35 @@ class StepGuard:
         ok = self.check(loss, optimizer)
 
         # snapshot every slot the update may write: params with grads,
-        # their master weights, and all existing accumulators
+        # their master weights, and all existing accumulators. On the
+        # fused multi-tensor path the flat bucket STORAGES are the
+        # written slots (optimizer/flat.py) — under jit capture the
+        # per-param views are skipped (the compiled program threads
+        # only the storages; blending them is a handful of selects
+        # instead of O(params)). EAGERLY the views are snapshotted too:
+        # a FlatMismatch can defuse the buckets mid-step, and the
+        # per-param fallback's writes must still roll back.
+        from ..core import tensor as _tm
         snaps = []
+        capturing = _tm._tracker is not None
+
+        def _skip(t):
+            fv = t._flat_view
+            return capturing and fv is not None and fv[1] >= 0
+        fused_slots = getattr(optimizer, "_fused_guard_slots", None)
+        if fused_slots is not None:
+            for t in fused_slots():
+                snaps.append((t, t._read()))
         for p, _g in optimizer._collect():
-            snaps.append((p, p._read()))
+            if not _skip(p):
+                snaps.append((p, p._read()))
             mw = optimizer._master_weights.get(id(p))
-            if mw is not None:
+            if mw is not None and not _skip(mw):
                 snaps.append((mw, mw._read()))
         for store in optimizer._accumulators.values():
             for t in store.values():
-                snaps.append((t, t._read()))
+                if not _skip(t):
+                    snaps.append((t, t._read()))
 
         # accumulators/master weights born DURING this step (only the
         # first-ever optimizer step) blend back to their creation value
@@ -117,13 +136,30 @@ class StepGuard:
 
         optimizer._acc = patched_acc
         optimizer._get_master = patched_master
+        # flat bucket storages born during THIS step (the first fused
+        # step builds them) blend back to their creation values, the
+        # same first-step contract as patched_acc above
+        optimizer._flat_created_log = created
         try:
             optimizer.step()
         finally:
             del optimizer._acc
             del optimizer._get_master
+            optimizer._flat_created_log = None
 
         for t, snap in snaps + created:
+            fv = t._flat_view
+            if fv is not None and fv[1] >= 0:
+                # still a bound flat view at blend time: its bucket
+                # storage is itself in the blend set (snapshotted via
+                # _fused_guard_slots, or in the created log when born
+                # this step) and the view reads through it lazily — a
+                # direct write would mark a local override and force a
+                # full per-member re-sync of the bucket next step. The
+                # view snapshots matter only when a mid-step defuse
+                # unbound them, in which case fv is cleared and the
+                # write below runs.
+                continue
             cur = t._read()
             t._write(jnp.where(ok, cur, snap))
 

@@ -284,6 +284,9 @@ def test_window_runner_matches_sequential():
     ref = [float(step(*b)) for b in batches]
     ref_params = {k: np.asarray(v._read()).copy()
                   for k, v in net.state_dict().items()}
+    # sequential continuation over the same batches again: the reference
+    # for the second window launched below
+    ref2 = [float(step(*b)) for b in batches]
 
     for k, v in net.state_dict().items():
         v._write(sd[k])
@@ -296,9 +299,10 @@ def test_window_runner_matches_sequential():
     for k, v in net.state_dict().items():
         np.testing.assert_allclose(np.asarray(v._read()), ref_params[k],
                                    atol=1e-6)
-    # outputs="last" on a fresh window continues from the updated state
+    # outputs="last" on a fresh window continues from the updated state:
+    # it must reproduce the final loss of the sequential continuation
     last = w.run(*stacks, outputs="last")
-    assert float(last) < ref[0]
+    np.testing.assert_allclose(float(last), ref2[-1], rtol=1e-5)
 
 
 def test_window_runner_per_step_lr_matches_sequential():
@@ -389,7 +393,9 @@ def test_window_runner_donate_false_reuses_carry():
     after1 = {k: np.asarray(v._read()).copy()
               for k, v in net.state_dict().items()}
     for k, v in net.state_dict().items():
-        v._data = snap[k]
+        # through the write funnel: with the fused optimizer the params
+        # are flat-bucket views and a raw _data poke would be invisible
+        v._write(snap[k])
         v._node = None
     l2 = float(w.run(*stacks, outputs="last"))
     np.testing.assert_allclose(l1, l2, rtol=1e-6)
