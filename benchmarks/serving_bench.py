@@ -31,6 +31,16 @@ returning pages to the free list.  Its ``tokens_per_sec`` is the
 continuous-batching throughput claim and must beat the fixed-batch
 ``paged_b8`` row to justify the scheduler.
 
+plus an ``overload`` row (ISSUE 5): the same engine driven PAST its
+capacity — page pool sized below the arrival working set, a bounded
+admission queue, and tight deadlines on a slice of the requests — so
+the overload policies (preempt-and-requeue, reject, timeout) are what
+is being measured.  Reports ``goodput_tokens_per_sec`` (tokens of
+normally-finished requests only), ``preemptions``, ``timeouts``,
+``rejected`` and ``completed_ok``; a lab engine crashes on this
+workload, a serving engine degrades and the row quantifies the
+degradation.
+
 Results persist via benchmarks/measured_cache.py and surface as a
 compact ``serving`` entry in bench.py's enriched record and in
 BASELINE.md.  Run standalone on the real chip:
@@ -190,6 +200,7 @@ def measure():
     run("paged_b1_long", 1, 1024, 64, "paged", 16)
     rows["continuous_mixed"] = _measure_continuous(
         cfg, model, gbps, launch)
+    rows["overload"] = _measure_overload(cfg, model)
     return rows
 
 
@@ -265,9 +276,90 @@ def _measure_continuous(cfg, model, gbps, launch, slots=8,
     return row
 
 
+def _measure_overload(cfg, model, slots=8, max_seq_len=512,
+                      prompt_range=(32, 257), new_range=(16, 65),
+                      n_requests=24, page_size=16, decode_window=16,
+                      prefill_chunk=128, max_queue=8,
+                      deadline_every=6, deadline_ms=300.0):
+    """Drive the engine PAST capacity and measure the degradation the
+    overload policies buy: the page pool holds ~55% of the slots'
+    worst-case working set (growth preempts), the queue is bounded
+    with policy 'reject' (arrivals past depth shed), and every
+    ``deadline_every``-th request carries a tight deadline."""
+    from paddle_tpu.inference import ContinuousBatchingEngine
+
+    rng = np.random.default_rng(2)
+    specs = _mixed_workload(rng, n_requests, prompt_range, new_range)
+    np_per_seq = -(-max_seq_len // page_size)
+    total_pages = 1 + int(slots * np_per_seq * 0.55)
+
+    def drive():
+        from paddle_tpu.core.errors import QueueFullError
+
+        eng = ContinuousBatchingEngine(
+            model, max_slots=slots, page_size=page_size,
+            max_seq_len=max_seq_len, total_pages=total_pages,
+            decode_window=decode_window, prefill_chunk=prefill_chunk,
+            max_queue=max_queue, queue_policy="reject")
+        pending = list(enumerate(specs))
+        done = {}
+        rejected = 0
+        t0 = time.perf_counter()
+        while eng.has_work or pending:
+            # arrivals outpace service: two per engine step
+            for _ in range(2):
+                if not pending:
+                    break
+                i, (p_len, n_new) = pending.pop(0)
+                dl = (deadline_ms if i % deadline_every == 0
+                      else None)
+                try:
+                    eng.add_request(
+                        rng.integers(0, cfg.vocab_size,
+                                     p_len).astype(np.int32),
+                        n_new, deadline_ms=dl)
+                except QueueFullError:  # load shed by design; anything
+                    rejected += 1       # else must FAIL the bench
+            for c in eng.step():
+                done[c.request_id] = c
+        wall = time.perf_counter() - t0
+        return eng, done, rejected, wall
+
+    drive()                            # compile + warm both programs
+    eng, done, rejected, wall = drive()
+    ok = [c for c in done.values() if c.ok]
+    good_toks = sum(c.tokens.size for c in ok)
+    st = eng.stats
+    row = {
+        "batch": slots, "kv_cache": "paged",
+        "decode_window": decode_window,
+        "requests": len(specs), "total_pages": total_pages,
+        "max_queue": max_queue,
+        "wall_s": round(wall, 3),
+        "tokens_per_sec": round(st["tokens_generated"] / wall, 1),
+        "goodput_tokens_per_sec": round(good_toks / wall, 1),
+        "completed_ok": len(ok),
+        "preemptions": st["preemptions"],
+        "timeouts": st["timeouts"],
+        "rejected": rejected,
+        "pages_leaked": st["pages_in_use"],   # must be 0
+    }
+    print(f"overload: {row['goodput_tokens_per_sec']} good tok/s "
+          f"({row['completed_ok']}/{row['requests']} ok, "
+          f"{row['preemptions']} preempts, {row['timeouts']} timeouts, "
+          f"{row['rejected']} rejected)", file=sys.stderr, flush=True)
+    return row
+
+
+# the serving rows' validity depends on the engine's scheduling layer
+# and its policy knobs (core/state.py serving_* flags, resilience
+# guard/retry), not just the kernels — include them in code_version so
+# policy changes re-measure
 FILES = ["benchmarks/serving_bench.py",
          "paddle_tpu/models/generation.py",
          "paddle_tpu/inference/engine.py",
+         "paddle_tpu/resilience/serving.py",
+         "paddle_tpu/core/state.py",
          "paddle_tpu/ops/pallas/paged_attention.py",
          "paddle_tpu/ops/pallas/flash_attention.py"]
 

@@ -424,3 +424,94 @@ def check_eager_optimizer_loop(fndef, ctx):
                 "step in @paddle.jit.to_static (or use "
                 "Model.fit(window=K)) so the loop body compiles to one "
                 "program")
+
+
+# constructor kwargs that bound a serving engine's overload behavior
+# (inference/engine.py): any one of them makes PDT109 stand down.
+# dispatch_retries is deliberately NOT here — it bounds transient
+# retry, not queue growth or request lifetime.
+_ENGINE_BOUND_KWARGS = {"max_queue", "queue_policy",
+                        "default_deadline_ms"}
+
+
+@register(
+    "PDT109", "unbounded-serving-run", Severity.NOTE, "ast",
+    scope="eager",
+    example="""
+import paddle_tpu as paddle
+from paddle_tpu.inference import ContinuousBatchingEngine
+
+def serve(model, prompts):
+    eng = ContinuousBatchingEngine(model, max_slots=4)
+    for p in prompts:
+        eng.add_request(p, 32)
+    return eng.run()
+""",
+    near_miss="""
+import paddle_tpu as paddle
+from paddle_tpu.inference import ContinuousBatchingEngine
+
+def serve(model, prompts):
+    eng = ContinuousBatchingEngine(model, max_slots=4, max_queue=64,
+                                   queue_policy="reject")
+    for p in prompts:
+        eng.add_request(p, 32)
+    return eng.run()
+""")
+def check_unbounded_serving_run(fndef, ctx):
+    """``ContinuousBatchingEngine.run()`` on an engine constructed with
+    no overload policy (no ``max_queue``/``queue_policy`` bound, no
+    ``default_deadline_ms`` TTL): fine in the lab, but under real
+    traffic an unbounded queue plus deadline-free requests means
+    overload shows up as unbounded memory and latency instead of
+    rejections/timeouts.  Configure the bounds (or the ``serving_*``
+    flags in ``core/state.py``).  Note-level advice, not an error."""
+    # pass 1: every assignment to a name, in source order — a name is
+    # suspect at a .run() site iff its latest PRECEDING assignment is
+    # an engine constructed without any bound (so rebinding the name
+    # to anything else clears it; _walk_fn order is not source order)
+    assigns: dict[str, list[tuple[tuple[int, int], bool]]] = {}
+    for node in _walk_fn(fndef):
+        if isinstance(node, ast.Assign):
+            is_engine = (isinstance(node.value, ast.Call)
+                         and (_dotted(node.value.func) or "")
+                         .split(".")[-1] == "ContinuousBatchingEngine")
+            suspect = is_engine and not any(
+                kw.arg in _ENGINE_BOUND_KWARGS
+                for kw in node.value.keywords)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    assigns.setdefault(tgt.id, []).append(
+                        ((node.lineno, node.col_offset), suspect))
+    for hist in assigns.values():
+        hist.sort()
+
+    def _unbounded_at(name, pos):
+        last = None
+        for apos, suspect in assigns.get(name, ()):
+            if apos > pos:
+                break
+            last = suspect
+        return bool(last)
+
+    for node in _walk_fn(fndef):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute) \
+                or node.func.attr != "run":
+            continue
+        base = node.func.value
+        chained = (isinstance(base, ast.Call)
+                   and (_dotted(base.func) or "").split(".")[-1]
+                   == "ContinuousBatchingEngine"
+                   and not any(kw.arg in _ENGINE_BOUND_KWARGS
+                               for kw in base.keywords))
+        named = (isinstance(base, ast.Name)
+                 and _unbounded_at(base.id, (node.lineno,
+                                             node.col_offset)))
+        if chained or named:
+            yield node, (
+                "ContinuousBatchingEngine.run() with no overload "
+                "policy configured: pass max_queue/queue_policy "
+                "and/or default_deadline_ms (or set the serving_* "
+                "flags) so heavy traffic degrades to rejections/"
+                "timeouts instead of unbounded queues")

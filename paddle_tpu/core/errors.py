@@ -102,6 +102,37 @@ class CheckpointNotFoundError(EnforceNotMet, FileNotFoundError):
     error_code = "PDT-E015"
 
 
+class PageBudgetError(EnforceNotMet, ValueError):
+    """A serving request can NEVER be satisfied by the engine's page
+    pool: ``ceil((prompt + max_new_tokens) / page_size)`` exceeds the
+    usable pool (``total_pages - 1``; page 0 is the reserved null page).
+    Raised eagerly at ``ContinuousBatchingEngine.add_request`` so an
+    unservable request is rejected at submission instead of poisoning
+    the queue and crashing ``step()`` after it drains."""
+
+    error_code = "PDT-E016"
+
+
+class QueueFullError(EnforceNotMet):
+    """``ContinuousBatchingEngine.add_request`` under the ``reject``
+    admission policy: the bounded queue (``max_queue``) is full. Callers
+    shed load (retry later / route elsewhere); the ``block`` policy
+    steps the engine until room frees instead of raising."""
+
+    error_code = "PDT-E017"
+
+
+class NonFiniteLogitsError(EnforceNotMet, FloatingPointError):
+    """The serving decode guard found non-finite logits for ONE request
+    (device-side finite-ness flag carried through the mixed/decode
+    programs). The engine fails only that request — recorded on its
+    ``CompletedRequest.error`` with ``finish_reason == "failed"`` — and
+    co-resident requests finish unperturbed; this error is never raised
+    through the engine loop."""
+
+    error_code = "PDT-E018"
+
+
 def enforce(cond: bool, msg: str, exc=InvalidArgumentError):
     """PADDLE_ENFORCE: raise ``exc`` with ``msg`` unless ``cond``."""
     if not cond:

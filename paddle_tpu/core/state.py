@@ -72,6 +72,27 @@ define_flag("fused_opt", True,
             "fallback). Exotic cases (per-param LR/clip/regularizer, "
             "sharded or lazy params, unsupported optimizers/clips) fall "
             "back automatically.")
+define_flag("serving_max_queue", 0,
+            "bounded admission queue for inference.ContinuousBatching"
+            "Engine: add_request past this depth applies the queue "
+            "policy. 0 = unbounded (lab default; PDT109 notes it). "
+            "Engine kwarg max_queue overrides per instance.")
+define_flag("serving_queue_policy", "reject",
+            "what a full serving queue does to add_request: 'reject' "
+            "raises QueueFullError (PDT-E017) so the caller sheds "
+            "load; 'block' steps the engine until room frees. Engine "
+            "kwarg queue_policy overrides per instance.")
+define_flag("serving_deadline_ms", 0.0,
+            "default per-request deadline for the serving engine, "
+            "checked at step boundaries (finish_reason 'timeout'). "
+            "0 = no deadline. add_request(deadline_ms=...) overrides "
+            "per request.")
+define_flag("serving_dispatch_retries", 3,
+            "bounded resilience.retry RE-attempts after a transient "
+            "failure of a serving engine dispatch (N retries = N+1 "
+            "attempts; 0 disables retry). Transient ConnectionErrors "
+            "— incl. the injected engine_dispatch fault site — are "
+            "absorbed; anything else propagates.")
 define_flag("while_grad_max_trip_count", 256,
             "trip bound for differentiable while_loop under jit capture "
             "(lowered to a masked lax.scan; XLA has no reverse-mode "

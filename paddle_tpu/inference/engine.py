@@ -28,44 +28,106 @@ Shape discipline (TPU-native):
     dispatch, slot state (tokens, positions, finished mask, page
     tables, KV pools) carried through the scan — one host round-trip
     per K tokens.
-  Admission and retirement only change tensor VALUES (block tables,
-  lengths, masks) between dispatches — shapes never change, so no
+  Admission, retirement, preemption, cancellation and deadlines only
+  change tensor VALUES (block tables, lengths, masks, the guard's
+  poison vector) between dispatches — shapes never change, so no
   per-request recompiles.
 * Greedy decoding (the serving bench's measurement mode); sampling
   belongs to ``models.generate``.
+
+Overload behavior (ISSUE 5; the Gemma study and the Ragged Paged
+Attention paper both treat admission under bounded HBM and
+eviction/recompute of preempted sequences as first-class serving
+mechanics):
+
+* ON-DEMAND paging — admission reserves pages for the prompt plus one
+  decode page only; block tables grow as decode crosses page
+  boundaries.  Under pool pressure the allocator PREEMPTS a victim
+  slot (latest-admitted first, never one admitted before the grower),
+  returns its pages and requeues it at the queue head; re-admission
+  re-prefills ``prompt + tokens_so_far``, which is bitwise-identical
+  to an uncontended run (greedy decode is deterministic and the
+  ragged prefill and decode paths agree bitwise — the engine-vs-
+  generate parity tests pin that).  The earliest-admitted resident can
+  always grow (eager admission bounds every request by the pool), so
+  overload degrades throughput, never liveness.
+* ADMISSION CONTROL — ``max_queue`` bounds the queue; policy
+  ``reject`` raises :class:`~paddle_tpu.core.errors.QueueFullError`
+  (PDT-E017), ``block`` steps the engine until room frees.  Requests
+  that can NEVER fit the pool are rejected eagerly at ``add_request``
+  with :class:`~paddle_tpu.core.errors.PageBudgetError` (PDT-E016).
+* DEADLINES / CANCELLATION — per-request ``deadline_ms`` checked at
+  step boundaries (``finish_reason == "timeout"``), ``cancel(rid)``
+  for queued or resident requests (``"cancelled"``).
+* DECODE GUARD — a device-side finite-ness flag over each slot's
+  logits rides the mixed program and the decode-window scan carry
+  (``models.generation.guarded_argmax``); a non-finite request fails
+  ALONE (``finish_reason == "failed"``, coded
+  ``NonFiniteLogitsError`` recorded on the result) while co-resident
+  requests finish unperturbed.
+* FAULT DRILLS — every dispatch runs under bounded
+  ``resilience.retry``; the ``engine_dispatch`` / ``engine_nan_decode``
+  / ``engine_page_pressure`` sites (``resilience.serving``) drill the
+  retry, guard and preemption paths deterministically.
 """
 from __future__ import annotations
 
+import time
+import warnings
 from collections import deque
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.errors import PageBudgetError, QueueFullError
 from ..core.tensor import Tensor
+from ..resilience import faults
+from ..resilience.serving import (SITE_PAGE_PRESSURE, DecodeGuard,
+                                  dispatch_retry)
 
 __all__ = ["ContinuousBatchingEngine", "CompletedRequest"]
 
 
 class _Request:
-    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id")
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
+                 "done_toks", "deadline", "preemptions")
 
-    def __init__(self, rid, prompt, max_new_tokens, eos_token_id):
+    def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
+                 deadline=None):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
+        self.done_toks: list[int] = []  # generated before a preemption
+        self.deadline = deadline        # absolute clock() seconds | None
+        self.preemptions = 0
 
 
 class CompletedRequest:
-    """Result handed back by :meth:`ContinuousBatchingEngine.step`."""
+    """Result handed back by :meth:`ContinuousBatchingEngine.step`.
 
-    __slots__ = ("request_id", "prompt", "tokens")
+    ``finish_reason`` is one of ``resilience.serving.FINISH_REASONS``:
+    ``stop`` (eos), ``length`` (max_new_tokens), ``timeout`` (deadline
+    expired at a step boundary), ``cancelled`` (:meth:`cancel`), or
+    ``failed`` (decode guard; the coded error is on ``error``).
+    ``tokens`` holds whatever was generated before the cut."""
 
-    def __init__(self, request_id, prompt, tokens):
+    __slots__ = ("request_id", "prompt", "tokens", "finish_reason",
+                 "error")
+
+    def __init__(self, request_id, prompt, tokens,
+                 finish_reason="length", error=None):
         self.request_id = request_id
         self.prompt = prompt          # np.int32 [S]
         self.tokens = tokens          # np.int32 [<= max_new_tokens]
+        self.finish_reason = finish_reason
+        self.error = error            # coded exception for "failed"
+
+    @property
+    def ok(self):
+        """True for a normally-finished request (stop/length)."""
+        return self.finish_reason in ("stop", "length")
 
     @property
     def sequence(self):
@@ -75,7 +137,8 @@ class CompletedRequest:
 
 class _Slot:
     __slots__ = ("req", "phase", "pages", "cur_tok", "cur_pos",
-                 "prefill_off", "out_toks", "stop_len", "eos")
+                 "prefill_ids", "prefill_off", "out_toks", "stop_len",
+                 "eos", "admit_seq", "cancelled")
 
     def __init__(self):
         self.req = None
@@ -83,10 +146,13 @@ class _Slot:
         self.pages = []
         self.cur_tok = 0
         self.cur_pos = 0
+        self.prefill_ids = None       # prompt + replayed done_toks
         self.prefill_off = 0
         self.out_toks = []
         self.stop_len = 0
         self.eos = -1
+        self.admit_seq = -1
+        self.cancelled = False
 
     @property
     def len_written(self):
@@ -110,11 +176,22 @@ class _Slot:
 class ContinuousBatchingEngine:
     """Request-level scheduler: ``add_request`` any time, ``step`` until
     it returns completions, or ``run`` to drain.  See the module
-    docstring for the shape discipline."""
+    docstring for the shape discipline and the overload policies.
+
+    Policy knobs (engine kwargs; ``None`` falls back to the
+    ``serving_*`` flags in ``core/state.py``): ``max_queue`` +
+    ``queue_policy`` bound admission, ``default_deadline_ms`` applies a
+    TTL to every request, ``dispatch_retries`` bounds the per-dispatch
+    retry.  ``clock`` (tests) replaces ``time.monotonic`` for
+    deterministic deadline drills."""
 
     def __init__(self, model, *, max_slots=8, page_size=16,
                  max_seq_len=None, total_pages=None, decode_window=8,
-                 prefill_chunk=64, q_block=8, pages_per_block=None):
+                 prefill_chunk=64, q_block=8, pages_per_block=None,
+                 max_queue=None, queue_policy=None,
+                 default_deadline_ms=None, dispatch_retries=None,
+                 clock=None):
+        from ..core import state as _state
         from ..models.generation import (_decode_fn, _ragged_fn,
                                          _zero_pool)
         cfg = model.cfg
@@ -141,6 +218,24 @@ class ContinuousBatchingEngine:
         self.token_budget = (self.max_slots * self.q_block
                              + self.prefill_chunk)
 
+        # overload policies (kwarg > flag; 0 flag values mean "off")
+        self.max_queue = int(_state.get_flag("serving_max_queue")
+                             if max_queue is None else max_queue)
+        self.queue_policy = str(_state.get_flag("serving_queue_policy")
+                                if queue_policy is None else queue_policy)
+        if self.queue_policy not in ("reject", "block"):
+            raise ValueError(
+                f"queue_policy must be 'reject' or 'block', "
+                f"got {self.queue_policy!r}")
+        dl = float(_state.get_flag("serving_deadline_ms")
+                   if default_deadline_ms is None else default_deadline_ms)
+        self.default_deadline_ms = dl if dl > 0 else None
+        self.dispatch_retries = int(
+            _state.get_flag("serving_dispatch_retries")
+            if dispatch_retries is None else dispatch_retries)
+        self._clock = time.monotonic if clock is None else clock
+        self._guard = DecodeGuard(self.max_slots)
+
         n_kv = getattr(cfg, "num_kv_heads", cfg.num_heads)
         shape = (n_kv, self.total_pages, self.page_size, cfg.head_dim)
         self._caches = [Tensor(a)
@@ -149,19 +244,33 @@ class ContinuousBatchingEngine:
         self._bt = np.zeros((self.max_slots, self.np_per_seq), np.int32)
         self._slots = [_Slot() for _ in range(self.max_slots)]
         self._queue: deque[_Request] = deque()
+        self._early: list[CompletedRequest] = []  # finalized off-dispatch
         self._next_rid = 0
+        self._admit_counter = 0
         self._step_fn = None
         self._mixed_fn = None
         self._decode_exe = None
-        # allocator stats (page-reuse evidence for tests/bench)
-        self.stats = {"admitted": 0, "retired": 0, "steps": 0,
-                      "mixed_steps": 0, "decode_dispatches": 0,
-                      "tokens_generated": 0, "pages_allocated": 0,
-                      "peak_pages_in_use": 0}
+        # counters; the ``stats`` property adds the live gauges
+        self._stats = {"admitted": 0, "retired": 0, "steps": 0,
+                       "mixed_steps": 0, "decode_dispatches": 0,
+                       "tokens_generated": 0, "pages_allocated": 0,
+                       "peak_pages_in_use": 0, "preemptions": 0,
+                       "timeouts": 0, "cancelled": 0, "failed": 0,
+                       "rejected": 0, "retries": 0}
 
     # ------------------------------------------------------------ API --
+    @property
+    def stats(self):
+        """Health snapshot: the lifetime counters plus live gauges
+        (``pages_in_use``/``pages_free``/``queue_depth``)."""
+        d = dict(self._stats)
+        d["pages_in_use"] = self.total_pages - 1 - len(self._free_pages)
+        d["pages_free"] = len(self._free_pages)
+        d["queue_depth"] = len(self._queue)
+        return d
+
     def add_request(self, prompt, max_new_tokens, eos_token_id=None,
-                    request_id=None):
+                    request_id=None, deadline_ms=None):
         prompt = np.asarray(
             prompt.numpy() if isinstance(prompt, Tensor) else prompt,
             np.int32).reshape(-1)
@@ -170,6 +279,33 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"request needs {total} tokens > engine max_seq_len "
                 f"{self.max_seq_len}")
+        # eager page-budget rejection: a request whose full length can
+        # never fit the pool must fail HERE, not poison the queue and
+        # crash step() after everything ahead of it drains
+        need_full = -(-total // self.page_size)
+        if need_full > self.total_pages - 1:
+            self._stats["rejected"] += 1
+            raise PageBudgetError(
+                f"request needs {need_full} pages but the pool only has "
+                f"{self.total_pages - 1}; raise total_pages or lower "
+                f"max_new_tokens [{PageBudgetError.error_code}]")
+        if self.max_queue and len(self._queue) >= self.max_queue:
+            if self.queue_policy == "reject":
+                self._stats["rejected"] += 1
+                raise QueueFullError(
+                    f"admission queue full ({self.max_queue}); shed load "
+                    f"or use queue_policy='block' "
+                    f"[{QueueFullError.error_code}]")
+            # block: drive the engine until the queue drains one slot.
+            # Admissible requests always drain (see module docstring),
+            # so this terminates; the guard catches a wedged engine.
+            for _ in range(1_000_000):
+                if len(self._queue) < self.max_queue or not self.has_work:
+                    break
+                self._early.extend(self.step())
+            else:
+                raise RuntimeError("queue_policy='block': engine made no "
+                                   "progress draining the queue")
         if request_id is None:
             rid = self._next_rid
             self._next_rid += 1
@@ -181,52 +317,156 @@ class ContinuousBatchingEngine:
                 s.req.rid for s in self._slots if s.req is not None}
             if rid in in_flight:
                 raise ValueError(f"request_id {rid!r} already in flight")
+        dl_ms = (self.default_deadline_ms
+                 if deadline_ms is None else float(deadline_ms))
+        deadline = (self._clock() + dl_ms / 1e3) if dl_ms else None
         self._queue.append(_Request(
             rid, prompt, max_new_tokens,
-            -1 if eos_token_id is None else int(eos_token_id)))
+            -1 if eos_token_id is None else int(eos_token_id), deadline))
         return rid
+
+    def cancel(self, rid) -> bool:
+        """Cancel a queued or resident request; its CompletedRequest
+        (``finish_reason == "cancelled"``, tokens generated so far)
+        surfaces from the next :meth:`step`. False when ``rid`` is not
+        in flight (already completed or unknown)."""
+        for i, r in enumerate(self._queue):
+            if r.rid == rid:
+                del self._queue[i]
+                self._stats["cancelled"] += 1
+                self._early.append(CompletedRequest(
+                    rid, r.prompt, np.asarray(r.done_toks, np.int32),
+                    "cancelled"))
+                return True
+        for s in self._slots:
+            if s.req is not None and s.req.rid == rid and not s.cancelled:
+                s.cancelled = True   # finalized at the next step boundary
+                return True
+        return False
+
+    def pending_requests(self):
+        """Request ids still in flight (resident slots, then queued) —
+        what a budget-exhausted :meth:`run` leaves behind."""
+        out = [s.req.rid for s in self._slots if s.req is not None]
+        out.extend(r.rid for r in self._queue)
+        return out
 
     @property
     def has_work(self):
-        return bool(self._queue) or any(
+        return bool(self._queue) or bool(self._early) or any(
             s.req is not None for s in self._slots)
 
     def run(self, max_steps=10000):
         """Drain: step until every queued/resident request completes.
-        Returns {request_id: CompletedRequest} in completion order."""
+        Returns {request_id: CompletedRequest} in completion order.
+        Warns (once) when ``max_steps`` is exhausted with requests
+        still in flight — see :meth:`pending_requests`."""
         done = {}
         for _ in range(max_steps):
             if not self.has_work:
                 break
             for c in self.step():
                 done[c.request_id] = c
+        for c in self._early:   # finalized after the last step ran
+            done[c.request_id] = c
+        self._early.clear()
+        if self.has_work:
+            pend = self.pending_requests()
+            warnings.warn(
+                f"ContinuousBatchingEngine.run: step budget "
+                f"({max_steps}) exhausted with {len(pend)} request(s) "
+                f"unfinished — engine.pending_requests() lists them; "
+                f"raise max_steps or check admission (queue depth "
+                f"{len(self._queue)})", RuntimeWarning, stacklevel=2)
         return done
 
     # ------------------------------------------------- scheduling -----
+    def _release_slot(self, b):
+        """Free slot ``b``: pages back to the free list, block-table
+        row nulled (null page: a frozen slot's writes can never touch
+        a reissued page), slot reset.  The ONLY way pages leave a
+        slot — every retire/finalize/preempt path funnels here."""
+        s = self._slots[b]
+        self._free_pages.extend(s.pages)
+        self._bt[b, :] = 0
+        self._slots[b] = _Slot()
+
+    def _finalize_slot(self, b, reason, error=None):
+        """Retire slot ``b`` off the normal path (timeout / cancelled /
+        failed / preempt-to-nowhere): free its pages, null its block
+        table row, emit the partial result."""
+        s = self._slots[b]
+        toks = np.asarray(s.out_toks[:s.req.max_new_tokens], np.int32)
+        comp = CompletedRequest(s.req.rid, s.req.prompt, toks, reason,
+                                error)
+        self._release_slot(b)
+        return comp
+
     def _retire(self):
         out = []
         for b, s in enumerate(self._slots):
-            if s.req is None or not s.done:
-                continue
+            if s.req is None or not s.done or s.cancelled:
+                continue  # cancelled-but-done: _sweep finalizes it as
+                          # "cancelled" (cancel() already promised so)
             toks = s.out_toks[:s.req.max_new_tokens]
+            reason = "length"
             if s.eos >= 0 and s.eos in toks:
                 toks = toks[:toks.index(s.eos) + 1]
+                reason = "stop"
             out.append(CompletedRequest(
-                s.req.rid, s.req.prompt, np.asarray(toks, np.int32)))
-            self._free_pages.extend(s.pages)
-            self._bt[b, :] = 0        # null page: a frozen slot's writes
-            self._slots[b] = _Slot()  # can never touch a reissued page
-            self.stats["retired"] += 1
+                s.req.rid, s.req.prompt, np.asarray(toks, np.int32),
+                reason))
+            self._release_slot(b)
+            self._stats["retired"] += 1
         return out
 
+    def _sweep(self, now):
+        """Step-boundary policy sweep: expire deadlines (queued AND
+        resident) and finalize cancelled residents."""
+        out = []
+        if any(r.deadline is not None and now >= r.deadline
+               for r in self._queue):
+            kept = deque()
+            for r in self._queue:
+                if r.deadline is not None and now >= r.deadline:
+                    self._stats["timeouts"] += 1
+                    out.append(CompletedRequest(
+                        r.rid, r.prompt,
+                        np.asarray(r.done_toks, np.int32), "timeout"))
+                else:
+                    kept.append(r)
+            self._queue = kept
+        for b, s in enumerate(self._slots):
+            if s.req is None:
+                continue
+            if s.cancelled:
+                self._stats["cancelled"] += 1
+                out.append(self._finalize_slot(b, "cancelled"))
+            elif s.req.deadline is not None and now >= s.req.deadline:
+                self._stats["timeouts"] += 1
+                out.append(self._finalize_slot(b, "timeout"))
+        return out
+
+    # --------------------------------------------- page allocation ----
+    def _admit_need(self, req):
+        """Pages an admission reserves: the (resume) prompt plus ONE
+        decode slot — growth is on-demand from there."""
+        resume = req.prompt.size + len(req.done_toks)
+        stop = req.prompt.size + req.max_new_tokens
+        target = max(resume, min(resume + 1, stop))
+        return max(1, -(-target // self.page_size))
+
+    def _note_peak(self):
+        in_use = self.total_pages - 1 - len(self._free_pages)
+        self._stats["peak_pages_in_use"] = max(
+            self._stats["peak_pages_in_use"], in_use)
+
     def _admit(self):
-        admitted = False
         for b, s in enumerate(self._slots):
             if s.req is not None or not self._queue:
                 continue
             req = self._queue[0]
-            need = -(-(req.prompt.size + req.max_new_tokens)
-                     // self.page_size)
+            need = self._admit_need(req)
             if need > len(self._free_pages):
                 break                 # head-of-line: keep arrival order
             self._queue.popleft()
@@ -234,43 +474,121 @@ class ContinuousBatchingEngine:
             s.req = req
             s.phase = "prefill"
             s.pages = pages
+            # a preempted request re-prefills prompt + tokens_so_far:
+            # greedy decode is deterministic and the ragged prefill and
+            # decode paths agree bitwise, so the resumed stream is
+            # identical to the uncontended one
+            if req.done_toks:
+                s.prefill_ids = np.concatenate(
+                    [req.prompt,
+                     np.asarray(req.done_toks, np.int32)])
+            else:
+                s.prefill_ids = req.prompt
             s.prefill_off = 0
-            s.out_toks = []
+            s.out_toks = list(req.done_toks)
             s.stop_len = req.prompt.size + req.max_new_tokens
             s.eos = req.eos_token_id
+            s.admit_seq = self._admit_counter
+            self._admit_counter += 1
             self._bt[b, :] = 0
             self._bt[b, :need] = pages
-            self.stats["admitted"] += 1
-            self.stats["pages_allocated"] += need
-            admitted = True
-        in_use = self.total_pages - 1 - len(self._free_pages)
-        self.stats["peak_pages_in_use"] = max(
-            self.stats["peak_pages_in_use"], in_use)
-        return admitted
+            self._stats["admitted"] += 1
+            self._stats["pages_allocated"] += need
+        self._note_peak()
+
+    def _pick_victim(self, b):
+        """Preemption victim for grower ``b``: the latest-admitted
+        resident admitted AFTER ``b`` (never one ahead of it — the
+        earliest resident must always win, which is what makes
+        preemption converge). None when ``b`` is itself the latest."""
+        me = self._slots[b].admit_seq
+        victim, vseq = None, me
+        for i, s in enumerate(self._slots):
+            if i != b and s.req is not None and s.admit_seq > vseq:
+                victim, vseq = i, s.admit_seq
+        return victim
+
+    def _preempt(self, b):
+        """Evict slot ``b``: return its pages and requeue it at the
+        HEAD (it outranks everything queued) for re-prefill recompute."""
+        s = self._slots[b]
+        req = s.req
+        req.done_toks = list(s.out_toks)
+        req.preemptions += 1
+        self._queue.appendleft(req)
+        self._release_slot(b)
+        self._stats["preemptions"] += 1
+
+    def _ensure_tokens(self, b, n_tokens):
+        """Grow slot ``b``'s block table to hold ``n_tokens`` resident
+        tokens, preempting later-admitted victims under pool pressure
+        (or under the injected ``engine_page_pressure`` drill). Returns
+        False when ``b`` itself had to be preempted (it was the
+        latest-admitted and the pool is exhausted)."""
+        s = self._slots[b]
+        need = -(-n_tokens // self.page_size)
+        while len(s.pages) < need:
+            pressure = faults.check(
+                SITE_PAGE_PRESSURE, key=str(s.req.rid)) \
+                or not self._free_pages
+            if pressure:
+                victim = self._pick_victim(b)
+                if victim is None:
+                    self._preempt(b)
+                    return False
+                self._preempt(victim)
+                continue
+            pg = self._free_pages.popleft()
+            self._bt[b, len(s.pages)] = pg
+            s.pages.append(pg)
+            self._stats["pages_allocated"] += 1
+        self._note_peak()
+        return True
 
     def step(self):
-        """One scheduling step: retire, admit, dispatch.  Returns the
-        requests completed by the PREVIOUS dispatch (retirement happens
-        at step boundaries)."""
+        """One scheduling step: retire, sweep policies, admit, grow/
+        preempt, dispatch.  Returns the requests completed by the
+        PREVIOUS dispatch plus any policy finalizations (retirement
+        happens at step boundaries)."""
         completed = self._retire()
+        if self._early:
+            completed.extend(self._early)
+            self._early.clear()
+        completed.extend(self._sweep(self._clock()))
         self._admit()
-        self.stats["steps"] += 1
+        self._stats["steps"] += 1
         if any(s.phase == "prefill" for s in self._slots):
             self._run_mixed()
         elif any(s.phase == "decode" for s in self._slots):
             self._run_decode()
         elif self._queue:
-            # nothing resident and the head request STILL could not be
-            # admitted: with every slot free the full page budget is
-            # available, so no amount of stepping will ever serve it
+            # backstop only: with every slot free the full pool is
+            # available and eager PageBudgetError already rejected
+            # anything that cannot fit it, so this is unreachable for
+            # admissible request mixes
             req = self._queue[0]
-            need = -(-(req.prompt.size + req.max_new_tokens)
-                     // self.page_size)
             raise RuntimeError(
-                f"request {req.rid} needs {need} pages but the pool "
-                f"only has {self.total_pages - 1}; raise total_pages "
-                "or lower max_new_tokens")
+                f"request {req.rid} needs {self._admit_need(req)} pages "
+                f"but the pool only has {self.total_pages - 1}; raise "
+                "total_pages or lower max_new_tokens")
         return completed
+
+    def _fail(self, b):
+        """Decode guard hit: fail ONE request with the coded error; the
+        engine and every co-resident request keep going."""
+        s = self._slots[b]
+        err = DecodeGuard.failure(s.req.rid, s.len_written)
+        self._stats["failed"] += 1
+        self._early.append(self._finalize_slot(b, "failed", err))
+
+    def _dispatch(self, kind, fn):
+        def _on_retry(_exc, _attempt):
+            self._stats["retries"] += 1
+        # dispatch_retries counts RETRIES (re-attempts after a
+        # transient), so N=0 disables retry and N=1 absorbs one fault
+        return dispatch_retry(kind, fn,
+                              max_attempts=self.dispatch_retries + 1,
+                              on_retry=_on_retry)
 
     # compiled serving programs cache ON the model (generate()'s
     # _decode_step_cache idiom): engines with the same bucket geometry
@@ -287,25 +605,26 @@ class ContinuousBatchingEngine:
     # ------------------------------------------------- mixed step -----
     def _get_mixed_fn(self):
         if self._mixed_fn is None:
-            key = ("mixed",) + self._geometry()
+            key = ("mixed", "guard") + self._geometry()
             cache = self._program_cache()
             self._mixed_fn = cache.get(key)
         if self._mixed_fn is None:
             from .. import jit as jit_mod
             from .. import ops
+            from ..models.generation import guarded_argmax
             model, ragged, qb = self.model, self._ragged, self.q_block
             ppb = self.pages_per_block
 
             def mixed(ids_t, tok_pos, tok_slot, tok_valid, kv_lens,
-                      q_lens, last_idx, bt, *cs):
+                      q_lens, last_idx, poison, bt, *cs):
                 import paddle_tpu as pp
                 with pp.no_grad():
                     logits, new = ragged(model, ids_t, tok_pos, tok_slot,
                                          tok_valid, kv_lens, q_lens, bt,
                                          list(cs), qb, ppb)
                     lg = ops.gather(logits, last_idx)       # [B, V]
-                    nxt = ops.argmax(lg, axis=-1, dtype="int32")
-                return (nxt,) + tuple(new)
+                    nxt, bad = guarded_argmax(lg, poison)
+                return (nxt, bad) + tuple(new)
 
             self._mixed_fn = jit_mod.to_static(mixed)
             cache[key] = self._mixed_fn
@@ -313,11 +632,44 @@ class ContinuousBatchingEngine:
 
     def _run_mixed(self):
         """Pack one q_block-aligned segment per active slot — decode
-        slots their current token, prefill slots the next prompt chunk
-        that fits — and advance everything in ONE dispatch."""
+        slots their current token, prefill slots the next chunk that
+        fits — grow/preempt for the pages this step will write, and
+        advance everything in ONE dispatch."""
         qb, T, B = self.q_block, self.token_budget, self.max_slots
         budget = T - sum(qb for s in self._slots
                          if s.phase == "decode")
+        plan = {}      # b -> (segment tokens, pos0, prefill take|None)
+        for b, s in enumerate(self._slots):
+            if s.phase == "decode":
+                plan[b] = ([int(s.cur_tok)], s.cur_pos, None)
+            elif s.phase == "prefill":
+                rem = s.prefill_ids.size - s.prefill_off
+                take = min(rem, budget)
+                while take > 0 and -(-take // qb) * qb > budget:
+                    take -= 1     # q_block padding must fit the budget
+                if take <= 0:
+                    continue      # budget exhausted: sits out this step
+                budget -= -(-take // qb) * qb
+                plan[b] = (list(s.prefill_ids[s.prefill_off:
+                                              s.prefill_off + take]),
+                           s.prefill_off, take)
+        # page growth in admission order (earliest first — it can
+        # always win); growth may preempt later-admitted slots, planned
+        # or not, so drop plans whose slot got evicted
+        order = sorted(plan, key=lambda b: self._slots[b].admit_seq)
+        for b in order:
+            s = self._slots[b]
+            if s.req is None:           # evicted by an earlier grower
+                plan.pop(b)
+                continue
+            seg, pos0, take = plan[b]
+            target = (s.cur_pos + 1) if take is None else pos0 + len(seg)
+            if not self._ensure_tokens(b, target):
+                plan.pop(b)             # self-preempted (latest + dry)
+        plan = {b: p for b, p in plan.items()
+                if self._slots[b].req is not None}
+        if not plan:
+            return
         tok = np.zeros(T, np.int32)
         tpos = np.zeros(T, np.int32)
         tslot = np.zeros(T, np.int32)
@@ -325,26 +677,12 @@ class ContinuousBatchingEngine:
         kv_lens = np.ones(B, np.int32)
         q_lens = np.zeros(B, np.int32)
         last_idx = np.zeros(B, np.int32)
-        chunks = {}
         cur = 0
-        for b, s in enumerate(self._slots):
-            if s.phase == "decode":
-                seg = [int(s.cur_tok)]
-                pos0 = s.cur_pos
-            elif s.phase == "prefill":
-                rem = s.req.prompt.size - s.prefill_off
-                take = min(rem, budget)
-                while take > 0 and -(-take // qb) * qb > budget:
-                    take -= 1     # q_block padding must fit the budget
-                if take <= 0:
-                    continue      # budget exhausted: sits out this step
-                budget -= -(-take // qb) * qb
-                seg = list(s.req.prompt[s.prefill_off:
-                                        s.prefill_off + take])
-                pos0 = s.prefill_off
-                chunks[b] = take
-            else:
+        for b in range(B):
+            if b not in plan:
                 continue
+            s = self._slots[b]
+            seg, pos0, _take = plan[b]
             n = len(seg)
             tok[cur:cur + n] = seg
             tpos[cur:cur + n] = pos0 + np.arange(n)
@@ -354,6 +692,9 @@ class ContinuousBatchingEngine:
             kv_lens[b] = s.len_written + n
             last_idx[b] = cur + n - 1
             cur += -(-n // qb) * qb   # next segment at a q_block boundary
+        poison = self._guard.poison(
+            [self._slots[b].req.rid if b in plan else None
+             for b in range(B)])
         fn = self._get_mixed_fn()
         args = [Tensor(jnp.asarray(tok[None, :])),
                 Tensor(jnp.asarray(tpos)), Tensor(jnp.asarray(tslot)),
@@ -361,31 +702,36 @@ class ContinuousBatchingEngine:
                 Tensor(jnp.asarray(kv_lens)),
                 Tensor(jnp.asarray(q_lens)),
                 Tensor(jnp.asarray(last_idx)),
+                Tensor(jnp.asarray(poison)),
                 Tensor(jnp.asarray(self._bt))]
-        res = fn(*args, *self._caches)
+        res = self._dispatch("mixed", lambda: fn(*args, *self._caches))
         nxt = np.asarray(res[0]._read()).reshape(-1)
-        self._caches = list(res[1:])
-        self.stats["mixed_steps"] += 1
-        self.stats["decode_dispatches"] += 1
-        for b, s in enumerate(self._slots):
-            if s.req is None or q_lens[b] == 0:
+        bad = np.asarray(res[1]._read()).reshape(-1)
+        self._caches = list(res[2:])
+        self._stats["mixed_steps"] += 1
+        self._stats["decode_dispatches"] += 1
+        for b in sorted(plan):
+            s = self._slots[b]
+            _seg, _pos0, take = plan[b]
+            if bad[b]:
+                self._fail(b)
                 continue
-            if s.phase == "decode":
+            if take is None:
                 self._accept(s, int(nxt[b]))
             else:
-                s.prefill_off += chunks[b]
-                if s.prefill_off >= s.req.prompt.size:
+                s.prefill_off += take
+                if s.prefill_off >= s.prefill_ids.size:
                     s.phase = "decode"
-                    s.cur_pos = s.req.prompt.size
+                    s.cur_pos = s.prefill_ids.size
                     s.cur_tok = int(nxt[b])
                     s.out_toks.append(int(nxt[b]))
-                    self.stats["tokens_generated"] += 1
+                    self._stats["tokens_generated"] += 1
 
     def _accept(self, s, t):
         s.out_toks.append(t)
         s.cur_tok = t
         s.cur_pos += 1
-        self.stats["tokens_generated"] += 1
+        self._stats["tokens_generated"] += 1
 
     # ------------------------------------------------ decode window ---
     def _get_step_fn(self):
@@ -421,6 +767,7 @@ class ContinuousBatchingEngine:
         fin = np.ones(B, bool)
         eos = np.full(B, -1, np.int32)
         stop = np.ones(B, np.int32)
+        rids = [None] * B
         for b, s in enumerate(self._slots):
             if s.phase != "decode":
                 continue
@@ -429,10 +776,29 @@ class ContinuousBatchingEngine:
             fin[b] = s.done
             eos[b] = s.eos
             stop[b] = s.stop_len
-        return tok, pos, fin, eos, stop
+            rids[b] = s.req.rid
+        return tok, pos, fin, eos, stop, rids
+
+    def _grow_decode_slots(self):
+        """Reserve the pages the next decode dispatch can write: up to
+        ``decode_window`` tokens per live slot (capped at stop_len),
+        preempting under pressure. Earliest-admitted first."""
+        order = sorted(
+            (b for b, s in enumerate(self._slots)
+             if s.phase == "decode"),
+            key=lambda b: self._slots[b].admit_seq)
+        for b in order:
+            s = self._slots[b]
+            if s.req is None:           # evicted by an earlier grower
+                continue
+            target = min(s.cur_pos + self.decode_window, s.stop_len)
+            self._ensure_tokens(b, max(target, s.cur_pos + 1))
 
     def _run_decode(self):
-        tok, pos, fin, eos, stop = self._slot_vectors()
+        self._grow_decode_slots()
+        if not any(s.phase == "decode" for s in self._slots):
+            return                      # everyone got preempted
+        tok, pos, fin, eos, stop, rids = self._slot_vectors()
         step_fn = self._get_step_fn()
         if self._decode_exe is None:
             # a model-cache hit may hand us an already-compiled step
@@ -442,23 +808,31 @@ class ContinuousBatchingEngine:
                 self._decode_exe = next(iter(wrapped._cache.values()))
         if self._decode_exe is None:
             # first decode dispatch compiles the scalar step; its logits
-            # advance every live slot by one token (host argmax)
-            res = step_fn(Tensor(jnp.asarray(tok)),
-                          Tensor(jnp.asarray(pos)),
-                          Tensor(jnp.asarray(self._bt)), *self._caches)
-            lg = np.asarray(res[0]._read())
+            # advance every live slot by one token (host argmax; the
+            # guard check runs host-side on the same poisoned values
+            # the windowed path applies in-graph)
+            res = self._dispatch("decode", lambda: step_fn(
+                Tensor(jnp.asarray(tok)), Tensor(jnp.asarray(pos)),
+                Tensor(jnp.asarray(self._bt)), *self._caches))
+            lg = np.asarray(res[0]._read()).astype(np.float32)
             self._caches = list(res[1:])
-            nxt = lg.argmax(-1).astype(np.int32)
-            self.stats["decode_dispatches"] += 1
+            lg = lg + self._guard.poison(rids)[:, None]
+            bad = ~np.isfinite(lg).all(-1)
+            nxt = np.where(bad, 0, lg.argmax(-1)).astype(np.int32)
+            self._stats["decode_dispatches"] += 1
             for b, s in enumerate(self._slots):
-                if not fin[b]:
-                    self._accept(s, int(nxt[b]))
+                if fin[b]:
+                    continue
+                if bad[b]:
+                    self._fail(b)
+                    continue
+                self._accept(s, int(nxt[b]))
             wrapped = (step_fn if hasattr(step_fn, "_cache")
                        else getattr(step_fn, "__wrapped__", None))
             if wrapped is not None and getattr(wrapped, "_cache", None):
                 self._decode_exe = next(iter(wrapped._cache.values()))
             return
-        self._run_window(tok, pos, fin, eos, stop)
+        self._run_window(tok, pos, fin, eos, stop, rids)
 
     def _get_window_runner(self, K):
         # cached on the executable (generate()'s idiom) so engines
@@ -471,9 +845,11 @@ class ContinuousBatchingEngine:
             runners[K] = runner
         return runner
 
-    def _run_window(self, tok, pos, fin, eos, stop):
+    def _run_window(self, tok, pos, fin, eos, stop, rids):
         """K scanned decode steps in one dispatch; slot state rides the
-        scan carry (models/generation.py's window machinery, per-slot)."""
+        scan carry (models/generation.py's window machinery, per-slot).
+        The guard's bad flag is part of the carry: a slot that goes
+        non-finite freezes in-graph and is failed host-side."""
         exe = self._decode_exe
         K = self.decode_window
         for sync in exe.discovery.host_syncs:
@@ -483,25 +859,52 @@ class ContinuousBatchingEngine:
         cache_vals = [c._read() for c in self._caches]
         cstate = [capt[i]._read() for i in carry_idx]
         const_state = [capt[i]._read() for i in const_idx]
+        poison = self._guard.poison(rids)
         runner = self._get_window_runner(K)
-        toks, tokf, posf, finf, cache_vals, cstate = runner(
-            jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(fin),
-            jnp.asarray(eos), jnp.asarray(stop),
-            jnp.asarray(self._bt), cache_vals, cstate, const_state)
+        donated = cache_vals + cstate    # runner donate_argnums=(8, 9)
+
+        def _window_call():
+            # retry can only re-run this closure while its donated
+            # inputs are still alive (a transient raised BEFORE the
+            # program consumed them — the engine_dispatch drill, a
+            # submit-side connection error). Past donation the buffers
+            # are gone: surface that clearly instead of retrying into
+            # a confusing deleted-buffer error.
+            if any(getattr(v, "is_deleted", lambda: False)()
+                   for v in donated):
+                raise RuntimeError(
+                    "decode-window dispatch failed after its KV/state "
+                    "buffers were donated; a mid-execution transient "
+                    "is unrecoverable at this layer — re-create the "
+                    "engine and re-submit the pending requests")
+            return runner(
+                jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(fin),
+                jnp.asarray(np.zeros(self.max_slots, bool)),
+                jnp.asarray(eos), jnp.asarray(stop),
+                jnp.asarray(poison), jnp.asarray(self._bt),
+                cache_vals, cstate, const_state)
+
+        toks, bads, tokf, posf, finf, badf, cache_vals, cstate = \
+            self._dispatch("window", _window_call)
         toks = np.asarray(toks)                       # [K, B]
+        bads = np.asarray(bads)                       # [K, B] cumulative
         for i, v in zip(carry_idx, cstate):
             capt[i]._data = v
             capt[i]._node = None
         for t, v in zip(self._caches, cache_vals):
             t._data = v
             t._node = None
-        self.stats["decode_dispatches"] += 1
+        self._stats["decode_dispatches"] += 1
         # host replay of the device stop rule (identical predicate, so
-        # the accepted prefix matches the carried fin exactly)
+        # the accepted prefix matches the carried fin exactly); the
+        # first bad step fails the slot and discards its frozen tail
         for b, s in enumerate(self._slots):
             if s.phase != "decode" or fin[b]:
                 continue
             for k in range(K):
+                if bads[k, b]:
+                    self._fail(b)
+                    break
                 t = int(toks[k, b])
                 self._accept(s, t)
                 if (s.eos >= 0 and t == s.eos) \
@@ -511,11 +914,15 @@ class ContinuousBatchingEngine:
 
 def _make_slot_window(exe, K):
     """Scan K per-slot greedy decode steps into ONE jitted dispatch.
-    The carry holds (token, position, finished) PER SLOT plus caches
-    and mutated captured state; finished slots freeze (position and
-    token stop advancing, so their page writes keep landing on already
-    owned — or null — pages)."""
+    The carry holds (token, position, finished, guard-bad) PER SLOT
+    plus caches and mutated captured state; finished OR guard-failed
+    slots freeze (position and token stop advancing, so their page
+    writes keep landing on already owned — or null — pages). The
+    stacked per-step bad flags come back so the host can locate the
+    first poisoned step exactly."""
     from jax import lax
+
+    from ..models.generation import guarded_argmax
 
     pure = exe._pure
     n_ret = exe.n_ret
@@ -523,10 +930,10 @@ def _make_slot_window(exe, K):
     capt = exe.capt_state
     carry_idx, const_idx = exe.state_split()
 
-    def window(tok, pos, fin, eos_ids, stop_lens, bt, caches, cstate,
-               const_state):
+    def window(tok, pos, fin, bad, eos_ids, stop_lens, poison, bt,
+               caches, cstate, const_state):
         def body(c, _):
-            tok, pos, fin, caches, cstate = c
+            tok, pos, fin, bad, caches, cstate = c
             state = [None] * len(capt)
             for i, v in zip(carry_idx, cstate):
                 state[i] = v
@@ -537,17 +944,18 @@ def _make_slot_window(exe, K):
             new_caches = list(outs[1:1 + n_caches])
             new_cstate = list(outs[1 + n_caches:
                                    1 + n_caches + len(carry_idx)])
-            nxt = lg.argmax(-1).astype(jnp.int32)         # [B]
-            adv = jnp.logical_not(fin)
-            nxt = jnp.where(adv, nxt, tok[:, 0])
+            nxt_raw, row_bad = guarded_argmax.raw(lg, poison)     # [B]
+            bad2 = bad | (row_bad & jnp.logical_not(fin))
+            adv = jnp.logical_not(fin | bad2)
+            nxt = jnp.where(adv, nxt_raw, tok[:, 0])
             pos2 = jnp.where(adv, pos + 1, pos)
-            fin2 = fin | ((eos_ids >= 0) & (nxt == eos_ids)) \
+            fin2 = fin | bad2 | ((eos_ids >= 0) & (nxt == eos_ids)) \
                 | (pos2 + 1 >= stop_lens)
-            return (nxt[:, None], pos2, fin2, new_caches,
-                    new_cstate), nxt
+            return (nxt[:, None], pos2, fin2, bad2, new_caches,
+                    new_cstate), (nxt, bad2)
 
-        (tok, pos, fin, caches, cstate), toks = lax.scan(
-            body, (tok, pos, fin, caches, cstate), None, length=K)
-        return toks, tok, pos, fin, caches, cstate
+        (tok, pos, fin, bad, caches, cstate), (toks, bads) = lax.scan(
+            body, (tok, pos, fin, bad, caches, cstate), None, length=K)
+        return toks, bads, tok, pos, fin, bad, caches, cstate
 
-    return jax.jit(window, donate_argnums=(6, 7))
+    return jax.jit(window, donate_argnums=(8, 9))

@@ -153,6 +153,32 @@ def ragged_paged_step(q, k_new, v_new, k_pages, v_pages, tok_pos,
 
 
 @primitive
+def guarded_argmax(lg, poison):
+    """Greedy token pick with a device-side finite-ness flag — the
+    serving decode guard's in-graph half (``resilience.serving``).
+    (``guarded_argmax.raw`` is the jnp-level form the decode-window
+    scan body uses.)
+
+    ``lg`` [B, V] logits, ``poison`` [B] float32 (0.0 normally, NaN for
+    a slot the ``engine_nan_decode`` drill poisons). Returns
+    ``(nxt [B] int32, bad [B] bool)``. Adding 0.0f to finite logits is
+    argmax-invariant (the lone effect, -0.0 -> +0.0, compares equal),
+    so token streams are unchanged when the guard is idle; a bad row's
+    token is forced to 0 so the engine's host replay sees a
+    deterministic (discarded) value instead of argmax-over-NaN.
+
+    Runs INSIDE the engine's compiled mixed/decode programs and rides
+    the decode-window scan carry: detection of a non-finite request —
+    whatever layer the NaN entered at, since rows only mix within a
+    slot on the ``ragged_paged_step`` path — costs no extra host sync.
+    """
+    lg = lg.astype(jnp.float32) + poison.reshape(-1)[:, None]
+    bad = jnp.logical_not(jnp.all(jnp.isfinite(lg), axis=-1))
+    nxt = jnp.where(bad, 0, lg.argmax(-1)).astype(jnp.int32)
+    return nxt, bad
+
+
+@primitive
 def cache_prefill(k_new, v_new, k_cache, v_cache):
     """Write the WHOLE prompt's K/V [B, S, Hkv, D] into cache[:, :S] in
     one shot (batched prefill — the serving-path complement of the

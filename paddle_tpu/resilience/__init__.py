@@ -22,15 +22,24 @@ stack:
   consecutive-bad-step budget (``NonFiniteStepError`` PDT-E013) and
   GradScaler backoff; ``preempt`` — SIGTERM/SIGINT ->
   checkpoint-on-preempt + clean exit.
+* ``serving``           — the serving-side analogs (ISSUE 5): the
+  per-request decode guard (``DecodeGuard`` + in-graph flag; a bad
+  request fails alone with ``NonFiniteLogitsError`` PDT-E018), the
+  bounded-retry dispatch wrapper, and the ``engine_dispatch`` /
+  ``engine_nan_decode`` / ``engine_page_pressure`` fault sites the
+  serving drills fire.
 """
 from . import faults  # noqa: F401
 from . import preempt  # noqa: F401
+from . import serving  # noqa: F401
 from .atomic import atomic_write, fsync_dir  # noqa: F401
 from .checkpoint import CheckpointManager  # noqa: F401
 from .guard import StepGuard  # noqa: F401
 from .retry import retry, retry_call  # noqa: F401
+from .serving import DecodeGuard  # noqa: F401
 
 __all__ = [
-    "faults", "preempt", "atomic_write", "fsync_dir",
-    "CheckpointManager", "StepGuard", "retry", "retry_call",
+    "faults", "preempt", "serving", "atomic_write", "fsync_dir",
+    "CheckpointManager", "StepGuard", "DecodeGuard", "retry",
+    "retry_call",
 ]

@@ -20,6 +20,18 @@ sleeps or randomness:
   floating batch input with NaN. Key = 1-based GLOBAL step number.
 * ``preempt``            — the hapi fit loop raises a synthetic
   SIGTERM through the real signal path. Key = global step number.
+* ``engine_dispatch``    — a serving engine dispatch raises
+  ``InjectedConnectionError`` before touching the device; absorbed by
+  the bounded retry every dispatch runs under. Key = dispatch kind
+  (``mixed``/``decode``/``window``).
+* ``engine_nan_decode``  — ONE serving slot's logits are poisoned with
+  NaN for one dispatch, drilling the decode guard (that request fails
+  with ``finish_reason='failed'``; co-residents are untouched). Key =
+  the request id.
+* ``engine_page_pressure`` — the engine's page allocator treats the
+  free list as empty for one growth attempt, forcing the
+  preempt-and-requeue path without shrinking the pool. Key = the
+  request id of the slot being grown.
 
 Spec grammar (``;``-separated rules)::
 

@@ -1,0 +1,101 @@
+"""Serving-side resilience: the decode guard and the serving fault
+sites (ISSUE 5).
+
+The training stack guards a step with :class:`resilience.StepGuard` —
+an in-graph finite-ness predicate that makes a bad step a bitwise
+no-op. Serving needs the per-REQUEST analog: one request whose logits
+go non-finite (bad weights region, poisoned KV, an injected drill)
+must fail alone, never the engine or its co-resident requests. The
+pieces here are model-agnostic and host-side; the in-graph half
+(:func:`models.generation.guarded_argmax`) rides inside the engine's
+compiled mixed/decode programs as a device-side flag, so detection
+costs no extra host sync.
+
+Serving fault sites (``resilience.faults`` spec grammar):
+
+* ``engine_dispatch``      — raises ``InjectedConnectionError`` at the
+  top of an engine dispatch; absorbed by the bounded retry every
+  dispatch runs under. Key = dispatch kind (``mixed``/``decode``/
+  ``window``).
+* ``engine_nan_decode``    — poisons ONE slot's logits with NaN for
+  one dispatch (host-built poison vector, added in-graph), drilling
+  the decode guard. Key = the request id.
+* ``engine_page_pressure`` — makes the page allocator behave as if
+  the free list were empty for one growth attempt, drilling
+  preempt-and-requeue without shrinking the pool. Key = the request
+  id of the slot being grown.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.errors import NonFiniteLogitsError
+from . import faults
+
+__all__ = [
+    "FINISH_REASONS", "DecodeGuard", "dispatch_retry",
+    "SITE_DISPATCH", "SITE_NAN_DECODE", "SITE_PAGE_PRESSURE",
+]
+
+#: Every value ``CompletedRequest.finish_reason`` can take.
+FINISH_REASONS = ("stop", "length", "timeout", "cancelled", "failed")
+
+SITE_DISPATCH = "engine_dispatch"
+SITE_NAN_DECODE = "engine_nan_decode"
+SITE_PAGE_PRESSURE = "engine_page_pressure"
+
+
+class DecodeGuard:
+    """Host half of the serving decode guard.
+
+    Builds the per-slot poison vector each dispatch (NaN where the
+    ``engine_nan_decode`` drill fires, else 0.0 — adding 0.0f to finite
+    logits is argmax-invariant, so the guard is free when idle) and
+    turns a device-reported bad flag into the coded error the engine
+    records on the failed request.
+    """
+
+    def __init__(self, max_slots: int):
+        self.max_slots = int(max_slots)
+
+    def poison(self, slot_rids) -> np.ndarray:
+        """[max_slots] float32: NaN for slots whose request id fires
+        the ``engine_nan_decode`` site this dispatch, 0.0 elsewhere.
+        ``slot_rids`` maps slot index -> request id (None = idle)."""
+        vec = np.zeros(self.max_slots, np.float32)
+        for b, rid in enumerate(slot_rids):
+            if rid is None:
+                continue
+            if faults.check(SITE_NAN_DECODE, key=str(rid)):
+                vec[b] = np.nan
+        return vec
+
+    @staticmethod
+    def failure(rid, position) -> NonFiniteLogitsError:
+        """The coded error recorded on a guard-failed request (never
+        raised through the engine loop)."""
+        return NonFiniteLogitsError(
+            f"request {rid!r}: non-finite logits at position "
+            f"{position} — decode guard failed this request only "
+            f"[{NonFiniteLogitsError.error_code}]")
+
+
+def dispatch_retry(kind: str, fn, *, max_attempts=3, on_retry=None):
+    """Run one engine dispatch under bounded retry.
+
+    The ``engine_dispatch`` fault check sits INSIDE the retried
+    closure, so an injected transient is consumed per attempt and a
+    ``*N``-spec drill is absorbed by ``N`` retries exactly like a real
+    transient ConnectionError from a network-attached device. Delays
+    are kept tiny: a serving step retried at human backoff scales
+    would blow the latency budget before the second attempt.
+    """
+    from .retry import retry_call
+
+    def call():
+        faults.maybe_raise(SITE_DISPATCH, kind)
+        return fn()
+
+    return retry_call(call, max_attempts=max(1, int(max_attempts)),
+                      base_delay=0.005, max_delay=0.05,
+                      retry_on=(ConnectionError,), on_retry=on_retry)
