@@ -41,6 +41,17 @@ normally-finished requests only), ``preemptions``, ``timeouts``,
 workload, a serving engine degrades and the row quantifies the
 degradation.
 
+plus a ``shared_prefix`` row (ISSUE 6): a system-prompt-heavy workload
+(~90% of arrivals share a long prefix) through the engine with the
+cross-request KV prefix cache (``inference/prefix_cache.py``) on vs.
+off.  Reports the ROADMAP measure directly:
+``prefill_tokens_computed`` vs. ``prefill_tokens_requested`` (the
+saved fraction is the cache's compute win), mean time-to-first-token
+with and without the cache, plus ``cache_hits``/``cache_hit_tokens``/
+``evictions``.  The CPU tiny-model smoke
+(``tests/test_serving_engine.py``) validates the accounting; absolute
+times are TPU-measured.
+
 Results persist via benchmarks/measured_cache.py and surface as a
 compact ``serving`` entry in bench.py's enriched record and in
 BASELINE.md.  Run standalone on the real chip:
@@ -201,6 +212,7 @@ def measure():
     rows["continuous_mixed"] = _measure_continuous(
         cfg, model, gbps, launch)
     rows["overload"] = _measure_overload(cfg, model)
+    rows["shared_prefix"] = _measure_shared_prefix(cfg, model)
     return rows
 
 
@@ -351,6 +363,97 @@ def _measure_overload(cfg, model, slots=8, max_seq_len=512,
     return row
 
 
+def _measure_shared_prefix(cfg, model, slots=8, max_seq_len=512,
+                           shared_len=192, tail_range=(8, 49),
+                           new_tokens=32, n_requests=20,
+                           hit_every=10, page_size=16,
+                           decode_window=16, prefill_chunk=128,
+                           seed=3, warm=True):
+    """System-prompt-heavy traffic (ISSUE 6): every request but each
+    ``hit_every``-th shares a ``shared_len``-token prefix (~90% prefix
+    hit rate), driven twice — prefix cache OFF then ON — over identical
+    arrivals.  The ROADMAP measure: prefill tokens computed vs.
+    requested and mean TTFT at a high hit rate.  Works on the CPU tiny
+    model too (the accounting smoke in tests/test_serving_engine.py
+    uses it); absolute times only mean something on the TPU."""
+    from paddle_tpu.inference import ContinuousBatchingEngine
+
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(0, cfg.vocab_size, shared_len).astype(np.int32)
+    specs = []
+    for i in range(n_requests):
+        tail = rng.integers(0, cfg.vocab_size,
+                            int(rng.integers(*tail_range))).astype(
+                                np.int32)
+        if i % hit_every == hit_every - 1:    # ~10% cold prompts
+            prompt = rng.integers(
+                0, cfg.vocab_size,
+                shared_len + tail.size).astype(np.int32)
+        else:
+            prompt = np.concatenate([shared, tail])
+        specs.append(prompt)
+
+    def drive(prefix_cache):
+        eng = ContinuousBatchingEngine(
+            model, max_slots=slots, page_size=page_size,
+            max_seq_len=max_seq_len, decode_window=decode_window,
+            prefill_chunk=prefill_chunk, prefix_cache=prefix_cache)
+        submit, first = {}, {}
+        pending = list(enumerate(specs))
+        t0 = time.perf_counter()
+        while eng.has_work or pending:
+            for _ in range(2):                # staggered arrivals
+                if not pending:
+                    break
+                i, prompt = pending.pop(0)
+                rid = eng.add_request(prompt, new_tokens)
+                submit[rid] = (i, time.perf_counter())
+            eng.step()
+            now = time.perf_counter()
+            for s in eng._slots:              # TTFT: first token out
+                if s.req is not None and s.out_toks \
+                        and s.req.rid not in first:
+                    first[s.req.rid] = now - submit[s.req.rid][1]
+        wall = time.perf_counter() - t0
+        return eng, wall, first
+
+    if warm:                                  # compile + warm (the CPU
+        drive(False)                          # smoke skips the timing
+    eng_off, wall_off, first_off = drive(False)  # rigor for speed)
+    eng_on, wall_on, first_on = drive(True)
+    st_on, st_off = eng_on.stats, eng_off.stats
+    row = {
+        "batch": slots, "kv_cache": "paged", "requests": n_requests,
+        "shared_len": shared_len, "new_tokens": new_tokens,
+        "hit_rate_cfg": round(1.0 - 1.0 / hit_every, 2),
+        "prefill_tokens_requested": st_on["prefill_tokens_requested"],
+        "prefill_tokens_computed": st_on["prefill_tokens_computed"],
+        "prefill_saved_frac": round(
+            1.0 - st_on["prefill_tokens_computed"]
+            / max(st_on["prefill_tokens_requested"], 1), 3),
+        "cache_hits": st_on["cache_hits"],
+        "cache_hit_tokens": st_on["cache_hit_tokens"],
+        "evictions": st_on["evictions"],
+        "cached_pages": st_on["cached_pages"],
+        "ttft_ms_avg": round(
+            1e3 * float(np.mean(list(first_on.values()))), 2),
+        "ttft_ms_avg_nocache": round(
+            1e3 * float(np.mean(list(first_off.values()))), 2),
+        "tokens_per_sec": round(
+            st_on["tokens_generated"] / wall_on, 1),
+        "tokens_per_sec_nocache": round(
+            st_off["tokens_generated"] / wall_off, 1),
+        "wall_s": round(wall_on, 3),
+        "pages_leaked": st_on["pages_in_use"],   # must be 0
+    }
+    print(f"shared_prefix: {row['prefill_saved_frac']:.0%} prefill "
+          f"saved ({row['prefill_tokens_computed']}/"
+          f"{row['prefill_tokens_requested']} tokens computed), TTFT "
+          f"{row['ttft_ms_avg']} ms vs {row['ttft_ms_avg_nocache']} ms "
+          f"uncached", file=sys.stderr, flush=True)
+    return row
+
+
 # the serving rows' validity depends on the engine's scheduling layer
 # and its policy knobs (core/state.py serving_* flags, resilience
 # guard/retry), not just the kernels — include them in code_version so
@@ -358,6 +461,7 @@ def _measure_overload(cfg, model, slots=8, max_seq_len=512,
 FILES = ["benchmarks/serving_bench.py",
          "paddle_tpu/models/generation.py",
          "paddle_tpu/inference/engine.py",
+         "paddle_tpu/inference/prefix_cache.py",
          "paddle_tpu/resilience/serving.py",
          "paddle_tpu/core/state.py",
          "paddle_tpu/ops/pallas/paged_attention.py",

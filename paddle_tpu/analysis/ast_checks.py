@@ -16,6 +16,7 @@ from __future__ import annotations
 import ast
 import copy
 
+from ..core.state import PREFIX_CACHE_OFF_SPELLINGS
 from .registry import Severity, decorator_name, register
 
 _HOST_SYNC_METHODS = {"numpy", "item", "tolist"}
@@ -515,3 +516,75 @@ def check_unbounded_serving_run(fndef, ctx):
                 "and/or default_deadline_ms (or set the serving_* "
                 "flags) so heavy traffic degrades to rejections/"
                 "timeouts instead of unbounded queues")
+
+
+# constant values that disable the engine's prefix cache — the string
+# spellings are the engine's case-insensitive parse set
+_PREFIX_CACHE_OFF = (False, 0) + PREFIX_CACHE_OFF_SPELLINGS
+
+
+def _prefix_cache_off(node) -> bool:
+    if not isinstance(node, ast.Constant):
+        return False
+    v = node.value
+    if isinstance(v, str):
+        v = v.lower()
+    return v in _PREFIX_CACHE_OFF
+
+
+@register(
+    "PDT110", "prefix-cache-off-under-load", Severity.NOTE, "ast",
+    scope="eager",
+    example="""
+import paddle_tpu as paddle
+from paddle_tpu.inference import ContinuousBatchingEngine
+
+def serve(model, prompts):
+    eng = ContinuousBatchingEngine(model, max_slots=8, max_queue=64,
+                                   queue_policy="reject",
+                                   prefix_cache=False)
+    for p in prompts:
+        eng.add_request(p, 32)
+    return eng.run()
+""",
+    near_miss="""
+import paddle_tpu as paddle
+from paddle_tpu.inference import ContinuousBatchingEngine
+
+def serve(model, prompts):
+    # overload-bounded engine keeps the prefix cache (default on)
+    eng = ContinuousBatchingEngine(model, max_slots=8, max_queue=64,
+                                   queue_policy="reject")
+    for p in prompts:
+        eng.add_request(p, 32)
+    lab = ContinuousBatchingEngine(model, max_slots=8,
+                                   prefix_cache=False)  # lab parity rig
+    return eng.run()
+""")
+def check_prefix_cache_off_under_load(fndef, ctx):
+    """A serving engine constructed with the prefix cache explicitly
+    DISABLED (``prefix_cache=False``/``'off'``) while overload knobs
+    (``max_queue``/``queue_policy``/``default_deadline_ms``) are set:
+    the high-traffic configuration those knobs exist for is exactly the
+    one that most benefits from cross-request prefix caching — shared
+    system prompts stop re-prefilling and preempt-requeue stops
+    recomputing work the engine already did, at zero output difference
+    (cache hits are bitwise-identical).  Disabling it is legitimate for
+    parity rigs and memory-ceiling experiments, hence note-level
+    advice, not an error."""
+    for node in _walk_fn(fndef):
+        if not isinstance(node, ast.Call) \
+                or (_dotted(node.func) or "").split(".")[-1] \
+                != "ContinuousBatchingEngine":
+            continue
+        kws = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        if _prefix_cache_off(kws.get("prefix_cache")) \
+                and any(k in _ENGINE_BOUND_KWARGS for k in kws):
+            yield node, (
+                "engine bounded for overload (max_queue/queue_policy/"
+                "default_deadline_ms) but built with "
+                "prefix_cache=False: high-traffic serving is where the "
+                "KV prefix cache pays most (shared prompts skip "
+                "re-prefill; preempted requests restore instead of "
+                "recomputing) and hits are bitwise-identical — drop "
+                "the override or set serving_prefix_cache")

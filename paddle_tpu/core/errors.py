@@ -133,6 +133,19 @@ class NonFiniteLogitsError(EnforceNotMet, FloatingPointError):
     error_code = "PDT-E018"
 
 
+class CacheIntegrityError(EnforceNotMet):
+    """The serving page allocator's conservation invariants broke: a
+    page double-freed, referenced while on the free list, the reserved
+    null page 0 entering circulation, or
+    ``pages_in_use + pages_free + cached_pages`` no longer summing to
+    the usable pool (``inference/prefix_cache.py``).  Raised by
+    ``PrefixCache.check()`` (the randomized property test calls it
+    after every mutation) and defensively by the acquire/release paths
+    — a raise here means an allocator bug, never a user error."""
+
+    error_code = "PDT-E019"
+
+
 def enforce(cond: bool, msg: str, exc=InvalidArgumentError):
     """PADDLE_ENFORCE: raise ``exc`` with ``msg`` unless ``cond``."""
     if not cond:

@@ -93,6 +93,21 @@ define_flag("serving_dispatch_retries", 3,
             "attempts; 0 disables retry). Transient ConnectionErrors "
             "— incl. the injected engine_dispatch fault site — are "
             "absorbed; anything else propagates.")
+define_flag("serving_prefix_cache", True,
+            "cross-request KV prefix cache for the serving engine "
+            "(inference/prefix_cache.py): admissions map shared "
+            "prompt/few-shot prefixes onto already-written KV pages "
+            "via a radix index (copy-on-write at the divergence page, "
+            "LRU eviction under pool pressure) and preempt-requeue "
+            "re-admission restores from its own published pages "
+            "instead of re-prefilling. Outputs are bitwise-identical "
+            "either way. PDTPU_SERVING_PREFIX_CACHE=off restores "
+            "uncached admission; engine kwarg prefix_cache overrides "
+            "per instance. PDT110 notes high-traffic engines built "
+            "with the cache off.")
+# String spellings that disable the prefix cache, shared by the engine's
+# prefix_cache kwarg parse and the PDT110 lint so they cannot diverge.
+PREFIX_CACHE_OFF_SPELLINGS = ("off", "false", "0", "no")
 define_flag("while_grad_max_trip_count", 256,
             "trip bound for differentiable while_loop under jit capture "
             "(lowered to a masked lax.scan; XLA has no reverse-mode "

@@ -198,6 +198,8 @@ def create_predictor(config: Config) -> Predictor:
 
 from .engine import CompletedRequest  # noqa: E402
 from .engine import ContinuousBatchingEngine  # noqa: E402
+from .prefix_cache import PrefixCache  # noqa: E402
 
 __all__ = ["Config", "Predictor", "create_predictor",
-           "ContinuousBatchingEngine", "CompletedRequest"]
+           "ContinuousBatchingEngine", "CompletedRequest",
+           "PrefixCache"]

@@ -67,8 +67,38 @@ mechanics):
   requests finish unperturbed.
 * FAULT DRILLS — every dispatch runs under bounded
   ``resilience.retry``; the ``engine_dispatch`` / ``engine_nan_decode``
-  / ``engine_page_pressure`` sites (``resilience.serving``) drill the
-  retry, guard and preemption paths deterministically.
+  / ``engine_page_pressure`` / ``engine_cache_evict`` sites
+  (``resilience.serving``) drill the retry, guard, preemption and
+  eviction paths deterministically.
+
+Prefix caching (ISSUE 6; ``inference/prefix_cache.py``):
+
+* CROSS-REQUEST KV REUSE — retirement and preemption PUBLISH a
+  request's fully-written pages into a radix index keyed on
+  page-granular token content instead of freeing them; admission walks
+  the index and maps the matched prefix onto the existing pages
+  (per-page refcounts pin shared pages while any resident uses them),
+  so prefill starts at the first uncached token.  Because the ragged
+  kernel treats block tables and lengths as data, a cache hit is
+  purely a block-table indirection — outputs are bitwise-identical to
+  the uncached engine and to ``generate(kv_cache='paged')``.
+* COPY-ON-WRITE at the divergence page — a fully-cached (page-aligned)
+  prompt still needs its last position's logits, so the last matched
+  page is device-COPIED (one donated dispatch) and the one recomputed
+  token writes to the private copy; every other admission starts
+  prefill at a page boundary past the match, so shared pages are never
+  write targets.
+* LRU EVICTION — ref-0 cached pages are reclaimed least-recently-used
+  (trie leaves first) before the allocator resorts to preemption;
+  an evicted prefix transparently re-prefills.  Preempt-requeue
+  re-admission hits the victim's own just-published pages, fixing the
+  recompute gap: only ``tokens_since_last_full_page`` are re-prefilled
+  instead of ``prompt + tokens_so_far``.
+* The ``serving_prefix_cache`` flag (default on; ``off`` restores the
+  uncached engine bitwise) / ``prefix_cache`` engine kwarg gate it;
+  ``stats`` grows ``cache_hits`` / ``cache_hit_tokens`` /
+  ``cached_pages`` / ``evictions`` and the prefill accounting pair
+  ``prefill_tokens_requested`` / ``prefill_tokens_computed``.
 """
 from __future__ import annotations
 
@@ -85,6 +115,7 @@ from ..core.tensor import Tensor
 from ..resilience import faults
 from ..resilience.serving import (SITE_PAGE_PRESSURE, DecodeGuard,
                                   dispatch_retry)
+from .prefix_cache import PrefixCache
 
 __all__ = ["ContinuousBatchingEngine", "CompletedRequest"]
 
@@ -182,15 +213,17 @@ class ContinuousBatchingEngine:
     ``serving_*`` flags in ``core/state.py``): ``max_queue`` +
     ``queue_policy`` bound admission, ``default_deadline_ms`` applies a
     TTL to every request, ``dispatch_retries`` bounds the per-dispatch
-    retry.  ``clock`` (tests) replaces ``time.monotonic`` for
-    deterministic deadline drills."""
+    retry, ``prefix_cache`` gates the cross-request KV prefix cache
+    (``serving_prefix_cache`` flag; ``False``/``'off'`` restores
+    uncached admission bitwise).  ``clock`` (tests) replaces
+    ``time.monotonic`` for deterministic deadline drills."""
 
     def __init__(self, model, *, max_slots=8, page_size=16,
                  max_seq_len=None, total_pages=None, decode_window=8,
                  prefill_chunk=64, q_block=8, pages_per_block=None,
                  max_queue=None, queue_policy=None,
                  default_deadline_ms=None, dispatch_retries=None,
-                 clock=None):
+                 prefix_cache=None, clock=None):
         from ..core import state as _state
         from ..models.generation import (_decode_fn, _ragged_fn,
                                          _zero_pool)
@@ -241,6 +274,14 @@ class ContinuousBatchingEngine:
         self._caches = [Tensor(a)
                         for a in _zero_pool(shape, 2 * cfg.num_layers)]
         self._free_pages = deque(range(1, self.total_pages))  # 0 = null
+        pc = (_state.get_flag("serving_prefix_cache")
+              if prefix_cache is None else prefix_cache)
+        if isinstance(pc, str):
+            pc = pc.lower() not in _state.PREFIX_CACHE_OFF_SPELLINGS
+        self.prefix_cache_enabled = bool(pc)
+        self._cache = PrefixCache(self.page_size, self._free_pages,
+                                  enabled=self.prefix_cache_enabled,
+                                  total_pages=self.total_pages)
         self._bt = np.zeros((self.max_slots, self.np_per_seq), np.int32)
         self._slots = [_Slot() for _ in range(self.max_slots)]
         self._queue: deque[_Request] = deque()
@@ -249,6 +290,7 @@ class ContinuousBatchingEngine:
         self._admit_counter = 0
         self._step_fn = None
         self._mixed_fn = None
+        self._cow_fn = None
         self._decode_exe = None
         # counters; the ``stats`` property adds the live gauges
         self._stats = {"admitted": 0, "retired": 0, "steps": 0,
@@ -256,15 +298,24 @@ class ContinuousBatchingEngine:
                        "tokens_generated": 0, "pages_allocated": 0,
                        "peak_pages_in_use": 0, "preemptions": 0,
                        "timeouts": 0, "cancelled": 0, "failed": 0,
-                       "rejected": 0, "retries": 0}
+                       "rejected": 0, "retries": 0, "cache_hits": 0,
+                       "cache_hit_tokens": 0,
+                       "prefill_tokens_requested": 0,
+                       "prefill_tokens_computed": 0}
 
     # ------------------------------------------------------------ API --
     @property
     def stats(self):
         """Health snapshot: the lifetime counters plus live gauges
-        (``pages_in_use``/``pages_free``/``queue_depth``)."""
+        (``pages_in_use``/``pages_free``/``cached_pages``/
+        ``queue_depth``).  ``pages_in_use + pages_free + cached_pages``
+        always sums to the usable pool (``total_pages - 1``)."""
         d = dict(self._stats)
-        d["pages_in_use"] = self.total_pages - 1 - len(self._free_pages)
+        d["cached_pages"] = self._cache.cached_pages
+        d["evictions"] = self._cache.evictions
+        d["pages_in_use"] = (self.total_pages - 1
+                             - len(self._free_pages)
+                             - self._cache.cached_pages)
         d["pages_free"] = len(self._free_pages)
         d["queue_depth"] = len(self._queue)
         return d
@@ -382,14 +433,35 @@ class ContinuousBatchingEngine:
 
     # ------------------------------------------------- scheduling -----
     def _release_slot(self, b):
-        """Free slot ``b``: pages back to the free list, block-table
-        row nulled (null page: a frozen slot's writes can never touch
-        a reissued page), slot reset.  The ONLY way pages leave a
-        slot — every retire/finalize/preempt path funnels here."""
+        """Free slot ``b``: pages drop their resident reference (the
+        prefix cache routes them — ref-0 indexed pages stay CACHED for
+        future admissions, the rest return to the free list), the
+        block-table row is nulled (null page: a frozen slot's writes
+        can never touch a reissued page), the slot reset.  The ONLY
+        way pages leave a slot — every retire/finalize/preempt path
+        funnels here."""
         s = self._slots[b]
-        self._free_pages.extend(s.pages)
+        self._cache.release(s.pages)
         self._bt[b, :] = 0
         self._slots[b] = _Slot()
+
+    def _publish_slot(self, b):
+        """Index slot ``b``'s fully-written pages in the prefix cache
+        (partial tail pages stay private) so later admissions — and
+        this request's OWN re-admission after a preemption — map the
+        prefix instead of re-prefilling.  Must run before
+        :meth:`_release_slot` reads the slot's state away."""
+        s = self._slots[b]
+        n = s.len_written
+        if n < self.page_size:
+            return
+        if s.phase == "prefill":
+            ids = s.prefill_ids[:n]
+        else:
+            ids = np.concatenate(
+                [s.req.prompt,
+                 np.asarray(s.out_toks, np.int32)])[:n]
+        self._cache.publish(ids, s.pages, n)
 
     def _finalize_slot(self, b, reason, error=None):
         """Retire slot ``b`` off the normal path (timeout / cancelled /
@@ -399,6 +471,8 @@ class ContinuousBatchingEngine:
         toks = np.asarray(s.out_toks[:s.req.max_new_tokens], np.int32)
         comp = CompletedRequest(s.req.rid, s.req.prompt, toks, reason,
                                 error)
+        if reason != "failed":  # a guard-failed slot's KV is suspect:
+            self._publish_slot(b)  # never index poisoned pages
         self._release_slot(b)
         return comp
 
@@ -416,6 +490,7 @@ class ContinuousBatchingEngine:
             out.append(CompletedRequest(
                 s.req.rid, s.req.prompt, np.asarray(toks, np.int32),
                 reason))
+            self._publish_slot(b)
             self._release_slot(b)
             self._stats["retired"] += 1
         return out
@@ -457,7 +532,8 @@ class ContinuousBatchingEngine:
         return max(1, -(-target // self.page_size))
 
     def _note_peak(self):
-        in_use = self.total_pages - 1 - len(self._free_pages)
+        in_use = (self.total_pages - 1 - len(self._free_pages)
+                  - self._cache.cached_pages)
         self._stats["peak_pages_in_use"] = max(
             self._stats["peak_pages_in_use"], in_use)
 
@@ -466,34 +542,61 @@ class ContinuousBatchingEngine:
             if s.req is not None or not self._queue:
                 continue
             req = self._queue[0]
-            need = self._admit_need(req)
-            if need > len(self._free_pages):
-                break                 # head-of-line: keep arrival order
-            self._queue.popleft()
-            pages = [self._free_pages.popleft() for _ in range(need)]
-            s.req = req
-            s.phase = "prefill"
-            s.pages = pages
-            # a preempted request re-prefills prompt + tokens_so_far:
+            # a preempted request resumes at prompt + tokens_so_far:
             # greedy decode is deterministic and the ragged prefill and
             # decode paths agree bitwise, so the resumed stream is
             # identical to the uncontended one
             if req.done_toks:
-                s.prefill_ids = np.concatenate(
-                    [req.prompt,
-                     np.asarray(req.done_toks, np.int32)])
+                resume_ids = np.concatenate(
+                    [req.prompt, np.asarray(req.done_toks, np.int32)])
             else:
-                s.prefill_ids = req.prompt
-            s.prefill_off = 0
+                resume_ids = req.prompt
+            resume = int(resume_ids.size)
+            stop = req.prompt.size + req.max_new_tokens
+            need_total = self._admit_need(req)
+            # radix walk: the longest already-indexed prefix rides on
+            # its existing pages; prefill starts at the first uncached
+            # token.  A FULL (page-aligned) hit still has to compute
+            # the last position's logits, so the divergence page is
+            # copy-on-write: the shared page's KV is duplicated into a
+            # private page and the one recomputed token writes there —
+            # shared pages are never write targets.
+            matched = self._cache.match(resume_ids)
+            cow_src = None
+            if matched and len(matched) * self.page_size >= resume:
+                cow_src = matched.pop()
+            prefill_off = (resume - 1 if cow_src is not None
+                           else len(matched) * self.page_size)
+            self._cache.retain(matched)   # pin before availability math
+            n_alloc = need_total - len(matched)
+            if n_alloc > self._cache.available():
+                self._cache.release(matched)  # unpin: back to the LRU
+                break                 # head-of-line: keep arrival order
+            self._queue.popleft()
+            alloc = []
+            for _ in range(n_alloc):  # cannot dry up: available() holds
+                alloc.append(self._cache.acquire(key=str(req.rid)))
+            pages = matched + alloc
+            s.req = req
+            s.phase = "prefill"
+            s.pages = pages
+            s.prefill_ids = resume_ids
+            s.prefill_off = prefill_off
             s.out_toks = list(req.done_toks)
-            s.stop_len = req.prompt.size + req.max_new_tokens
+            s.stop_len = stop
             s.eos = req.eos_token_id
             s.admit_seq = self._admit_counter
             self._admit_counter += 1
             self._bt[b, :] = 0
-            self._bt[b, :need] = pages
+            self._bt[b, :len(pages)] = pages
+            if cow_src is not None:
+                self._cow_page(cow_src, alloc[0])
             self._stats["admitted"] += 1
-            self._stats["pages_allocated"] += need
+            self._stats["pages_allocated"] += len(alloc)
+            self._stats["prefill_tokens_requested"] += resume
+            if prefill_off:
+                self._stats["cache_hits"] += 1
+                self._stats["cache_hit_tokens"] += prefill_off
         self._note_peak()
 
     def _pick_victim(self, b):
@@ -509,36 +612,43 @@ class ContinuousBatchingEngine:
         return victim
 
     def _preempt(self, b):
-        """Evict slot ``b``: return its pages and requeue it at the
-        HEAD (it outranks everything queued) for re-prefill recompute."""
+        """Evict slot ``b``: PUBLISH its fully-written pages into the
+        prefix cache (they become ref-0 cached, not freed — LRU-newest,
+        so they survive unless the pool is truly starved) and requeue
+        the request at the HEAD (it outranks everything queued).
+        Re-admission walks the index and restores from its own pages:
+        only the tokens past the last full page re-prefill, closing
+        the recompute gap of plain preempt-and-requeue."""
         s = self._slots[b]
         req = s.req
         req.done_toks = list(s.out_toks)
         req.preemptions += 1
         self._queue.appendleft(req)
+        self._publish_slot(b)
         self._release_slot(b)
         self._stats["preemptions"] += 1
 
     def _ensure_tokens(self, b, n_tokens):
         """Grow slot ``b``'s block table to hold ``n_tokens`` resident
-        tokens, preempting later-admitted victims under pool pressure
-        (or under the injected ``engine_page_pressure`` drill). Returns
-        False when ``b`` itself had to be preempted (it was the
-        latest-admitted and the pool is exhausted)."""
+        tokens.  Under pool pressure the allocator first EVICTS ref-0
+        cached prefix pages (LRU), then preempts later-admitted victims
+        (or under the injected ``engine_page_pressure`` drill, which
+        forces the preempt path directly). Returns False when ``b``
+        itself had to be preempted (it was the latest-admitted and the
+        pool is exhausted)."""
         s = self._slots[b]
         need = -(-n_tokens // self.page_size)
         while len(s.pages) < need:
-            pressure = faults.check(
-                SITE_PAGE_PRESSURE, key=str(s.req.rid)) \
-                or not self._free_pages
-            if pressure:
+            pg = None
+            if not faults.check(SITE_PAGE_PRESSURE, key=str(s.req.rid)):
+                pg = self._cache.acquire(key=str(s.req.rid))
+            if pg is None:
                 victim = self._pick_victim(b)
                 if victim is None:
                     self._preempt(b)
                     return False
                 self._preempt(victim)
                 continue
-            pg = self._free_pages.popleft()
             self._bt[b, len(s.pages)] = pg
             s.pages.append(pg)
             self._stats["pages_allocated"] += 1
@@ -563,9 +673,10 @@ class ContinuousBatchingEngine:
             self._run_decode()
         elif self._queue:
             # backstop only: with every slot free the full pool is
-            # available and eager PageBudgetError already rejected
-            # anything that cannot fit it, so this is unreachable for
-            # admissible request mixes
+            # available (cached prefix pages are all evictable once no
+            # resident pins them) and eager PageBudgetError already
+            # rejected anything that cannot fit it, so this is
+            # unreachable for admissible request mixes
             req = self._queue[0]
             raise RuntimeError(
                 f"request {req.rid} needs {self._admit_need(req)} pages "
@@ -601,6 +712,52 @@ class ContinuousBatchingEngine:
         return (self.max_slots, self.page_size, self.np_per_seq,
                 self.total_pages, self.token_budget, self.q_block,
                 self.pages_per_block)
+
+    # ------------------------------------------- copy-on-write --------
+    def _get_cow_fn(self):
+        if self._cow_fn is None:
+            key = ("cow", len(self._caches)) + self._geometry()
+            cache = self._program_cache()
+            self._cow_fn = cache.get(key)
+            if self._cow_fn is None:
+                n = len(self._caches)
+
+                def cow(src, dst, *pools):
+                    return tuple(p.at[:, dst].set(p[:, src])
+                                 for p in pools)
+
+                self._cow_fn = jax.jit(
+                    cow, donate_argnums=tuple(range(2, 2 + n)))
+                cache[key] = self._cow_fn
+        return self._cow_fn
+
+    def _cow_page(self, src, dst):
+        """Copy-on-write at the divergence page: duplicate shared page
+        ``src``'s KV (every layer pool) into private page ``dst`` in
+        ONE donated-buffer dispatch — src/dst are traced scalars, so
+        every COW event reuses the same compiled program.  The copied
+        bits are exactly what this request's own prefill would have
+        written, so the recompute that follows stays bitwise."""
+        fn = self._get_cow_fn()
+        vals = [c._read() for c in self._caches]
+
+        def _cow_call():
+            # donated inputs: only retry while they are still alive
+            # (same contract as the decode-window dispatch)
+            if any(getattr(v, "is_deleted", lambda: False)()
+                   for v in vals):
+                raise RuntimeError(
+                    "cow dispatch failed after its KV buffers were "
+                    "donated; a mid-execution transient is "
+                    "unrecoverable at this layer — re-create the "
+                    "engine and re-submit the pending requests")
+            return fn(jnp.asarray(src, jnp.int32),
+                      jnp.asarray(dst, jnp.int32), *vals)
+
+        new = self._dispatch("cow", _cow_call)
+        for t, v in zip(self._caches, new):
+            t._data = v
+            t._node = None
 
     # ------------------------------------------------- mixed step -----
     def _get_mixed_fn(self):
@@ -692,6 +849,8 @@ class ContinuousBatchingEngine:
             kv_lens[b] = s.len_written + n
             last_idx[b] = cur + n - 1
             cur += -(-n // qb) * qb   # next segment at a q_block boundary
+            if _take is not None:     # honest prefill-compute meter:
+                self._stats["prefill_tokens_computed"] += _take
         poison = self._guard.poison(
             [self._slots[b].req.rid if b in plan else None
              for b in range(B)])

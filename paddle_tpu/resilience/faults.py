@@ -32,6 +32,11 @@ sleeps or randomness:
   free list as empty for one growth attempt, forcing the
   preempt-and-requeue path without shrinking the pool. Key = the
   request id of the slot being grown.
+* ``engine_cache_evict``  — the serving prefix cache evicts its LRU
+  cached page on one allocation even while free pages remain, forcing
+  the eviction path (an evicted prefix transparently re-prefills with
+  bitwise-identical output). Key = the request id the allocation
+  serves.
 
 Spec grammar (``;``-separated rules)::
 

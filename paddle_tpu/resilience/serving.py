@@ -24,6 +24,11 @@ Serving fault sites (``resilience.faults`` spec grammar):
   the free list were empty for one growth attempt, drilling
   preempt-and-requeue without shrinking the pool. Key = the request
   id of the slot being grown.
+* ``engine_cache_evict`` — forces the prefix cache
+  (``inference/prefix_cache.py``) to evict its LRU cached page on one
+  allocation even while free pages remain, drilling eviction-then-
+  transparent-re-prefill without filling the pool. Key = the request
+  id the allocation serves.
 """
 from __future__ import annotations
 
@@ -35,6 +40,7 @@ from . import faults
 __all__ = [
     "FINISH_REASONS", "DecodeGuard", "dispatch_retry",
     "SITE_DISPATCH", "SITE_NAN_DECODE", "SITE_PAGE_PRESSURE",
+    "SITE_CACHE_EVICT",
 ]
 
 #: Every value ``CompletedRequest.finish_reason`` can take.
@@ -43,6 +49,7 @@ FINISH_REASONS = ("stop", "length", "timeout", "cancelled", "failed")
 SITE_DISPATCH = "engine_dispatch"
 SITE_NAN_DECODE = "engine_nan_decode"
 SITE_PAGE_PRESSURE = "engine_page_pressure"
+SITE_CACHE_EVICT = "engine_cache_evict"
 
 
 class DecodeGuard:

@@ -1,0 +1,261 @@
+"""Prefix-cache allocator invariants (ISSUE 6).
+
+The radix index layers per-page refcounts onto the serving engine's
+free list; these tests pin the conservation law the whole design rests
+on — every page is in exactly ONE of {free, in-use (ref>0), cached
+(ref-0, indexed)}, the null page 0 never circulates, nothing leaks and
+nothing double-frees — plus the index semantics (page-granular
+matching, incumbent-wins publication, leaf-first LRU eviction, the
+``engine_cache_evict`` drill, PDT-E019 on corruption).
+
+The randomized property test replays the engine's exact allocation
+discipline (admit with match/retain/acquire + the COW divergence-page
+rule, decode growth, retire-with-publish, preempt, cancel, forced
+eviction) for >1000 mixed steps with ``PrefixCache.check()`` after
+every mutation — no model dispatches, so it runs in milliseconds.
+"""
+from collections import deque
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import errors
+from paddle_tpu.inference.prefix_cache import PrefixCache
+from paddle_tpu.resilience import faults
+
+PS = 4          # page_size
+TOTAL = 33      # total_pages (32 usable; page 0 reserved null)
+
+
+def _mk(enabled=True, total=TOTAL):
+    free = deque(range(1, total))
+    return PrefixCache(PS, free, enabled=enabled), free
+
+
+def _ids(rng, n):
+    return rng.integers(0, 50, (n,)).astype(np.int32)
+
+
+# ======================================================================
+# unit semantics
+# ======================================================================
+
+def test_match_publish_roundtrip():
+    cache, free = _mk()
+    rng = np.random.default_rng(0)
+    ids = _ids(rng, 11)                       # 2 full pages + tail
+    pages = [cache.acquire() for _ in range(3)]
+    assert cache.publish(ids, pages, 11) == 2  # only FULL pages indexed
+    cache.release(pages)
+    assert cache.cached_pages == 2 and len(free) == TOTAL - 1 - 2
+    # longest-prefix walk: full match, then divergence at page 2
+    assert cache.match(ids) == pages[:2]
+    other = ids.copy()
+    other[PS] += 1                            # diverge inside page 2
+    assert cache.match(other) == pages[:1]
+    assert cache.match(_ids(rng, 20)) == []   # cold prefix
+    cache.check()
+
+
+def test_publish_incumbent_wins():
+    """Two residents prefilling the same prefix concurrently: the first
+    publication owns the index, the twin's duplicate pages stay private
+    and return to the free list on release."""
+    cache, free = _mk()
+    ids = np.arange(PS, dtype=np.int32)
+    a = [cache.acquire()]
+    b = [cache.acquire()]
+    assert cache.publish(ids, a, PS) == 1
+    assert cache.publish(ids, b, PS) == 0     # incumbent keeps the node
+    cache.release(a)
+    cache.release(b)
+    assert cache.cached_pages == 1
+    assert cache.match(ids) == a and b[0] in free
+    cache.check()
+
+
+def test_retain_pins_against_eviction():
+    """A matched-and-retained path is ref>0: not evictable even under
+    the forced-eviction drill; releasing it re-enters the LRU pool."""
+    cache, free = _mk()
+    ids = np.arange(2 * PS, dtype=np.int32)
+    pages = [cache.acquire(), cache.acquire()]
+    cache.publish(ids, pages, 2 * PS)
+    cache.release(pages)
+    assert cache.cached_pages == 2
+    got = cache.match(ids)
+    cache.retain(got)
+    assert cache.cached_pages == 0            # pinned, off the LRU
+    faults.clear()
+    try:
+        faults.inject("engine_cache_evict", times=0)
+        pg = cache.acquire()                  # drill: nothing evictable
+        assert cache.evictions == 0 and pg is not None
+        cache.release([pg])
+        cache.release(got)
+        assert cache.cached_pages == 2        # back to evictable
+        pg = cache.acquire()                  # drill: now it evicts
+        assert cache.evictions == 1
+        cache.release([pg])
+    finally:
+        faults.clear()
+    cache.check()
+
+
+def test_eviction_leaf_first_lru():
+    """Eviction takes trie LEAVES oldest-first: an interior page waits
+    until its subtree drains (children would become unreachable), so a
+    chain evicts tip-to-root."""
+    cache, _free = _mk(total=1 + 3)           # 3 usable pages
+    ids = np.arange(3 * PS, dtype=np.int32)
+    pages = [cache.acquire() for _ in range(3)]
+    cache.publish(ids, pages, 3 * PS)
+    cache.release(pages)                      # chain p0 -> p1 -> p2
+    assert cache.available() == 3 and not _free
+    got = cache.acquire()                     # must evict to serve
+    assert got == pages[2]                    # leaf first, not the root
+    assert cache.match(ids) == pages[:2]      # prefix remnant survives
+    got2 = cache.acquire()
+    assert got2 == pages[1]
+    cache.release([got, got2])
+    cache.check()
+
+
+def test_double_release_raises_coded():
+    cache, _ = _mk()
+    pg = cache.acquire()
+    cache.release([pg])
+    with pytest.raises(errors.CacheIntegrityError, match="PDT-E019"):
+        cache.release([pg])
+    assert errors.CacheIntegrityError.error_code == "PDT-E019"
+
+
+def test_check_catches_corruption():
+    cache, free = _mk()
+    free.appendleft(0)                        # null page in circulation
+    with pytest.raises(errors.CacheIntegrityError, match="page 0"):
+        cache.check()
+    free.popleft()
+    cache.check()
+    pg = cache.acquire()
+    free.append(pg)                           # free while referenced
+    with pytest.raises(errors.CacheIntegrityError):
+        cache.check()
+
+
+def test_disabled_mode_is_plain_free_list():
+    """enabled=False (serving_prefix_cache off): never indexes, never
+    matches, never evicts — every release goes straight back to the
+    free list, which is exactly the uncached engine's allocator."""
+    cache, free = _mk(enabled=False)
+    ids = np.arange(2 * PS, dtype=np.int32)
+    pages = [cache.acquire(), cache.acquire()]
+    assert cache.publish(ids, pages, 2 * PS) == 0
+    cache.release(pages)
+    assert cache.cached_pages == 0 and len(free) == TOTAL - 1
+    assert cache.match(ids) == []
+    assert cache.evictions == 0
+    cache.check()
+
+
+# ======================================================================
+# randomized property test: the engine's allocation discipline
+# ======================================================================
+
+def test_prefix_cache_randomized_invariants():
+    """>1000 mixed admit/grow/retire/preempt/cancel/evict steps with
+    page conservation audited after EVERY mutation: no leaked pages, no
+    double-free, null page never referenced, and
+    ``in_use + free + cached == total - 1`` throughout and after the
+    final drain."""
+    rng = np.random.default_rng(1234)
+    cache, free = _mk()
+    # shared prefix templates so matching/sharing actually happens
+    prefixes = [_ids(rng, PS * k) for k in (1, 2, 3, 4)]
+    slots = []      # resident: {"ids", "pages", "written"}
+
+    def conserve():
+        cache.check()
+        held = {p for s in slots for p in s["pages"]}
+        assert 0 not in held
+        assert (len(held) + len(free) + cache.cached_pages
+                == TOTAL - 1)
+
+    def publish_release(s):
+        cache.publish(s["ids"], s["pages"], s["written"])
+        cache.release(s["pages"])
+
+    steps = 0
+    for _ in range(1200):
+        op = int(rng.integers(0, 100))
+        if op < 35 and len(slots) < 4:
+            # ADMIT: longest-prefix match, retain, COW rule, acquire
+            pre = prefixes[int(rng.integers(0, len(prefixes)))]
+            tail = _ids(rng, int(rng.integers(0, 9)))
+            ids = np.concatenate([pre, tail])
+            matched = cache.match(ids)
+            if matched and len(matched) * PS >= ids.size:
+                matched.pop()                 # COW: copy, don't share
+            cache.retain(matched)
+            n_alloc = max(1, -(-(ids.size + 1) // PS)) - len(matched)
+            if n_alloc > cache.available():
+                cache.release(matched)        # head-of-line unwind
+            else:
+                got = [cache.acquire(key="prop") for _ in range(n_alloc)]
+                assert None not in got        # available() promised
+                slots.append({"ids": ids, "pages": matched + got,
+                              "written": int(ids.size)})
+        elif op < 60 and slots:
+            # GROW: one decode token; page on demand; dry -> preempt
+            s = slots[int(rng.integers(0, len(slots)))]
+            s["ids"] = np.append(s["ids"],
+                                 np.int32(rng.integers(0, 50)))
+            s["written"] += 1
+            if -(-s["written"] // PS) > len(s["pages"]):
+                pg = cache.acquire(key="prop")
+                if pg is None:                # pool dry: preempt self
+                    publish_release(s)
+                    slots.remove(s)
+                else:
+                    s["pages"].append(pg)
+        elif op < 80 and slots:
+            # RETIRE: publish full pages, drop the residency
+            publish_release(slots.pop(int(rng.integers(0, len(slots)))))
+        elif op < 90 and slots:
+            # CANCEL/FAIL: release without publishing
+            cache.release(
+                slots.pop(int(rng.integers(0, len(slots))))["pages"])
+        else:
+            # EVICT drill: forced reclaim while free pages remain
+            faults.clear()
+            faults.inject("engine_cache_evict", match="prop")
+            pg = cache.acquire(key="prop")
+            faults.clear()
+            if pg is not None:
+                cache.release([pg])
+        steps += 1
+        conserve()
+    assert steps >= 1000
+    for s in slots:                           # final drain
+        publish_release(s)
+    slots.clear()
+    conserve()
+    assert len(free) + cache.cached_pages == TOTAL - 1
+    assert cache.evictions > 0                # the drill really drilled
+
+
+def test_prefix_cache_pool_never_deadlocks_when_cached():
+    """Everything cached, nothing free: acquire still serves by
+    evicting — a fully-cached pool is never mistaken for an exhausted
+    one (the engine's step() backstop stays unreachable)."""
+    cache, free = _mk(total=1 + 4)
+    ids = np.arange(4 * PS, dtype=np.int32)
+    pages = [cache.acquire() for _ in range(4)]
+    cache.publish(ids, pages, 4 * PS)
+    cache.release(pages)
+    assert not free and cache.available() == 4
+    got = [cache.acquire() for _ in range(4)]
+    assert None not in got and cache.evictions == 4
+    assert cache.acquire() is None            # now genuinely dry
+    cache.release(got)
+    cache.check()

@@ -30,6 +30,26 @@ def _refs(model, prompts, new):
             for p, n in zip(prompts, new)]
 
 
+def _assert_pool_conserved(eng, drained=True):
+    """Allocator conservation: free, cached and in-use pages are
+    disjoint, never include the null page, and sum to the usable pool.
+    A DRAINED engine additionally has zero pages in use (retired pages
+    may legitimately stay CACHED in the prefix index — the free list
+    alone is no longer the whole story)."""
+    st = eng.stats
+    free = set(eng._free_pages)
+    cached = set(eng._cache.cached_page_ids())
+    assert len(eng._free_pages) == len(free)          # no duplicates
+    assert not (free & cached)
+    assert 0 not in free and 0 not in cached
+    assert (st["pages_in_use"] + st["pages_free"]
+            + st["cached_pages"]) == eng.total_pages - 1
+    eng._cache.check()                                # PDT-E019 audit
+    if drained:
+        assert st["pages_in_use"] == 0
+        assert free | cached == set(range(1, eng.total_pages))
+
+
 def test_engine_matches_generate_with_slot_contention(gpt):
     """4 ragged requests through 2 slots: later requests are admitted
     MID-STREAM as earlier ones retire; mixed steps run admissions'
@@ -71,11 +91,12 @@ def test_engine_page_reuse_and_free_list_restore(gpt):
         np.testing.assert_array_equal(done[rid].sequence, ref)
     st = eng.stats
     assert st["pages_allocated"] > st["peak_pages_in_use"]  # reuse
-    assert len(eng._free_pages) == eng.total_pages - 1      # all freed
+    _assert_pool_conserved(eng)          # nothing leaked, nothing dup'd
     assert st["peak_pages_in_use"] <= 2  # one slot's worst case
-    # health gauges: a drained engine reads empty
+    # health gauges: a drained engine holds no pages in use (retired
+    # full pages may stay CACHED in the prefix index by design)
     assert st["pages_in_use"] == 0
-    assert st["pages_free"] == eng.total_pages - 1
+    assert st["pages_free"] + st["cached_pages"] == eng.total_pages - 1
     assert st["queue_depth"] == 0
     # ... and a loaded engine reads loaded: queue 3 deep behind slot 0
     eng.add_request(prompts[0], 4)
@@ -84,9 +105,12 @@ def test_engine_page_reuse_and_free_list_restore(gpt):
     eng.step()
     st = eng.stats
     assert st["queue_depth"] == 3 and st["pages_in_use"] > 0
-    assert st["pages_free"] == eng.total_pages - 1 - st["pages_in_use"]
+    assert st["pages_free"] == (eng.total_pages - 1
+                                - st["pages_in_use"]
+                                - st["cached_pages"])
     eng.run()
     assert eng.stats["pages_in_use"] == 0
+    _assert_pool_conserved(eng)
 
 
 def test_engine_eos_early_retire(gpt):
@@ -106,7 +130,7 @@ def test_engine_eos_early_retire(gpt):
     got = done[rid].sequence
     assert got[-1] == eos and got.size < prompt.size + 8  # stopped early
     np.testing.assert_array_equal(got, ref[:got.size])
-    assert len(eng._free_pages) == eng.total_pages - 1
+    _assert_pool_conserved(eng)
 
 
 def test_engine_llama_gqa():
@@ -177,9 +201,7 @@ def test_engine_preempt_requeue_bitwise(gpt):
     st = eng.stats
     assert st["preemptions"] > 0          # contention actually happened
     assert st["pages_in_use"] == 0        # zero leaked
-    assert len(eng._free_pages) == eng.total_pages - 1
-    assert sorted(set(eng._free_pages)) == list(
-        range(1, eng.total_pages))        # free-list cardinality intact
+    _assert_pool_conserved(eng)           # free+cached = the whole pool
 
 
 def test_engine_serving_fault_drill(gpt):
@@ -229,8 +251,7 @@ def test_engine_serving_fault_drill(gpt):
         assert st["failed"] == 1 and st["cancelled"] == 1
         assert st["timeouts"] == 1
         assert st["pages_in_use"] == 0 and st["queue_depth"] == 0
-        assert sorted(set(eng._free_pages)) == list(
-            range(1, eng.total_pages))
+        _assert_pool_conserved(eng)
     finally:
         faults.clear()
 
@@ -386,3 +407,190 @@ def test_engine_cancel_after_final_token_honored(gpt):
     assert done[rid].finish_reason == "cancelled"
     assert eng.stats["cancelled"] == 1 and eng.stats["retired"] == 0
     assert eng.stats["pages_in_use"] == 0
+
+
+# ----------------------------------------------------------------------
+# Cross-request KV prefix cache (ISSUE 6): a radix index over the page
+# pool maps shared prefixes onto already-written pages (block-table
+# indirection only), with copy-on-write at the divergence page and LRU
+# eviction — bitwise-identical to generate(kv_cache='paged') and to the
+# cache-off engine in every mix, including preempt-requeue restore and
+# post-eviction re-admission.
+# ----------------------------------------------------------------------
+
+def _engine(gpt, **kw):
+    args = dict(max_slots=2, page_size=4, max_seq_len=32,
+                decode_window=4, prefill_chunk=8, q_block=2)
+    args.update(kw)
+    return ContinuousBatchingEngine(gpt, **args)
+
+
+def test_engine_prefix_cache_shared_prefix_bitwise(gpt):
+    """Requests sharing a long prompt prefix: later admissions map the
+    shared pages from the index (prefill tokens computed drops below
+    tokens requested) and every output is bitwise-identical to the
+    uncached reference AND to a cache-off engine."""
+    rng = np.random.default_rng(29)
+    shared = rng.integers(0, 96, (12,)).astype(np.int32)  # 3 full pages
+    tails = [rng.integers(0, 96, (n,)).astype(np.int32)
+             for n in (3, 2, 5, 1)]
+    prompts = [np.concatenate([shared, t]) for t in tails]
+    new = [6, 5, 4, 6]
+    refs = _paged_refs(gpt, prompts, new)
+
+    outs = {}
+    for mode in (True, False):
+        eng = _engine(gpt, prefix_cache=mode)
+        rids = [eng.add_request(p, n) for p, n in zip(prompts, new)]
+        done = eng.run()
+        outs[mode] = [done[r].sequence for r in rids]
+        st = eng.stats
+        if mode:
+            # the first two admissions run concurrently (2 slots) and
+            # prefill the shared prefix independently; both later
+            # admissions hit the published pages
+            assert st["cache_hits"] >= 2
+            assert st["cache_hit_tokens"] >= 2 * 12
+            assert (st["prefill_tokens_computed"]
+                    < st["prefill_tokens_requested"])
+            _assert_pool_conserved(eng)
+        else:
+            # cache off restores the uncached meter exactly
+            assert st["cache_hits"] == 0 and st["cached_pages"] == 0
+            assert (st["prefill_tokens_computed"]
+                    == st["prefill_tokens_requested"])
+            assert len(eng._free_pages) == eng.total_pages - 1
+    for got_on, got_off, ref in zip(outs[True], outs[False], refs):
+        np.testing.assert_array_equal(got_on, ref)
+        np.testing.assert_array_equal(got_off, ref)
+
+
+def test_engine_prefix_cache_cow_full_prompt(gpt):
+    """A fully-cached page-aligned prompt takes the copy-on-write
+    path: the divergence page is duplicated, exactly ONE token is
+    recomputed for the last position's logits, the shared page is
+    never written, and the output stays bitwise."""
+    rng = np.random.default_rng(31)
+    prompt = rng.integers(0, 96, (8,)).astype(np.int32)  # 2 full pages
+    (ref,) = _paged_refs(gpt, [prompt], [6])
+    eng = _engine(gpt)
+    r1 = eng.add_request(prompt, 6)
+    done = eng.run()
+    np.testing.assert_array_equal(done[r1].sequence, ref)
+    # retirement published the full prompt pages
+    assert eng.stats["cached_pages"] >= 2
+    base = eng.stats["prefill_tokens_computed"]
+    r2 = eng.add_request(prompt, 6)           # identical prompt: full hit
+    done = eng.run()
+    np.testing.assert_array_equal(done[r2].sequence, ref)
+    st = eng.stats
+    assert st["cache_hit_tokens"] >= prompt.size - 1   # COW: all but one
+    assert st["prefill_tokens_computed"] - base == 1   # 1 recomputed tok
+    _assert_pool_conserved(eng)
+
+
+def test_engine_preempt_requeue_recompute_drop(gpt):
+    """The PR5 recompute gap, closed: a preempted victim's pages are
+    PUBLISHED to the index (not freed), so its re-admission restores
+    from its own just-published pages — prefill-tokens-computed drops
+    versus the cache-off engine on the identical forced-preemption
+    workload, outputs bitwise both ways.  (In a truly starved pool the
+    LRU may reclaim some of the victim's pages for the grower — that
+    path is covered by test_engine_preempt_requeue_bitwise; here the
+    pool is roomy and the ``engine_page_pressure`` drill forces the
+    preemption, so the published pages survive to the re-admission.)"""
+    from paddle_tpu.resilience import faults
+
+    rng = np.random.default_rng(41)
+    p1 = rng.integers(0, 96, (6,)).astype(np.int32)
+    p2 = rng.integers(0, 96, (7,)).astype(np.int32)
+    refs = _paged_refs(gpt, [p1, p2], [8, 8])
+    computed = {}
+    faults.clear()
+    try:
+        for mode in (False, True):
+            eng = _engine(gpt, prefix_cache=mode)
+            r1 = eng.add_request(p1, 8)
+            r2 = eng.add_request(p2, 8)
+            # r1's growth hits injected pressure -> r2 (latest) preempts
+            faults.inject("engine_page_pressure", match=str(r1))
+            done = eng.run()
+            np.testing.assert_array_equal(done[r1].sequence, refs[0])
+            np.testing.assert_array_equal(done[r2].sequence, refs[1])
+            st = eng.stats
+            assert st["preemptions"] >= 1
+            computed[mode] = st["prefill_tokens_computed"]
+            if mode:
+                # prompts are DISTINCT, so every hit is the victim's
+                # re-admission restoring from its own published pages
+                assert st["cache_hits"] >= 1
+                assert st["evictions"] == 0    # roomy pool: none lost
+                _assert_pool_conserved(eng)
+            else:
+                assert st["cache_hits"] == 0
+    finally:
+        faults.clear()
+    assert computed[True] < computed[False]
+
+
+def test_engine_cache_evict_drill_bitwise(gpt):
+    """The deterministic engine_cache_evict drill: cached prefix pages
+    are evicted under the injected pressure, and a re-admission of the
+    evicted prefix transparently re-prefills with bitwise-identical
+    output (the cache can only ever cost recompute, never
+    correctness)."""
+    from paddle_tpu.resilience import faults
+
+    rng = np.random.default_rng(37)
+    p1 = rng.integers(0, 96, (9,)).astype(np.int32)
+    p2 = rng.integers(0, 96, (6,)).astype(np.int32)
+    ref1, ref2 = _paged_refs(gpt, [p1, p2], [6, 5])
+    faults.clear()
+    try:
+        eng = _engine(gpt)
+        r1 = eng.add_request(p1, 6)
+        assert eng.run()[r1].finish_reason == "length"
+        assert eng.stats["cached_pages"] >= 2   # p1's prefix published
+        # every allocation for p2 forcibly evicts the LRU cached page
+        faults.inject("engine_cache_evict", times=0)
+        r2 = eng.add_request(p2, 5)
+        done = eng.run()
+        np.testing.assert_array_equal(done[r2].sequence, ref2)
+        faults.clear()
+        st = eng.stats
+        assert st["evictions"] >= 2             # drill actually evicted
+        hits_before = st["cache_hits"]
+        # p1 again: its prefix was evicted -> full re-prefill, bitwise
+        r3 = eng.add_request(p1, 6)
+        done = eng.run()
+        np.testing.assert_array_equal(done[r3].sequence, ref1)
+        assert eng.stats["cache_hits"] == hits_before  # true miss
+        _assert_pool_conserved(eng)
+    finally:
+        faults.clear()
+
+
+def test_serving_bench_shared_prefix_accounting(gpt):
+    """CPU tiny-model smoke for the serving_bench ``shared_prefix``
+    row: the accounting must show prefill tokens computed < tokens
+    requested at a high prefix-hit rate, zero leaked pages, and a
+    sane saved fraction (absolute times are TPU-only claims)."""
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "benchmarks", "serving_bench.py")
+    spec = importlib.util.spec_from_file_location(
+        "serving_bench_smoke", path)
+    sb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sb)
+    row = sb._measure_shared_prefix(
+        gpt.cfg, gpt, slots=2, max_seq_len=64, shared_len=12,
+        tail_range=(2, 7), new_tokens=4, n_requests=6, hit_every=3,
+        page_size=4, decode_window=4, prefill_chunk=8, warm=False)
+    assert (row["prefill_tokens_computed"]
+            < row["prefill_tokens_requested"])
+    assert row["prefill_saved_frac"] > 0
+    assert row["cache_hits"] >= 2 and row["cache_hit_tokens"] >= 2 * 12
+    assert row["pages_leaked"] == 0
+    assert row["ttft_ms_avg"] > 0 and row["ttft_ms_avg_nocache"] > 0
