@@ -41,6 +41,21 @@ normally-finished requests only), ``preemptions``, ``timeouts``,
 workload, a serving engine degrades and the row quantifies the
 degradation.
 
+plus two QUANT rows (ISSUE 7) whose roofline is recomputed from the
+QUANTIZED bytes — the whole point of the int8 paths is to lower the
+bandwidth floor itself, so the target column must move with them:
+
+* ``quant_b8`` — the fixed-batch engine workload twice over identical
+  traffic, ``kv_quant`` off then on (int8 KV pages + in-kernel
+  dequant): per-token latency both ways, ``roofline_ms`` from int8+
+  scale KV bytes, ``kv_page_bytes`` on/off (the halved-bytes claim),
+  ``pages_per_request``, and the ``roofline_x`` delta vs the fp twin.
+* ``weight_only_b1`` — ``generate(kv_cache='paged')`` on a
+  ``weight_only_quantize``d model (int8 weights through the Pallas
+  fused dequant-matmul) vs the same fp model: ms/token both ways,
+  ``roofline_ms`` from int8 weight bytes + per-channel scales, and the
+  weight-byte ratio.
+
 plus a ``shared_prefix`` row (ISSUE 6): a system-prompt-heavy workload
 (~90% of arrivals share a long prefix) through the engine with the
 cross-request KV prefix cache (``inference/prefix_cache.py``) on vs.
@@ -114,17 +129,42 @@ def _param_bytes(model) -> int:
     return total
 
 
-def _kv_bytes_per_seq(cfg, avg_len, itemsize=4) -> int:
+def _kv_bytes_per_seq(cfg, avg_len, itemsize=4, scale_bytes=0) -> int:
+    """KV bytes one sequence's cache reads per step; ``itemsize`` 1 +
+    ``scale_bytes`` 4 is the int8 page-pool layout (one f32 absmax
+    scale per head per token slot riding the side-pools)."""
     n_kv = getattr(cfg, "num_kv_heads", cfg.num_heads)
-    return 2 * cfg.num_layers * n_kv * cfg.head_dim * avg_len * itemsize
+    return 2 * cfg.num_layers * n_kv * avg_len \
+        * (cfg.head_dim * itemsize + scale_bytes)
 
 
-def roofline_ms(cfg, model, batch, prompt_len, new_tokens, gbps) -> float:
+def _quant_param_bytes(model) -> int:
+    """Weight bytes of a ``weight_only_quantize``d model: Linear
+    weights at 1 byte + a 4-byte per-out-channel scale; everything
+    else (embeddings, norms, biases) at float width."""
+    from paddle_tpu.nn.layers import Linear
+    total = _param_bytes(model)
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, Linear):
+            n_in, n_out = (int(s) for s in layer.weight.shape)
+            total -= n_in * n_out * 4           # fp32 weight out...
+            total += n_in * n_out + n_out * 4   # ...int8 + scales in
+    return total
+
+
+def roofline_ms(cfg, model, batch, prompt_len, new_tokens, gbps,
+                kv_itemsize=4, kv_scale_bytes=0,
+                param_bytes=None) -> float:
     """HBM floor for ONE decode step serving ``batch`` sequences: every
-    weight byte read once, plus each sequence's (average-length) KV."""
+    weight byte read once, plus each sequence's (average-length) KV.
+    The quant rows move the floor itself: ``kv_itemsize=1,
+    kv_scale_bytes=4`` prices int8 KV pages, ``param_bytes`` overrides
+    the weight term for int8 weights."""
     avg_len = prompt_len + new_tokens // 2
-    bytes_step = _param_bytes(model) \
-        + batch * _kv_bytes_per_seq(cfg, avg_len)
+    bytes_step = (param_bytes if param_bytes is not None
+                  else _param_bytes(model)) \
+        + batch * _kv_bytes_per_seq(cfg, avg_len, kv_itemsize,
+                                    kv_scale_bytes)
     return bytes_step / (gbps * 1e9) * 1e3
 
 
@@ -213,6 +253,8 @@ def measure():
         cfg, model, gbps, launch)
     rows["overload"] = _measure_overload(cfg, model)
     rows["shared_prefix"] = _measure_shared_prefix(cfg, model)
+    rows["quant_b8"] = _measure_quant(cfg, model, gbps)
+    rows["weight_only_b1"] = _measure_weight_only(cfg, model, gbps)
     return rows
 
 
@@ -454,6 +496,148 @@ def _measure_shared_prefix(cfg, model, slots=8, max_seq_len=512,
     return row
 
 
+def _measure_quant(cfg, model, gbps, slots=8, prompt_len=128,
+                   new_tokens=64, page_size=16, decode_window=16,
+                   prefill_chunk=128, max_seq_len=512, q_block=8,
+                   seed=4, warm=True):
+    """ISSUE 7 ``quant_b8``: the fixed-batch engine workload driven
+    twice over IDENTICAL traffic — ``kv_quant`` off (the fp twin) then
+    on (int8 KV pages, in-kernel dequant).  The roofline for the quant
+    half is recomputed from the quantized bytes (int8 data + f32
+    per-slot scales), because lowering that floor is the optimization's
+    claim; ``kv_page_bytes`` on/off carries the halved-bytes
+    acceptance number and ``outputs_equal`` pins token-identical greedy
+    streams.  Works on the CPU tiny models for the accounting smoke;
+    absolute times are TPU claims."""
+    from paddle_tpu.inference import ContinuousBatchingEngine
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            prompt_len).astype(np.int32)
+               for _ in range(slots)]
+
+    def drive(kv_quant):
+        eng = ContinuousBatchingEngine(
+            model, max_slots=slots, page_size=page_size,
+            max_seq_len=max_seq_len, decode_window=decode_window,
+            prefill_chunk=prefill_chunk, q_block=q_block,
+            kv_quant=kv_quant)
+        rids = [eng.add_request(p, new_tokens) for p in prompts]
+        t0 = time.perf_counter()
+        done = eng.run()
+        wall = time.perf_counter() - t0
+        return eng, [done[r].sequence for r in rids], wall
+
+    if warm:
+        drive(False)
+        drive(True)
+    eng_fp, out_fp, wall_fp = drive(False)
+    eng_q, out_q, wall_q = drive(True)
+    toks = eng_q.stats["tokens_generated"]
+    toks_fp = eng_fp.stats["tokens_generated"]
+    ms_fp = wall_fp * 1e3 / max(toks_fp / slots, 1)
+    ms_q = wall_q * 1e3 / max(toks / slots, 1)
+    rl_fp = roofline_ms(cfg, model, slots, prompt_len, new_tokens, gbps)
+    rl_q = roofline_ms(cfg, model, slots, prompt_len, new_tokens, gbps,
+                       kv_itemsize=1, kv_scale_bytes=4)
+    row = {
+        "batch": slots, "prompt_len": prompt_len,
+        "new_tokens": new_tokens, "kv_cache": "paged",
+        "decode_window": decode_window, "kv_quant": True,
+        "ms_per_token": round(ms_q, 2),
+        "tokens_per_sec": round(toks / wall_q, 1),
+        "wall_s": round(wall_q, 3),
+        "ms_per_token_fp": round(ms_fp, 2),
+        # 6-decimal rooflines: the quant row's claim is rl_q < rl_fp,
+        # which 3 decimals would erase for the CPU tiny-model smoke
+        "roofline_ms": round(rl_q, 6),
+        "roofline_ms_fp": round(rl_fp, 6),
+        "roofline_x": round(ms_q / rl_q, 1),
+        "roofline_x_fp": round(ms_fp / rl_fp, 1),
+        "kv_page_bytes": eng_q.stats["kv_page_bytes"],
+        "kv_page_bytes_fp": eng_fp.stats["kv_page_bytes"],
+        "kv_bytes_ratio": round(eng_q.stats["kv_page_bytes"]
+                                / eng_fp.stats["kv_page_bytes"], 3),
+        "pages_per_request": round(
+            eng_q.stats["pages_allocated"] / slots, 1),
+        "outputs_equal": all(
+            np.array_equal(a, b) for a, b in zip(out_q, out_fp)),
+        "pages_leaked": eng_q.stats["pages_in_use"],   # must be 0
+    }
+    print(f"quant_b8: {row['ms_per_token']} ms/token vs "
+          f"{row['ms_per_token_fp']} fp (roofline x{row['roofline_x']}"
+          f" vs x{row['roofline_x_fp']}, kv bytes x"
+          f"{row['kv_bytes_ratio']}, outputs_equal="
+          f"{row['outputs_equal']})", file=sys.stderr, flush=True)
+    return row
+
+
+def _measure_weight_only(cfg, model, gbps, prompt_len=128,
+                         new_tokens=64, seed=5, qmodel=None,
+                         warm=True):
+    """ISSUE 7 ``weight_only_b1``: single-request paged decode on a
+    ``weight_only_quantize``d twin of the bench model — every Linear
+    routed through the Pallas fused dequant-matmul — vs the fp model on
+    the same prompt.  The roofline weight term is recomputed from int8
+    weight + per-channel scale bytes (the weight-byte floor is what
+    weight-only quantization buys at batch 1)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.quantization import weight_only_quantize
+
+    if qmodel is None:
+        # deterministic twin: same seed + config rebuilds the weights
+        paddle.seed(0)
+        qmodel = weight_only_quantize(type(model)(cfg))
+        qmodel.eval()
+    rng = np.random.default_rng(seed)
+    ids = paddle.to_tensor(rng.integers(
+        0, cfg.vocab_size, (1, prompt_len)).astype(np.int32))
+
+    def drive(m):
+        kw = dict(max_new_tokens=new_tokens, temperature=1.0,
+                  kv_cache="paged", decode_window=16)
+        out = generate(m, ids, **kw)
+        np.asarray(out._read())
+        best = float("inf")
+        reps = 3 if warm else 1
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = generate(m, ids, **kw)
+            np.asarray(out._read())
+            best = min(best, time.perf_counter() - t0)
+        return np.asarray(out._read()), best
+
+    out_fp, wall_fp = drive(model)
+    out_q, wall_q = drive(qmodel)
+    pb_fp = _param_bytes(model)
+    pb_q = _quant_param_bytes(model)
+    rl_fp = roofline_ms(cfg, model, 1, prompt_len, new_tokens, gbps)
+    rl_q = roofline_ms(cfg, model, 1, prompt_len, new_tokens, gbps,
+                       param_bytes=pb_q)
+    row = {
+        "batch": 1, "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "kv_cache": "paged", "decode_window": 16, "weight_only": "int8",
+        "ms_per_token": round(wall_q * 1e3 / new_tokens, 2),
+        "ms_per_token_fp": round(wall_fp * 1e3 / new_tokens, 2),
+        "tokens_per_sec": round(new_tokens / wall_q, 1),
+        "wall_s": round(wall_q, 3),
+        "roofline_ms": round(rl_q, 6),
+        "roofline_ms_fp": round(rl_fp, 6),
+        "roofline_x": round(wall_q * 1e3 / new_tokens / rl_q, 1),
+        "roofline_x_fp": round(wall_fp * 1e3 / new_tokens / rl_fp, 1),
+        "weight_bytes": pb_q,
+        "weight_bytes_fp": pb_fp,
+        "weight_bytes_ratio": round(pb_q / pb_fp, 3),
+        "outputs_equal": bool(np.array_equal(out_q, out_fp)),
+    }
+    print(f"weight_only_b1: {row['ms_per_token']} ms/token vs "
+          f"{row['ms_per_token_fp']} fp (weight bytes x"
+          f"{row['weight_bytes_ratio']}, roofline x{row['roofline_x']}"
+          f" vs x{row['roofline_x_fp']})", file=sys.stderr, flush=True)
+    return row
+
+
 # the serving rows' validity depends on the engine's scheduling layer
 # and its policy knobs (core/state.py serving_* flags, resilience
 # guard/retry), not just the kernels — include them in code_version so
@@ -465,7 +649,9 @@ FILES = ["benchmarks/serving_bench.py",
          "paddle_tpu/resilience/serving.py",
          "paddle_tpu/core/state.py",
          "paddle_tpu/ops/pallas/paged_attention.py",
-         "paddle_tpu/ops/pallas/flash_attention.py"]
+         "paddle_tpu/ops/pallas/flash_attention.py",
+         "paddle_tpu/ops/pallas/quant_matmul.py",
+         "paddle_tpu/quantization/__init__.py"]
 
 
 def cached_rows(dev):

@@ -518,6 +518,84 @@ def check_unbounded_serving_run(fndef, ctx):
                 "timeouts instead of unbounded queues")
 
 
+@register(
+    "PDT111", "dequant-then-matmul", Severity.NOTE, "ast", scope="any",
+    example="""
+from paddle_tpu.quantization import weight_dequantize
+
+def serve(x, qw, scale):
+    w = weight_dequantize(qw, scale)
+    return x @ w
+""",
+    near_miss="""
+from paddle_tpu.quantization import (weight_dequantize,
+                                     weight_only_linear)
+
+def serve(x, qw, scale):
+    probe = weight_dequantize(qw, scale)   # inspected, never matmul'd
+    shape = probe.shape
+    return weight_only_linear(x, qw, scale), shape
+""")
+def check_dequant_then_matmul(fndef, ctx):
+    """``weight_dequantize`` whose result feeds a matmul (``@``,
+    ``matmul(...)``, ``linear(...)``): the dequantized weight is
+    materialized at FLOAT width before the matmul reads it — eagerly
+    that is a full extra HBM round-trip at 4x the quantized bytes, and
+    even under jit it gambles on XLA fusing the pair.
+    ``quantization.weight_only_linear`` (the Pallas fused
+    dequant-matmul, ``ops/pallas/quant_matmul.py``) reads the weights
+    at int8 width and applies the scale after the K reduction.
+    Note-level advice, not an error."""
+    # source-position-aware name tracking (the PDT109 hardening): a
+    # name is a dequant result at a use site iff its latest PRECEDING
+    # assignment was a weight_dequantize call — rebinding clears it,
+    # and a later dequant assignment does not taint earlier uses
+    assigns: dict[str, list[tuple[tuple[int, int], bool]]] = {}
+    for node in _walk_fn(fndef):
+        if isinstance(node, ast.Assign):
+            is_dq = (isinstance(node.value, ast.Call)
+                     and (_dotted(node.value.func) or "")
+                     .split(".")[-1] == "weight_dequantize")
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    assigns.setdefault(tgt.id, []).append(
+                        ((node.lineno, node.col_offset), is_dq))
+    for hist in assigns.values():
+        hist.sort()
+
+    def _is_dequant(arg, pos):
+        if isinstance(arg, ast.Name):
+            last = None
+            for apos, is_dq in assigns.get(arg.id, ()):
+                if apos >= pos:
+                    break
+                last = is_dq
+            return bool(last)
+        return (isinstance(arg, ast.Call)
+                and (_dotted(arg.func) or "").split(".")[-1]
+                == "weight_dequantize")
+
+    msg = ("matmul over a weight_dequantize result materializes the "
+           "float weights in HBM before the matmul re-reads them; "
+           "weight_only_linear fuses the dequant into the matmul at "
+           "int8 read width")
+    for node in _walk_fn(fndef):
+        if not isinstance(node, (ast.BinOp, ast.Call)):
+            continue
+        pos = (node.lineno, node.col_offset)
+        if isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                      ast.MatMult):
+            if _is_dequant(node.left, pos) or _is_dequant(node.right,
+                                                          pos):
+                yield node, msg
+        elif isinstance(node, ast.Call) \
+                and (_dotted(node.func) or "").split(".")[-1] \
+                in ("matmul", "linear") \
+                and any(_is_dequant(a, pos) for a in node.args
+                        + [kw.value for kw in node.keywords]):
+            yield node, msg
+
+
 # constant values that disable the engine's prefix cache — the string
 # spellings are the engine's case-insensitive parse set
 _PREFIX_CACHE_OFF = (False, 0) + PREFIX_CACHE_OFF_SPELLINGS

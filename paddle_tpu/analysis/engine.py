@@ -172,7 +172,7 @@ def analyze_source(source: str, filename: str = "<string>", *,
         for spec in REGISTRY.values():
             if spec.frontend != "ast" or spec.func is None:
                 continue
-            if (spec.scope == "jit") != in_jit:
+            if spec.scope != "any" and (spec.scope == "jit") != in_jit:
                 continue
             if spec.code in suppressed or spec.code in dec_sup:
                 continue

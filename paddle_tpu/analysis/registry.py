@@ -78,6 +78,9 @@ class CheckSpec:
     scope: str = "jit"  # "jit": functions in a jit context (the default)
     #                     "eager": functions NOT in a jit context (e.g.
     #                     PDT108's eager train-loop advice)
+    #                     "any": both (e.g. PDT111's dequant-then-matmul
+    #                     advice — the unfused pattern wastes HBM either
+    #                     way)
 
 
 _CODE_RE = re.compile(r"^PDT[12]\d\d$")
@@ -92,7 +95,7 @@ def register(code: str, name: str, severity: Severity, frontend: str, *,
     ``(fndef, ctx)`` and yield ``(node, message)``; IR checks take
     ``(closed_jaxpr, ctx)`` and yield ``(message, eqn_or_None)``.
     ``scope`` (AST checks only): "jit" runs over functions in a jit
-    context, "eager" over functions outside one.
+    context, "eager" over functions outside one, "any" over both.
     """
     if not _CODE_RE.match(code):
         raise ValueError(f"diagnostic code {code!r} must match PDT[12]xx")
@@ -101,7 +104,7 @@ def register(code: str, name: str, severity: Severity, frontend: str, *,
     if (frontend == "ast") != code.startswith("PDT1"):
         raise ValueError(f"{code}: PDT1xx codes are AST checks, "
                          f"PDT2xx are IR/runtime checks")
-    if scope not in ("jit", "eager"):
+    if scope not in ("jit", "eager", "any"):
         raise ValueError(f"unknown scope {scope!r}")
 
     def deco(fn):

@@ -108,6 +108,36 @@ define_flag("serving_prefix_cache", True,
 # String spellings that disable the prefix cache, shared by the engine's
 # prefix_cache kwarg parse and the PDT110 lint so they cannot diverge.
 PREFIX_CACHE_OFF_SPELLINGS = ("off", "false", "0", "no")
+define_flag("serving_kv_quant", False,
+            "int8 KV page pools for the serving engine (ISSUE 7): "
+            "pages store int8 with per-page scale side-pools "
+            "(quantization.kv_quantize), dequantized inside the ragged "
+            "paged-attention kernel's DMA loop — KV bytes per resident "
+            "sequence drop >2x (serving_bench recomputes the roofline "
+            "from the quantized bytes) at token-identical greedy "
+            "outputs on the serving parity suite. Default off; "
+            "PDTPU_SERVING_KV_QUANT=1 (or engine kwarg kv_quant) "
+            "enables, and the off state is bitwise-identical to the "
+            "pre-quantization fp path.")
+# Spellings that toggle KV quantization in the engine's kv_quant kwarg
+# (off set shared with the prefix cache — one convention for on/off
+# strings).  Unlike prefix_cache (bitwise-identical either way), this
+# switch changes numerics, so unrecognized spellings must never
+# silently enable it: the engine raises, the env alias ignores.
+KV_QUANT_OFF_SPELLINGS = PREFIX_CACHE_OFF_SPELLINGS
+KV_QUANT_ON_SPELLINGS = ("on", "true", "1", "yes")
+# Both env spellings — the canonical PDTPU_SERVING_KV_QUANT the flag
+# registry derives and the short PDTPU_KV_QUANT alias — parse through
+# the SAME on/off sets (define_flag's bool parse misses "on"), the
+# alias taking precedence when both are set.
+for _env_name in ("PDTPU_SERVING_KV_QUANT", "PDTPU_KV_QUANT"):
+    _env_kvq = os.environ.get(_env_name)
+    if _env_kvq is not None:
+        if _env_kvq.lower() in KV_QUANT_ON_SPELLINGS:
+            _FLAGS["serving_kv_quant"] = True
+        elif _env_kvq.lower() in KV_QUANT_OFF_SPELLINGS:
+            _FLAGS["serving_kv_quant"] = False
+del _env_name, _env_kvq
 define_flag("while_grad_max_trip_count", 256,
             "trip bound for differentiable while_loop under jit capture "
             "(lowered to a masked lax.scan; XLA has no reverse-mode "

@@ -99,6 +99,28 @@ Prefix caching (ISSUE 6; ``inference/prefix_cache.py``):
   ``stats`` grows ``cache_hits`` / ``cache_hit_tokens`` /
   ``cached_pages`` / ``evictions`` and the prefill accounting pair
   ``prefill_tokens_requested`` / ``prefill_tokens_computed``.
+
+Quantized KV (ISSUE 7; ``serving_kv_quant`` flag / ``kv_quant`` kwarg,
+default off):
+
+* INT8 PAGE POOLS — data pools store int8 and per-page scale
+  side-pools ([Hk, P, page_size] f32, ``quantization.kv_quantize``)
+  APPEND to the cache list; writes quantize inside
+  ``models.generation.ragged_paged_step`` / ``paged_slot_attention``
+  (each token's bytes a pure function of its own K/V vector — page
+  content is write-path-independent), reads dequantize inside the
+  ragged kernel's DMA loop.  KV bytes per resident sequence drop to
+  ``(D + 4) / 4D`` of fp32 (< 0.5 for every real head dim;
+  ``stats["kv_page_bytes"]``), which halves the HBM roofline term and
+  doubles the sequences a fixed pool can hold.
+* Because the scale pools ride the SAME block tables and page ids, the
+  prefix cache (match, COW, publish, eviction), preempt-requeue and
+  the decode-window donation all carry them transparently — no scale-
+  aware branch exists anywhere in the scheduling layer.
+* Greedy outputs are token-identical to the fp engine on the serving
+  parity suite (int8 absmax per-vector error does not flip tiny-model
+  argmax); with the flag off the engine is bitwise-identical to the
+  pre-quantization fp path.
 """
 from __future__ import annotations
 
@@ -215,7 +237,9 @@ class ContinuousBatchingEngine:
     TTL to every request, ``dispatch_retries`` bounds the per-dispatch
     retry, ``prefix_cache`` gates the cross-request KV prefix cache
     (``serving_prefix_cache`` flag; ``False``/``'off'`` restores
-    uncached admission bitwise).  ``clock`` (tests) replaces
+    uncached admission bitwise), ``kv_quant`` stores KV pages int8
+    with in-kernel dequant (``serving_kv_quant`` flag; default off =
+    bitwise fp path).  ``clock`` (tests) replaces
     ``time.monotonic`` for deterministic deadline drills."""
 
     def __init__(self, model, *, max_slots=8, page_size=16,
@@ -223,7 +247,7 @@ class ContinuousBatchingEngine:
                  prefill_chunk=64, q_block=8, pages_per_block=None,
                  max_queue=None, queue_policy=None,
                  default_deadline_ms=None, dispatch_retries=None,
-                 prefix_cache=None, clock=None):
+                 prefix_cache=None, kv_quant=None, clock=None):
         from ..core import state as _state
         from ..models.generation import (_decode_fn, _ragged_fn,
                                          _zero_pool)
@@ -269,10 +293,41 @@ class ContinuousBatchingEngine:
         self._clock = time.monotonic if clock is None else clock
         self._guard = DecodeGuard(self.max_slots)
 
+        kq = (_state.get_flag("serving_kv_quant")
+              if kv_quant is None else kv_quant)
+        if isinstance(kq, str):
+            # strict parse: kv_quant changes numerics, so a typo must
+            # not silently enable lossy int8 KV
+            if kq.lower() in _state.KV_QUANT_ON_SPELLINGS:
+                kq = True
+            elif kq.lower() in _state.KV_QUANT_OFF_SPELLINGS:
+                kq = False
+            else:
+                raise ValueError(
+                    f"kv_quant={kq!r}: expected one of "
+                    f"{_state.KV_QUANT_ON_SPELLINGS} or "
+                    f"{_state.KV_QUANT_OFF_SPELLINGS}")
+        self.kv_quant = bool(kq)
         n_kv = getattr(cfg, "num_kv_heads", cfg.num_heads)
         shape = (n_kv, self.total_pages, self.page_size, cfg.head_dim)
-        self._caches = [Tensor(a)
-                        for a in _zero_pool(shape, 2 * cfg.num_layers)]
+        # int8 KV (ISSUE 7): data pools go int8 and per-page scale
+        # side-pools [Hk, P, page_size] APPEND to the cache list —
+        # every downstream consumer (COW copy, decode-window donation,
+        # program signatures) treats the list opaquely, and the model
+        # forwards split it by length (models/generation._split_caches),
+        # so block tables, the prefix cache and preempt-requeue carry
+        # the scales without knowing they exist.
+        kv_dtype = "int8" if self.kv_quant else "float32"
+        self._caches = [Tensor(a) for a in _zero_pool(
+            shape, 2 * cfg.num_layers, kv_dtype)]
+        if self.kv_quant:
+            self._caches += [Tensor(a) for a in _zero_pool(
+                shape[:3], 2 * cfg.num_layers, "float32")]
+        # bytes per page across all layers (data + scales): the
+        # serving-roofline accounting the quant path halves
+        itemsize = 1 if self.kv_quant else 4
+        self._page_bytes = 2 * cfg.num_layers * n_kv * self.page_size \
+            * (cfg.head_dim * itemsize + (4 if self.kv_quant else 0))
         self._free_pages = deque(range(1, self.total_pages))  # 0 = null
         pc = (_state.get_flag("serving_prefix_cache")
               if prefix_cache is None else prefix_cache)
@@ -318,6 +373,12 @@ class ContinuousBatchingEngine:
                              - self._cache.cached_pages)
         d["pages_free"] = len(self._free_pages)
         d["queue_depth"] = len(self._queue)
+        # KV byte accounting (ISSUE 7): per-page bytes across all
+        # layers including int8 scale side-pools — the quant path's
+        # halved-bytes acceptance gate reads these
+        d["kv_quant"] = self.kv_quant
+        d["kv_page_bytes"] = self._page_bytes
+        d["kv_bytes_in_use"] = d["pages_in_use"] * self._page_bytes
         return d
 
     def add_request(self, prompt, max_new_tokens, eos_token_id=None,
@@ -711,7 +772,7 @@ class ContinuousBatchingEngine:
     def _geometry(self):
         return (self.max_slots, self.page_size, self.np_per_seq,
                 self.total_pages, self.token_budget, self.q_block,
-                self.pages_per_block)
+                self.pages_per_block, self.kv_quant)
 
     # ------------------------------------------- copy-on-write --------
     def _get_cow_fn(self):
@@ -907,10 +968,12 @@ class ContinuousBatchingEngine:
             def step(tok, pos, bt, *cs):
                 import paddle_tpu as pp
                 with pp.no_grad():
-                    def attend(q, k, v, kc, vc, p):
+                    def attend(q, k, v, kc, vc, p, ks=None, vs=None):
                         return paged_slot_attention(q, k, v, kc, vc,
                                                     p, bt,
-                                                    pages_per_block=ppb)
+                                                    pages_per_block=ppb,
+                                                    k_scales=ks,
+                                                    v_scales=vs)
                     logits, new = decode(model, tok, pos, list(cs),
                                          attend=attend)
                 return (logits,) + tuple(new)

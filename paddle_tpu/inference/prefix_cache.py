@@ -52,6 +52,19 @@ path routes by ``block_table[slot, pos // page_size]``).
 ``enabled=False`` (the ``serving_prefix_cache`` flag's ``off`` value)
 keeps the refcount bookkeeping — one code path, same invariants — but
 never indexes or matches, which restores the uncached engine bitwise.
+
+Quantized KV (ISSUE 7) note — SCALE TRAVEL: under ``kv_quant`` the
+engine's page pools are int8 with per-page scale side-pools indexed by
+the SAME page ids this cache hands around.  The cache itself never
+touches tensor data (it moves page IDS between free/in-use/cached), so
+a published page implicitly publishes its scale vector, a matched page
+brings its scales along through the block-table indirection, and the
+engine's COW copy program duplicates data and scale pools in the same
+dispatch.  Quantized bytes are also write-path-independent (per-token
+absmax, ``quantization.kv_quantize``), so a cache hit reconstructs
+exactly the bytes the request's own prefill would have written — the
+cache-on/off parity suite re-runs with ``serving_kv_quant=on``
+unchanged.
 """
 from __future__ import annotations
 

@@ -86,7 +86,8 @@ def paged_cache_attention(q, k_new, v_new, k_pages, v_pages, pos,
 
 @primitive
 def paged_slot_attention(q, k_new, v_new, k_pages, v_pages, positions,
-                         block_tables, scale=None, pages_per_block=None):
+                         block_tables, scale=None, pages_per_block=None,
+                         k_scales=None, v_scales=None):
     """One decode step against a paged KV cache with PER-SLOT state —
     the continuous-batching variant of :func:`paged_cache_attention`.
 
@@ -96,29 +97,50 @@ def paged_slot_attention(q, k_new, v_new, k_pages, v_pages, positions,
     their VALUES between dispatches, never recompiling.  Writes each
     slot's new K/V at its own (page, slot) and attends through the
     ragged Pallas kernel with per-slot lengths.
+
+    ``k_scales``/``v_scales`` [Hk, P, page_size] switch on the int8 KV
+    path: the new K/V quantize on write (``quantization.kv_quantize``,
+    one absmax scale per head per token slot — path-independent bytes),
+    the kernel dequantizes in its DMA loop, and the updated scale pools
+    return alongside the data pools.
     """
     from ..ops.pallas.paged_attention import paged_decode_attention
+    from ..quantization import kv_quantize
 
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("paged_slot_attention: pass both k_scales "
+                         "and v_scales or neither")
+    quant = k_scales is not None
     p = positions.reshape(-1).astype(jnp.int32)             # [B]
     bt = block_tables.astype(jnp.int32)
     b = q.shape[0]
     ps = k_pages.shape[2]
     page = bt[jnp.arange(b), jnp.minimum(p // ps, bt.shape[1] - 1)]
     slot = p % ps
-    kn = jnp.swapaxes(k_new[:, 0], 0, 1).astype(k_pages.dtype)
-    vn = jnp.swapaxes(v_new[:, 0], 0, 1).astype(v_pages.dtype)
-    k_pages = k_pages.at[:, page, slot].set(kn)
-    v_pages = v_pages.at[:, page, slot].set(vn)
+    kn = jnp.swapaxes(k_new[:, 0], 0, 1)                    # [Hk, B, D]
+    vn = jnp.swapaxes(v_new[:, 0], 0, 1)
+    if quant:
+        kn, k_sc = kv_quantize(kn)
+        vn, v_sc = kv_quantize(vn)
+        k_scales = k_scales.at[:, page, slot].set(k_sc)
+        v_scales = v_scales.at[:, page, slot].set(v_sc)
+    k_pages = k_pages.at[:, page, slot].set(kn.astype(k_pages.dtype))
+    v_pages = v_pages.at[:, page, slot].set(vn.astype(v_pages.dtype))
     out = paged_decode_attention(q[:, 0], k_pages, v_pages, bt, p + 1,
                                  scale=scale,
-                                 pages_per_block=pages_per_block)
-    return out[:, None].astype(q.dtype), k_pages, v_pages
+                                 pages_per_block=pages_per_block,
+                                 k_scales=k_scales, v_scales=v_scales)
+    out = out[:, None].astype(q.dtype)
+    if quant:
+        return out, k_pages, v_pages, k_scales, v_scales
+    return out, k_pages, v_pages
 
 
 @primitive
 def ragged_paged_step(q, k_new, v_new, k_pages, v_pages, tok_pos,
                       tok_slot, tok_valid, kv_lens, q_lens, block_tables,
-                      scale=None, q_block=8, pages_per_block=None):
+                      scale=None, q_block=8, pages_per_block=None,
+                      k_scales=None, v_scales=None):
     """Attention for ONE continuously-batched step over packed tokens.
 
     q/k_new/v_new: [T, H(q|kv), D] — tokens of all sequences packed in
@@ -129,9 +151,24 @@ def ragged_paged_step(q, k_new, v_new, k_pages, v_pages, tok_pos,
     (kv INCLUDING this step's tokens).  Prefill chunks and single-token
     decodes share this one call — the kernel's per-sequence causal
     offset handles both.
+
+    ``k_scales``/``v_scales`` [Hk, P, page_size] switch on the int8 KV
+    path (ISSUE 7): this step's K/V quantize ON WRITE at page-slot
+    granularity (``quantization.kv_quantize`` — each token's bytes are
+    a pure function of its own K/V vector, so a page filled by prefill
+    chunks or token-by-token decode holds identical bytes and prefix-
+    cache reuse stays exact), the scale vectors land in side-pools
+    indexed by the same block tables, and the ragged kernel dequantizes
+    inside its DMA loop.  The updated scale pools return after the data
+    pools.
     """
     from ..ops.pallas.paged_attention import ragged_paged_attention
+    from ..quantization import kv_quantize
 
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("ragged_paged_step: pass both k_scales "
+                         "and v_scales or neither")
+    quant = k_scales is not None
     bt = block_tables.astype(jnp.int32)
     ps = k_pages.shape[2]
     pos = tok_pos.astype(jnp.int32)
@@ -140,16 +177,25 @@ def ragged_paged_step(q, k_new, v_new, k_pages, v_pages, tok_pos,
     page = jnp.where(
         ok, bt[sl, jnp.minimum(pos // ps, bt.shape[1] - 1)], 0)
     wslot = jnp.where(ok, pos % ps, 0)
-    kn = jnp.swapaxes(k_new, 0, 1).astype(k_pages.dtype)    # [Hk, T, D]
-    vn = jnp.swapaxes(v_new, 0, 1).astype(v_pages.dtype)
-    k_pages = k_pages.at[:, page, wslot].set(kn)
-    v_pages = v_pages.at[:, page, wslot].set(vn)
+    kn = jnp.swapaxes(k_new, 0, 1)                          # [Hk, T, D]
+    vn = jnp.swapaxes(v_new, 0, 1)
+    if quant:
+        kn, k_sc = kv_quantize(kn)
+        vn, v_sc = kv_quantize(vn)
+        k_scales = k_scales.at[:, page, wslot].set(k_sc)
+        v_scales = v_scales.at[:, page, wslot].set(v_sc)
+    k_pages = k_pages.at[:, page, wslot].set(kn.astype(k_pages.dtype))
+    v_pages = v_pages.at[:, page, wslot].set(vn.astype(v_pages.dtype))
     out = ragged_paged_attention(q, k_pages, v_pages, bt,
                                  kv_lens.astype(jnp.int32),
                                  q_lens.astype(jnp.int32),
                                  q_block=q_block, scale=scale,
-                                 pages_per_block=pages_per_block)
-    return out.astype(q.dtype), k_pages, v_pages
+                                 pages_per_block=pages_per_block,
+                                 k_scales=k_scales, v_scales=v_scales)
+    out = out.astype(q.dtype)
+    if quant:
+        return out, k_pages, v_pages, k_scales, v_scales
+    return out, k_pages, v_pages
 
 
 @primitive
@@ -245,13 +291,34 @@ def rope_at(x, pos, theta=10000.0):
     return _apply_rope(x, cos, sin)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _zero_pool(shape, count):
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _zero_pool(shape, count, dtype="float32"):
     """``count`` zeroed arrays of ``shape`` in ONE device launch (jit's
     static-arg cache keeps one compiled program per geometry): a
     12-layer KV pool as 24 separate ``jnp.zeros`` dispatches pays 24
-    launches of per-request latency over a network-attached chip."""
-    return tuple(jnp.zeros(shape, jnp.float32) for _ in range(count))
+    launches of per-request latency over a network-attached chip.
+    ``dtype`` (static string) lets the quantized serving engine build
+    int8 data pools and f32 scale pools through the same program
+    cache."""
+    return tuple(jnp.zeros(shape, jnp.dtype(dtype))
+                 for _ in range(count))
+
+
+def _split_caches(caches, n_layers):
+    """Serving cache-list layout: ``[k0, v0, ..., kL-1, vL-1]`` for fp
+    pools, with the int8 path APPENDING the per-page scale side-pools
+    ``[ks0, vs0, ..., ksL-1, vsL-1]`` (``inference/engine.py`` builds
+    the list; the length is self-describing).  Returns
+    ``(data, scales)`` with ``scales == []`` on the fp path — the ONE
+    place the decode/ragged forwards learn whether KV is quantized."""
+    n = 2 * n_layers
+    if len(caches) == 2 * n:
+        return caches[:n], caches[n:]
+    if len(caches) != n:
+        raise ValueError(
+            f"expected {n} (fp) or {2 * n} (int8 + scales) cache pools "
+            f"for {n_layers} layers, got {len(caches)}")
+    return caches, []
 
 
 def _empty_caches(model, batch, max_len):
@@ -261,42 +328,58 @@ def _empty_caches(model, batch, max_len):
     return [Tensor(a) for a in _zero_pool(shape, 2 * cfg.num_layers)]
 
 
+def _attend_layer(attend, q, k, v, data, scales, li, pos):
+    """One layer's cache update + attention, fp or int8: returns
+    ``(att, new_data_pair, new_scale_pair)``.  The quantized call adds
+    the layer's scale pools and gets them back updated."""
+    kc, vc = data[2 * li], data[2 * li + 1]
+    if scales:
+        ks, vs = scales[2 * li], scales[2 * li + 1]
+        att, kc, vc, ks, vs = attend(q, k, v, kc, vc, pos, ks, vs)
+        return att, [kc, vc], [ks, vs]
+    att, kc, vc = attend(q, k, v, kc, vc, pos)
+    return att, [kc, vc], []
+
+
 def _gpt_decode(model, ids_t, pos, caches, attend=cache_attention):
     """One-token logits for GPTForCausalLM given flat [k0,v0,k1,v1,...]
-    caches; returns (logits [B, V], new caches). ``pos`` may be [1]
+    caches (int8 serving appends scale pools — ``_split_caches``);
+    returns (logits [B, V], new caches). ``pos`` may be [1]
     (one shared position) or [B] (per-slot positions — the serving
     engine's continuously-batched decode)."""
     from .. import ops
     gpt = model.gpt
+    data, scales = _split_caches(caches, len(gpt.blocks))
     x = gpt.wte(ids_t) + gpt.wpe(ops.reshape(pos, [-1, 1]))
-    new = []
+    new, new_sc = [], []
     for li, blk in enumerate(gpt.blocks):
-        kc, vc = caches[2 * li], caches[2 * li + 1]
         h = blk.ln1(x)
         b, s, hidden = h.shape
         qkv = ops.reshape(blk.attn.qkv(h),
                           [b, 1, 3, blk.attn.num_heads,
                            blk.attn.head_dim])
         q, k, v = ops.unbind(qkv, axis=2)
-        att, kc, vc = attend(q, k, v, kc, vc, pos)
+        att, pair, sc_pair = _attend_layer(attend, q, k, v, data,
+                                           scales, li, pos)
         x = x + blk.attn.proj(ops.reshape(att, [b, 1, hidden]))
         x = x + blk.mlp(blk.ln2(x))
-        new.extend([kc, vc])
+        new.extend(pair)
+        new_sc.extend(sc_pair)
     h = gpt.ln_f(x)
     if model.lm_head is not None:
         logits = model.lm_head(h)
     else:
         logits = ops.matmul(h, gpt.wte.weight, transpose_y=True)
-    return ops.reshape(logits, [logits.shape[0], -1]), new
+    return ops.reshape(logits, [logits.shape[0], -1]), new + new_sc
 
 
 def _llama_decode(model, ids_t, pos, caches, attend=cache_attention):
     from .. import ops
     lm = model.llama
+    data, scales = _split_caches(caches, len(lm.layers))
     x = lm.embed_tokens(ids_t)
-    new = []
+    new, new_sc = [], []
     for li, layer in enumerate(lm.layers):
-        kc, vc = caches[2 * li], caches[2 * li + 1]
         att_in = layer.input_norm(x)
         a = layer.attn
         b = att_in.shape[0]
@@ -307,16 +390,38 @@ def _llama_decode(model, ids_t, pos, caches, attend=cache_attention):
                         [b, 1, a.num_kv_heads, a.head_dim])
         q = rope_at(q, pos, theta=a.rope_theta)
         k = rope_at(k, pos, theta=a.rope_theta)
-        att, kc, vc = attend(q, k, v, kc, vc, pos)
+        att, pair, sc_pair = _attend_layer(attend, q, k, v, data,
+                                           scales, li, pos)
         x = x + a.o_proj(ops.reshape(att, [b, 1, -1]))
         x = x + layer.mlp(layer.post_norm(x))
-        new.extend([kc, vc])
+        new.extend(pair)
+        new_sc.extend(sc_pair)
     h = lm.norm(x)
     if model.lm_head is not None:
         logits = model.lm_head(h)
     else:
         logits = ops.matmul(h, lm.embed_tokens.weight, transpose_y=True)
-    return ops.reshape(logits, [logits.shape[0], -1]), new
+    return ops.reshape(logits, [logits.shape[0], -1]), new + new_sc
+
+
+def _ragged_attend_layer(q, k, v, data, scales, li, tok_pos, tok_slot,
+                         tok_valid, kv_lens, q_lens, bt, q_block,
+                         pages_per_block):
+    """One layer's packed-token page write + ragged attention, fp or
+    int8 (the :func:`_attend_layer` analog for the mixed serving step):
+    returns ``(att, new_data_pair, new_scale_pair)``."""
+    kc, vc = data[2 * li], data[2 * li + 1]
+    if scales:
+        att, kc, vc, ks, vs = ragged_paged_step(
+            q, k, v, kc, vc, tok_pos, tok_slot, tok_valid, kv_lens,
+            q_lens, bt, q_block=q_block,
+            pages_per_block=pages_per_block,
+            k_scales=scales[2 * li], v_scales=scales[2 * li + 1])
+        return att, [kc, vc], [ks, vs]
+    att, kc, vc = ragged_paged_step(
+        q, k, v, kc, vc, tok_pos, tok_slot, tok_valid, kv_lens,
+        q_lens, bt, q_block=q_block, pages_per_block=pages_per_block)
+    return att, [kc, vc], []
 
 
 def _gpt_ragged_forward(model, ids_t, tok_pos, tok_slot, tok_valid,
@@ -330,28 +435,28 @@ def _gpt_ragged_forward(model, ids_t, tok_pos, tok_slot, tok_valid,
     new page pools)."""
     from .. import ops
     gpt = model.gpt
+    data, scales = _split_caches(caches, len(gpt.blocks))
     t = ids_t.shape[1]
     x = gpt.wte(ids_t) + gpt.wpe(ops.reshape(tok_pos, [1, -1]))
-    new = []
+    new, new_sc = [], []
     for li, blk in enumerate(gpt.blocks):
-        kc, vc = caches[2 * li], caches[2 * li + 1]
         h = blk.ln1(x)
         hd, nh = blk.attn.head_dim, blk.attn.num_heads
         qkv = ops.reshape(blk.attn.qkv(h), [t, 3, nh, hd])
         q, k, v = ops.unbind(qkv, axis=1)                  # [T, nh, hd]
-        att, kc, vc = ragged_paged_step(
-            q, k, v, kc, vc, tok_pos, tok_slot, tok_valid, kv_lens,
-            q_lens, bt, q_block=q_block,
-            pages_per_block=pages_per_block)
+        att, pair, sc_pair = _ragged_attend_layer(
+            q, k, v, data, scales, li, tok_pos, tok_slot, tok_valid,
+            kv_lens, q_lens, bt, q_block, pages_per_block)
         x = x + blk.attn.proj(ops.reshape(att, [1, t, nh * hd]))
         x = x + blk.mlp(blk.ln2(x))
-        new.extend([kc, vc])
+        new.extend(pair)
+        new_sc.extend(sc_pair)
     h = gpt.ln_f(x)
     if model.lm_head is not None:
         logits = model.lm_head(h)
     else:
         logits = ops.matmul(h, gpt.wte.weight, transpose_y=True)
-    return ops.reshape(logits, [t, -1]), new
+    return ops.reshape(logits, [t, -1]), new + new_sc
 
 
 def _llama_ragged_forward(model, ids_t, tok_pos, tok_slot, tok_valid,
@@ -359,11 +464,11 @@ def _llama_ragged_forward(model, ids_t, tok_pos, tok_slot, tok_valid,
                           pages_per_block=None):
     from .. import ops
     lm = model.llama
+    data, scales = _split_caches(caches, len(lm.layers))
     t = ids_t.shape[1]
     x = lm.embed_tokens(ids_t)
-    new = []
+    new, new_sc = [], []
     for li, layer in enumerate(lm.layers):
-        kc, vc = caches[2 * li], caches[2 * li + 1]
         att_in = layer.input_norm(x)
         a = layer.attn
         q = ops.reshape(a.q_proj(att_in), [1, t, a.num_heads, a.head_dim])
@@ -373,21 +478,22 @@ def _llama_ragged_forward(model, ids_t, tok_pos, tok_slot, tok_valid,
                         [1, t, a.num_kv_heads, a.head_dim])
         q = rope_at(q, tok_pos, theta=a.rope_theta)
         k = rope_at(k, tok_pos, theta=a.rope_theta)
-        att, kc, vc = ragged_paged_step(
+        att, pair, sc_pair = _ragged_attend_layer(
             ops.reshape(q, [t, a.num_heads, a.head_dim]),
             ops.reshape(k, [t, a.num_kv_heads, a.head_dim]),
             ops.reshape(v, [t, a.num_kv_heads, a.head_dim]),
-            kc, vc, tok_pos, tok_slot, tok_valid, kv_lens, q_lens, bt,
-            q_block=q_block, pages_per_block=pages_per_block)
+            data, scales, li, tok_pos, tok_slot, tok_valid,
+            kv_lens, q_lens, bt, q_block, pages_per_block)
         x = x + a.o_proj(ops.reshape(att, [1, t, -1]))
         x = x + layer.mlp(layer.post_norm(x))
-        new.extend([kc, vc])
+        new.extend(pair)
+        new_sc.extend(sc_pair)
     h = lm.norm(x)
     if model.lm_head is not None:
         logits = model.lm_head(h)
     else:
         logits = ops.matmul(h, lm.embed_tokens.weight, transpose_y=True)
-    return ops.reshape(logits, [t, -1]), new
+    return ops.reshape(logits, [t, -1]), new + new_sc
 
 
 def _ragged_fn(model):

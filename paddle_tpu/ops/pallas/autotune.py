@@ -9,11 +9,15 @@ and persists the winner per (device kind, op, shape signature) in a
 JSON cache so later processes skip the sweep.
 
 Tuned entries: ``flash_attention`` (block_q, block_k — see
-flash_attention._autotuned_blocks) and ``paged_attention_ppb``
+flash_attention._autotuned_blocks), ``paged_attention_ppb``
 (pages_per_block of the ragged paged-KV serving kernel — see
 paged_attention.pick_pages_per_block; candidates are powers of two
 bounded by the block-table width and a VMEM cap, cache hits apply under
-a trace, sweeps run on synthetic decode shapes when enabled).
+a trace, sweeps run on synthetic decode shapes when enabled),
+``fused_optimizer_rows`` (row-block of the fused optimizer update —
+fused_optimizer.pick_rows) and ``quant_matmul_blocks`` ((bm, bn) output
+tiling of the fused weight-only int8 matmul —
+quant_matmul.pick_blocks).
 
 LIMITATION (measured, round 4): the sweep times candidates in an
 isolated chained program; the winner inside a REAL train step can

@@ -35,6 +35,13 @@ Capability analog of the reference's paged/block KV serving kernels
   ``rep = Hq // Hk`` query heads of a kv head into the sublane
   dimension.
 
+* INT8 KV pages (ISSUE 7): when the pools are int8, per-page scale
+  side-pools [Hk, P, page_size] (``quantization.kv_quantize``) are
+  DMA'd alongside each data page and the dequant happens in VMEM right
+  after the copy completes — attention reads a QUARTER of the fp32 KV
+  bytes per step, which is the serving roofline term
+  (benchmarks/serving_bench.py), and no float page ever exists in HBM.
+
 Public entries: ``paged_decode_attention`` (one token per sequence —
 the ``models.generate(kv_cache='paged')`` path, API-compatible with the
 previous kernel) and ``ragged_paged_attention`` (mixed token counts —
@@ -147,14 +154,28 @@ def _count_items(kv_lens, q_lens, q_block, blk_tokens):
 
 def _ragged_kernel(seq_ref, qb_ref, kb_ref, qbg_ref, first_ref, last_ref,
                    nitems_ref, bt_ref, kvl_ref, ql_ref,
-                   q_ref, k_hbm, v_hbm, o_ref,
-                   m_s, l_s, acc_s, kbuf, vbuf, ksem, vsem,
-                   *, scale, page_size, pages_per_block, q_block, rep_p):
+                   q_ref, *refs,
+                   scale, page_size, pages_per_block, q_block, rep_p,
+                   quant):
     """One compacted work item: walk ``pages_per_block`` pages of one
     sequence's kv block against one q block.  Scalars (prefetched):
     item maps + block tables [B, NP] + kv/q lengths [B].  q/o blocks:
     [1, 1, q_block*rep_p, D].  k/v pools stay in HBM; pages are DMA'd
-    into VMEM scratch only for live items."""
+    into VMEM scratch only for live items.
+
+    ``quant``: the pools are int8 and two per-page scale side-pools
+    [Hk, P, page_size] ride along — each page's scale vector is DMA'd
+    with its data page and the dequant (one VPU multiply per token row)
+    happens right here in VMEM, so quantized attention reads a QUARTER
+    of the fp32 KV bytes per step and never materializes a float page
+    in HBM (PAPERS.md #3's fuse-dequant-into-the-consumer argument
+    applied to the DMA loop)."""
+    if quant:
+        (k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref, m_s, l_s, acc_s,
+         kbuf, vbuf, ksbuf, vsbuf, ksem, vsem, kssem, vssem) = refs
+    else:
+        (k_hbm, v_hbm, o_ref, m_s, l_s, acc_s,
+         kbuf, vbuf, ksem, vsem) = refs
     i = pl.program_id(1)
     ih = pl.program_id(0)
     live = i < nitems_ref[0]
@@ -175,29 +196,36 @@ def _ragged_kernel(seq_ref, qb_ref, kb_ref, qbg_ref, first_ref, last_ref,
         page0 = kb * pages_per_block
 
         def _copies(p, pid):
-            return (pltpu.make_async_copy(k_hbm.at[ih, pid], kbuf.at[p],
-                                          ksem.at[p]),
-                    pltpu.make_async_copy(v_hbm.at[ih, pid], vbuf.at[p],
-                                          vsem.at[p]))
+            cps = [pltpu.make_async_copy(k_hbm.at[ih, pid], kbuf.at[p],
+                                         ksem.at[p]),
+                   pltpu.make_async_copy(v_hbm.at[ih, pid], vbuf.at[p],
+                                         vsem.at[p])]
+            if quant:
+                cps.append(pltpu.make_async_copy(
+                    ks_hbm.at[ih, pid], ksbuf.at[p], kssem.at[p]))
+                cps.append(pltpu.make_async_copy(
+                    vs_hbm.at[ih, pid], vsbuf.at[p], vssem.at[p]))
+            return cps
 
         for p in range(pages_per_block):        # static unroll
             @pl.when(page0 + p < npg)
             def _start(p=p):
                 pid = bt_ref[b, page0 + p]
-                ck, cv = _copies(p, pid)
-                ck.start()
-                cv.start()
+                for c in _copies(p, pid):
+                    c.start()
         for p in range(pages_per_block):
             @pl.when(page0 + p < npg)
             def _wait(p=p):
                 pid = bt_ref[b, page0 + p]
-                ck, cv = _copies(p, pid)
-                ck.wait()
-                cv.wait()
+                for c in _copies(p, pid):
+                    c.wait()
 
         q = q_ref[0, 0].astype(jnp.float32) * scale      # [rows, D]
         kblk = kbuf[...].reshape(blk_tokens, -1).astype(jnp.float32)
         vblk = vbuf[...].reshape(blk_tokens, -1).astype(jnp.float32)
+        if quant:   # in-DMA-loop dequant: int8 row * its per-slot scale
+            kblk = kblk * ksbuf[...].reshape(blk_tokens, 1)
+            vblk = vblk * vsbuf[...].reshape(blk_tokens, 1)
         # tokens past kv_len sit in pages never fetched this item —
         # uninitialized VMEM. Zero them BEFORE the dots: the softmax
         # mask alone is not enough (0-weight x NaN garbage = NaN in the
@@ -239,46 +267,64 @@ def _ragged_kernel(seq_ref, qb_ref, kb_ref, qbg_ref, first_ref, last_ref,
 
 def _ragged_call(qx, k_pages, v_pages, bt, kv_lens, q_lens, plan,
                  item_budget, *, scale, q_block, rep_p, pages_per_block,
-                 interpret):
+                 interpret, k_scales=None, v_scales=None):
     """Shared pallas_call: ``qx`` is the blocked q layout
-    [Hk, n_q_blocks, q_block*rep_p, D]; returns the same layout."""
+    [Hk, n_q_blocks, q_block*rep_p, D]; returns the same layout.
+    ``k_scales``/``v_scales`` [Hk, P, page_size] switch on the int8
+    in-kernel-dequant variant."""
     hk, nqb_total, rows, d = qx.shape
     page_size = k_pages.shape[2]
     grid = (hk, item_budget)
+    quant = k_scales is not None
     kernel = functools.partial(
         _ragged_kernel, scale=float(scale), page_size=page_size,
-        pages_per_block=pages_per_block, q_block=q_block, rep_p=rep_p)
+        pages_per_block=pages_per_block, q_block=q_block, rep_p=rep_p,
+        quant=quant)
     kv_dt = k_pages.dtype
 
     def q_index(ih, i, seq, qb, kb, qbg, first, last, nitems, btm, kvl,
                 ql):
         return (ih, qbg[i], 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, 1, rows, d), q_index),
+        pl.BlockSpec(memory_space=pltpu.ANY),   # k page pool
+        pl.BlockSpec(memory_space=pltpu.ANY),   # v page pool
+    ]
+    scratch = [
+        pltpu.VMEM((rows, _LANE), jnp.float32),
+        pltpu.VMEM((rows, _LANE), jnp.float32),
+        pltpu.VMEM((rows, d), jnp.float32),
+        pltpu.VMEM((pages_per_block, page_size, d), kv_dt),
+        pltpu.VMEM((pages_per_block, page_size, d), kv_dt),
+    ]
+    extra = []
+    if quant:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY),  # k scales
+                     pl.BlockSpec(memory_space=pltpu.ANY)]  # v scales
+        scratch += [pltpu.VMEM((pages_per_block, page_size), jnp.float32),
+                    pltpu.VMEM((pages_per_block, page_size), jnp.float32)]
+        extra = [k_scales.astype(jnp.float32),
+                 v_scales.astype(jnp.float32)]
+    scratch += [pltpu.SemaphoreType.DMA((pages_per_block,)),
+                pltpu.SemaphoreType.DMA((pages_per_block,))]
+    if quant:
+        scratch += [pltpu.SemaphoreType.DMA((pages_per_block,)),
+                    pltpu.SemaphoreType.DMA((pages_per_block,))]
+
     return pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=10,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, 1, rows, d), q_index),
-                pl.BlockSpec(memory_space=pltpu.ANY),   # k page pool
-                pl.BlockSpec(memory_space=pltpu.ANY),   # v page pool
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, rows, d), q_index),
-            scratch_shapes=[
-                pltpu.VMEM((rows, _LANE), jnp.float32),
-                pltpu.VMEM((rows, _LANE), jnp.float32),
-                pltpu.VMEM((rows, d), jnp.float32),
-                pltpu.VMEM((pages_per_block, page_size, d), kv_dt),
-                pltpu.VMEM((pages_per_block, page_size, d), kv_dt),
-                pltpu.SemaphoreType.DMA((pages_per_block,)),
-                pltpu.SemaphoreType.DMA((pages_per_block,)),
-            ],
+            scratch_shapes=scratch,
         ),
         out_shape=jax.ShapeDtypeStruct(qx.shape, qx.dtype),
         interpret=interpret,
     )(*plan, bt.astype(jnp.int32), kv_lens.astype(jnp.int32),
-      q_lens.astype(jnp.int32), qx, k_pages, v_pages)
+      q_lens.astype(jnp.int32), qx, k_pages, v_pages, *extra)
 
 
 # --------------------------------------------------------------------------
@@ -300,7 +346,8 @@ def _is_concrete(*xs):
 
 def ragged_paged_attention(q, k_pages, v_pages, block_tables, kv_lens,
                            q_lens, q_block=8, pages_per_block=None,
-                           scale=None, interpret=None, item_budget=None):
+                           scale=None, interpret=None, item_budget=None,
+                           k_scales=None, v_scales=None):
     """Attention for a continuously-batched step over a paged KV cache.
 
     q: [T, Hq, D] — tokens of ALL sequences packed in sequence order,
@@ -312,11 +359,18 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, kv_lens,
     kv_lens: [B] total kv tokens per sequence INCLUDING this step's;
     q_lens: [B] tokens each sequence contributes this step (0 = sits
       out; decode rows 1; prefill rows the prompt-chunk length).
+    k_scales/v_scales: [Hk, total_pages, page_size] f32 side-pools for
+      INT8 pools (``quantization.kv_quantize`` layout): pages dequantize
+      inside the kernel's DMA loop, so a quantized step moves a quarter
+      of the fp32 KV bytes.  Both or neither.
 
     Returns [T, Hq, D] (rows of segment padding are garbage — callers
     gather real token rows only).  Mixed prefill+decode batches are the
     point: one call, one grid, per-sequence causal offsets.
     """
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("ragged_paged_attention: pass both k_scales "
+                         "and v_scales or neither")
     t, hq, d = q.shape
     hk, _, page_size, _ = k_pages.shape
     if hk == 0 or hq % hk != 0:
@@ -357,14 +411,16 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, kv_lens,
                        jnp.asarray(q_lens), plan, item_budget,
                        scale=scale, q_block=q_block, rep_p=rep_p,
                        pages_per_block=pages_per_block,
-                       interpret=interpret)
+                       interpret=interpret, k_scales=k_scales,
+                       v_scales=v_scales)
     out = out.reshape(hk, tp, rep_p, d)[:, :t, :rep]
     return jnp.transpose(out, (1, 0, 2, 3)).reshape(t, hq, d)
 
 
 def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
                            scale=None, interpret=None,
-                           pages_per_block=None):
+                           pages_per_block=None, k_scales=None,
+                           v_scales=None):
     """One decode step of attention over a paged KV cache.
 
     q: [B, Hq, D] (one query token per sequence);
@@ -372,7 +428,9 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
     block_tables: [B, pages_per_seq] int32 — global page ids per
       sequence (may be traced: the serving engine re-points tables at
       admission without recompiling);
-    seq_lens: [B] int32 — valid tokens (including the current one).
+    seq_lens: [B] int32 — valid tokens (including the current one);
+    k_scales/v_scales: int8-pool scale side-pools (see
+      ``ragged_paged_attention``).
     Returns [B, Hq, D]. ``Hq`` must be a multiple of ``Hk`` (GQA).
 
     This is ``ragged_paged_attention`` with every sequence contributing
@@ -384,7 +442,7 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
         q, k_pages, v_pages, block_tables,
         jnp.asarray(seq_lens), jnp.ones((b,), jnp.int32),
         q_block=1, pages_per_block=pages_per_block, scale=scale,
-        interpret=interpret)
+        interpret=interpret, k_scales=k_scales, v_scales=v_scales)
 
 
 # --------------------------------------------------------------------------

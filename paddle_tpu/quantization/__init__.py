@@ -9,8 +9,24 @@ Capability analog of ``python/paddle/quantization/`` (QuantConfig
 
 TPU-native mechanics: fake-quant uses the straight-through estimator
 expressed as ``x + stop_gradient(q(x) - x)`` on the tape (no custom
-backward kernel needed); weight-only int8 stores per-channel abs-max
-scales and dequantizes into the matmul, which XLA fuses into one HBM pass.
+backward kernel needed); weight-only int8 routes through the Pallas
+fused dequant-matmul (``ops/pallas/quant_matmul.py``) so the weights
+are read at int8 width and the per-channel scale is applied after the
+K reduction — one HBM pass at a quarter of the float bytes.
+
+Quantized serving (ISSUE 7) additions:
+
+* ``kv_quantize``/``kv_dequantize`` — the ONE home of the int8 KV-cache
+  quantization arithmetic (per-(head, token-slot) absmax scales).  The
+  serving engine's page pools, the ragged paged-attention kernel's
+  in-DMA dequant, and the parity tests all import these, so the write
+  path and the read path cannot drift.
+* ``WeightOnlyLinear`` + ``weight_only_quantize(model)`` — swap a
+  model's ``nn.Linear`` layers for int8-weight replicas whose forward
+  is ``weight_only_linear``; ``models.generate`` and the continuous-
+  batching engine then serve the quantized model through the fused
+  kernel with no further changes (the decode bodies just call the
+  installed layers).
 """
 from __future__ import annotations
 
@@ -39,7 +55,19 @@ def _pack_int4(q):
 def _unpack_int4(p, n_in):
     """Inverse of :func:`_pack_int4`; arithmetic shifts sign-extend the
     nibbles. XLA fuses this unpack + the scale multiply into the matmul
-    read, so int4 weights cost half the int8 HBM traffic."""
+    read, so int4 weights cost half the int8 HBM traffic.
+
+    ``n_in`` must be recoverable from the packed rows (``2*rows`` or
+    ``2*rows - 1`` — the odd case carries one pad nibble): anything
+    else means the caller's ``in_features`` does not belong to this
+    pack, and silently returning ``2*rows`` rows (the old behavior)
+    hands back a weight matrix of the WRONG shape."""
+    n_in = int(n_in)
+    if not (0 < n_in <= 2 * p.shape[0]) or n_in < 2 * p.shape[0] - 1:
+        raise ValueError(
+            f"_unpack_int4: {p.shape[0]} packed rows hold "
+            f"{2 * p.shape[0] - 1} or {2 * p.shape[0]} values, not "
+            f"in_features={n_in}")
     lo = jnp.right_shift(jnp.left_shift(p, 4), 4)
     hi = jnp.right_shift(p, 4)
     w = jnp.stack([lo, hi], axis=1).reshape(-1, p.shape[-1])
@@ -84,18 +112,62 @@ def weight_dequantize(qw, scale, algo="weight_only_int8",
 @primitive("weight_only_linear")
 def weight_only_linear(x, qweight, scale, bias=None,
                        weight_dtype="int8"):
-    """y = x @ dequant(qweight) + bias; the dequant (and for int4 the
-    nibble unpack) feeds the MXU matmul directly — one fused HBM pass
-    under XLA at the quantized byte width."""
+    """y = x @ dequant(qweight) + bias.  int8 routes through the Pallas
+    fused dequant-matmul (``ops/pallas/quant_matmul.weight_only_matmul``:
+    int8 weight reads, f32 accumulate, per-channel scale applied after
+    the K reduction — one HBM pass at a quarter of the float bytes; the
+    unjitted jnp twin serves CPU bitwise).  int4 unpacks nibbles into
+    the matmul under XLA fusion as before (the packed layout's gather
+    does not fit the blocked kernel's weight tiles)."""
     if weight_dtype in ("int4", "weight_only_int4"):
         w = _unpack_int4(qweight, x.shape[-1]).astype(x.dtype) \
             * scale.astype(x.dtype)
-    else:
-        w = qweight.astype(x.dtype) * scale.astype(x.dtype)
-    y = x @ w
-    if bias is not None:
-        y = y + bias
-    return y
+        y = x @ w
+        if bias is not None:
+            y = y + bias
+        return y
+    from ..ops.pallas.quant_matmul import weight_only_matmul
+    return weight_only_matmul(x, qweight.astype(jnp.int8), scale,
+                              bias=bias)
+
+
+# --- int8 KV-cache quantization (serving) ----------------------------------
+#
+# The ONE home of the KV page-pool quantization arithmetic: the serving
+# engine's write path (models/generation.ragged_paged_step /
+# paged_slot_attention), the ragged paged-attention kernel's in-DMA
+# dequant, and the parity tests all use these two functions, so the
+# bytes written and the bytes the kernel reconstructs cannot drift.
+#
+# Granularity: one absmax scale per (kv head, token slot) — i.e. each
+# page carries a small per-page scale VECTOR ([page_size] per head)
+# riding in a side-pool indexed by the same block tables as the data
+# page.  Per-slot scales keep quantization a pure function of that
+# token's K/V vector: a page filled by one prefill chunk, by two
+# chunked-prefill steps, or token-by-token by decode holds IDENTICAL
+# bytes, which is what lets prefix-cache hits, COW copies and
+# preempt-requeue restores stay exact under quantization (a single
+# per-page scalar would force requantizing resident tokens on every
+# decode append — write-history-dependent bytes and compounding error).
+
+KV_QUANT_QMAX = 127.0
+
+
+def kv_quantize(x):
+    """[..., D] float K/V vectors -> (int8 [..., D], f32 scales [...]).
+    Symmetric absmax per vector; all-zero vectors get scale 1 so the
+    roundtrip stays exact."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    sc = jnp.where(amax > 0, amax / KV_QUANT_QMAX, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / sc[..., None]),
+                 -KV_QUANT_QMAX, KV_QUANT_QMAX).astype(jnp.int8)
+    return q, sc.astype(jnp.float32)
+
+
+def kv_dequantize(q, sc):
+    """Inverse of :func:`kv_quantize` (up to the int8 grid): int8
+    [..., D] * f32 scales [...] -> f32 [..., D]."""
+    return q.astype(jnp.float32) * sc.astype(jnp.float32)[..., None]
 
 
 # --- fake quant (QAT) ------------------------------------------------------
@@ -277,8 +349,56 @@ class PTQ:
     convert = QAT.convert
 
 
+# --- weight-only serving path ----------------------------------------------
+
+class WeightOnlyLinear(Layer):
+    """Inference replica of ``nn.Linear`` over pre-quantized int8/int4
+    weights: forward is :func:`weight_only_linear`, i.e. the Pallas
+    fused dequant-matmul for int8.  The quantized weight and scale are
+    plain (non-parameter) tensors — an optimizer never sees them, and
+    the jit capture funnel threads them like any other referenced
+    tensor, so a swapped model serves through ``models.generate`` and
+    the continuous-batching engine unchanged."""
+
+    def __init__(self, linear, algo="weight_only_int8"):
+        super().__init__()
+        qw, scale = weight_quantize(linear.weight, algo=algo)
+        self.qweight = qw
+        self.scale = scale
+        self.bias = linear.bias
+        self.weight_dtype = ("int4" if algo == "weight_only_int4"
+                             else "int8")
+        self.in_features = int(linear.weight.shape[0])
+        self.out_features = int(linear.weight.shape[1])
+
+    def forward(self, x):
+        return weight_only_linear(x, self.qweight, self.scale, self.bias,
+                                  weight_dtype=self.weight_dtype)
+
+
+def weight_only_quantize(model: Layer, algo="weight_only_int8",
+                         min_features: int = 1) -> Layer:
+    """Swap every ``nn.Linear`` under ``model`` (in place) for a
+    :class:`WeightOnlyLinear` holding int8 (or packed int4) weights +
+    per-out-channel scales — the ``models/`` weight-only generation
+    path: the returned model's decode/prefill matmuls all route through
+    the fused dequant-matmul kernel.  ``min_features`` skips layers
+    whose input dim is below it (tiny projections gain nothing)."""
+    from ..nn.layers import Linear
+    for name, child in list(model._sub_layers.items()):
+        if isinstance(child, Linear) \
+                and child.weight.shape[0] >= min_features:
+            model._sub_layers[name] = WeightOnlyLinear(child, algo=algo)
+        else:
+            weight_only_quantize(child, algo=algo,
+                                 min_features=min_features)
+    return model
+
+
 __all__ = [
     "QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
     "AbsmaxObserver", "QuantedLinear", "QuantedConv2D", "fake_quant",
     "weight_quantize", "weight_dequantize", "weight_only_linear",
+    "kv_quantize", "kv_dequantize", "WeightOnlyLinear",
+    "weight_only_quantize",
 ]

@@ -502,3 +502,120 @@ def test_pages_per_block_heuristic_and_candidates():
     cands = _tune_candidates(16, 128, 64)
     assert cands[0] == 1 and all(b == a * 2 for a, b in
                                  zip(cands, cands[1:]))
+
+
+# --------------------------------------------------------------------------
+# int8 KV pages with in-kernel dequant (ISSUE 7)
+# --------------------------------------------------------------------------
+
+def _quant_pools(rng, lens, hk, ps, d):
+    """Shuffled-page pools like ``_paged_setup``, plus their int8
+    quantization (``quantization.kv_quantize``)."""
+    from paddle_tpu.quantization import kv_quantize
+
+    pk, pv, bt = _paged_setup(rng, lens, hk, ps, d)
+    qk, sk = kv_quantize(jnp.asarray(pk))
+    qv, sv = kv_quantize(jnp.asarray(pv))
+    return pk, pv, bt, qk, sk, qv, sv
+
+
+@pytest.mark.parametrize("hq,hk,ps,lens,q_lens,ppb", [
+    (4, 2, 8, [13, 6, 21, 1], [5, 1, 9, 1], 2),  # mixed prefill+decode
+    (8, 2, 16, [1, 30, 17], [1, 1, 1], 2),       # GQA decode
+])
+def test_ragged_int8_kernel_bitwise_vs_dequant(hq, hk, ps, lens,
+                                               q_lens, ppb):
+    """The quant kernel's contract: int8 pages + per-slot scales through
+    the in-DMA dequant must be BITWISE what the fp kernel computes on
+    the dequantized pools (same f32 values entering the same flash
+    recurrence), and within int8 error of the original fp pools."""
+    from paddle_tpu.quantization import kv_dequantize
+
+    rng = np.random.default_rng(5)
+    d, qb = 16, 2
+    B = len(lens)
+    pk, pv, bt, qk, sk, qv, sv = _quant_pools(rng, lens, hk, ps, d)
+    segs = [-(-ql // qb) * qb for ql in q_lens]
+    starts = np.cumsum([0] + segs[:-1])
+    q = np.zeros((sum(segs), hq, d), np.float32)
+    for b in range(B):
+        q[starts[b]:starts[b] + q_lens[b]] = rng.normal(
+            size=(q_lens[b], hq, d))
+    args = (jnp.asarray(bt), jnp.asarray(lens, dtype=jnp.int32),
+            jnp.asarray(q_lens, dtype=jnp.int32))
+    out_q = np.asarray(pga.ragged_paged_attention(
+        jnp.asarray(q), qk, qv, *args, q_block=qb, pages_per_block=ppb,
+        interpret=True, k_scales=sk, v_scales=sv))
+    out_deq = np.asarray(pga.ragged_paged_attention(
+        jnp.asarray(q), kv_dequantize(qk, sk), kv_dequantize(qv, sv),
+        *args, q_block=qb, pages_per_block=ppb, interpret=True))
+    out_fp = np.asarray(pga.ragged_paged_attention(
+        jnp.asarray(q), jnp.asarray(pk), jnp.asarray(pv), *args,
+        q_block=qb, pages_per_block=ppb, interpret=True))
+    rows = np.concatenate([np.arange(starts[b], starts[b] + q_lens[b])
+                           for b in range(B)])
+    np.testing.assert_array_equal(out_q[rows], out_deq[rows])  # bitwise
+    # int8 absmax per-vector: softmax-weighted values stay close
+    np.testing.assert_allclose(out_q[rows], out_fp[rows], atol=0.05,
+                               rtol=0.05)
+
+
+def test_ragged_int8_exact_grid_is_bitwise_vs_fp():
+    """KV values on the int8 grid (v = n * s with s an exact binary
+    scale) quantize losslessly, so the QUANT kernel must reproduce the
+    FP kernel's output bit for bit — pinning that the dequant multiply
+    sits before the dots exactly where the fp path casts."""
+    rng = np.random.default_rng(9)
+    hq = hk = 2
+    ps, d, qb, ppb = 8, 16, 2, 2
+    lens, q_lens = [11, 7], [3, 1]
+    s = 2.0 ** -5                       # exact in fp32
+    B = len(lens)
+    NP = -(-max(lens) // ps) + 1
+    total = B * NP + 2
+    ints = rng.integers(-127, 128, size=(hk, total, ps, d))
+    ints2 = rng.integers(-127, 128, size=(hk, total, ps, d))
+    # pin every vector's absmax at 127 so kv_quantize's scale is
+    # EXACTLY s (127*s/127) and the int8 roundtrip is lossless
+    ints[..., 0] = 127
+    ints2[..., 0] = -127
+    pk = (ints * s).astype(np.float32)
+    pv = (ints2 * s).astype(np.float32)
+    from paddle_tpu.quantization import kv_quantize
+    qk, sk = kv_quantize(jnp.asarray(pk))
+    qv, sv = kv_quantize(jnp.asarray(pv))
+    np.testing.assert_array_equal(
+        np.asarray(qk, np.float32) * np.asarray(sk)[..., None], pk)
+    bt = np.zeros((B, NP), np.int32)
+    ids = np.arange(1, total)
+    rng.shuffle(ids)
+    n = 0
+    for b in range(B):
+        need = -(-lens[b] // ps)
+        bt[b, :need] = ids[n:n + need]
+        n += need
+    segs = [-(-ql // qb) * qb for ql in q_lens]
+    starts = np.cumsum([0] + segs[:-1])
+    q = rng.normal(size=(sum(segs), hq, d)).astype(np.float32)
+    args = (jnp.asarray(bt), jnp.asarray(lens, dtype=jnp.int32),
+            jnp.asarray(q_lens, dtype=jnp.int32))
+    out_q = np.asarray(pga.ragged_paged_attention(
+        jnp.asarray(q), qk, qv, *args, q_block=qb, pages_per_block=ppb,
+        interpret=True, k_scales=sk, v_scales=sv))
+    out_fp = np.asarray(pga.ragged_paged_attention(
+        jnp.asarray(q), jnp.asarray(pk), jnp.asarray(pv), *args,
+        q_block=qb, pages_per_block=ppb, interpret=True))
+    rows = np.concatenate([np.arange(starts[b], starts[b] + q_lens[b])
+                           for b in range(len(lens))])
+    np.testing.assert_array_equal(out_q[rows], out_fp[rows])
+
+
+def test_ragged_int8_requires_both_scales():
+    rng = np.random.default_rng(1)
+    pk, pv, bt, qk, sk, qv, sv = _quant_pools(rng, [9], 2, 8, 16)
+    with pytest.raises(ValueError, match="both"):
+        pga.ragged_paged_attention(
+            jnp.asarray(rng.normal(size=(2, 2, 16)), jnp.float32),
+            qk, qv, jnp.asarray(bt), jnp.asarray([9], dtype=jnp.int32),
+            jnp.asarray([2], dtype=jnp.int32), q_block=2,
+            pages_per_block=1, interpret=True, k_scales=sk)

@@ -1,6 +1,7 @@
 """Quantization tests (reference patterns: ``test/quantization/test_qat.py``,
 ``test_ptq.py``, ``test_weight_only_linear.py``)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -129,3 +130,165 @@ def test_weight_only_int4_odd_in_features():
     assert list(deq.shape) == [7, 5]
     err = np.abs(deq.numpy() - w.numpy())
     assert (err <= scale.numpy()[None, :] * 0.5 + 1e-6).all()
+
+
+# ----------------------------------------------------------------------
+# int4 pack/unpack hardening (ISSUE 7 satellite): odd lengths, negative
+# nibbles, end-to-end quantize/dequantize parity, misuse guards
+# ----------------------------------------------------------------------
+
+def test_int4_pack_unpack_property_roundtrip():
+    """Every nibble value (-8..7) through every odd/even row count:
+    _unpack_int4(_pack_int4(q)) must be the identity.  Negative values
+    exercise the arithmetic-shift sign extension and the two's-
+    complement low-nibble mask."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.quantization import _pack_int4, _unpack_int4
+
+    rng = np.random.default_rng(0)
+    # exhaustive value sweep in one column
+    q = jnp.asarray(np.arange(-8, 8, dtype=np.int8).reshape(16, 1))
+    np.testing.assert_array_equal(
+        np.asarray(_unpack_int4(_pack_int4(q), 16)), np.asarray(q))
+    for rows in (1, 2, 3, 7, 8, 17):
+        for cols in (1, 3, 8):
+            qv = rng.integers(-8, 8, size=(rows, cols)).astype(np.int8)
+            got = np.asarray(_unpack_int4(_pack_int4(jnp.asarray(qv)),
+                                          rows))
+            np.testing.assert_array_equal(got, qv)
+
+
+def test_int4_unpack_rejects_wrong_in_features():
+    """The old silent truncation/padding is now a coded refusal: an
+    ``in_features`` that cannot belong to the packed rows raises
+    instead of returning a wrong-shaped weight."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.quantization import _pack_int4, _unpack_int4
+
+    p = _pack_int4(jnp.asarray(np.zeros((7, 3), np.int8)))  # 4 rows
+    for bad in (0, 5, 9, 100):
+        with pytest.raises(ValueError, match="in_features"):
+            _unpack_int4(p, bad)
+    with pytest.raises(ValueError, match="in_features"):
+        weight_dequantize(paddle.to_tensor(np.asarray(p)),
+                          paddle.to_tensor(np.ones(3, np.float32)),
+                          algo="weight_only_int4", in_features=20)
+
+
+def test_int4_weight_quantize_dequantize_e2e_parity():
+    """quantize -> dequantize -> re-quantize is a FIXED POINT (same
+    int codes, same scales): the pack/unpack and the scale arithmetic
+    are mutually consistent end to end, negatives included."""
+    rng = np.random.default_rng(1)
+    for shape in ((7, 5), (16, 8), (1, 1), (2, 3)):
+        w = paddle.to_tensor(rng.normal(size=shape).astype(np.float32))
+        qw, s = weight_quantize(w, algo="weight_only_int4")
+        deq = weight_dequantize(qw, s, algo="weight_only_int4",
+                                in_features=shape[0])
+        assert tuple(deq.shape) == shape
+        qw2, s2 = weight_quantize(deq, algo="weight_only_int4")
+        np.testing.assert_array_equal(qw.numpy(), qw2.numpy())
+        np.testing.assert_allclose(s.numpy(), s2.numpy(), rtol=1e-6)
+
+
+# ----------------------------------------------------------------------
+# fused weight-only int8 matmul (ISSUE 7 tentpole): kernel-vs-twin
+# bitwise in interpret mode, fused routing of weight_only_linear
+# ----------------------------------------------------------------------
+
+def test_quant_matmul_kernel_bitwise_vs_jnp_twin():
+    """Interpret-mode kernel == the unjitted jnp twin replaying the
+    kernel's exact tile walk, BITWISE, across aligned, padded and
+    K-gridded geometries (the fused-optimizer parity contract)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas import quant_matmul as qm
+
+    rng = np.random.default_rng(2)
+    for (m, k, n) in ((8, 128, 128), (32, 256, 384), (24, 384, 640),
+                      (16, 130, 200), (3, 70, 33)):
+        x = jnp.asarray(rng.normal(size=(m, k)), jnp.float32)
+        w = jnp.asarray(rng.integers(-127, 128, size=(k, n)), jnp.int8)
+        s = jnp.asarray(rng.uniform(0.01, 0.1, size=(n,)), jnp.float32)
+        mp = qm._round_up(m, 8)
+        kp = qm._round_up(k, 128)
+        npad = qm._round_up(n, 128)
+        xp = jnp.pad(x, ((0, mp - m), (0, kp - k)))
+        wp = jnp.pad(w, ((0, kp - k), (0, npad - n)))
+        sp = jnp.pad(s, (0, npad - n))
+        blocks = qm.pick_blocks(mp, kp, npad)
+        ref = qm.quant_matmul_jnp(xp, wp, sp, blocks=blocks)[:m, :n]
+        got = qm.weight_only_matmul(x, w, s, impl="pallas_interpret")
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    # K-grid accumulation path (bk < K): force a small bk bound
+    old = qm._MAX_BK
+    qm._MAX_BK = 128
+    try:
+        x = jnp.asarray(rng.normal(size=(8, 512)), jnp.float32)
+        w = jnp.asarray(rng.integers(-127, 128, size=(512, 128)),
+                        jnp.int8)
+        s = jnp.ones((128,), jnp.float32)
+        blocks = qm.pick_blocks(8, 512, 128)
+        assert blocks[2] < 512            # really multi-step over K
+        ref = qm.quant_matmul_jnp(x, w, s, blocks=blocks)
+        got = qm.weight_only_matmul(x, w, s, impl="pallas_interpret")
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    finally:
+        qm._MAX_BK = old
+
+
+def test_quant_matmul_blocks_divide_padded_problem():
+    from paddle_tpu.ops.pallas import quant_matmul as qm
+
+    for (m, k, n) in ((8, 128, 128), (24, 384, 640), (256, 2176, 512),
+                      (8, 2048, 512), (8, 4096, 50304 // 128 * 128)):
+        bm, bn, bk = qm.default_blocks(m, k, n)
+        assert m % bm == 0 and n % bn == 0 and k % bk == 0
+        # x + w(int8 + f32 cast) + acc tiles honor the cap (except the
+        # bn=128 floor, which is the minimum legal lane tile)
+        assert bn == 128 or (bm * bk + bk * bn * 2 + bm * bn) * 4 \
+            <= qm._VMEM_CAP_BYTES
+
+
+def test_weight_only_linear_routes_through_fused_matmul():
+    """The primitive's int8 path is the fused kernel's jnp twin on CPU:
+    (x @ q) * s with f32 accumulation — equal to the dequant-then-
+    matmul reference within fp rounding, bias and 3-D x included."""
+    rng = np.random.default_rng(3)
+    x = paddle.to_tensor(rng.normal(size=(2, 5, 16)).astype(np.float32))
+    w = paddle.to_tensor(rng.normal(size=(16, 8)).astype(np.float32))
+    b = paddle.to_tensor(rng.normal(size=(8,)).astype(np.float32))
+    qw, s = weight_quantize(w)
+    y = weight_only_linear(x, qw, s, b)
+    assert tuple(y.shape) == (2, 5, 8)
+    ref = (x.numpy() @ (qw.numpy().astype(np.float32) * s.numpy())
+           + b.numpy())
+    np.testing.assert_allclose(y.numpy(), ref, atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# int8 KV quantization helpers (serving write path's one home)
+# ----------------------------------------------------------------------
+
+def test_kv_quantize_roundtrip_and_determinism():
+    import jax.numpy as jnp
+
+    from paddle_tpu.quantization import kv_dequantize, kv_quantize
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(2, 9, 16)), jnp.float32)
+    q, s = kv_quantize(x)
+    assert str(q.dtype) == "int8" and s.shape == (2, 9)
+    # absmax symmetric: error bounded by scale/2 per element
+    err = np.abs(np.asarray(kv_dequantize(q, s)) - np.asarray(x))
+    assert (err <= np.asarray(s)[..., None] * 0.5 + 1e-7).all()
+    # pure per-vector function: bytes independent of batching/order
+    q2, s2 = kv_quantize(x[:, 3:4])
+    np.testing.assert_array_equal(np.asarray(q[:, 3:4]), np.asarray(q2))
+    np.testing.assert_array_equal(np.asarray(s[:, 3:4]), np.asarray(s2))
+    # zero vectors: scale 1, exact zeros back
+    qz, sz = kv_quantize(jnp.zeros((3, 4)))
+    assert (np.asarray(sz) == 1.0).all()
+    assert (np.asarray(kv_dequantize(qz, sz)) == 0.0).all()

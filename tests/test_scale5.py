@@ -195,11 +195,17 @@ def test_zero_mp_pp_1f1b_single_layout():
     assert "mp" in spec and "pp" in spec, spec
 
 
+@pytest.mark.slow
 def test_gpt13b_capture_path_aot_lowering():
     """VERDICT r4 item 9: the framework's OWN capture path — LazyGuard
     GPTForCausalLM + shard_gpt + AMP O2 + ZeRO-1 + jit.aot_lower — must
     lower and compile at the 13B config on 32 virtual devices with the
-    same HBM fit (fresh process: needs 32 devices)."""
+    same HBM fit (fresh process: needs 32 devices).
+
+    ``slow``: a 13B lowering in a fresh 32-device CPU subprocess is the
+    single most expensive test in the repo (~6 min alone — nearly half
+    the tier-1 870s budget, which was clipping the trailing vision
+    files; PR7 budget audit); run it with ``-m slow``."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=32"

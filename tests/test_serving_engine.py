@@ -11,18 +11,14 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.inference import ContinuousBatchingEngine
 from paddle_tpu.models import generate
-from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
 
 @pytest.fixture(scope="module")
-def gpt():
-    paddle.seed(0)
-    m = GPTForCausalLM(GPTConfig(
-        vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
-        max_seq_len=64, dropout=0.0))
-    m.eval()
-    return m
+def gpt(serving_gpt):
+    # the session-scoped tiny model (tests/conftest.py): its compiled
+    # program caches are shared with test_quant_serving.py
+    return serving_gpt
 
 
 def _refs(model, prompts, new):
