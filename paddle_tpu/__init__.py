@@ -37,6 +37,7 @@ from . import metric  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import distribution  # noqa: F401
+from . import observability  # noqa: F401
 from . import profiler  # noqa: F401
 from . import device  # noqa: F401
 from .device import set_device, get_device  # noqa: F401

@@ -596,6 +596,96 @@ def check_dequant_then_matmul(fndef, ctx):
             yield node, msg
 
 
+# call names that read as "logging": the sink whose arguments PDT112
+# scans for device->host syncs. Bare names take only the unambiguous
+# spellings; dotted chains match logger METHOD names on the last part
+# (logger.info / self.log.debug) — deliberately NOT "log", which as an
+# attribute is overwhelmingly math (math.log/np.log/jnp.log), where
+# the sync is a real data dependency the check must not flag.
+_LOG_SINK_BARE = {"print", "log"}
+_LOG_SINK_METHODS = {"info", "debug", "warning", "error", "critical",
+                     "exception"}
+_HOST_SYNC_LOOP_METHODS = {"item", "numpy", "tolist"}
+
+
+@register(
+    "PDT112", "host-sync-in-loop", Severity.NOTE, "ast", scope="eager",
+    example="""
+import paddle_tpu as paddle
+
+def train(model, batches):
+    for x in batches:
+        loss = model(x).mean()
+        print("loss:", float(loss))
+""",
+    near_miss="""
+import math
+import paddle_tpu as paddle
+
+def train(model, batches):
+    for x in batches:
+        loss = model(x).mean()
+        scale = math.log(float(loss))     # math, not logging
+        if float(loss) < 0.1:
+            break
+""")
+def check_host_sync_in_loop(fndef, ctx):
+    """``float(x)`` / ``x.item()`` / ``x.numpy()`` / ``x.tolist()``
+    feeding a logging call (``print`` / ``log.info`` / ...) inside a
+    training or serving loop body: each one blocks the host on a
+    device->host transfer EVERY iteration, purely to print a number —
+    on a network-attached TPU that is a full round-trip per step.
+    ``paddle_tpu.observability`` gauges read LAZILY (the value is
+    fetched at snapshot/render time, not in the loop), so telemetry
+    costs the loop nothing; syncs that feed control flow (early
+    stopping on ``float(loss)``) are real data dependencies and are
+    not flagged.  Note-level advice, not an error."""
+
+    def _sync_desc(node):
+        """The sync expression inside a log-call argument, or None."""
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) \
+                    and f.attr in _HOST_SYNC_LOOP_METHODS \
+                    and not node.args and not node.keywords:
+                return f".{f.attr}()"
+            if isinstance(f, ast.Name) and f.id == "float" \
+                    and len(node.args) == 1 and not node.keywords:
+                return "float()"
+        return None
+
+    for loop in _walk_fn(fndef):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        # own-scope walk of the loop body (nested defs lint themselves)
+        stack = list(ast.iter_child_nodes(loop))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(sub))
+            if not isinstance(sub, ast.Call):
+                continue
+            fname = (_dotted(sub.func) or "").split(".")[-1]
+            is_sink = (fname in _LOG_SINK_BARE
+                       if isinstance(sub.func, ast.Name)
+                       else fname in _LOG_SINK_METHODS)
+            if not is_sink:
+                continue
+            for arg in sub.args + [kw.value for kw in sub.keywords]:
+                for inner in ast.walk(arg):
+                    desc = _sync_desc(inner)
+                    if desc is not None:
+                        yield inner, (
+                            f"{desc} inside a loop body feeds only "
+                            f"{fname}(): that is one device->host sync "
+                            f"per iteration spent on logging — record "
+                            f"into a paddle_tpu.observability gauge/"
+                            f"histogram instead (gauges read lazily at "
+                            f"snapshot time, so the loop pays nothing)")
+                        break  # one finding per log-call argument
+
+
 # constant values that disable the engine's prefix cache — the string
 # spellings are the engine's case-insensitive parse set
 _PREFIX_CACHE_OFF = (False, 0) + PREFIX_CACHE_OFF_SPELLINGS

@@ -108,13 +108,19 @@ def apply(name: str, fn: Callable, *args, **kwargs):
     unwrapped to its value (read through the jit tracker) but NOT
     differentiated — ops must take differentiable operands positionally.
     """
-    if _profile_hook is not None:
+    hook = _profile_hook   # local: the profiler may clear it mid-op
+    if hook is not None:
         import time as _time
         _t0 = _time.perf_counter_ns()
         try:
             return _apply(name, fn, *args, **kwargs)
         finally:
-            _profile_hook(name, _t0, _time.perf_counter_ns())
+            # an observer must never fail the op it observes: a raising
+            # hook would mask the op's own result/exception
+            try:
+                hook(name, _t0, _time.perf_counter_ns())
+            except Exception:
+                pass
     return _apply(name, fn, *args, **kwargs)
 
 

@@ -138,6 +138,21 @@ for _env_name in ("PDTPU_SERVING_KV_QUANT", "PDTPU_KV_QUANT"):
         elif _env_kvq.lower() in KV_QUANT_OFF_SPELLINGS:
             _FLAGS["serving_kv_quant"] = False
 del _env_name, _env_kvq
+define_flag("metrics", True,
+            "observability runtime (paddle_tpu.observability): metrics "
+            "registry recording, structured-event ring buffer, serving "
+            "timelines, training step telemetry and flight-recorder "
+            "dumps. PDTPU_METRICS=off makes every record call a "
+            "near-no-op (one dict lookup) and restores the "
+            "pre-observability behavior bitwise; counters that back "
+            "the serving engine's stats contract are created with "
+            "always=True and keep recording either way.")
+define_flag("metrics_log_every", 0,
+            "training StepTimer one-line log cadence: every N train "
+            "steps hapi.Model.fit logs step wall-time, tokens/sec, "
+            "MFU estimate and retrace count through the "
+            "'paddle_tpu.observability' logger. 0 (default) = no "
+            "periodic log; the gauges/histograms record regardless.")
 define_flag("while_grad_max_trip_count", 256,
             "trip bound for differentiable while_loop under jit capture "
             "(lowered to a masked lax.scan; XLA has no reverse-mode "

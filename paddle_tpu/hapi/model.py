@@ -291,6 +291,14 @@ class Model:
             if installed:
                 _preempt.clear()
         self.stop_training = False
+        # training step telemetry (ISSUE 8, observability.StepTimer):
+        # step wall-time histogram, tokens/sec + MFU gauges, and a
+        # retrace counter over the compiled train step — recorded into
+        # the process-global registry; near-no-op with PDTPU_METRICS=off
+        from ..observability import StepTimer
+        self._step_timer = StepTimer(n_params=sum(
+            int(np.prod([int(s) for s in p.shape]) or 1)
+            for p in self.network.parameters()))
         try:
             cbks.on_train_begin()
             logs = {}
@@ -298,6 +306,9 @@ class Model:
             self._window_fallback_warned = False  # warn once per fit
             for epoch in range(start_epoch, epochs):
                 cbks.on_epoch_begin(epoch)
+                # re-arm the step clock: the gap since last epoch's end
+                # (eval pass, checkpoint write) is not a train step
+                self._step_timer.mark()
                 for m in self._metrics:
                     m.reset()
                 logs = {}
@@ -320,6 +331,7 @@ class Model:
                                                update=update)
                         logs = self._make_logs(res)
                         cbks.on_train_batch_end(step, logs)
+                        self._note_train_step(inputs)
                         it += 1
                         if update:
                             if self._maybe_preempt(mgr, epoch, step + 1,
@@ -406,6 +418,7 @@ class Model:
             res = self.train_batch(inputs, labels)
             logs = self._make_logs(res)
             cbks.on_train_batch_end(step, logs)
+            self._note_train_step(inputs)
             step += 1
             it += 1
             self._maybe_preempt(mgr, epoch, step, it, epoch_steps=esteps)
@@ -455,6 +468,7 @@ class Model:
                 metrics = self._update_metrics(outputs, label_lists[k])
                 logs = self._make_logs([loss_val] + metrics)
                 cbks.on_train_batch_end(step, logs)
+                self._note_train_step(poisoned[k][0])
                 step += 1
                 it += 1
                 # synthetic preemption keyed on each step's number still
@@ -572,6 +586,44 @@ class Model:
                 f"dispatch ({reason}); throughput will be the "
                 "per-batch path's", RuntimeWarning, stacklevel=3)
         return False
+
+    # -- observability (step telemetry) --------------------------------
+    def _train_trace_count(self):
+        """Total XLA (re)traces of the compiled train step — the
+        StepTimer turns increases past the first compile into the
+        ``train.retraces`` counter (a steady-state increment is the
+        shape/state-churn regression the jit guards warn about)."""
+        sf = self._train_step
+        sf = sf if hasattr(sf, "_cache") else getattr(
+            sf, "__wrapped__", sf)
+        cache = getattr(sf, "_cache", None) or {}
+        return sum(getattr(e, "trace_count", 0)
+                   for e in cache.values())
+
+    def _note_train_step(self, inputs):
+        """One completed train step for the StepTimer: tokens from the
+        first input's element count (batch x seq for an LM — the
+        standard throughput denominator), retraces from the compiled
+        step. Near-no-op when PDTPU_METRICS=off."""
+        st = getattr(self, "_step_timer", None)
+        if st is None:
+            return
+        from ..observability import metrics as _obs_metrics
+        if not _obs_metrics.enabled():
+            # honor the flag's near-no-op contract BEFORE the jit-cache
+            # walk and token math below — off must cost one dict lookup
+            st.step()
+            return
+        toks = None
+        first = _to_list(inputs)
+        if first:
+            shp = getattr(first[0], "shape", None)
+            if shp is not None:
+                try:
+                    toks = int(np.prod([int(s) for s in shp])) or None
+                except (TypeError, ValueError):
+                    toks = None
+        st.step(tokens=toks, trace_count=self._train_trace_count())
 
     # -- resilience (preemption, resume, fault hooks) ------------------
     @property

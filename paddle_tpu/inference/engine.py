@@ -121,6 +121,28 @@ default off):
   parity suite (int8 absmax per-vector error does not flip tiny-model
   argmax); with the flag off the engine is bitwise-identical to the
   pre-quantization fp path.
+
+Observability (ISSUE 8; ``paddle_tpu.observability``):
+
+* The engine's counters are RE-BACKED by a private metrics registry —
+  ``stats`` keeps its exact pre-existing keys/values (always-on
+  counters; the ``PDTPU_METRICS`` flag cannot zero the contract) while
+  ``metrics()`` returns the full snapshot: the counters plus derived
+  per-request timelines (queue-time, TTFT, TPOT,
+  decode-tokens-per-window and per-dispatch latency histograms,
+  finish-reason-labeled counters).  Phase attribution NEEDS engine
+  events: prefill chunks and decodes share one ragged dispatch, so
+  wrapping calls with host timers cannot tell requests apart.
+* Scheduling emits structured events (enqueued / admitted /
+  prefill_chunk / first_token / decode_window / preempted / retired,
+  plus dispatch kinds) into the process event ring; coded failures —
+  the decode guard's ``NonFiniteLogitsError``, a
+  ``CacheIntegrityError`` page-conservation violation, the pool
+  backstop — dump the ring as a JSON flight record
+  (``PDTPU_FLIGHT_DIR``), so the postmortem starts from the last N
+  events.  Clean runs dump nothing; ``PDTPU_METRICS=off`` restores
+  the pre-observability engine bitwise (serving_bench's
+  ``metrics_overhead`` row pins the on state at <= 3% tokens/sec).
 """
 from __future__ import annotations
 
@@ -132,8 +154,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.errors import PageBudgetError, QueueFullError
+from ..core.errors import (CacheIntegrityError, PageBudgetError,
+                           QueueFullError)
 from ..core.tensor import Tensor
+from ..observability import Registry as _ObsRegistry
+from ..observability import flight as _flight
+from ..observability import metrics as _obs_metrics
+from ..observability.serving import RegistryCounters, ServingTimelines
 from ..resilience import faults
 from ..resilience.serving import (SITE_PAGE_PRESSURE, DecodeGuard,
                                   dispatch_retry)
@@ -347,30 +374,55 @@ class ContinuousBatchingEngine:
         self._mixed_fn = None
         self._cow_fn = None
         self._decode_exe = None
-        # counters; the ``stats`` property adds the live gauges
-        self._stats = {"admitted": 0, "retired": 0, "steps": 0,
-                       "mixed_steps": 0, "decode_dispatches": 0,
-                       "tokens_generated": 0, "pages_allocated": 0,
-                       "peak_pages_in_use": 0, "preemptions": 0,
-                       "timeouts": 0, "cancelled": 0, "failed": 0,
-                       "rejected": 0, "retries": 0, "cache_hits": 0,
-                       "cache_hit_tokens": 0,
-                       "prefill_tokens_requested": 0,
-                       "prefill_tokens_computed": 0}
+        # counters, RE-BACKED by a private observability registry
+        # (ISSUE 8): the ``stats`` property reads the same keys/values
+        # as the old plain dict (always=True counters — the stats
+        # contract predates the metrics flag), while ``metrics()``
+        # exposes them alongside the timeline histograms.  The registry
+        # is per-engine so concurrent engines never alias counters.
+        self._registry = _ObsRegistry("serving_engine")
+        self._stats = RegistryCounters(self._registry, (
+            "admitted", "retired", "steps", "mixed_steps",
+            "decode_dispatches", "tokens_generated", "pages_allocated",
+            "peak_pages_in_use", "preemptions", "timeouts", "cancelled",
+            "failed", "rejected", "retries", "cache_hits",
+            "cache_hit_tokens", "prefill_tokens_requested",
+            "prefill_tokens_computed"))
+        # per-request serving timelines (queue/TTFT/TPOT histograms +
+        # structured events for the flight recorder), on the engine's
+        # deadline clock so tests can drive them deterministically
+        self._tl = ServingTimelines(self._registry, clock=self._clock)
+        # live gauges read LAZILY at snapshot time (no work per step)
+        reg = self._registry
+        reg.gauge("serving.pages_in_use").set_function(
+            self._pages_in_use)
+        reg.gauge("serving.pages_free").set_function(
+            lambda: len(self._free_pages))
+        reg.gauge("serving.cached_pages").set_function(
+            lambda: self._cache.cached_pages)
+        reg.gauge("serving.queue_depth").set_function(
+            lambda: len(self._queue))
+        reg.gauge("serving.kv_page_bytes").set_function(
+            lambda: self._page_bytes)
 
     # ------------------------------------------------------------ API --
+    def _pages_in_use(self) -> int:
+        """Pages held by resident slots: the usable pool minus free
+        minus cached — ONE home for the formula (the stats property,
+        the lazy gauge and peak tracking all read it here)."""
+        return (self.total_pages - 1 - len(self._free_pages)
+                - self._cache.cached_pages)
+
     @property
     def stats(self):
         """Health snapshot: the lifetime counters plus live gauges
         (``pages_in_use``/``pages_free``/``cached_pages``/
         ``queue_depth``).  ``pages_in_use + pages_free + cached_pages``
         always sums to the usable pool (``total_pages - 1``)."""
-        d = dict(self._stats)
+        d = self._stats.as_dict()
         d["cached_pages"] = self._cache.cached_pages
         d["evictions"] = self._cache.evictions
-        d["pages_in_use"] = (self.total_pages - 1
-                             - len(self._free_pages)
-                             - self._cache.cached_pages)
+        d["pages_in_use"] = self._pages_in_use()
         d["pages_free"] = len(self._free_pages)
         d["queue_depth"] = len(self._queue)
         # KV byte accounting (ISSUE 7): per-page bytes across all
@@ -380,6 +432,18 @@ class ContinuousBatchingEngine:
         d["kv_page_bytes"] = self._page_bytes
         d["kv_bytes_in_use"] = d["pages_in_use"] * self._page_bytes
         return d
+
+    def metrics(self) -> dict:
+        """Full observability snapshot (nested JSON): every ``stats``
+        counter plus the derived serving timelines — queue-time, TTFT,
+        TPOT and decode-tokens-per-window histograms, finish-reason
+        labeled counters, per-dispatch latency.  See
+        ``paddle_tpu.observability`` for the snapshot format."""
+        return self._registry.snapshot()
+
+    def render_prometheus(self) -> str:
+        """This engine's metrics in Prometheus text format."""
+        return self._registry.render_prometheus()
 
     def add_request(self, prompt, max_new_tokens, eos_token_id=None,
                     request_id=None, deadline_ms=None):
@@ -435,6 +499,7 @@ class ContinuousBatchingEngine:
         self._queue.append(_Request(
             rid, prompt, max_new_tokens,
             -1 if eos_token_id is None else int(eos_token_id), deadline))
+        self._tl.enqueued(rid, prompt.size, max_new_tokens)
         return rid
 
     def cancel(self, rid) -> bool:
@@ -449,6 +514,8 @@ class ContinuousBatchingEngine:
                 self._early.append(CompletedRequest(
                     rid, r.prompt, np.asarray(r.done_toks, np.int32),
                     "cancelled"))
+                self._tl.retired(rid, "cancelled", len(r.done_toks),
+                                 r.preemptions)
                 return True
         for s in self._slots:
             if s.req is not None and s.req.rid == rid and not s.cancelled:
@@ -534,6 +601,8 @@ class ContinuousBatchingEngine:
                                 error)
         if reason != "failed":  # a guard-failed slot's KV is suspect:
             self._publish_slot(b)  # never index poisoned pages
+        self._tl.retired(s.req.rid, reason, int(toks.size),
+                         s.req.preemptions)
         self._release_slot(b)
         return comp
 
@@ -552,6 +621,8 @@ class ContinuousBatchingEngine:
                 s.req.rid, s.req.prompt, np.asarray(toks, np.int32),
                 reason))
             self._publish_slot(b)
+            self._tl.retired(s.req.rid, reason, len(toks),
+                             s.req.preemptions)
             self._release_slot(b)
             self._stats["retired"] += 1
         return out
@@ -569,6 +640,8 @@ class ContinuousBatchingEngine:
                     out.append(CompletedRequest(
                         r.rid, r.prompt,
                         np.asarray(r.done_toks, np.int32), "timeout"))
+                    self._tl.retired(r.rid, "timeout",
+                                     len(r.done_toks), r.preemptions)
                 else:
                     kept.append(r)
             self._queue = kept
@@ -593,10 +666,8 @@ class ContinuousBatchingEngine:
         return max(1, -(-target // self.page_size))
 
     def _note_peak(self):
-        in_use = (self.total_pages - 1 - len(self._free_pages)
-                  - self._cache.cached_pages)
         self._stats["peak_pages_in_use"] = max(
-            self._stats["peak_pages_in_use"], in_use)
+            self._stats["peak_pages_in_use"], self._pages_in_use())
 
     def _admit(self):
         for b, s in enumerate(self._slots):
@@ -655,6 +726,8 @@ class ContinuousBatchingEngine:
             self._stats["admitted"] += 1
             self._stats["pages_allocated"] += len(alloc)
             self._stats["prefill_tokens_requested"] += resume
+            self._tl.admitted(req.rid, b, cached_tokens=prefill_off,
+                              resume_len=resume)
             if prefill_off:
                 self._stats["cache_hits"] += 1
                 self._stats["cache_hit_tokens"] += prefill_off
@@ -685,6 +758,7 @@ class ContinuousBatchingEngine:
         req.done_toks = list(s.out_toks)
         req.preemptions += 1
         self._queue.appendleft(req)
+        self._tl.preempted(req.rid, len(s.out_toks))
         self._publish_slot(b)
         self._release_slot(b)
         self._stats["preemptions"] += 1
@@ -720,7 +794,16 @@ class ContinuousBatchingEngine:
         """One scheduling step: retire, sweep policies, admit, grow/
         preempt, dispatch.  Returns the requests completed by the
         PREVIOUS dispatch plus any policy finalizations (retirement
-        happens at step boundaries)."""
+        happens at step boundaries).  A page-accounting violation
+        (``CacheIntegrityError``, PDT-E019 — an allocator bug, never a
+        user error) dumps a flight record before propagating."""
+        try:
+            return self._step_inner()
+        except CacheIntegrityError as e:
+            _flight.dump("cache_integrity", error=e)
+            raise
+
+    def _step_inner(self):
         completed = self._retire()
         if self._early:
             completed.extend(self._early)
@@ -739,28 +822,42 @@ class ContinuousBatchingEngine:
             # rejected anything that cannot fit it, so this is
             # unreachable for admissible request mixes
             req = self._queue[0]
-            raise RuntimeError(
+            err = RuntimeError(
                 f"request {req.rid} needs {self._admit_need(req)} pages "
                 f"but the pool only has {self.total_pages - 1}; raise "
                 "total_pages or lower max_new_tokens")
+            _flight.dump("pool_backstop", error=err,
+                         extra={"rid": req.rid})
+            raise err
         return completed
 
     def _fail(self, b):
         """Decode guard hit: fail ONE request with the coded error; the
-        engine and every co-resident request keep going."""
+        engine and every co-resident request keep going.  The flight
+        recorder dumps the recent event ring — the failed request's
+        admission/prefill/decode timeline included — so the postmortem
+        starts with context, not a bare error string."""
         s = self._slots[b]
-        err = DecodeGuard.failure(s.req.rid, s.len_written)
+        rid = s.req.rid
+        err = DecodeGuard.failure(rid, s.len_written)
         self._stats["failed"] += 1
         self._early.append(self._finalize_slot(b, "failed", err))
+        _flight.dump("nan_decode", error=err,
+                     extra={"rid": rid, "slot": b})
 
     def _dispatch(self, kind, fn):
         def _on_retry(_exc, _attempt):
             self._stats["retries"] += 1
         # dispatch_retries counts RETRIES (re-attempts after a
         # transient), so N=0 disables retry and N=1 absorbs one fault
-        return dispatch_retry(kind, fn,
-                              max_attempts=self.dispatch_retries + 1,
-                              on_retry=_on_retry)
+        timed = _obs_metrics.enabled()
+        t0 = time.perf_counter() if timed else 0.0
+        res = dispatch_retry(kind, fn,
+                             max_attempts=self.dispatch_retries + 1,
+                             on_retry=_on_retry)
+        if timed:
+            self._tl.dispatch(kind, (time.perf_counter() - t0) * 1e3)
+        return res
 
     # compiled serving programs cache ON the model (generate()'s
     # _decode_step_cache idiom): engines with the same bucket geometry
@@ -912,6 +1009,7 @@ class ContinuousBatchingEngine:
             cur += -(-n // qb) * qb   # next segment at a q_block boundary
             if _take is not None:     # honest prefill-compute meter:
                 self._stats["prefill_tokens_computed"] += _take
+                self._tl.prefill_chunk(s.req.rid, b, _take, pos0)
         poison = self._guard.poison(
             [self._slots[b].req.rid if b in plan else None
              for b in range(B)])
@@ -946,12 +1044,14 @@ class ContinuousBatchingEngine:
                     s.cur_tok = int(nxt[b])
                     s.out_toks.append(int(nxt[b]))
                     self._stats["tokens_generated"] += 1
+                    self._tl.token(s.req.rid)
 
     def _accept(self, s, t):
         s.out_toks.append(t)
         s.cur_tok = t
         s.cur_pos += 1
         self._stats["tokens_generated"] += 1
+        self._tl.token(s.req.rid)
 
     # ------------------------------------------------ decode window ---
     def _get_step_fn(self):
@@ -1042,6 +1142,7 @@ class ContinuousBatchingEngine:
             bad = ~np.isfinite(lg).all(-1)
             nxt = np.where(bad, 0, lg.argmax(-1)).astype(np.int32)
             self._stats["decode_dispatches"] += 1
+            accepted = 0
             for b, s in enumerate(self._slots):
                 if fin[b]:
                     continue
@@ -1049,6 +1150,8 @@ class ContinuousBatchingEngine:
                     self._fail(b)
                     continue
                 self._accept(s, int(nxt[b]))
+                accepted += 1
+            self._tl.decode_window(accepted, int((~fin).sum()))
             wrapped = (step_fn if hasattr(step_fn, "_cache")
                        else getattr(step_fn, "__wrapped__", None))
             if wrapped is not None and getattr(wrapped, "_cache", None):
@@ -1120,18 +1223,22 @@ class ContinuousBatchingEngine:
         # host replay of the device stop rule (identical predicate, so
         # the accepted prefix matches the carried fin exactly); the
         # first bad step fails the slot and discards its frozen tail
+        live = accepted = 0
         for b, s in enumerate(self._slots):
             if s.phase != "decode" or fin[b]:
                 continue
+            live += 1
             for k in range(K):
                 if bads[k, b]:
                     self._fail(b)
                     break
                 t = int(toks[k, b])
                 self._accept(s, t)
+                accepted += 1
                 if (s.eos >= 0 and t == s.eos) \
                         or s.cur_pos + 1 >= s.stop_len:
                     break
+        self._tl.decode_window(accepted, live)
 
 
 def _make_slot_window(exe, K):

@@ -256,6 +256,11 @@ class PrefixCache:
         self._ref.pop(page, None)
         self.free.append(page)
         self.evictions += 1
+        # event-ring breadcrumb (ISSUE 8): cache churn is the first
+        # thing a TTFT-regression postmortem looks for
+        from ..observability import events as _events
+        _events.emit("serving.cache_evict", page=int(page),
+                     evictions=int(self.evictions))
         return page
 
     # ------------------------------------------------- invariants -----

@@ -1,0 +1,87 @@
+"""``paddle_tpu.observability`` — unified metrics + structured events.
+
+A low-overhead, always-on observability runtime (ISSUE 8): one metrics
+registry, one structured-event stream, one crash flight recorder —
+instead of per-subsystem ``stats`` dicts and ad-hoc host timers.  The
+``PDTPU_METRICS`` flag (``metrics`` in ``core/state.py``, on by
+default) gates every record call; off makes each one a near-no-op and
+restores pre-observability behavior bitwise (metrics backing the
+serving engine's public ``stats`` contract are ``always=True`` and
+record regardless).
+
+Pieces
+------
+* ``metrics``   — thread-safe :class:`Counter`/:class:`Gauge`/
+  :class:`Histogram` (fixed log-spaced buckets so snapshots merge
+  elementwise) in process-global named registries
+  (:func:`registry`); ``snapshot()`` nested JSON and a stable
+  Prometheus text exporter (:func:`render_prometheus`).
+* ``events``    — a bounded ring of recent structured events
+  (:func:`emit`/:func:`tail`) fed by the serving engine, the resilience
+  runtime (retries, StepGuard skips, fault firings, preemption
+  signals) and the profiler; :func:`dump` writes the ring as a JSON
+  flight record when a coded failure fires.
+* ``serving``   — :class:`ServingTimelines` reconstructs per-request
+  phase latencies (queue-time, TTFT, TPOT, decode-tokens-per-window,
+  preemption / cache-hit histograms labeled by finish reason) from
+  engine scheduling events — the ragged mixed program batches many
+  requests into one dispatch, so host-side ``time.time()`` wrapping
+  cannot attribute phases; the engine's own events can.
+* ``steptimer`` — :class:`StepTimer` training telemetry (step wall
+  histogram, retrace counter over ``Executable.trace_count``,
+  tokens/sec + MFU estimate gauges, fused-optimizer bucket dispatch
+  counter) hooked into ``hapi.Model.fit`` and ``Optimizer.step``.
+
+Event schema
+------------
+Every event is one flat JSON-able dict::
+
+    {"seq": int, "ts": float, "kind": str, ...fields}
+
+``seq`` is process-monotone, ``ts`` is ``time.time()``.  Kinds in use
+(producers in parentheses; fields beyond rid/slot are scalars):
+
+    serving.enqueued      rid, prompt_len, max_new_tokens   (engine)
+    serving.admitted      rid, slot, cached_tokens, resume_len
+    serving.prefill_chunk rid, slot, tokens, offset
+    serving.first_token   rid, ttft_ms
+    serving.decode_window tokens, live_slots
+    serving.dispatch      name (mixed|decode|window|cow), ms
+    serving.preempted     rid, tokens_done
+    serving.retired       rid, finish_reason, tokens, preemptions
+    serving.cache_evict   page, evictions              (prefix cache LRU)
+    serving.nan_poison    rid, slot    (engine_nan_decode drill firing)
+    retry.attempt         attempt, error, kind?         (resilience.retry)
+    guard.step_skip       streak                        (StepGuard)
+    fault.fired           site, key                     (faults.check)
+    preempt.signal        signum                        (preempt handler)
+    span                  name, dur_us                  (RecordEvent)
+    op                    name, dur_us                  (dispatch hook,
+                                                         while profiling)
+    flight.dump           reason, path                  (flight recorder)
+
+Flight records are JSON files under ``PDTPU_FLIGHT_DIR`` (default
+``<tempdir>/paddle_tpu_flight``); see ``events.dump``.
+"""
+from __future__ import annotations
+
+from . import events  # noqa: F401
+from . import metrics  # noqa: F401
+from .events import dump, dump_dir, emit, last_dump, tail  # noqa: F401
+from .metrics import (COUNT_BUCKETS, LATENCY_BUCKETS_MS,  # noqa: F401
+                      Counter, Gauge, Histogram, Registry, enabled,
+                      registry, render_prometheus, snapshot)
+from .serving import RegistryCounters, ServingTimelines  # noqa: F401
+from .steptimer import StepTimer, device_peak_flops  # noqa: F401
+
+# events.dump is the flight recorder; keep a namespaced alias so call
+# sites read as what they do: flight.dump(...)
+from . import events as flight  # noqa: F401
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "registry",
+    "snapshot", "render_prometheus", "enabled", "LATENCY_BUCKETS_MS",
+    "COUNT_BUCKETS", "emit", "tail", "dump", "last_dump", "dump_dir",
+    "flight", "events", "metrics", "ServingTimelines",
+    "RegistryCounters", "StepTimer", "device_peak_flops",
+]

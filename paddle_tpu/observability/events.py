@@ -1,0 +1,193 @@
+"""Structured-event ring buffer + crash flight recorder.
+
+One process-wide bounded ring of recent structured events (ISSUE 8
+tentpole, part 3).  Producers across the stack :func:`emit` into it —
+the serving engine's request lifecycle, dispatch kinds, retry attempts
+(``resilience/retry.py``), StepGuard skips (``resilience/guard.py``),
+fault-injection firings (``resilience/faults.py``), preemption signals
+(``resilience/preempt.py``) and the profiler's ``RecordEvent`` spans /
+per-op dispatch events — so every subsystem's last moves land in ONE
+stream.  The ring is the cheap always-on half; when a coded failure
+fires (``NonFiniteLogitsError``, ``CacheIntegrityError``, the page-pool
+backstop, a SIGTERM preemption) the owning code calls :func:`dump` and
+the postmortem starts from the last N events instead of a bare
+traceback.
+
+Event schema (every event is one flat JSON-able dict)::
+
+    {"seq":  int,    # process-monotone sequence number
+     "ts":   float,  # time.time() wall clock (epoch seconds)
+     "kind": str,    # dotted producer.kind, e.g. "serving.admitted"
+     ...fields}      # producer-specific scalars (rid, slot, ms, ...)
+
+Emission is gated on the ``PDTPU_METRICS`` flag (off = one dict lookup
+and return, and :func:`dump` writes nothing), and every field must be a
+plain scalar/short string — events are recorded on the hot path and
+serialized only at dump time.
+
+Dump files are JSON ``{"reason", "error", "time", "pid", "extra",
+"events": [...]}`` written to ``PDTPU_FLIGHT_DIR`` (default
+``<tempdir>/paddle_tpu_flight``) as ``flight_<pid>_<seq>.json``;
+:func:`last_dump` returns the newest path this process wrote.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+
+from .metrics import enabled
+
+__all__ = ["emit", "tail", "clear", "capacity", "set_capacity",
+           "dump", "last_dump", "dump_dir", "EventRing"]
+
+_DEFAULT_CAPACITY = 512
+
+
+class EventRing:
+    """Bounded ring of event dicts; overwrites oldest when full."""
+
+    def __init__(self, capacity=_DEFAULT_CAPACITY):
+        self._cap = max(1, int(capacity))
+        self._buf: list = [None] * self._cap
+        self._seq = 0
+        # REENTRANT: the preemption signal handler dumps the ring, and
+        # a signal can land while the main thread is inside emit() —
+        # a plain Lock would deadlock the handler against its own
+        # thread. Re-entry may observe a half-applied emit; for a
+        # flight record that beats hanging the eviction grace period.
+        self._lock = threading.RLock()
+
+    @property
+    def capacity(self):
+        return self._cap
+
+    def emit(self, kind: str, **fields):
+        if not enabled():
+            return
+        ev = {"seq": 0, "ts": time.time(), "kind": str(kind)}
+        ev.update(fields)
+        with self._lock:
+            ev["seq"] = self._seq
+            self._buf[self._seq % self._cap] = ev
+            self._seq += 1
+
+    def tail(self, n=None) -> list:
+        """Last ``n`` events (all retained when None), oldest first."""
+        with self._lock:
+            seq, cap = self._seq, self._cap
+            live = min(seq, cap)
+            out = [self._buf[i % cap] for i in range(seq - live, seq)]
+        return out if n is None else out[-int(n):]
+
+    def clear(self):
+        with self._lock:
+            self._buf = [None] * self._cap
+            self._seq = 0
+
+    def resize(self, capacity):
+        keep = self.tail()
+        with self._lock:
+            self._cap = max(1, int(capacity))
+            self._buf = [None] * self._cap
+            for ev in keep[-self._cap:]:
+                self._buf[self._seq % self._cap] = ev
+                self._seq += 1
+
+
+def _env_capacity() -> int:
+    """PDTPU_EVENT_RING, parsed defensively: this runs at package
+    import, where a malformed value must degrade to the default, not
+    make ``import paddle_tpu`` itself raise."""
+    try:
+        return int(os.environ.get("PDTPU_EVENT_RING",
+                                  _DEFAULT_CAPACITY))
+    except (TypeError, ValueError):
+        return _DEFAULT_CAPACITY
+
+
+_ring = EventRing(_env_capacity())
+
+
+def emit(kind: str, **fields):
+    """Record one structured event in the process ring (flag-gated)."""
+    _ring.emit(kind, **fields)
+
+
+def tail(n=None) -> list:
+    return _ring.tail(n)
+
+
+def clear():
+    _ring.clear()
+
+
+def capacity() -> int:
+    return _ring.capacity
+
+
+def set_capacity(n: int):
+    _ring.resize(n)
+
+
+# ------------------------------------------------------------------
+# flight recorder
+# ------------------------------------------------------------------
+_last_dump: str | None = None
+_dump_lock = threading.RLock()  # reentrant: see EventRing._lock
+_dump_seq = 0
+
+
+def dump_dir() -> str:
+    """Where flight records land: ``PDTPU_FLIGHT_DIR`` (read at dump
+    time so tests can redirect) or ``<tempdir>/paddle_tpu_flight``."""
+    return os.environ.get(
+        "PDTPU_FLIGHT_DIR",
+        os.path.join(tempfile.gettempdir(), "paddle_tpu_flight"))
+
+
+def dump(reason: str, *, error=None, extra=None, path=None):
+    """Write the ring's current contents as one JSON flight record.
+
+    Returns the written path, or None when metrics are off (the off
+    state must restore pre-observability behavior — no stray files) or
+    the write itself fails (a flight recorder must never turn a
+    diagnosed failure into an IO failure).
+    """
+    global _last_dump, _dump_seq
+    if not enabled():
+        return None
+    try:
+        with _dump_lock:
+            _dump_seq += 1
+            seq = _dump_seq
+        if path is None:
+            d = dump_dir()
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"flight_{os.getpid()}_{seq:04d}.json")
+        rec = {
+            "reason": str(reason),
+            "error": (None if error is None
+                      else f"{type(error).__name__}: {error}"),
+            "error_code": getattr(type(error), "error_code", None)
+            if error is not None else None,
+            "time": time.time(),
+            "pid": os.getpid(),
+            "extra": extra or {},
+            "events": _ring.tail(),
+        }
+        with open(path, "w") as f:
+            json.dump(rec, f, indent=1, default=str)
+        _last_dump = path
+        emit("flight.dump", reason=str(reason), path=path)
+        return path
+    except Exception:
+        return None
+
+
+def last_dump():
+    """Path of the newest flight record this process wrote (or None)."""
+    return _last_dump

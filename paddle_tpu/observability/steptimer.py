@@ -1,0 +1,157 @@
+"""Training step telemetry: wall-time, retraces, tokens/sec, MFU.
+
+``hapi.Model.fit`` owns a :class:`StepTimer` per fit and calls
+:meth:`StepTimer.step` once per completed train step (per-batch path,
+windowed path and epoch tails alike); custom loops can do the same.
+The timer records into the process-global ``"default"`` registry:
+
+* ``train.step_ms``        — step wall-time histogram (log buckets)
+* ``train.steps``          — completed steps counter
+* ``train.retraces``       — RE-traces of the compiled train step past
+  the first compile (``Executable.trace_count`` deltas): a steady-state
+  increment here is the shape/weakref churn regression the jit cache
+  guards warn about, surfaced as a counter a dashboard can alert on
+* ``train.tokens_per_sec`` — online throughput gauge (EMA-free: last
+  completed step's tokens / wall)
+* ``train.mfu``            — model-flops-utilization estimate gauge,
+  ``6 * n_params * tokens/sec / peak_flops`` (the standard LM
+  approximation); 0.0 when the device's peak is unknown (CPU)
+
+``Optimizer.step`` feeds the same registry from its own side:
+``train.opt_step_ms`` (eager update wall time) and
+``train.fused_bucket_dispatches`` (flat-bucket kernel launches per
+fused step — the PR4 O(buckets) claim as a live counter).
+
+With ``PDTPU_METRICS=off`` every call is a flag check and return.  The
+optional one-line log (``metrics_log_every`` flag / ``log_every``
+kwarg) goes through the ``paddle_tpu.observability`` logger every N
+steps.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+from . import metrics as _metrics
+from .metrics import LATENCY_BUCKETS_MS, enabled
+
+__all__ = ["StepTimer", "device_peak_flops", "note_optimizer_step"]
+
+_log = logging.getLogger("paddle_tpu.observability")
+
+# bf16 peak TFLOP/s by TPU device kind (vendor specs) — the MFU
+# denominator; None (CPU / unknown) leaves the mfu gauge at 0.0
+_PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def device_peak_flops():
+    """Peak FLOP/s of device 0 (None when unknown, e.g. CPU)."""
+    try:
+        import jax
+        kind = str(getattr(jax.devices()[0], "device_kind", ""))
+    except Exception:
+        return None
+    for k, v in _PEAK_TFLOPS.items():
+        if k.lower() in kind.lower():
+            return v * 1e12
+    return None
+
+
+class StepTimer:
+    def __init__(self, registry=None, prefix="train", n_params=None,
+                 peak_flops=None, log_every=None):
+        from ..core import state as _state
+        reg = registry or _metrics.registry()
+        self.n_params = int(n_params) if n_params else None
+        self.peak_flops = (device_peak_flops() if peak_flops is None
+                           else peak_flops)
+        self.log_every = int(_state.get_flag("metrics_log_every")
+                             if log_every is None else log_every)
+        self._h_step = reg.histogram(
+            prefix + ".step_ms", "train step wall time",
+            LATENCY_BUCKETS_MS)
+        self._c_steps = reg.counter(prefix + ".steps",
+                                    "completed train steps")
+        self._c_retrace = reg.counter(
+            prefix + ".retraces",
+            "compiled-train-step re-traces past the first compile")
+        self._g_tps = reg.gauge(prefix + ".tokens_per_sec",
+                                "tokens consumed per second (online)")
+        self._g_mfu = reg.gauge(
+            prefix + ".mfu", "model-flops-utilization estimate "
+            "(6*N*tokens/sec over device peak)")
+        self._t = None
+        self._base_traces = None
+        self._seen = 0
+
+    def mark(self):
+        """(Re)arm the step clock without recording — call after a
+        pause (eval pass, checkpoint) so the gap isn't a 'step'."""
+        self._t = time.perf_counter() if enabled() else None
+
+    def step(self, tokens=None, trace_count=None):
+        """One completed train step. ``tokens``: tokens this step
+        consumed (throughput/MFU gauges); ``trace_count``: current
+        total ``Executable.trace_count`` of the compiled step."""
+        if not enabled():
+            self._t = None
+            return
+        now = time.perf_counter()
+        if self._t is not None:
+            dt = now - self._t
+            self._h_step.observe(dt * 1e3)
+            self._c_steps.inc()
+            self._seen += 1
+            if tokens and dt > 0:
+                tps = float(tokens) / dt
+                self._g_tps.set(round(tps, 1))
+                if self.peak_flops and self.n_params:
+                    self._g_mfu.set(round(
+                        6.0 * self.n_params * tps / self.peak_flops, 4))
+        if trace_count is not None:
+            if self._base_traces is None:
+                # the first observation is the compile itself, not a
+                # regression — count deltas from here
+                self._base_traces = int(trace_count)
+            elif trace_count > self._base_traces:
+                self._c_retrace.inc(int(trace_count) - self._base_traces)
+                self._base_traces = int(trace_count)
+        if self.log_every and self._seen \
+                and self._seen % self.log_every == 0:
+            _log.info(
+                "step %d: %.2f ms/step (mean), %.1f tok/s, mfu %.3f, "
+                "retraces %d", self._seen, self._h_step.mean,
+                float(self._g_tps.value or 0.0),
+                float(self._g_mfu.value or 0.0), self._c_retrace.value)
+        self._t = now
+
+
+# cached metric handles for the optimizer-side hook (one-time lookups)
+_opt_hist = None
+_bucket_counter = None
+
+
+def note_optimizer_step(wall_ms, fused_buckets=0):
+    """Record one eager optimizer update: wall time histogram plus the
+    fused flat-bucket dispatch count (0 = per-param path)."""
+    global _opt_hist, _bucket_counter
+    if not enabled():
+        return
+    if _opt_hist is None:
+        reg = _metrics.registry()
+        _opt_hist = reg.histogram(
+            "train.opt_step_ms", "eager optimizer.step wall time",
+            LATENCY_BUCKETS_MS)
+        _bucket_counter = reg.counter(
+            "train.fused_bucket_dispatches",
+            "fused flat-bucket update kernels launched")
+    _opt_hist.observe(float(wall_ms))
+    if fused_buckets:
+        _bucket_counter.inc(int(fused_buckets))

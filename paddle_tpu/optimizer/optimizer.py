@@ -193,9 +193,23 @@ class Optimizer:
     def step(self):
         self._step_count += 1
         pairs = self._collect()
+        # step telemetry (ISSUE 8): eager-only wall time + fused bucket
+        # dispatch count into the default observability registry. Under
+        # jit capture the whole update is traced into the step program
+        # — host timing there measures trace time, so skip it.
+        from ..observability import metrics as _obs_metrics
+        from ..observability.steptimer import note_optimizer_step
+        import time as _time
+        t0 = (_time.perf_counter()
+              if _tm._tracker is None and _obs_metrics.enabled()
+              else None)
         if self._fused_enabled():
             try:
                 if self._fused_step(pairs):
+                    if t0 is not None:
+                        note_optimizer_step(
+                            (_time.perf_counter() - t0) * 1e3,
+                            fused_buckets=len(self._flat or ()))
                     return
             except _flat.FlatMismatch as e:
                 self._defuse(str(e))
@@ -208,6 +222,8 @@ class Optimizer:
         if self._grad_clip is not None:
             pairs = self._grad_clip(pairs)
         self._apply_pairs(pairs, self._live_lr())
+        if t0 is not None:
+            note_optimizer_step((_time.perf_counter() - t0) * 1e3)
 
     def _apply_pairs(self, pairs, lr):
         """The per-param update loop (grads already clipped)."""

@@ -128,10 +128,15 @@ class RecordEvent:
     def end(self):
         if self._t0 is None:
             return
+        dur_us = (time.perf_counter_ns() - self._t0) // 1000
         if _active_profiler is not None and _active_profiler._recording:
-            _buffer.add(self.name, self._t0 // 1000,
-                        (time.perf_counter_ns() - self._t0) // 1000,
+            _buffer.add(self.name, self._t0 // 1000, dur_us,
                         threading.get_ident(), "user")
+        # same stream as everything else (ISSUE 8): user spans land in
+        # the observability event ring too, so chrome traces and flight
+        # records tell one story
+        from ..observability import events as _obs_events
+        _obs_events.emit("span", name=self.name, dur_us=int(dur_us))
         if self._jax_ctx is not None:
             self._jax_ctx.__exit__(None, None, None)
             self._jax_ctx = None
@@ -146,8 +151,14 @@ class RecordEvent:
 
 
 def _op_profile_hook(name: str, t0_ns: int, t1_ns: int):
-    _buffer.add(name, t0_ns // 1000, max((t1_ns - t0_ns) // 1000, 1),
+    dur_us = max((t1_ns - t0_ns) // 1000, 1)
+    _buffer.add(name, t0_ns // 1000, dur_us,
                 threading.get_ident(), "op")
+    # per-op dispatch names feed the observability ring while a record
+    # window is open — a flight record dumped during profiling shows
+    # the exact dispatch sequence leading up to the failure
+    from ..observability import events as _obs_events
+    _obs_events.emit("op", name=name, dur_us=int(dur_us))
 
 
 class Profiler:
@@ -196,12 +207,17 @@ class Profiler:
 
     def stop(self):
         global _active_profiler
-        if self._recording:
-            self._stop_record()
-            if self.on_trace_ready is not None:
-                self.on_trace_ready(self)
-        _active_profiler = None
-        self.current_state = ProfilerState.CLOSED
+        try:
+            if self._recording:
+                self._stop_record()
+                if self.on_trace_ready is not None:
+                    self.on_trace_ready(self)
+        finally:
+            # a raising on_trace_ready handler must not leave the
+            # profiler registered as active (the hook is already down:
+            # _stop_record runs first and is unconditional)
+            _active_profiler = None
+            self.current_state = ProfilerState.CLOSED
 
     def step(self, num_samples: Optional[int] = None):
         now = time.perf_counter()
@@ -215,9 +231,16 @@ class Profiler:
                 self._recording and
                 self.current_state in (ProfilerState.CLOSED,
                                        ProfilerState.READY)):
-            self._stop_record()
-            if self.on_trace_ready is not None:
-                self.on_trace_ready(self)
+            try:
+                self._stop_record()
+                if self.on_trace_ready is not None:
+                    self.on_trace_ready(self)
+            except BaseException:
+                # fail safe: a raising trace handler leaves the bracket
+                # DOWN (hook cleared, device tracer stopped) instead of
+                # re-arming a window the caller will never close
+                self.current_state = ProfilerState.CLOSED
+                raise
         self._apply_state()
 
     def __enter__(self):
@@ -234,30 +257,47 @@ class Profiler:
                 self._start_record()
 
     def _start_record(self):
+        """Open a record window. Exception-safe bracket (ISSUE 8
+        satellite): if anything raises mid-open — including a
+        BaseException out of ``jax.profiler.start_trace`` that the
+        Exception net below doesn't catch — the half-opened window is
+        torn down before the error propagates, so the global dispatch
+        hook and the device tracer can never outlive a failed start."""
         self._recording = True
-        if not self.timer_only:
-            _dispatch._profile_hook = _op_profile_hook
-        if any(t in (ProfilerTarget.GPU, ProfilerTarget.TPU,
-                     ProfilerTarget.CUSTOM_DEVICE) for t in self.targets):
-            try:
-                import jax
-                self._trace_dir = os.environ.get(
-                    "PDTPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
-                jax.profiler.start_trace(self._trace_dir)
-                self._device_tracing = True
-            except Exception:
-                self._device_tracing = False
+        try:
+            if not self.timer_only:
+                _dispatch._profile_hook = _op_profile_hook
+            if any(t in (ProfilerTarget.GPU, ProfilerTarget.TPU,
+                         ProfilerTarget.CUSTOM_DEVICE)
+                   for t in self.targets):
+                try:
+                    import jax
+                    self._trace_dir = os.environ.get(
+                        "PDTPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
+                    jax.profiler.start_trace(self._trace_dir)
+                    self._device_tracing = True
+                except Exception:
+                    self._device_tracing = False
+        except BaseException:
+            self._stop_record()
+            raise
 
     def _stop_record(self):
+        """Close the record window. The global hook comes down FIRST
+        and unconditionally — a raising step inside a RECORD window
+        exits through here (``__exit__`` -> ``stop``), and the one
+        unrecoverable outcome would be the hook surviving to poison
+        every later dispatch; ``jax.profiler.stop_trace`` runs under
+        its own net for the same reason."""
         self._recording = False
         _dispatch._profile_hook = None
         if self._device_tracing:
+            self._device_tracing = False
             try:
                 import jax
                 jax.profiler.stop_trace()
             except Exception:
                 pass
-            self._device_tracing = False
 
     # -- output --------------------------------------------------------
     def export(self, path: str, format: str = "json"):

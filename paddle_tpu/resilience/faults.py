@@ -185,8 +185,19 @@ def check(site: str, key: str = "") -> bool:
             if rule.seen >= rule.at and (rule.times == 0
                                          or rule.fired < rule.times):
                 rule.fired += 1
-                return True
-    return False
+                fired_site = rule.site
+                break
+        else:
+            return False
+    # outside the lock: a firing is an event the flight recorder wants
+    # in its ring (drills should read like the real failures they
+    # simulate), and emit takes the ring's own lock
+    try:
+        from ..observability import events as _events
+        _events.emit("fault.fired", site=fired_site, key=str(key))
+    except Exception:
+        pass
+    return True
 
 
 def maybe_raise(site: str, key: str, exc_type=InjectedConnectionError):

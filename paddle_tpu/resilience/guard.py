@@ -192,6 +192,15 @@ class StepGuard:
             return False
         self._host_streak += 1
         self.last_skipped = True
+        # observability breadcrumbs: the skip streak in the event ring
+        # (flight records show the NaN steps preceding a blow-up) and a
+        # process-global counter a dashboard can alert on
+        from ..observability import events as _events
+        from ..observability import metrics as _metrics
+        _events.emit("guard.step_skip", streak=self._host_streak)
+        _metrics.registry().counter(
+            "train.guard_skips",
+            "non-finite train steps skipped in-graph by StepGuard").inc()
         if self._scaler is not None and self._scaler.is_enable():
             # the reference GradScaler response: shrink the loss scale
             self._scaler._found_inf = True

@@ -40,6 +40,17 @@ def _handler(signum, frame):
         return
     _last_signal = signum
     _requested = True
+    # flight record at the eviction notice (ISSUE 8): the checkpoint
+    # this flag triggers is the run's last act, so the postmortem for
+    # "what was the job doing when it was preempted" starts from the
+    # last N structured events, not from grepping logs. The handler
+    # must never die on observability IO — best effort only.
+    try:
+        from ..observability import events as _events
+        _events.emit("preempt.signal", signum=int(signum))
+        _events.dump("preempt_signal", extra={"signum": int(signum)})
+    except Exception:
+        pass
 
 
 def install(signals=DEFAULT_SIGNALS) -> bool:

@@ -53,6 +53,12 @@ def retry_call(fn, *, max_attempts=4, base_delay=0.05, max_delay=2.0,
             if attempt >= max_attempts or (giveup is not None
                                            and giveup(e)):
                 raise
+            # structured-event breadcrumb: retries are rare, and a
+            # flight record that shows the transient(s) preceding a
+            # failure is the whole point of the event ring
+            from ..observability import events as _events
+            _events.emit("retry.attempt", attempt=attempt,
+                         error=f"{type(e).__name__}: {e}"[:200])
             delay = min(max_delay, base_delay * backoff ** (attempt - 1))
             if jitter:
                 delay *= 1.0 + jitter * _rng.random()

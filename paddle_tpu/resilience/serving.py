@@ -75,6 +75,11 @@ class DecodeGuard:
                 continue
             if faults.check(SITE_NAN_DECODE, key=str(rid)):
                 vec[b] = np.nan
+                # flight-recorder breadcrumb: the poison lands one
+                # dispatch before the guard reports it, so the drilled
+                # timeline reads cause -> effect like a real NaN would
+                from ..observability import events as _events
+                _events.emit("serving.nan_poison", rid=rid, slot=b)
         return vec
 
     @staticmethod

@@ -41,7 +41,8 @@ import pytest  # noqa: E402
 # ---------------------------------------------------------------------------
 _TIER1_ORDER = [
     # dense: hundreds of fast tests, ~270s total
-    "test_prefix_cache.py", "test_profiler_device.py",
+    "test_prefix_cache.py", "test_observability.py",
+    "test_profiler_device.py",
     "test_native_io.py", "test_analysis.py", "test_autograd.py",
     "test_tensor.py", "test_geometric_namespaces.py",
     "test_optimizer.py", "test_optimizer_fused.py",

@@ -5,6 +5,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import profiler
@@ -79,3 +80,74 @@ def test_event_elapsed_time():
     s = paddle.device.current_stream()
     s.synchronize()
     assert s.query()
+
+
+def test_profiler_bracket_survives_raising_step():
+    """ISSUE 8 satellite: a step that raises inside a RECORD window
+    must not leave the global dispatch hook installed (it would poison
+    every later dispatch) nor the device tracer running."""
+    from paddle_tpu.core import dispatch
+
+    x = paddle.to_tensor(np.ones((4, 4), "float32"))
+    with pytest.raises(RuntimeError, match="step blew up"):
+        with profiler.Profiler(targets=[profiler.ProfilerTarget.CPU,
+                                        profiler.ProfilerTarget.TPU]):
+            _ = x @ x
+            raise RuntimeError("step blew up")
+    assert dispatch._profile_hook is None
+    # dispatch still works and a fresh profiler can open a new window
+    _ = x + 1
+    with profiler.Profiler(targets=[profiler.ProfilerTarget.CPU]) as p:
+        _ = x * 2
+        p.step()
+    assert dispatch._profile_hook is None
+
+
+def test_profiler_raising_trace_handler_clears_state():
+    """A raising ``on_trace_ready`` handler must still tear the record
+    window down: hook cleared, profiler deregistered, state CLOSED."""
+    from paddle_tpu.core import dispatch
+
+    def bad_handler(prof):
+        raise ValueError("handler blew up")
+
+    p = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU],
+                          on_trace_ready=bad_handler)
+    p.start()
+    assert dispatch._profile_hook is not None
+    with pytest.raises(ValueError, match="handler blew up"):
+        p.stop()
+    assert dispatch._profile_hook is None
+    assert profiler._active_profiler is None
+    assert p.current_state is profiler.ProfilerState.CLOSED
+    # step()-driven handler failures fail safe too: window down, not
+    # re-armed for a caller that just saw an exception
+    p2 = profiler.Profiler(
+        targets=[profiler.ProfilerTarget.CPU],
+        scheduler=profiler.make_scheduler(closed=0, ready=0, record=1,
+                                          repeat=2),
+        on_trace_ready=bad_handler)
+    p2.start()
+    with pytest.raises(ValueError, match="handler blew up"):
+        p2.step()
+    assert dispatch._profile_hook is None
+    assert p2.current_state is profiler.ProfilerState.CLOSED
+    p2.stop()
+    assert profiler._active_profiler is None
+
+
+def test_profiler_spans_feed_event_ring():
+    """RecordEvent spans land in the observability event ring (one
+    stream for chrome traces and flight records)."""
+    from paddle_tpu import observability as obs
+
+    old = paddle.get_flags("metrics")["metrics"]
+    paddle.set_flags({"metrics": True})
+    try:
+        obs.events.clear()
+        with profiler.RecordEvent("ring_span"):
+            pass
+        assert any(e["kind"] == "span" and e["name"] == "ring_span"
+                   for e in obs.tail())
+    finally:
+        paddle.set_flags({"metrics": old})
