@@ -67,6 +67,19 @@ with and without the cache, plus ``cache_hits``/``cache_hit_tokens``/
 (``tests/test_serving_engine.py``) validates the accounting; absolute
 times are TPU-measured.
 
+plus a ``speculative`` row (ISSUE 9): a repetitive-text workload
+(prompts tile a short motif, the regime where the model-free n-gram /
+prompt-lookup proposer finds its continuations in context) driven
+twice over identical traffic — ``spec_decode`` off (plain decode) then
+on.  Reports ``accepted_tokens_per_step`` (the verify multiplier: mean
+tokens emitted per slot per verify dispatch, from the engine's
+``spec_accepted_per_step`` histogram), ``spec_accept_rate``,
+tokens/sec both ways, and the ``outputs_equal`` gate — greedy
+speculative output must be BITWISE the plain stream, so speculation
+can only ever move throughput, never tokens.  The n-gram proposer runs
+on the CPU smoke (``tests/test_speculative.py``); absolute times are
+TPU claims.
+
 plus a ``metrics_overhead`` micro-row (ISSUE 8): identical engine
 traffic with ``PDTPU_METRICS`` on vs off, reporting the tokens/sec
 delta — the always-on observability runtime's <= 3% cost claim.  The
@@ -278,6 +291,7 @@ def measure():
     rows["shared_prefix"] = _measure_shared_prefix(cfg, model)
     rows["quant_b8"] = _measure_quant(cfg, model, gbps)
     rows["weight_only_b1"] = _measure_weight_only(cfg, model, gbps)
+    rows["speculative"] = _measure_speculative(cfg, model)
     rows["metrics_overhead"] = _measure_metrics_overhead(cfg, model)
     return rows
 
@@ -670,6 +684,79 @@ def _measure_weight_only(cfg, model, gbps, prompt_len=128,
     return row
 
 
+def _measure_speculative(cfg, model, slots=4, max_seq_len=512,
+                         prompt_len=64, motif_len=8, new_tokens=48,
+                         n_requests=8, spec_k=4, page_size=16,
+                         decode_window=16, prefill_chunk=128,
+                         q_block=8, seed=7, warm=True):
+    """ISSUE 9 ``speculative`` row: repetitive-text traffic (each
+    prompt tiles its own short motif) through the engine twice over
+    IDENTICAL arrivals — ``spec_decode`` off, then on with the
+    model-free n-gram proposer.  The verify multiplier is
+    ``accepted_tokens_per_step`` (mean tokens emitted per slot per
+    verify dispatch); ``outputs_equal`` pins the bitwise-greedy claim.
+    Works on the CPU tiny models (the accounting smoke in
+    tests/test_speculative.py drives it); absolute times are
+    TPU-measured."""
+    from paddle_tpu.inference import ContinuousBatchingEngine
+
+    rng = np.random.default_rng(seed)
+    prompts = []
+    for _ in range(n_requests):
+        motif = rng.integers(0, cfg.vocab_size,
+                             motif_len).astype(np.int32)
+        prompts.append(np.tile(motif, -(-prompt_len // motif_len))
+                       [:prompt_len])
+
+    def drive(spec):
+        eng = ContinuousBatchingEngine(
+            model, max_slots=slots, page_size=page_size,
+            max_seq_len=max_seq_len, decode_window=decode_window,
+            prefill_chunk=prefill_chunk, q_block=q_block,
+            spec_decode=spec, spec_k=spec_k)
+        rids = [eng.add_request(p, new_tokens) for p in prompts]
+        t0 = time.perf_counter()
+        done = eng.run()
+        wall = time.perf_counter() - t0
+        return eng, [done[r].sequence for r in rids], wall
+
+    if warm:                       # compile + warm both program sets
+        drive(False)
+        drive(True)
+    eng_off, out_off, wall_off = drive(False)
+    eng_on, out_on, wall_on = drive(True)
+    st = eng_on.stats
+    row = {
+        "batch": slots, "prompt_len": prompt_len,
+        "new_tokens": new_tokens, "kv_cache": "paged",
+        "spec_k": spec_k, "proposer": "ngram",
+        "requests": n_requests,
+        "tokens_per_sec": round(
+            st["tokens_generated"] / wall_on, 1),
+        "tokens_per_sec_plain": round(
+            eng_off.stats["tokens_generated"] / wall_off, 1),
+        "wall_s": round(wall_on, 3),
+        # mean tokens emitted per slot per verify dispatch — the
+        # decode-throughput multiplier speculation buys
+        "accepted_tokens_per_step": round(
+            _tl_mean(eng_on, "spec_accepted_per_step"), 2),
+        "spec_accept_rate": st["spec_accept_rate"],
+        "spec_proposed": st["spec_proposed"],
+        "spec_accepted": st["spec_accepted"],
+        "dispatches": st["decode_dispatches"],
+        "dispatches_plain": eng_off.stats["decode_dispatches"],
+        "outputs_equal": all(
+            np.array_equal(a, b) for a, b in zip(out_on, out_off)),
+        "pages_leaked": st["pages_in_use"],   # must be 0
+    }
+    print(f"speculative: {row['accepted_tokens_per_step']} accepted "
+          f"tokens/step (accept rate {row['spec_accept_rate']}), "
+          f"{row['tokens_per_sec']} tok/s vs "
+          f"{row['tokens_per_sec_plain']} plain, outputs_equal="
+          f"{row['outputs_equal']}", file=sys.stderr, flush=True)
+    return row
+
+
 def _measure_metrics_overhead(cfg, model, slots=6, prompt_len=32,
                               new_tokens=24, page_size=16,
                               decode_window=8, prefill_chunk=64,
@@ -756,6 +843,7 @@ FILES = ["benchmarks/serving_bench.py",
          "paddle_tpu/models/generation.py",
          "paddle_tpu/inference/engine.py",
          "paddle_tpu/inference/prefix_cache.py",
+         "paddle_tpu/inference/speculative.py",
          "paddle_tpu/resilience/serving.py",
          "paddle_tpu/core/state.py",
          "paddle_tpu/ops/pallas/paged_attention.py",

@@ -686,6 +686,71 @@ def check_host_sync_in_loop(fndef, ctx):
                         break  # one finding per log-call argument
 
 
+@register(
+    "PDT113", "greedy-spec-sampling-mismatch", Severity.NOTE, "ast",
+    scope="eager",
+    example="""
+import paddle_tpu as paddle
+from paddle_tpu.inference import ContinuousBatchingEngine
+
+def serve(model, prompts):
+    eng = ContinuousBatchingEngine(model, max_slots=8, spec_decode=True,
+                                   spec_temperature=0.8)
+    for p in prompts:
+        eng.add_request(p, 32)
+    return eng.run()
+""",
+    near_miss="""
+import paddle_tpu as paddle
+from paddle_tpu.inference import ContinuousBatchingEngine
+
+def serve(model, prompts):
+    # rejection sampling keeps sampled speculative output lossless
+    eng = ContinuousBatchingEngine(model, max_slots=8, spec_decode=True,
+                                   spec_temperature=0.8,
+                                   spec_rejection_sampling=True)
+    for p in prompts:
+        eng.add_request(p, 32)
+    greedy = ContinuousBatchingEngine(model, max_slots=8,
+                                      spec_decode=True)  # greedy: exact
+    return eng.run()
+""")
+def check_greedy_spec_sampling_mismatch(fndef, ctx):
+    """A serving engine constructed with ``spec_decode`` on and a
+    non-greedy sampler (``spec_temperature > 0``) but WITHOUT
+    ``spec_rejection_sampling``: token-equality acceptance against
+    sampled target tokens skews the output distribution toward the
+    proposer (a draft is kept whenever the sampler happens to agree,
+    so proposer-favored continuations are over-represented), which
+    silently changes what the model says, not just how fast.  Greedy
+    speculative decoding (``spec_temperature = 0``, the default) is
+    exact by construction; sampled speculative decoding is exact only
+    under the rejection-sampling rule — set
+    ``spec_rejection_sampling=True`` (or the
+    ``serving_spec_rejection_sampling`` flag) or drop the
+    temperature.  Note-level advice, not an error."""
+
+    def _truthy(node):
+        return isinstance(node, ast.Constant) and bool(node.value)
+
+    for node in _walk_fn(fndef):
+        if not isinstance(node, ast.Call) \
+                or (_dotted(node.func) or "").split(".")[-1] \
+                != "ContinuousBatchingEngine":
+            continue
+        kws = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        if _truthy(kws.get("spec_decode")) \
+                and _truthy(kws.get("spec_temperature")) \
+                and not _truthy(kws.get("spec_rejection_sampling")):
+            yield node, (
+                "spec_decode with spec_temperature but no "
+                "spec_rejection_sampling: greedy token-equality "
+                "acceptance under a sampling temperature biases "
+                "output toward the proposer — enable "
+                "spec_rejection_sampling (lossless speculative "
+                "sampling) or decode greedily")
+
+
 # constant values that disable the engine's prefix cache — the string
 # spellings are the engine's case-insensitive parse set
 _PREFIX_CACHE_OFF = (False, 0) + PREFIX_CACHE_OFF_SPELLINGS

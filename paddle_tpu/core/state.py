@@ -138,6 +138,38 @@ for _env_name in ("PDTPU_SERVING_KV_QUANT", "PDTPU_KV_QUANT"):
         elif _env_kvq.lower() in KV_QUANT_OFF_SPELLINGS:
             _FLAGS["serving_kv_quant"] = False
 del _env_name, _env_kvq
+define_flag("serving_spec_decode", False,
+            "speculative decoding for the serving engine (ISSUE 9, "
+            "inference/speculative.py): per decode step each slot "
+            "submits its current token plus K proposed tokens as one "
+            "ragged verify segment (q_lens=K+1 through the existing "
+            "mixed program) and advances by the longest draft prefix "
+            "the target model agrees with plus one free token. Greedy "
+            "outputs are bitwise-identical to the flag off; only "
+            "tokens-per-dispatch moves. Engine kwarg spec_decode "
+            "overrides per instance.")
+define_flag("serving_spec_k", 4,
+            "draft tokens proposed per slot per speculative decode "
+            "step (the verify segment is K+1 rows, padded to the "
+            "engine's q_block). Engine kwarg spec_k overrides.")
+define_flag("serving_spec_proposer", "ngram",
+            "default proposer for spec_decode engines: 'ngram' is the "
+            "model-free prompt-lookup proposer (zero extra FLOPs). "
+            "Pass a Proposer instance (e.g. DraftModelProposer) via "
+            "the engine's spec_proposer kwarg for a draft model.")
+define_flag("serving_spec_temperature", 0.0,
+            "speculative-mode sampling temperature. 0 (default) = "
+            "greedy token-equality acceptance, bitwise vs plain "
+            "decode. > 0 samples the target's tokens — pair it with "
+            "serving_spec_rejection_sampling or the output "
+            "distribution skews toward the proposer (PDT113).")
+define_flag("serving_spec_rejection_sampling", False,
+            "lossless speculative SAMPLING acceptance: drafts accept "
+            "with probability p(draft) under the temperature-scaled "
+            "target distribution and rejections resample from the "
+            "residual, so the output distribution is exactly the "
+            "target's. Only meaningful with "
+            "serving_spec_temperature > 0.")
 define_flag("metrics", True,
             "observability runtime (paddle_tpu.observability): metrics "
             "registry recording, structured-event ring buffer, serving "

@@ -199,7 +199,10 @@ def create_predictor(config: Config) -> Predictor:
 from .engine import CompletedRequest  # noqa: E402
 from .engine import ContinuousBatchingEngine  # noqa: E402
 from .prefix_cache import PrefixCache  # noqa: E402
+from .speculative import (DraftModelProposer, NGramProposer,  # noqa: E402
+                          Proposer)
 
 __all__ = ["Config", "Predictor", "create_predictor",
            "ContinuousBatchingEngine", "CompletedRequest",
-           "PrefixCache"]
+           "PrefixCache", "Proposer", "NGramProposer",
+           "DraftModelProposer"]

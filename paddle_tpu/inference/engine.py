@@ -143,6 +143,37 @@ Observability (ISSUE 8; ``paddle_tpu.observability``):
   events.  Clean runs dump nothing; ``PDTPU_METRICS=off`` restores
   the pre-observability engine bitwise (serving_bench's
   ``metrics_overhead`` row pins the on state at <= 3% tokens/sec).
+
+Speculative decoding (ISSUE 9; ``inference/speculative.py``,
+``spec_decode`` kwarg / ``serving_spec_*`` flags, default off):
+
+* DRAFT-PROPOSE / RAGGED-VERIFY — per decode step each slot submits
+  its current token plus up to K proposed tokens as ONE ragged
+  segment (``q_lens = K+1``) through the mixed program; the verify
+  entry (``models.generation.verify_argmax``) returns the target's
+  greedy pick after EVERY position, and the slot advances by the
+  longest agreed draft prefix plus the target's free next token —
+  1..K+1 tokens per dispatch instead of exactly one.  Greedy outputs
+  are BITWISE-identical to ``spec_decode=off``: accepted tokens are by
+  construction the tokens plain decode would have produced.
+* RAGGED RETIREMENT / KV ROLLBACK — each slot's ``cur_pos`` /
+  ``len_written`` advances by its own accept count; KV written past
+  the first rejection is masked by ``kv_lens`` (data) and overwritten
+  positionally by the next dispatch, so published prefix-cache pages
+  only ever hold accepted tokens and ``kv_quant`` bytes for accepted
+  positions are identical to the non-speculative path.
+* PER-DRAFT GUARD — a slot whose verify segment contains any
+  non-finite row fails ALONE (PDT-E018) while co-residents keep
+  decoding; drilled by ``engine_draft_nan``, with
+  ``engine_draft_mismatch`` forcing the rejection path (bitwise, only
+  the accept rate moves).
+* PROPOSERS — the model-free n-gram / prompt-lookup proposer (zero
+  extra FLOPs, the serving-bench default) or a
+  ``DraftModelProposer`` (small GPT/LLaMA with its OWN paged KV pool
+  under the engine's free-list discipline).  ``stats`` grows
+  ``spec_proposed`` / ``spec_accepted`` / ``spec_accept_rate``;
+  timelines emit ``verify_window`` events and an
+  accepted-tokens-per-step histogram.
 """
 from __future__ import annotations
 
@@ -162,8 +193,10 @@ from ..observability import flight as _flight
 from ..observability import metrics as _obs_metrics
 from ..observability.serving import RegistryCounters, ServingTimelines
 from ..resilience import faults
-from ..resilience.serving import (SITE_PAGE_PRESSURE, DecodeGuard,
+from ..resilience.serving import (SITE_DRAFT_MISMATCH, SITE_DRAFT_NAN,
+                                  SITE_PAGE_PRESSURE, DecodeGuard,
                                   dispatch_retry)
+from . import speculative as _spec
 from .prefix_cache import PrefixCache
 
 __all__ = ["ContinuousBatchingEngine", "CompletedRequest"]
@@ -266,15 +299,20 @@ class ContinuousBatchingEngine:
     (``serving_prefix_cache`` flag; ``False``/``'off'`` restores
     uncached admission bitwise), ``kv_quant`` stores KV pages int8
     with in-kernel dequant (``serving_kv_quant`` flag; default off =
-    bitwise fp path).  ``clock`` (tests) replaces
-    ``time.monotonic`` for deterministic deadline drills."""
+    bitwise fp path), ``spec_decode``/``spec_k``/``spec_proposer``/
+    ``spec_temperature``/``spec_rejection_sampling`` drive speculative
+    decoding (``serving_spec_*`` flags; greedy spec is bitwise vs
+    off).  ``clock`` (tests) replaces ``time.monotonic`` for
+    deterministic deadline drills."""
 
     def __init__(self, model, *, max_slots=8, page_size=16,
                  max_seq_len=None, total_pages=None, decode_window=8,
                  prefill_chunk=64, q_block=8, pages_per_block=None,
                  max_queue=None, queue_policy=None,
                  default_deadline_ms=None, dispatch_retries=None,
-                 prefix_cache=None, kv_quant=None, clock=None):
+                 prefix_cache=None, kv_quant=None, spec_decode=None,
+                 spec_k=None, spec_proposer=None, spec_temperature=None,
+                 spec_rejection_sampling=None, spec_seed=0, clock=None):
         from ..core import state as _state
         from ..models.generation import (_decode_fn, _ragged_fn,
                                          _zero_pool)
@@ -297,9 +335,42 @@ class ContinuousBatchingEngine:
         if total_pages is None:
             total_pages = 1 + self.max_slots * self.np_per_seq
         self.total_pages = int(total_pages)
+        # speculative decoding (ISSUE 9; inference/speculative.py):
+        # decode slots submit K drafts + the current token as one
+        # ragged verify segment through the mixed program and advance
+        # by the accepted length — greedy outputs bitwise-identical to
+        # spec off, only tokens-per-dispatch moves
+        sd = (_state.get_flag("serving_spec_decode")
+              if spec_decode is None else spec_decode)
+        self.spec_decode = bool(sd)
+        self.spec_k = int(_state.get_flag("serving_spec_k")
+                          if spec_k is None else spec_k)
+        st_ = (_state.get_flag("serving_spec_temperature")
+               if spec_temperature is None else spec_temperature)
+        self.spec_temperature = float(st_)
+        rs = (_state.get_flag("serving_spec_rejection_sampling")
+              if spec_rejection_sampling is None
+              else spec_rejection_sampling)
+        self.spec_rejection_sampling = bool(rs)
+        self._proposer = None
+        self._spec_rng = np.random.default_rng(int(spec_seed))
+        if self.spec_decode:
+            if self.spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, "
+                                 f"got {self.spec_k}")
+            from .speculative import make_proposer
+            self._proposer = make_proposer(
+                _state.get_flag("serving_spec_proposer")
+                if spec_proposer is None else spec_proposer)
+            self._proposer.bind(self)
         # token budget of the mixed step: one q_block per slot (the
-        # ongoing decodes) + the prefill chunk
-        self.token_budget = (self.max_slots * self.q_block
+        # ongoing decodes; under spec_decode a slot's verify segment is
+        # up to spec_k+1 rows, q_block-padded) + the prefill chunk
+        seg_rows = self.q_block
+        if self.spec_decode:
+            seg_rows = max(seg_rows, -(-(self.spec_k + 1)
+                                       // self.q_block) * self.q_block)
+        self.token_budget = (self.max_slots * seg_rows
                              + self.prefill_chunk)
 
         # overload policies (kwarg > flag; 0 flag values mean "off")
@@ -372,6 +443,7 @@ class ContinuousBatchingEngine:
         self._admit_counter = 0
         self._step_fn = None
         self._mixed_fn = None
+        self._spec_fn = None
         self._cow_fn = None
         self._decode_exe = None
         # counters, RE-BACKED by a private observability registry
@@ -388,6 +460,11 @@ class ContinuousBatchingEngine:
             "failed", "rejected", "retries", "cache_hits",
             "cache_hit_tokens", "prefill_tokens_requested",
             "prefill_tokens_computed"))
+        # speculative counters (ISSUE 9) live in their OWN block so the
+        # stats property can APPEND them after every pre-existing key —
+        # the stats contract is keys/order-stable, new keys at the end
+        self._spec_stats = RegistryCounters(self._registry, (
+            "spec_proposed", "spec_accepted"))
         # per-request serving timelines (queue/TTFT/TPOT histograms +
         # structured events for the flight recorder), on the engine's
         # deadline clock so tests can drive them deterministically
@@ -431,6 +508,13 @@ class ContinuousBatchingEngine:
         d["kv_quant"] = self.kv_quant
         d["kv_page_bytes"] = self._page_bytes
         d["kv_bytes_in_use"] = d["pages_in_use"] * self._page_bytes
+        # speculative decoding (ISSUE 9) — APPENDED: every pre-existing
+        # key keeps its position (the backward-compat test pins that)
+        d["spec_proposed"] = self._spec_stats["spec_proposed"]
+        d["spec_accepted"] = self._spec_stats["spec_accepted"]
+        d["spec_accept_rate"] = round(
+            d["spec_accepted"] / d["spec_proposed"], 4) \
+            if d["spec_proposed"] else 0.0
         return d
 
     def metrics(self) -> dict:
@@ -569,6 +653,11 @@ class ContinuousBatchingEngine:
         way pages leave a slot — every retire/finalize/preempt path
         funnels here."""
         s = self._slots[b]
+        if self._proposer is not None and s.req is not None:
+            # proposer state follows the page discipline: a slot that
+            # drops its pages drops its draft KV too (a preempted
+            # request's proposer re-prefills on re-admission)
+            self._proposer.release(s.req.rid)
         self._cache.release(s.pages)
         self._bt[b, :] = 0
         self._slots[b] = _Slot()
@@ -811,7 +900,13 @@ class ContinuousBatchingEngine:
         completed.extend(self._sweep(self._clock()))
         self._admit()
         self._stats["steps"] += 1
-        if any(s.phase == "prefill" for s in self._slots):
+        if self.spec_decode and any(
+                s.phase in ("prefill", "decode") for s in self._slots):
+            # speculative mode: ONE program serves prefill chunks AND
+            # verify segments (q_lens up to spec_k+1) — the decode
+            # window scan cannot host a Python-side proposer
+            self._run_spec()
+        elif any(s.phase == "prefill" for s in self._slots):
             self._run_mixed()
         elif any(s.phase == "decode" for s in self._slots):
             self._run_decode()
@@ -945,46 +1040,53 @@ class ContinuousBatchingEngine:
             cache[key] = self._mixed_fn
         return self._mixed_fn
 
-    def _run_mixed(self):
-        """Pack one q_block-aligned segment per active slot — decode
-        slots their current token, prefill slots the next chunk that
-        fits — grow/preempt for the pages this step will write, and
-        advance everything in ONE dispatch."""
-        qb, T, B = self.q_block, self.token_budget, self.max_slots
-        budget = T - sum(qb for s in self._slots
-                         if s.phase == "decode")
-        plan = {}      # b -> (segment tokens, pos0, prefill take|None)
+    # shared segment planning/packing for the mixed AND speculative
+    # dispatch paths — ONE implementation, so spec-on scheduling can
+    # never drift from spec-off (the subsystem's bitwise-parity claim
+    # rests on both paths planning prefill, growing pages and packing
+    # tokens identically; only the decode-segment contents differ)
+    def _plan_prefill(self, plan, budget):
+        """Add each prefill slot's next chunk that fits ``budget`` to
+        ``plan`` (entries ``(segment, pos0, take, drafts)``)."""
+        qb = self.q_block
         for b, s in enumerate(self._slots):
-            if s.phase == "decode":
-                plan[b] = ([int(s.cur_tok)], s.cur_pos, None)
-            elif s.phase == "prefill":
-                rem = s.prefill_ids.size - s.prefill_off
-                take = min(rem, budget)
-                while take > 0 and -(-take // qb) * qb > budget:
-                    take -= 1     # q_block padding must fit the budget
-                if take <= 0:
-                    continue      # budget exhausted: sits out this step
-                budget -= -(-take // qb) * qb
-                plan[b] = (list(s.prefill_ids[s.prefill_off:
-                                              s.prefill_off + take]),
-                           s.prefill_off, take)
-        # page growth in admission order (earliest first — it can
-        # always win); growth may preempt later-admitted slots, planned
-        # or not, so drop plans whose slot got evicted
+            if s.phase != "prefill":
+                continue
+            rem = s.prefill_ids.size - s.prefill_off
+            take = min(rem, budget)
+            while take > 0 and -(-take // qb) * qb > budget:
+                take -= 1     # q_block padding must fit the budget
+            if take <= 0:
+                continue      # budget exhausted: sits out this step
+            budget -= -(-take // qb) * qb
+            plan[b] = (list(s.prefill_ids[s.prefill_off:
+                                          s.prefill_off + take]),
+                       s.prefill_off, take, None)
+
+    def _grow_plan(self, plan):
+        """Page growth in admission order (earliest first — it can
+        always win); growth may preempt later-admitted slots, planned
+        or not, so drop plans whose slot got evicted or that
+        self-preempted (latest + dry pool)."""
         order = sorted(plan, key=lambda b: self._slots[b].admit_seq)
         for b in order:
             s = self._slots[b]
             if s.req is None:           # evicted by an earlier grower
                 plan.pop(b)
                 continue
-            seg, pos0, take = plan[b]
-            target = (s.cur_pos + 1) if take is None else pos0 + len(seg)
-            if not self._ensure_tokens(b, target):
-                plan.pop(b)             # self-preempted (latest + dry)
-        plan = {b: p for b, p in plan.items()
-                if self._slots[b].req is not None}
-        if not plan:
-            return
+            seg, pos0, _take, _d = plan[b]
+            if not self._ensure_tokens(b, pos0 + len(seg)):
+                plan.pop(b)
+        for b in list(plan):
+            if self._slots[b].req is None:
+                plan.pop(b)
+
+    def _pack_plan(self, plan):
+        """Pack the plan's segments into the token-budget vectors;
+        returns ``(tok, tpos, tslot, tvalid, kv_lens, q_lens,
+        last_idx, row0)`` with each segment starting at a q_block
+        edge.  Also meters prefill compute (stats + timeline)."""
+        qb, T, B = self.q_block, self.token_budget, self.max_slots
         tok = np.zeros(T, np.int32)
         tpos = np.zeros(T, np.int32)
         tslot = np.zeros(T, np.int32)
@@ -992,12 +1094,13 @@ class ContinuousBatchingEngine:
         kv_lens = np.ones(B, np.int32)
         q_lens = np.zeros(B, np.int32)
         last_idx = np.zeros(B, np.int32)
+        row0 = {}
         cur = 0
         for b in range(B):
             if b not in plan:
                 continue
             s = self._slots[b]
-            seg, pos0, _take = plan[b]
+            seg, pos0, take, _d = plan[b]
             n = len(seg)
             tok[cur:cur + n] = seg
             tpos[cur:cur + n] = pos0 + np.arange(n)
@@ -1006,10 +1109,32 @@ class ContinuousBatchingEngine:
             q_lens[b] = n
             kv_lens[b] = s.len_written + n
             last_idx[b] = cur + n - 1
-            cur += -(-n // qb) * qb   # next segment at a q_block boundary
-            if _take is not None:     # honest prefill-compute meter:
-                self._stats["prefill_tokens_computed"] += _take
-                self._tl.prefill_chunk(s.req.rid, b, _take, pos0)
+            row0[b] = cur
+            cur += -(-n // qb) * qb   # next segment at a q_block edge
+            if take is not None:      # honest prefill-compute meter:
+                self._stats["prefill_tokens_computed"] += take
+                self._tl.prefill_chunk(s.req.rid, b, take, pos0)
+        return (tok, tpos, tslot, tvalid, kv_lens, q_lens, last_idx,
+                row0)
+
+    def _run_mixed(self):
+        """Pack one q_block-aligned segment per active slot — decode
+        slots their current token, prefill slots the next chunk that
+        fits — grow/preempt for the pages this step will write, and
+        advance everything in ONE dispatch."""
+        qb, T, B = self.q_block, self.token_budget, self.max_slots
+        plan = {}      # b -> (segment, pos0, prefill take|None, drafts)
+        budget = T
+        for b, s in enumerate(self._slots):
+            if s.phase == "decode":
+                plan[b] = ([int(s.cur_tok)], s.cur_pos, None, None)
+                budget -= qb
+        self._plan_prefill(plan, budget)
+        self._grow_plan(plan)
+        if not plan:
+            return
+        (tok, tpos, tslot, tvalid, kv_lens, q_lens, last_idx,
+         _row0) = self._pack_plan(plan)
         poison = self._guard.poison(
             [self._slots[b].req.rid if b in plan else None
              for b in range(B)])
@@ -1030,7 +1155,7 @@ class ContinuousBatchingEngine:
         self._stats["decode_dispatches"] += 1
         for b in sorted(plan):
             s = self._slots[b]
-            _seg, _pos0, take = plan[b]
+            _seg, _pos0, take, _d = plan[b]
             if bad[b]:
                 self._fail(b)
                 continue
@@ -1052,6 +1177,202 @@ class ContinuousBatchingEngine:
         s.cur_pos += 1
         self._stats["tokens_generated"] += 1
         self._tl.token(s.req.rid)
+
+    # --------------------------------------- speculative verify -------
+    def _get_spec_fn(self):
+        need_lg = self.spec_temperature > 0
+        key = ("spec", "guard", need_lg) + self._geometry()
+        cache = self._program_cache()
+        if self._spec_fn is None:
+            self._spec_fn = cache.get(key)
+        if self._spec_fn is None:
+            from .. import jit as jit_mod
+            from .. import ops
+            from ..models.generation import verify_argmax
+            model, ragged, qb = self.model, self._ragged, self.q_block
+            ppb = self.pages_per_block
+
+            if need_lg:
+                # sampling mode returns per-slot logits ROWS gathered
+                # in-graph ([B*(spec_k+1), V] — never the whole
+                # [token_budget, V] block, whose prefill/padding rows
+                # the host would not read)
+                def spec(ids_t, tok_pos, tok_slot, tok_valid, kv_lens,
+                         q_lens, poison, gather_idx, bt, *cs):
+                    import paddle_tpu as pp
+                    with pp.no_grad():
+                        logits, new = ragged(
+                            model, ids_t, tok_pos, tok_slot, tok_valid,
+                            kv_lens, q_lens, bt, list(cs), qb, ppb)
+                        toks, bad = verify_argmax(logits, tok_slot,
+                                                  tok_valid, poison)
+                        lgs = ops.gather(logits, gather_idx)
+                    return (toks, bad, lgs) + tuple(new)
+            else:
+                def spec(ids_t, tok_pos, tok_slot, tok_valid, kv_lens,
+                         q_lens, poison, bt, *cs):
+                    import paddle_tpu as pp
+                    with pp.no_grad():
+                        logits, new = ragged(
+                            model, ids_t, tok_pos, tok_slot, tok_valid,
+                            kv_lens, q_lens, bt, list(cs), qb, ppb)
+                        toks, bad = verify_argmax(logits, tok_slot,
+                                                  tok_valid, poison)
+                    return (toks, bad) + tuple(new)
+
+            self._spec_fn = jit_mod.to_static(spec)
+            cache[key] = self._spec_fn
+        return self._spec_fn
+
+    def _run_spec(self):
+        """Speculative mixed step (ISSUE 9): prefill slots pack chunks
+        exactly like :meth:`_run_mixed`; decode slots pack their
+        current token plus up to ``spec_k`` proposed tokens as a
+        ragged VERIFY segment (``q_lens = K+1`` — per-sequence lengths
+        are DATA to the kernel, so this is the same compiled program
+        every step) and advance by the accepted length.  Retirement is
+        RAGGED: each slot's ``cur_pos``/``len_written`` moves by its
+        own accept count, and KV written past the first rejection is
+        rolled back positionally — ``kv_lens`` masks it and the next
+        dispatch overwrites the same (page, slot) bytes, so published
+        prefix pages only ever hold accepted tokens."""
+        qb, T, B = self.q_block, self.token_budget, self.max_slots
+        plan = {}   # b -> (segment, pos0, prefill take|None, drafts)
+        budget = T
+        for b, s in enumerate(self._slots):
+            if s.phase != "decode":
+                continue
+            # room: at most stop_len - cur_pos - 1 tokens may still be
+            # emitted and one verify emits up to K+1, so K is clamped
+            # to keep every written position inside the page table
+            k = min(self.spec_k, max(s.stop_len - s.cur_pos - 2, 0))
+            drafts = np.empty(0, np.int32)
+            if k > 0:
+                ids = np.concatenate(
+                    [s.req.prompt, np.asarray(s.out_toks, np.int32)])
+                drafts = np.asarray(
+                    self._proposer.propose(s.req.rid, ids, k),
+                    np.int32).reshape(-1)[:k]
+                if drafts.size and faults.check(
+                        SITE_DRAFT_MISMATCH, key=str(s.req.rid)):
+                    # drill: corrupt the proposal so this verify step
+                    # rejects it — outputs must stay bitwise, only the
+                    # accept rate moves
+                    drafts = ((drafts + 1)
+                              % self.model.cfg.vocab_size).astype(
+                                  np.int32)
+            seg = [int(s.cur_tok)] + [int(t) for t in drafts]
+            plan[b] = (seg, s.cur_pos, None, drafts)
+            budget -= -(-len(seg) // qb) * qb
+        self._plan_prefill(plan, budget)
+        self._grow_plan(plan)
+        if not plan:
+            return
+        (tok, tpos, tslot, tvalid, kv_lens, q_lens, _last_idx,
+         row0) = self._pack_plan(plan)
+        # the standing nan drill arms on every dispatch a slot rides;
+        # engine_draft_nan arms ONLY on slots with a verify segment
+        # this dispatch (the site's documented scope)
+        poison = self._guard.poison(
+            [self._slots[b].req.rid if b in plan else None
+             for b in range(B)])
+        poison = poison + self._guard.poison(
+            [self._slots[b].req.rid
+             if b in plan and plan[b][2] is None else None
+             for b in range(B)], sites=(SITE_DRAFT_NAN,))
+        need_lg = self.spec_temperature > 0
+        W = self.spec_k + 1            # gathered rows per slot
+        fn = self._get_spec_fn()
+        args = [Tensor(jnp.asarray(tok[None, :])),
+                Tensor(jnp.asarray(tpos)), Tensor(jnp.asarray(tslot)),
+                Tensor(jnp.asarray(tvalid)),
+                Tensor(jnp.asarray(kv_lens)),
+                Tensor(jnp.asarray(q_lens)),
+                Tensor(jnp.asarray(poison))]
+        if need_lg:
+            # sampling needs logits rows: slot b's W-row window holds
+            # its verify rows (padded by repetition) — or, for a
+            # prefill slot, its LAST chunk row at window position 0
+            # (the first-token sample when the chunk completes prefill)
+            gather_idx = np.zeros(B * W, np.int32)
+            for b, (seg, _pos0, take, _d) in plan.items():
+                if take is None:
+                    n = len(seg)
+                    idx = row0[b] + np.minimum(np.arange(W), n - 1)
+                else:
+                    idx = np.full(W, row0[b] + take - 1)
+                gather_idx[b * W:(b + 1) * W] = idx
+            args.append(Tensor(jnp.asarray(gather_idx)))
+        args.append(Tensor(jnp.asarray(self._bt)))
+        res = self._dispatch("verify",
+                             lambda: fn(*args, *self._caches))
+        toks = np.asarray(res[0]._read()).reshape(-1)
+        bad = np.asarray(res[1]._read()).reshape(-1)
+        n_head = 2
+        logits = None
+        if need_lg:
+            logits = np.asarray(res[2]._read()).astype(
+                np.float32).reshape(B * W, -1)
+            n_head = 3
+        self._caches = list(res[n_head:])
+        self._stats["decode_dispatches"] += 1
+        if any(p[2] is not None for p in plan.values()):
+            self._stats["mixed_steps"] += 1
+        for b in sorted(plan):
+            s = self._slots[b]
+            seg, pos0, take, drafts = plan[b]
+            if bad[b]:
+                self._fail(b)        # per-draft guard: this slot alone
+                continue
+            if take is not None:     # prefill chunk — as _run_mixed,
+                s.prefill_off += take       # except a sampling engine
+                if s.prefill_off >= s.prefill_ids.size:  # SAMPLES the
+                    if need_lg:                     # first token too
+                        nxt = self._sample_row(logits[b * W])
+                    else:
+                        nxt = int(toks[row0[b] + take - 1])
+                    s.phase = "decode"
+                    s.cur_pos = s.prefill_ids.size
+                    s.cur_tok = nxt
+                    s.out_toks.append(nxt)
+                    self._stats["tokens_generated"] += 1
+                    self._tl.token(s.req.rid)
+                continue
+            # verify: greedy accepts the longest agreed draft prefix
+            # plus the target's free next token; spec_temperature > 0
+            # switches to the sampling rule over the gathered logits
+            n = len(seg)
+            if need_lg:
+                emitted, m = _spec.accept_sampled(
+                    drafts, logits[b * W:b * W + n],
+                    self.spec_temperature, self._spec_rng,
+                    rejection_sampling=self.spec_rejection_sampling)
+            else:
+                emitted, m = _spec.accept_greedy(
+                    drafts, toks[row0[b]:row0[b] + n])
+            self._spec_stats["spec_proposed"] += int(drafts.size)
+            self._spec_stats["spec_accepted"] += int(m)
+            adv = 0
+            for t in emitted:
+                self._accept(s, int(t))
+                adv += 1
+                if (s.eos >= 0 and int(t) == s.eos) \
+                        or s.cur_pos + 1 >= s.stop_len:
+                    break            # host replay of the stop rule
+            self._tl.verify_window(s.req.rid, int(drafts.size),
+                                   int(m), adv)
+
+    def _sample_row(self, row):
+        """Sample one token from a single logits row at the engine's
+        speculative temperature (the prefill-completion token of a
+        sampling-mode engine — argmax here would leak a greedy token
+        into an otherwise exactly-sampled stream).  Routes through
+        ``accept_sampled``'s free-token path so the sampling rule has
+        ONE home and cannot drift."""
+        emitted, _ = _spec.accept_sampled(
+            np.empty(0, np.int32), row[None], self.spec_temperature,
+            self._spec_rng)
+        return int(emitted[0])
 
     # ------------------------------------------------ decode window ---
     def _get_step_fn(self):

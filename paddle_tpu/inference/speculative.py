@@ -1,0 +1,372 @@
+"""Speculative decoding: draft-propose / ragged-verify (ISSUE 9).
+
+Decode throughput is bounded by one target-model dispatch per token —
+but the ragged paged-attention entry (PAPERS.md #1) already serves
+arbitrary per-sequence ``q_lens`` in a single call, which is exactly
+"verify K draft tokens per sequence".  The engine's speculative mode
+submits, per decode step and per slot, the slot's current token plus K
+proposed tokens as ONE ragged segment (``q_lens = K+1``) through the
+existing mixed program — no new kernel, no recompile per K (lengths are
+data) — and accepts the longest draft prefix the target model agrees
+with.
+
+Acceptance rule (greedy, the default):
+
+* the verify dispatch returns the target's greedy token after EVERY
+  position of the segment (``models.generation.verify_argmax``);
+* with committed position ``P``, current token ``t0`` and drafts
+  ``d1..dK``: let ``g_i`` be the target's pick after ``t0 d1..d_{i-1}``
+  and ``m`` the count of leading matches (``d_i == g_i``).  The step
+  emits ``g_1..g_{m+1}`` — the ``m`` agreed drafts plus the target's
+  own next token, which is free (its logits row was already computed).
+  Every emitted token is BY CONSTRUCTION the token plain greedy decode
+  would have produced on the same committed context, so speculative
+  greedy output is bitwise-identical to ``spec_decode=off``; drafts can
+  only change HOW MANY tokens a step emits (1..K+1), never which.
+* KV ROLLBACK is positional: the verify dispatch wrote K+1 tokens' KV
+  at positions ``P..P+K``, but the slot's ``len_written`` advances only
+  past the accepted prefix (``P+m+1``) — attention masks everything
+  beyond it (``kv_lens`` is data) and the next dispatch overwrites the
+  stale slots, because writes route by ``block_table[slot, pos //
+  page_size]``.  Published prefix-cache pages therefore only ever
+  contain accepted tokens (publication is bounded by ``len_written``),
+  and under ``kv_quant`` the accepted positions' bytes are identical to
+  the non-speculative path (per-token absmax quantization is a pure
+  function of each token's K/V vector).
+
+Rejection sampling (``spec_rejection_sampling``, off by default) makes
+speculative decoding lossless under a sampling temperature: draft
+``d_i`` is accepted with probability ``p_i(d_i)`` (the proposers here
+are deterministic, so the draft distribution is a delta and the
+classic ``min(1, p/q)`` rule reduces to ``p``); a rejection resamples
+from the residual ``p`` with ``d_i`` masked out, which preserves the
+target distribution exactly.  Greedy acceptance under a temperature
+WITHOUT rejection sampling skews the output distribution toward the
+proposer — the PDT113 lint flags that construction.
+
+Proposers:
+
+* :class:`NGramProposer` — model-free prompt-lookup: match the tail of
+  ``prompt + generated`` against earlier context and propose the
+  tokens that followed the most recent earlier occurrence.  Zero extra
+  FLOPs, zero state, fully CPU-testable; strongest on repetitive or
+  quote-heavy text (and on greedy loops, which untrained models love).
+* :class:`DraftModelProposer` — a small GPT/LLaMA drafts
+  autoregressively against its OWN paged KV pool, run with the same
+  page discipline as the engine (free-list allocator, reserved null
+  page 0, per-request block tables).  Draft KV rolls back by longest
+  common prefix with the committed stream, so rejected drafts cost
+  exactly their stale positions (overwritten on the next propose).
+
+The engine guards each verify dispatch per-draft: a slot whose segment
+contains ANY non-finite row fails alone (``NonFiniteLogitsError``,
+PDT-E018) while co-resident slots keep decoding — drilled by the
+``engine_draft_nan`` fault site; ``engine_draft_mismatch`` corrupts a
+slot's proposals to force rejection-path coverage (outputs stay
+bitwise, only the accept rate moves).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Proposer", "NGramProposer", "DraftModelProposer",
+           "make_proposer", "accept_greedy", "accept_sampled"]
+
+
+# ------------------------------------------------------------------ accept
+def accept_greedy(drafts, greedy):
+    """Longest-agreed-prefix acceptance: ``drafts`` [K] proposed tokens,
+    ``greedy`` [K+1] the target's greedy pick after each segment
+    position.  Returns the emitted tokens ``g_1..g_{m+1}`` (``m``
+    leading matches plus the target's free next token) and ``m``."""
+    drafts = np.asarray(drafts, np.int64).reshape(-1)
+    greedy = np.asarray(greedy, np.int64).reshape(-1)
+    m = 0
+    while m < drafts.size and drafts[m] == greedy[m]:
+        m += 1
+    return greedy[:m + 1].astype(np.int32), m
+
+
+def accept_sampled(drafts, logits, temperature, rng, *,
+                   rejection_sampling=True):
+    """Sampling-mode acceptance over the verify segment's logits rows.
+
+    ``logits`` [K+1, V] float32, ``temperature`` > 0.  With
+    ``rejection_sampling`` the deterministic-draft speculative-sampling
+    rule runs: accept ``d_i`` with probability ``p_i(d_i)`` (the
+    proposer's distribution is a delta at ``d_i``), on rejection
+    resample from the residual ``p_i`` with ``d_i`` masked — the output
+    distribution is exactly the target's.  Without it (the PDT113
+    misconfiguration, kept only so the lint has a real semantic to
+    describe) each row is sampled independently and drafts are accepted
+    by token equality, which biases toward the proposer.  Returns
+    ``(emitted tokens, accepted draft count)``."""
+    drafts = np.asarray(drafts, np.int64).reshape(-1)
+    lg = np.asarray(logits, np.float64) / max(float(temperature), 1e-6)
+    p = np.exp(lg - lg.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    out = []
+    if not rejection_sampling:
+        sampled = np.array([rng.choice(p.shape[-1], p=row) for row in p])
+        emitted, m = accept_greedy(drafts, sampled)
+        return emitted, m
+    m = 0
+    for i, d in enumerate(drafts):
+        if rng.random() < p[i, d]:
+            out.append(int(d))
+            m += 1
+            continue
+        resid = p[i].copy()
+        resid[d] = 0.0
+        z = resid.sum()
+        if z <= 0.0:          # p was itself a delta at d: accept it
+            out.append(int(d))
+            m += 1
+            continue
+        out.append(int(rng.choice(resid.size, p=resid / z)))
+        return np.asarray(out, np.int32), m
+    # every draft accepted: the last row's sample is free
+    out.append(int(rng.choice(p.shape[-1], p=p[drafts.size])))
+    return np.asarray(out, np.int32), m
+
+
+# --------------------------------------------------------------- proposers
+class Proposer:
+    """Interface the engine drives once per speculative decode step.
+
+    ``propose(rid, ids, k)`` returns up to ``k`` int32 draft tokens
+    predicted to continue ``ids`` (the request's committed
+    ``prompt + generated`` stream, last element included).  Returning
+    fewer — or none — is always legal: the engine falls back to a
+    plain 1-token step for that slot.  ``bind(engine)`` runs once at
+    engine construction (pool sizing); ``release(rid)`` whenever the
+    engine's ``_release_slot`` funnel drops the request's pages
+    (retire / finalize / preempt), so proposer state follows the
+    engine's own page discipline."""
+
+    def bind(self, engine):
+        pass
+
+    def propose(self, rid, ids, k):
+        raise NotImplementedError
+
+    def release(self, rid):
+        pass
+
+
+class NGramProposer(Proposer):
+    """Model-free prompt-lookup proposer (n-gram suffix match).
+
+    Matches the longest tail of length ``max_ngram`` down to
+    ``min_ngram`` against EARLIER context; on a hit, proposes the
+    tokens that followed the most recent earlier occurrence.  Costs no
+    FLOPs and no state — the drafts are free, and wrong drafts cost
+    nothing but their (rejected) verify rows."""
+
+    def __init__(self, max_ngram=3, min_ngram=1):
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = max(1, int(min_ngram))
+        if self.max_ngram < self.min_ngram:
+            raise ValueError("max_ngram < min_ngram")
+
+    def propose(self, rid, ids, k):
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        k = int(k)
+        if k <= 0:
+            return np.empty(0, np.int32)
+        for n in range(min(self.max_ngram, ids.size - 1),
+                       self.min_ngram - 1, -1):
+            tail = ids[-n:]
+            # windows ending strictly before the final position, newest
+            # first: the most recent occurrence tracks local context
+            win = np.lib.stride_tricks.sliding_window_view(
+                ids[:-1], n)                       # [ids.size - n, n]
+            hits = np.flatnonzero((win == tail).all(axis=1))
+            if hits.size == 0:
+                continue
+            j = int(hits[-1])                      # latest occurrence
+            cont = ids[j + n:j + n + k]
+            if cont.size:
+                return cont.astype(np.int32, copy=True)
+        return np.empty(0, np.int32)
+
+
+class _DraftSeq:
+    __slots__ = ("pages", "ctx")
+
+    def __init__(self):
+        self.pages = []
+        self.ctx = np.empty(0, np.int32)   # tokens whose KV is written
+
+
+class DraftModelProposer(Proposer):
+    """Draft-model proposer: a small causal LM generates K greedy draft
+    tokens per request against its OWN paged KV pool.
+
+    The pool runs the engine's page discipline — free-list allocator
+    with reserved null page 0, per-request block tables sized to the
+    engine's ``max_seq_len`` (``bind`` reads the geometry) — so draft
+    KV scales with resident tokens and releases deterministically with
+    the request.  Rejected drafts roll back by longest-common-prefix:
+    the stale positions are simply re-written on the next propose
+    (positional writes, same rollback argument as the target pool).
+
+    Propose cost is one compiled single-token dispatch per token fed
+    (catch-up + K drafts); the step program is cached on the draft
+    model per geometry, so every request shares it."""
+
+    def __init__(self, model, *, page_size=None, total_pages=None,
+                 pages_per_block=None):
+        model.eval()
+        from ..models.generation import _decode_fn
+        self.model = model
+        self._decode, _, self._hard_limit = _decode_fn(model)
+        self.page_size = page_size          # None: bind to the engine's
+        self.total_pages = total_pages
+        self.pages_per_block = pages_per_block
+        self._caches = None
+        self._free = None
+        self._seqs: dict[object, _DraftSeq] = {}
+        self._step_fn = None
+        self.max_seq_len = None
+        self.np_per_seq = None
+
+    # pool construction is deferred to bind(): the proposer mirrors the
+    # ENGINE's geometry (page size, sequence cap) so its free-list math
+    # lines up with the requests it serves
+    def bind(self, engine):
+        from collections import deque
+
+        from ..core.tensor import Tensor
+        from ..models.generation import _zero_pool
+        if self._caches is not None:
+            return
+        cfg = self.model.cfg
+        self.page_size = int(self.page_size or engine.page_size)
+        self.max_seq_len = int(engine.max_seq_len)
+        if self._hard_limit and self.max_seq_len > cfg.max_seq_len:
+            raise ValueError(
+                f"draft model max_seq_len {cfg.max_seq_len} < engine "
+                f"max_seq_len {self.max_seq_len}: the draft cannot "
+                f"reach every position the target serves")
+        self.np_per_seq = -(-self.max_seq_len // self.page_size)
+        if self.total_pages is None:
+            self.total_pages = 1 + engine.max_slots * self.np_per_seq
+        self.total_pages = int(self.total_pages)
+        n_kv = getattr(cfg, "num_kv_heads", cfg.num_heads)
+        shape = (n_kv, self.total_pages, self.page_size, cfg.head_dim)
+        self._caches = [Tensor(a) for a in _zero_pool(
+            shape, 2 * cfg.num_layers)]
+        self._free = deque(range(1, self.total_pages))  # 0 = null page
+
+    def _get_step_fn(self):
+        if self._step_fn is not None:
+            return self._step_fn
+        key = ("draft_step", self.page_size, self.np_per_seq,
+               self.total_pages, self.pages_per_block)
+        cache = self.model.__dict__.setdefault("_serving_step_cache", {})
+        self._step_fn = cache.get(key)
+        if self._step_fn is None:
+            from .. import jit as jit_mod
+            from ..models.generation import paged_slot_attention
+            model, decode = self.model, self._decode
+            ppb = self.pages_per_block
+
+            def step(tok, pos, bt, *cs):
+                import paddle_tpu as pp
+                with pp.no_grad():
+                    def attend(q, k, v, kc, vc, p):
+                        return paged_slot_attention(
+                            q, k, v, kc, vc, p, bt,
+                            pages_per_block=ppb)
+                    logits, new = decode(model, tok, pos, list(cs),
+                                         attend=attend)
+                return (logits,) + tuple(new)
+
+            self._step_fn = jit_mod.to_static(step)
+            cache[key] = self._step_fn
+        return self._step_fn
+
+    def _feed(self, tok, pos, bt):
+        """One draft-model token: write KV at ``pos``, return greedy
+        next token (host argmax — the draft is advisory, it needs no
+        guard)."""
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        fn = self._get_step_fn()
+        res = fn(Tensor(jnp.asarray([[tok]], jnp.int32)),
+                 Tensor(jnp.asarray([pos], jnp.int32)),
+                 Tensor(jnp.asarray(bt)), *self._caches)
+        self._caches = list(res[1:])
+        lg = np.asarray(res[0]._read()).astype(np.float32).reshape(-1)
+        return int(lg.argmax())
+
+    def propose(self, rid, ids, k):
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        k = int(k)
+        if self._caches is None:
+            raise RuntimeError("DraftModelProposer.propose before "
+                               "bind() — construct the engine first")
+        if self._hard_limit:
+            # learned position table: never feed past the draft's range
+            k = min(k, self.model.cfg.max_seq_len - ids.size + 1)
+        if k <= 0 or ids.size == 0:
+            return np.empty(0, np.int32)
+        st = self._seqs.setdefault(rid, _DraftSeq())
+        # rollback: KV is valid exactly for the longest common prefix of
+        # what was written and the committed stream
+        n = min(st.ctx.size, ids.size - 1)
+        lcp = 0
+        if n:
+            neq = np.flatnonzero(st.ctx[:n] != ids[:n])
+            lcp = int(neq[0]) if neq.size else n
+        need = -(-(ids.size + k - 1) // self.page_size)
+        while len(st.pages) < need:
+            if not self._free:
+                return np.empty(0, np.int32)   # pool dry: no drafts
+            st.pages.append(self._free.popleft())
+        bt = np.zeros((1, self.np_per_seq), np.int32)
+        bt[0, :len(st.pages)] = st.pages
+        # catch-up (logits ignored) then K greedy drafts; every fed
+        # token's KV lands at its position, so ctx records the stream
+        out = []
+        written = list(ids[:lcp])
+        for pos in range(lcp, ids.size - 1):
+            self._feed(int(ids[pos]), pos, bt)
+            written.append(int(ids[pos]))
+        tok = int(ids[-1])
+        pos = ids.size - 1
+        for _ in range(k):
+            nxt = self._feed(tok, pos, bt)
+            written.append(tok)
+            out.append(nxt)
+            tok, pos = nxt, pos + 1
+        st.ctx = np.asarray(written, np.int32)
+        return np.asarray(out, np.int32)
+
+    def release(self, rid):
+        st = self._seqs.pop(rid, None)
+        if st is not None:
+            self._free.extend(st.pages)
+
+    @property
+    def pages_free(self):
+        """Free-list depth (tests audit the draft pool's conservation
+        the same way they audit the engine's)."""
+        return len(self._free) if self._free is not None else None
+
+
+def make_proposer(spec):
+    """Resolve the engine's ``spec_proposer`` kwarg / flag: a
+    :class:`Proposer` instance passes through, ``"ngram"`` builds the
+    model-free default.  (A draft model has constructor knobs of its
+    own — pass a :class:`DraftModelProposer` instance.)"""
+    if isinstance(spec, Proposer):
+        return spec
+    if isinstance(spec, str) and spec.lower() in ("ngram",
+                                                  "prompt_lookup"):
+        return NGramProposer()
+    raise ValueError(
+        f"spec_proposer={spec!r}: expected a Proposer instance or "
+        f"'ngram'")

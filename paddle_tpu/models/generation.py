@@ -225,6 +225,40 @@ def guarded_argmax(lg, poison):
 
 
 @primitive
+def verify_argmax(lg, tok_slot, tok_valid, poison):
+    """Per-ROW greedy pick + per-slot finiteness flag — the ragged
+    VERIFY entry of the speculative decoding subsystem (ISSUE 9;
+    ``inference/speculative.py``).
+
+    Where :func:`guarded_argmax` serves one gathered row per slot, the
+    speculative mixed program needs the target's greedy token after
+    EVERY packed position: a slot's verify segment (current token + K
+    drafts, ``q_lens = K+1``) yields K+1 candidate tokens, and the host
+    accepts the longest prefix whose drafts agree — the variable
+    per-slot advance that multiplies tokens per dispatch.
+
+    ``lg`` [T, V] packed logits, ``tok_slot``/``tok_valid`` [T] the
+    packing vectors, ``poison`` [B] float32 (0.0 normally; NaN for a
+    slot the ``engine_nan_decode``/``engine_draft_nan`` drills poison —
+    broadcast to the slot's rows, argmax-invariant when 0).  Returns
+    ``(toks [T] int32, bad [B] bool)``: ``bad`` is the PER-DRAFT guard
+    — ANY non-finite valid row fails its slot alone (padding rows are
+    masked; their logits are garbage by contract), and a bad row's
+    token is forced to 0 so the host replay sees a deterministic
+    discarded value."""
+    sl = tok_slot.reshape(-1).astype(jnp.int32)
+    pv = poison.reshape(-1)
+    lg = lg.astype(jnp.float32) + pv[sl][:, None]
+    valid = tok_valid.reshape(-1).astype(jnp.bool_)
+    row_bad = jnp.logical_not(jnp.all(jnp.isfinite(lg), axis=-1)) \
+        & valid
+    toks = jnp.where(row_bad, 0, lg.argmax(-1)).astype(jnp.int32)
+    bad = jnp.zeros(pv.shape[0], jnp.int32).at[sl].max(
+        row_bad.astype(jnp.int32)) > 0
+    return toks, bad
+
+
+@primitive
 def cache_prefill(k_new, v_new, k_cache, v_cache):
     """Write the WHOLE prompt's K/V [B, S, Hkv, D] into cache[:, :S] in
     one shot (batched prefill — the serving-path complement of the

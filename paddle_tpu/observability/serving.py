@@ -10,8 +10,9 @@ preempted/requeued -> retired) as it schedules; the timelines object
 
 * emits the corresponding structured events into the process ring
   (``serving.enqueued`` / ``serving.admitted`` / ``serving.first_token``
-  / ``serving.decode_window`` / ``serving.preempted`` /
-  ``serving.retired`` — the flight recorder's request-level story), and
+  / ``serving.decode_window`` / ``serving.verify_window`` /
+  ``serving.preempted`` / ``serving.retired`` — the flight recorder's
+  request-level story), and
 * derives the latency metrics the TPU serving literature frames
   comparisons in: queue-time, TTFT (enqueue -> first generated token),
   TPOT (steady-state inter-token), decode-tokens-per-window, plus
@@ -99,6 +100,10 @@ class ServingTimelines:
         self._h_dispatch = registry.histogram(
             "serving.dispatch_ms", "per-dispatch round trip",
             LATENCY_BUCKETS_MS)
+        self._h_spec = registry.histogram(
+            "serving.spec_accepted_per_step",
+            "tokens emitted per speculative verify step per slot "
+            "(accepted drafts + the free target token)", COUNT_BUCKETS)
 
     # labeled (by finish_reason) metrics are created on first use — the
     # registry get-or-creates, so repeat reasons share one object
@@ -171,6 +176,18 @@ class ServingTimelines:
         self._h_window.observe(int(tokens))
         _events.emit("serving.decode_window", tokens=int(tokens),
                      live_slots=int(live_slots))
+
+    def verify_window(self, rid, proposed, accepted, emitted):
+        """One slot's speculative verify outcome (ISSUE 9):
+        ``proposed`` drafts submitted, ``accepted`` of them agreed
+        with the target, ``emitted`` tokens advanced (accepted + the
+        free target token, clipped by eos/stop)."""
+        if not enabled():
+            return
+        self._h_spec.observe(int(emitted))
+        _events.emit("serving.verify_window", rid=rid,
+                     proposed=int(proposed), accepted=int(accepted),
+                     emitted=int(emitted))
 
     def dispatch(self, kind, ms):
         if not enabled():

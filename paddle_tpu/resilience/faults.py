@@ -37,6 +37,15 @@ sleeps or randomness:
   the eviction path (an evicted prefix transparently re-prefills with
   bitwise-identical output). Key = the request id the allocation
   serves.
+* ``engine_draft_nan``    — ONE slot's speculative verify rows are
+  poisoned with NaN for one dispatch, drilling the per-draft decode
+  guard (that request fails with ``finish_reason='failed'``
+  PDT-E018; co-resident slots keep decoding bitwise). Key = the
+  request id.
+* ``engine_draft_mismatch`` — one slot's draft proposal is corrupted
+  (tokens shifted mod vocab) before the verify dispatch, forcing the
+  rejection path: outputs stay bitwise, accepted-draft counters drop.
+  Key = the request id.
 
 Spec grammar (``;``-separated rules)::
 

@@ -29,6 +29,16 @@ Serving fault sites (``resilience.faults`` spec grammar):
   allocation even while free pages remain, drilling eviction-then-
   transparent-re-prefill without filling the pool. Key = the request
   id the allocation serves.
+* ``engine_draft_nan`` — poisons ONE slot's speculative VERIFY rows
+  with NaN for one dispatch (ISSUE 9): the per-draft guard
+  (``models.generation.verify_argmax``) fails exactly that request
+  with PDT-E018 while co-resident slots keep decoding. Key = the
+  request id.
+* ``engine_draft_mismatch`` — corrupts one slot's draft proposal
+  (tokens shifted mod vocab) so the verify step rejects it, forcing
+  the 0-accept path: outputs stay bitwise (the acceptance rule is
+  correct for ANY drafts), only the accept rate moves. Key = the
+  request id.
 """
 from __future__ import annotations
 
@@ -40,7 +50,7 @@ from . import faults
 __all__ = [
     "FINISH_REASONS", "DecodeGuard", "dispatch_retry",
     "SITE_DISPATCH", "SITE_NAN_DECODE", "SITE_PAGE_PRESSURE",
-    "SITE_CACHE_EVICT",
+    "SITE_CACHE_EVICT", "SITE_DRAFT_NAN", "SITE_DRAFT_MISMATCH",
 ]
 
 #: Every value ``CompletedRequest.finish_reason`` can take.
@@ -50,6 +60,8 @@ SITE_DISPATCH = "engine_dispatch"
 SITE_NAN_DECODE = "engine_nan_decode"
 SITE_PAGE_PRESSURE = "engine_page_pressure"
 SITE_CACHE_EVICT = "engine_cache_evict"
+SITE_DRAFT_NAN = "engine_draft_nan"
+SITE_DRAFT_MISMATCH = "engine_draft_mismatch"
 
 
 class DecodeGuard:
@@ -65,21 +77,27 @@ class DecodeGuard:
     def __init__(self, max_slots: int):
         self.max_slots = int(max_slots)
 
-    def poison(self, slot_rids) -> np.ndarray:
+    def poison(self, slot_rids, sites=(SITE_NAN_DECODE,)) -> np.ndarray:
         """[max_slots] float32: NaN for slots whose request id fires
-        the ``engine_nan_decode`` site this dispatch, 0.0 elsewhere.
-        ``slot_rids`` maps slot index -> request id (None = idle)."""
+        one of the ``sites`` this dispatch, 0.0 elsewhere.
+        ``slot_rids`` maps slot index -> request id (None = idle); the
+        speculative verify dispatch adds ``engine_draft_nan`` so a
+        NaN'd draft drills the per-draft guard."""
         vec = np.zeros(self.max_slots, np.float32)
         for b, rid in enumerate(slot_rids):
             if rid is None:
                 continue
-            if faults.check(SITE_NAN_DECODE, key=str(rid)):
-                vec[b] = np.nan
-                # flight-recorder breadcrumb: the poison lands one
-                # dispatch before the guard reports it, so the drilled
-                # timeline reads cause -> effect like a real NaN would
-                from ..observability import events as _events
-                _events.emit("serving.nan_poison", rid=rid, slot=b)
+            for site in sites:
+                if faults.check(site, key=str(rid)):
+                    vec[b] = np.nan
+                    # flight-recorder breadcrumb: the poison lands one
+                    # dispatch before the guard reports it, so the
+                    # drilled timeline reads cause -> effect like a
+                    # real NaN would
+                    from ..observability import events as _events
+                    _events.emit("serving.nan_poison", rid=rid, slot=b,
+                                 site=site)
+                    break
         return vec
 
     @staticmethod

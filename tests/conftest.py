@@ -58,8 +58,11 @@ _TIER1_ORDER = [
     "test_quantization.py", "test_auto_parallel.py",
     "test_sparse_breadth.py", "test_vision_ops_inference.py",
     "test_rnn.py",
-    # pinned acceptance block: kernels + serving parity (fp and quant)
+    # pinned acceptance block: kernels + serving parity (fp, quant,
+    # speculative — test_speculative reuses the session model and the
+    # serving-engine geometries, so it rides the same compiled programs)
     "test_pallas.py", "test_quant_serving.py", "test_serving_engine.py",
+    "test_speculative.py",
     # <- unlisted files slot in here (rank _TIER1_DEFAULT)
     # medium density; the budget cutoff lands somewhere below
     "test_fft_signal_distribution.py", "test_op_tail.py",

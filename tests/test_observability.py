@@ -222,6 +222,9 @@ _STAT_KEYS = [
     # live gauges appended by the stats property
     "cached_pages", "evictions", "pages_in_use", "pages_free",
     "queue_depth", "kv_quant", "kv_page_bytes", "kv_bytes_in_use",
+    # speculative decoding (ISSUE 9) — strictly APPENDED so every
+    # pre-existing key keeps its position
+    "spec_proposed", "spec_accepted", "spec_accept_rate",
 ]
 
 
@@ -258,7 +261,7 @@ def test_engine_stats_backward_compat(gpt):
     assert list(st_on) == _STAT_KEYS == list(st_off)
     assert st_on == st_off                   # flag changes NOTHING here
     for k in _STAT_KEYS:
-        if k != "kv_quant":
+        if k not in ("kv_quant", "spec_accept_rate"):
             assert isinstance(st_on[k], int), k
     # ...and the off engine's outputs match the on engine's bitwise
     for rid in done_on:
