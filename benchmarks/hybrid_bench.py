@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""gpt_3d bench row: hybrid DP x TP x PP training over the fleet
+topology (ISSUE 11).
+
+The row answers two questions the single-chip gpt124m headline cannot:
+
+1. does the hybrid path SCALE — tokens/sec on the full mesh vs the
+   1-device step rate times the device count (target >= 0.9x linear to
+   4 chips);
+2. is the communication HIDDEN — ``overlap_frac`` from the
+   overlap-scheduled bucketed DP grad sync (distributed/overlap.py) and
+   the pipeline's eager-issued ppermute sends (pp_overlap_p2p), with
+   ``comm_ms`` alongside so a regression shows up as a number, not a
+   vibe.
+
+Layout: ``HybridCommunicateGroup(dp, pp, mp)`` -> ``process_mesh()`` ->
+``GPTForCausalLMPipe.train_batch`` (fused 1F1B, dp via batch_axes, TP
+via the stacked-leaf tp_rules) compiled as ONE jit step. The overlap
+telemetry comes from an eager replicated-DP segment over the same
+device set — the path the scheduler exists for (in-program GSPMD comm
+is XLA-scheduled and unobservable from the host).
+
+CPU smoke (tests/test_overlap.py): tiny config, dp2 x pp2 on the forced
+8-device mesh, validates the row's accounting fields and the bitwise
+gates; absolute times and the >= 0.9x scaling gate are TPU-only claims.
+TP inside the pipeline needs partial-auto shard_map (jax >= 0.5) — on
+older jax the row demotes mp into dp and records the demotion.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _measure_gpt_3d(cfg, dp=2, pp=2, mp=1, batch_per_dp=2, seq=64,
+                    num_microbatches=2, steps=8, warmup=2,
+                    overlap_steps=3, lr=1e-4, peak_flops=None):
+    """One gpt_3d row. ``cfg``: GPTConfig (dropout must be 0). Batch is
+    ``batch_per_dp * dp`` so per-device work is constant as dp grows —
+    the weak-scaling convention the linearity gate assumes."""
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.core import state as _state
+    from paddle_tpu.distributed.fleet.topology import \
+        HybridCommunicateGroup
+    from paddle_tpu.models.gpt import GPTForCausalLM, GPTForCausalLMPipe
+
+    need = dp * pp * mp
+    ndev = len(jax.devices())
+    if ndev < need:
+        raise RuntimeError(f"gpt_3d wants {need} devices, have {ndev}")
+    tp_axis = "mp" if mp > 1 else None
+    demoted = False
+    from paddle_tpu.core.meshutil import legacy_manual_vjp
+    if tp_axis and legacy_manual_vjp():
+        # partial-auto shard_map (TP under GSPMD inside the manual
+        # pipeline) does not exist before jax 0.5 — fold mp into dp so
+        # the row still measures the full device set
+        dp, mp, tp_axis, demoted = dp * mp, 1, None, True
+    hcg = HybridCommunicateGroup(dp_degree=dp, pp_degree=pp,
+                                 mp_degree=mp)
+    mesh = hcg.process_mesh()
+    batch = batch_per_dp * dp
+
+    paddle.seed(0)
+    pipe = GPTForCausalLMPipe(cfg, mesh, pp_axis="pp", dp_axis="dp",
+                              num_microbatches=num_microbatches,
+                              tp_axis=tp_axis)
+    pipe.train()
+    opt = paddle.optimizer.AdamW(learning_rate=lr,
+                                 parameters=pipe.parameters())
+
+    @paddle.jit.to_static
+    def step(ids, labels):
+        loss = pipe.train_batch(ids, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.default_rng(0)
+
+    def batch_fn():
+        ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(
+            np.int32)
+        lab = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(
+            np.int32)
+        return paddle.to_tensor(ids), paddle.to_tensor(lab)
+
+    for _ in range(warmup):
+        loss = step(*batch_fn())
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(*batch_fn())
+    final_loss = float(loss)  # sync
+    dt = (time.perf_counter() - t0) / steps
+    tok_s = batch * seq / dt
+
+    # --- 1-device baseline at the SAME per-device batch (weak scaling)
+    paddle.seed(0)
+    ref = GPTForCausalLM(cfg)
+    ref.train()
+    ref_opt = paddle.optimizer.AdamW(learning_rate=lr,
+                                     parameters=ref.parameters())
+
+    @paddle.jit.to_static
+    def ref_step(ids, labels):
+        loss = ref(ids, labels)
+        loss.backward()
+        ref_opt.step()
+        ref_opt.clear_grad()
+        return loss
+
+    def ref_batch():
+        ids = rng.integers(0, cfg.vocab_size,
+                           (batch_per_dp, seq)).astype(np.int32)
+        lab = rng.integers(0, cfg.vocab_size,
+                           (batch_per_dp, seq)).astype(np.int32)
+        return paddle.to_tensor(ids), paddle.to_tensor(lab)
+
+    for _ in range(warmup):
+        rl = ref_step(*ref_batch())
+    float(rl)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        rl = ref_step(*ref_batch())
+    float(rl)
+    dt1 = (time.perf_counter() - t0) / steps
+    tok_s_1dev = batch_per_dp * seq / dt1
+    chips = dp * pp * mp
+    scaling_x = tok_s / (tok_s_1dev * chips) if tok_s_1dev else 0.0
+
+    # --- overlap telemetry: eager replicated-DP segment over the same
+    # device set, overlap scheduler ON (the in-program pipeline comm is
+    # XLA-scheduled; this is the host-observable half of the claim)
+    old_flag = _state.get_flag("dp_overlap_grad_sync")
+    _state.set_flags({"dp_overlap_grad_sync": True})
+    try:
+        paddle.seed(0)
+        dp_model = dist.DataParallel(GPTForCausalLM(cfg))
+        dp_opt = paddle.optimizer.AdamW(
+            learning_rate=lr, parameters=dp_model.parameters())
+        ids, lab = batch_fn()
+        for _ in range(overlap_steps):
+            loss = dp_model(ids, lab)
+            loss.backward()
+            dp_model.apply_collective_grads()
+            dp_opt.step()
+            dp_opt.clear_grad()
+        ov = dict(dp_model._overlap.last) if dp_model._overlap else {}
+        ov.pop("ready_order", None)
+        ov["collectives"] = getattr(dp_model, "_last_sync_collectives",
+                                    0)
+    finally:
+        _state.set_flags({"dp_overlap_grad_sync": old_flag})
+
+    flops_tok = ref.flops_per_token(seq)
+    achieved = tok_s * flops_tok
+    row = {
+        "metric": "gpt_3d_train_tokens_per_sec",
+        "value": round(tok_s, 1),
+        "unit": "tokens/sec",
+        "topology": {"dp": dp, "pp": pp, "mp": mp,
+                     "tp_demoted_to_dp": demoted,
+                     "num_microbatches": num_microbatches},
+        "chips": chips,
+        "batch": batch, "seq_len": seq,
+        "step_time_ms": round(dt * 1e3, 2),
+        "tokens_per_sec_1dev": round(tok_s_1dev, 1),
+        "scaling_x": round(scaling_x, 3),
+        "overlap": ov,
+        "pp_overlap_p2p": bool(_state.get_flag("pp_overlap_p2p")),
+        "final_loss": round(final_loss, 4),
+    }
+    if peak_flops:
+        row["mfu"] = round(achieved / (peak_flops * chips), 4)
+        row["model_tflops_per_sec"] = round(achieved / 1e12, 2)
+    return row
+
+
+def bench_row(peak_flops=None, smoke=False):
+    """The driver-facing row. ``smoke`` (CPU): tiny config, dp2 x pp2
+    (x mp2 when partial-auto shard_map exists), accounting-only."""
+    from paddle_tpu.models.gpt import GPTConfig
+
+    if smoke:
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                        num_heads=4, max_seq_len=64, dropout=0.0)
+        return _measure_gpt_3d(cfg, dp=2, pp=2, mp=2, batch_per_dp=2,
+                               seq=16, num_microbatches=2, steps=2,
+                               warmup=1, overlap_steps=2)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                    num_heads=12, max_seq_len=1024, dropout=0.0,
+                    recompute=False)
+    import jax
+    ndev = len(jax.devices())
+    # 4-chip target: dp2 x pp2 with TP folded in on >= 8 chips
+    dp = 2 if ndev >= 4 else 1
+    mp = 2 if ndev >= 8 else 1
+    pp = 2 if ndev >= 4 else max(1, ndev)
+    return _measure_gpt_3d(cfg, dp=dp, pp=pp, mp=mp, batch_per_dp=8,
+                           seq=1024, num_microbatches=8, steps=10,
+                           warmup=2, peak_flops=peak_flops)
+
+
+FILES = ["benchmarks/hybrid_bench.py",
+         "paddle_tpu/distributed/fleet/pipeline.py",
+         "paddle_tpu/distributed/fleet/topology.py",
+         "paddle_tpu/distributed/overlap.py",
+         "paddle_tpu/distributed/parallel.py",
+         "paddle_tpu/distributed/collective.py",
+         "paddle_tpu/core/meshutil.py",
+         "paddle_tpu/ops/pallas/flash_attention.py",
+         "paddle_tpu/models/gpt.py"]
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    if len(jax.devices()) < 4:
+        print("hybrid_bench: needs >= 4 devices; skipping",
+              file=sys.stderr)
+        return 0
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    import measured_cache as mc
+    kind = str(getattr(dev, "device_kind", dev.platform))
+    ver = mc.code_version(*FILES)
+    row = mc.load(kind, "gpt_3d", ver)
+    if row is None:
+        row = bench_row(smoke=(dev.platform != "tpu"))
+        mc.store(kind, "gpt_3d", ver, row)
+    print(json.dumps({"gpt_3d": row}, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

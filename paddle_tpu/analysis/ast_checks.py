@@ -821,3 +821,104 @@ def check_prefix_cache_off_under_load(fndef, ctx):
                 "re-prefill; preempted requests restore instead of "
                 "recomputing) and hits are bitwise-identical — drop "
                 "the override or set serving_prefix_cache")
+
+
+@register(
+    "PDT114", "serialized-grad-sync", Severity.NOTE, "ast",
+    scope="eager",
+    example="""
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+def train(model, opt, batches):
+    dp = dist.DataParallel(model)
+    for x, y in batches:
+        loss = ((dp(x) - y) ** 2).mean()
+        loss.backward()
+        dp.apply_collective_grads()
+        opt.step()
+        opt.clear_grad()
+""",
+    near_miss="""
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+def train(model, opt, batches):
+    # overlap scheduler: bucket collectives dispatch DURING backward,
+    # apply_collective_grads only drains the pending results
+    dp = dist.DataParallel(model, overlap_grad_sync=True)
+    for x, y in batches:
+        loss = ((dp(x) - y) ** 2).mean()
+        loss.backward()
+        dp.apply_collective_grads()
+        opt.step()
+        opt.clear_grad()
+""")
+def check_serialized_grad_sync(fndef, ctx):
+    """An explicit blocking gradient all-reduce
+    (``apply_collective_grads()`` / ``all_reduce(...grad...)``) between
+    ``backward()`` and ``step()`` in an eager train loop: every
+    collective waits for the WHOLE backward and the step waits for
+    every collective, so communication serializes with compute. The
+    bucketed overlap scheduler (``DataParallel(...,
+    overlap_grad_sync=True)`` or the ``dp_overlap_grad_sync`` flag)
+    dispatches one psum-mean per size-capped bucket as each bucket's
+    grads finalize during the backward walk — bitwise-identical
+    results, collectives hidden under the remaining backward compute
+    (``train.overlap_frac`` in the observability registry shows how
+    much). Note-level advice, not an error."""
+
+    def _overlap_enabled():
+        # a DataParallel(...) built anywhere in this function with a
+        # truthy overlap_grad_sync already overlaps: stand down
+        for node in _walk_fn(fndef):
+            if isinstance(node, ast.Call) \
+                    and (_dotted(node.func) or "").split(".")[-1] \
+                    == "DataParallel":
+                for kw in node.keywords:
+                    if kw.arg == "overlap_grad_sync" \
+                            and isinstance(kw.value, ast.Constant) \
+                            and bool(kw.value.value):
+                        return True
+        return False
+
+    if _overlap_enabled():
+        return
+    for node in _walk_fn(fndef):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        has_backward = False
+        sync_node = None
+        has_step = False
+        # own-scope walk (PDT108 contract): nested defs lint themselves
+        stack = [node]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(sub))
+            if not (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)):
+                continue
+            attr = sub.func.attr
+            if attr == "backward":
+                has_backward = True
+            elif attr == "apply_collective_grads":
+                sync_node = sync_node or sub
+            elif attr == "all_reduce" and sub.args:
+                # all_reduce(p.grad ...) — the hand-rolled per-tensor
+                # spelling of the same serialized sync
+                a0 = sub.args[0]
+                if isinstance(a0, ast.Attribute) and a0.attr == "grad":
+                    sync_node = sync_node or sub
+            elif attr in ("step", "minimize"):
+                has_step = True
+        if has_backward and sync_node is not None and has_step:
+            yield sync_node, (
+                "blocking grad all-reduce between backward() and "
+                "step(): the collectives serialize after the whole "
+                "backward — construct DataParallel with "
+                "overlap_grad_sync=True (or set dp_overlap_grad_sync) "
+                "so bucket collectives dispatch as grads finalize "
+                "during backward and overlap the remaining compute; "
+                "results are bitwise-identical")

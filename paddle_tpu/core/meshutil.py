@@ -1,5 +1,5 @@
 """Small shard_map helpers shared by the manual-collective modules
-(ring attention, pipeline, MoE)."""
+(ring attention, pipeline, MoE, DP grad sync)."""
 from __future__ import annotations
 
 from jax import lax
@@ -7,10 +7,63 @@ from jax import lax
 
 def pvary(xs, axes):
     """Mark values as varying over the given manual mesh axes (shard_map's
-    vma type system; the API name differs across jax versions)."""
+    vma type system; the API name differs across jax versions — and the
+    type system does not exist at all before jax 0.5, where this is a
+    no-op)."""
     axes = tuple(axes)
     if not axes:
         return xs
     if hasattr(lax, "pcast"):
         return lax.pcast(xs, axes, to="varying")
-    return lax.pvary(xs, axes)
+    if hasattr(lax, "pvary"):
+        return lax.pvary(xs, axes)
+    return xs  # jax < 0.5: no varying-manual-axes type system
+
+
+def axis_size(axis):
+    """``lax.axis_size`` across jax versions (pre-0.5 lacks it; the size
+    of a manual mesh axis is the psum of 1 over it — a compile-time
+    constant, not a runtime collective)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
+
+
+def legacy_manual_vjp() -> bool:
+    """True on the legacy experimental shard_map (jax < 0.5): its AD has
+    no varying-axes (vma) type system, so a ``jax.vjp`` taken INSIDE the
+    body produces purely LOCAL cotangents — callers must psum cotangents
+    of replicated inputs over the axes they are invariant on themselves
+    (the modern path inserts those psums automatically when the seed is
+    ``pvary``-marked)."""
+    import jax
+    return not hasattr(jax, "shard_map")
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None):
+    """``jax.shard_map`` across jax versions.
+
+    jax >= 0.5 spells it ``jax.shard_map(f, mesh=..., in_specs=...,
+    out_specs=..., axis_names=...)``; before that it lives at
+    ``jax.experimental.shard_map.shard_map`` with ``auto=`` (the
+    COMPLEMENT of ``axis_names`` — axes left to GSPMD) instead of
+    ``axis_names`` and a ``check_rep`` flag whose replication checker
+    predates the vma type system and rejects valid psum/where patterns
+    the modern checker accepts — so it is disabled on the legacy path.
+    """
+    import jax
+    smap = getattr(jax, "shard_map", None)
+    if smap is not None:
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = frozenset(axis_names)
+        return smap(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    **kw)
+    from jax.experimental.shard_map import shard_map as legacy
+    kw = {}
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kw["auto"] = auto
+    return legacy(f, mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False, **kw)

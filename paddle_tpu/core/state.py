@@ -170,6 +170,26 @@ define_flag("serving_spec_rejection_sampling", False,
             "residual, so the output distribution is exactly the "
             "target's. Only meaningful with "
             "serving_spec_temperature > 0.")
+define_flag("dp_overlap_grad_sync", False,
+            "overlap-scheduled bucketed DP gradient sync "
+            "(distributed/overlap.py): DataParallel registers per-param "
+            "hooks and issues one psum-mean per size-capped bucket as "
+            "each bucket's grads finalize DURING backward, so the "
+            "collectives hide behind the remaining backward compute; "
+            "apply_collective_grads() drains the pending results. "
+            "Bitwise-identical to the serialized sync. Off = the "
+            "pre-overlap serialized path; DataParallel kwarg "
+            "overlap_grad_sync overrides per instance. comm_ms / "
+            "overlap_frac surface through the observability registry. "
+            "PDT114 notes eager train loops that serialize the sync.")
+define_flag("pp_overlap_p2p", True,
+            "pipeline p2p/compute overlap (fleet/pipeline.py): issue "
+            "each stage's ppermute activation/cotangent sends BEFORE "
+            "the independent work of the same tick (output banking, "
+            "leaf-grad accumulation) so XLA can run the ICI transfer "
+            "under compute. Pure reordering of independent ops — "
+            "values are bitwise-identical either way; off restores the "
+            "send-last order for A/B timing.")
 define_flag("metrics", True,
             "observability runtime (paddle_tpu.observability): metrics "
             "registry recording, structured-event ring buffer, serving "

@@ -57,6 +57,26 @@ class Group:
     def process_group(self):  # reference API parity (returns backend handle)
         return self
 
+    def psum_mean(self, flat):
+        """ONE cached collective program: psum-mean of a replicated flat
+        buffer over this group's axis. Shared by the serialized
+        ``DataParallel.apply_collective_grads`` AND the overlap
+        scheduler (``distributed/overlap.py``) — one program is what
+        makes the two paths bitwise-identical. The jitted shard_map
+        wrapper is built once per group so per-step calls hit jax's
+        compile cache."""
+        f = getattr(self, "_psum_mean_fn", None)
+        if f is None:
+            from ..core.meshutil import shard_map as smap
+            from jax.sharding import PartitionSpec as P
+            n = self.nranks
+            ax = self.AXIS
+            f = jax.jit(smap(
+                lambda a, _ax=ax, _n=n: jax.lax.psum(a, _ax) / _n,
+                mesh=self.mesh, in_specs=P(), out_specs=P()))
+            self._psum_mean_fn = f
+        return f(flat)
+
     def __repr__(self):
         return f"Group(id={self.id}, ranks={self.ranks})"
 

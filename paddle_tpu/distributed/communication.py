@@ -108,8 +108,9 @@ def _smap(g, body, x, in_spec=None, out_spec=None):
     ax = _axis_of(g)
     in_spec = P(ax) if in_spec is None else in_spec
     out_spec = P(ax) if out_spec is None else out_spec
-    f = jax.shard_map(body, mesh=g.mesh, in_specs=in_spec,
-                      out_specs=out_spec)
+    from ..core.meshutil import shard_map as _shard_map
+    f = _shard_map(body, mesh=g.mesh, in_specs=in_spec,
+                   out_specs=out_spec)
     return f(_put(g.mesh, x, in_spec if isinstance(in_spec, P) else P(ax)))
 
 

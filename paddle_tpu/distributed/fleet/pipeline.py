@@ -23,6 +23,14 @@ Bubble fraction is the textbook ``(pp-1)/(M+pp-1)`` — raise
 ``num_microbatches`` to amortize, exactly as with the reference's GPipe
 mode.
 
+P2P/compute overlap (``pp_overlap_p2p`` flag, default on): every
+ppermute send is issued as soon as its payload exists — the forward
+activation hop before the same tick's output banking, the backward
+cotangent hop before the O(params) leaf-grad accumulation — so XLA's
+scheduler can run the ICI transfer under independent compute (the
+reference's async ``p2p_communication`` sends). Pure reordering:
+values are bitwise-identical with the flag off.
+
 Three schedules, matching the reference's set (D15):
 
 - ``forward()`` (default) — FThenB/GPipe via scan + transpose;
@@ -92,6 +100,14 @@ def functional_call(layer: Layer, param_vals: dict, *args):
 
 
 from ...core.meshutil import pvary as _pvary
+from ...core.meshutil import shard_map as _shard_map
+
+
+def _overlap_p2p() -> bool:
+    """pp_overlap_p2p flag (core/state.py): ppermute sends issued before
+    the independent work of the same tick so the transfer hides under
+    compute. Read at trace time; pure reordering, bitwise-identical."""
+    return bool(state.get_flag("pp_overlap_p2p"))
 
 
 class PipelinedBlocks(Layer):
@@ -259,6 +275,16 @@ class PipelinedBlocks(Layer):
                     inject = xloc[jnp.clip(t, 0, M - 1)]
                     h = jnp.where(i == 0, inject, h_in)
                     y, _ = lax.scan(block_apply, h, lvs)
+                    ring = [(r, (r + 1) % pp) for r in range(pp)]
+                    if _overlap_p2p():
+                        # issue the neighbor send FIRST: the output
+                        # banking below is independent of it, so the ICI
+                        # transfer runs under that work instead of after
+                        # it (the p2p/compute overlap of the reference's
+                        # p2p_communication async sends). Values are
+                        # bitwise-identical either way — only the
+                        # schedule moves.
+                        nxt = lax.ppermute(y, ax, ring)
                     m_out = t - (pp - 1)
                     idx = jnp.clip(m_out, 0, M - 1)
                     valid = (i == pp - 1) & (m_out >= 0)
@@ -266,9 +292,8 @@ class PipelinedBlocks(Layer):
                                                    keepdims=False)
                     outputs = lax.dynamic_update_index_in_dim(
                         outputs, jnp.where(valid, y, cur), idx, 0)
-                    nxt = lax.ppermute(y, ax,
-                                       [(r, (r + 1) % pp)
-                                        for r in range(pp)])
+                    if not _overlap_p2p():
+                        nxt = lax.ppermute(y, ax, ring)
                     return (nxt, outputs), None
 
                 h0 = jnp.zeros(mb_shape, xloc.dtype)
@@ -283,11 +308,11 @@ class PipelinedBlocks(Layer):
 
             xspec = P(None, batch_axes, *([None] * (xv.ndim - 1)))
             lspec = tuple(P(ax) for _ in leaves)
-            out = jax.shard_map(local, mesh=jmesh,
-                                in_specs=(xspec,) + lspec,
-                                out_specs=xspec,
-                                axis_names=self._manual_axes(jmesh),
-                                )(xm, *leaves)
+            out = _shard_map(local, mesh=jmesh,
+                             in_specs=(xspec,) + lspec,
+                             out_specs=xspec,
+                             axis_names=self._manual_axes(jmesh),
+                             )(xm, *leaves)
             return out.reshape((b,) + xv.shape[1:])
 
         return apply("pipelined_blocks", impl, x, *leaf_tensors)
@@ -382,11 +407,11 @@ class PipelinedBlocks(Layer):
 
             xspec = P(None, batch_axes, *([None] * (xv.ndim - 1)))
             lspec = tuple(P(ax) for _ in leaves)
-            out = jax.shard_map(local, mesh=jmesh,
-                                in_specs=(xspec,) + lspec,
-                                out_specs=xspec,
-                                axis_names=self._manual_axes(jmesh),
-                                )(xm, *leaves)
+            out = _shard_map(local, mesh=jmesh,
+                             in_specs=(xspec,) + lspec,
+                             out_specs=xspec,
+                             axis_names=self._manual_axes(jmesh),
+                             )(xm, *leaves)
             return out.reshape((b,) + xv.shape[1:])
 
         return apply("pipelined_blocks_vpp", impl, x, *leaf_tensors)
@@ -509,6 +534,17 @@ class PipelinedBlocks(Layer):
                             h_saved, lvs, tuple(post), has_aux=True)
                         dh, dlvs, dpost = vjp(
                             _pvary(jnp.ones((), obj.dtype), vary_axes))
+                        if _overlap_p2p():
+                            # issue the cotangent send as soon as dh
+                            # exists: the O(params) leaf-grad
+                            # accumulation below is independent of it,
+                            # so the backward ICI hop runs under that
+                            # work (values bitwise-identical; schedule
+                            # only)
+                            g_next = lax.ppermute(
+                                jnp.where(bvalid, dh,
+                                          jnp.zeros_like(dh)),
+                                ax, bwd_ring)
                         dacc = tuple(
                             da + jnp.where(bvalid, dl, 0)
                             for da, dl in zip(dacc, dlvs))
@@ -528,9 +564,11 @@ class PipelinedBlocks(Layer):
                         dx = lax.dynamic_update_index_in_dim(
                             dx, jnp.where(bvalid & (i == 0), dh, curx),
                             mc, 0)
-                        g_next = lax.ppermute(
-                            jnp.where(bvalid, dh, jnp.zeros_like(dh)),
-                            ax, bwd_ring)
+                        if not _overlap_p2p():
+                            g_next = lax.ppermute(
+                                jnp.where(bvalid, dh,
+                                          jnp.zeros_like(dh)),
+                                ax, bwd_ring)
                         return (h_next, g_next, ring, dacc, dpacc,
                                 loss_acc, dx), None
 
@@ -551,6 +589,20 @@ class PipelinedBlocks(Layer):
                     carry, _ = lax.scan(tick, carry0,
                                         jnp.arange(M + 2 * pp - 1))
                     _, _, _, dacc, dpacc, loss_acc, dx = carry
+                    from ...core.meshutil import legacy_manual_vjp
+                    if legacy_manual_vjp():
+                        # jax<0.5 shard_map: the in-body vjp cannot
+                        # auto-psum cotangents of replicated inputs —
+                        # fold the cross-dp leaf contributions and the
+                        # cross-stage (+dp) post contributions here
+                        # (mid stages contribute exact zeros to dpacc,
+                        # so the pp psum is the identity fold)
+                        if batch_tuple:
+                            dacc = tuple(lax.psum(da, batch_tuple)
+                                         for da in dacc)
+                        dpacc = tuple(
+                            lax.psum(dp_, (ax,) + batch_tuple)
+                            for dp_ in dpacc)
                     # loss lives on the last stage; grads of x on stage 0
                     loss_out = lax.psum(
                         jnp.where(is_last, loss_acc, 0.0), ax)
@@ -565,7 +617,7 @@ class PipelinedBlocks(Layer):
                           *([None] * (tm.ndim - 2)))
                 lspec = tuple(P(ax) for _ in lvs_in)
                 pspec = tuple(P() for _ in post_in)
-                outs = jax.shard_map(
+                outs = _shard_map(
                     local, mesh=jmesh,
                     in_specs=(xspec, tspec) + lspec + pspec,
                     out_specs=(P(), xspec) + lspec + pspec)(

@@ -194,3 +194,47 @@ class HybridCommunicateGroup:
 
     def get_rank_from_stage(self, stage_id, **kwargs):
         return stage_id
+
+    # --- hybrid-training bridge (ISSUE 11) -----------------------------
+    def process_mesh(self, axes: Optional[Sequence[str]] = None):
+        """The auto_parallel :class:`ProcessMesh` over this topology's
+        device grid — the object ``PipelinedBlocks.shard`` /
+        ``shard_parameter`` consume, so the hybrid topology can drive
+        the SPMD pipeline directly::
+
+            hcg = HybridCommunicateGroup(dp_degree=2, pp_degree=2,
+                                         mp_degree=2)
+            pipe = GPTForCausalLMPipe(cfg, hcg.process_mesh(),
+                                      pp_axis="pp", dp_axis="dp",
+                                      tp_axis="mp")
+
+        ``axes``: keep only these mesh dims (size-1 dims dropped by
+        default keep PartitionSpecs readable); None keeps every dim
+        whose degree > 1, or ``dp`` alone on a fully-degenerate
+        topology.
+        """
+        from ..auto_parallel.api import ProcessMesh
+        dims = [self._topo.get_dim(n) for n in
+                ("data", "pipe", "sharding", "sep", "model")]
+        ranks = np.arange(self.nranks).reshape(dims)
+        keep = [i for i, (name, deg) in enumerate(zip(AXES, dims))
+                if (axes is not None and name in axes)
+                or (axes is None and deg > 1)]
+        if not keep:
+            keep = [0]  # degenerate 1-device topology: a dp-only mesh
+        drop = [i for i in range(len(AXES)) if i not in keep]
+        ranks = ranks.transpose(keep + drop).reshape(
+            [dims[i] for i in keep])
+        return ProcessMesh(ranks, [AXES[i] for i in keep])
+
+    def get_data_parallel_comm_group(self):
+        """A ``collective.Group`` over the dp-axis devices at this
+        controller's coordinate (mp/pp/... fixed at 0) — what
+        ``DataParallel``/the overlap grad-sync scheduler take when the
+        replicated-eager DP path runs alongside the in-program pp/mp
+        axes."""
+        from .. import collective as _coll
+        dims = [self._topo.get_dim(n) for n in
+                ("data", "pipe", "sharding", "sep", "model")]
+        ranks = np.arange(self.nranks).reshape(dims)[:, 0, 0, 0, 0]
+        return _coll.new_group([int(r) for r in ranks])

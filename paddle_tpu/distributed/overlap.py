@@ -1,0 +1,239 @@
+"""Overlap-scheduled bucketed DP gradient synchronization (ISSUE 11).
+
+Capability analog of the reference ``EagerReducer``
+(``paddle/fluid/distributed/collective/reducer.h:88``): the reducer
+registers a hook per parameter, groups gradients into size-capped
+buckets in the order the BACKWARD WALK finalizes them (last layers
+first), and launches one fused all-reduce per bucket as soon as the
+bucket's last gradient lands — so the collectives run concurrently with
+the remaining backward compute instead of serialized after it.
+
+TPU-native mechanism: the autograd engine (``core/autograd.py``) calls a
+tensor's hooks exactly when its gradient is FINAL (all consumers
+processed — the reference's ``GradNodeAccumulation`` hook point), and
+jax dispatch is asynchronous — issuing the bucket's ``psum-mean``
+program during backward puts the ICI collective on the device stream
+while eager backward keeps dispatching compute behind it. ``finish()``
+(called from ``DataParallel.apply_collective_grads``) drains the
+pending results and writes them back; only time the collectives had NOT
+already overlapped is spent blocking there.
+
+Parity contract: ``psum-mean`` is elementwise, so bucket composition
+does not change values — the overlap-scheduled result is BITWISE
+identical to the serialized one-bucket-per-dtype sync (asserted by
+``tests/test_overlap.py`` on a CPU mesh), and both run the same cached
+collective program (``collective.Group.psum_mean``).
+
+Observability (PR8 registry):
+
+* ``train.comm_ms``      — per-bucket collective wall time histogram
+  (dispatch -> result ready)
+* ``train.overlap_frac`` — fraction of total collective time that ran
+  concurrent with backward (1.0 = fully hidden; serialized sync is 0.0)
+* ``train.bucket_syncs`` — bucket collectives issued
+* ``train.overlap_bytes``— gradient bytes synced through the scheduler
+
+The scheduler is EAGER-path machinery: under jit capture the whole step
+compiles into one program and XLA/GSPMD already schedules the grad
+psums into the backward — hooks see tracers and stand down.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tensor as _tm
+from ..core.tensor import Tensor
+
+__all__ = ["OverlapGradSync"]
+
+
+def _metrics_handles():
+    from ..observability import metrics as m
+    if not m.enabled():
+        return None
+    reg = m.registry()
+    return (
+        reg.histogram("train.comm_ms",
+                      "DP grad-sync collective wall time per bucket",
+                      m.LATENCY_BUCKETS_MS),
+        reg.gauge("train.overlap_frac",
+                  "fraction of grad-sync collective time overlapped "
+                  "with backward compute (last finished step)"),
+        reg.counter("train.bucket_syncs",
+                    "bucketed grad-sync collectives issued"),
+        reg.counter("train.overlap_bytes",
+                    "gradient bytes synced by the overlap scheduler"),
+    )
+
+
+class OverlapGradSync:
+    """Bucket-ready overlap scheduler for one :class:`DataParallel`.
+
+    ``bucket_mb`` caps a bucket's payload (the reference DataParallel's
+    ``comm_buffer_size`` knob, reused): smaller buckets start their
+    collectives earlier in the backward walk; one giant bucket degrades
+    to the serialized schedule. Buckets never mix dtypes.
+    """
+
+    def __init__(self, dp, bucket_mb: Optional[float] = None):
+        self.dp = dp
+        mb = dp.comm_buffer_size if bucket_mb is None else bucket_mb
+        self.bucket_bytes = int(float(mb) * (1 << 20))
+        self._params = [p for p in dp._layers.parameters()
+                        if not p.stop_gradient
+                        and not getattr(p, "no_sync", False)]
+        self._pid = {id(p): i for i, p in enumerate(self._params)}
+        self._paused = 0
+        self._hooks = []
+        self.last = {}          # accounting of the last finished step
+        self._reset()
+        self._install()
+
+    # ------------------------------------------------------------ state --
+    def _reset(self):
+        self._ready_ids = set()
+        self.last_ready_order = []   # backward-walk finalize order
+        self._open = {}              # dtype -> [params] awaiting close
+        self._open_bytes = {}        # dtype -> payload bytes
+        self._closed = []            # buckets awaiting dispatch
+        self._pending = []           # (params, reduced, t_dispatch, bytes)
+        self._synced_ids = set()
+
+    def _install(self):
+        for p in self._params:
+            self._hooks.append(p.register_hook(self._make_hook(p)))
+
+    def remove(self):
+        """Unhook every parameter (the scheduler becomes inert)."""
+        for h in self._hooks:
+            h.remove()
+        self._hooks = []
+        self._reset()
+
+    # ------------------------------------------------------------ hooks --
+    def _make_hook(self, p):
+        def hook(g):
+            self._on_grad_final(p, g)
+            return None  # never modifies the gradient
+        return hook
+
+    def _on_grad_final(self, p, g):
+        if self._paused or self.dp.group.nranks == 1:
+            return
+        if _tm._tracker is not None:
+            return  # jit capture: GSPMD owns the grad psums
+        val = g._read() if isinstance(g, Tensor) else g
+        if isinstance(val, jax.core.Tracer):
+            return
+        if id(p) in self._ready_ids:
+            # a second backward before finish(): stale scheduling state
+            # from the previous walk — start over (pending results are
+            # dropped; finish() will fall back to the leftover path)
+            self._reset()
+        # grads finalized at EARLIER hooks are fully written by now:
+        # dispatch every closed bucket before banking this one
+        self._flush_closed()
+        self._ready_ids.add(id(p))
+        self.last_ready_order.append(self._pid[id(p)])
+        dt = jnp.dtype(val.dtype)
+        nbytes = int(val.size) * dt.itemsize
+        self._open.setdefault(dt, []).append(p)
+        self._open_bytes[dt] = self._open_bytes.get(dt, 0) + nbytes
+        if self._open_bytes[dt] >= self.bucket_bytes:
+            self._closed.append(self._open.pop(dt))
+            self._open_bytes.pop(dt)
+
+    def _flush_closed(self):
+        while self._closed:
+            self._dispatch(self._closed.pop(0))
+
+    def _dispatch(self, params):
+        """ONE collective for the bucket: concat the final grads (same
+        elementwise values the serialized sync reduces), psum-mean
+        through the group's cached program, keep the future."""
+        vals = []
+        for p in params:
+            if p.grad is None:      # defensive: leave to the fallback
+                return
+            v = p.grad._read()
+            if isinstance(v, jax.core.Tracer):
+                return
+            vals.append(v)
+        flat = jnp.concatenate([jnp.ravel(v) for v in vals]) \
+            if len(vals) > 1 else jnp.ravel(vals[0])
+        red = self.dp._psum_mean(flat)   # async jax dispatch
+        nbytes = sum(int(v.size) * v.dtype.itemsize for v in vals)
+        self._pending.append((params, vals, red, time.perf_counter(),
+                              nbytes))
+
+    # ----------------------------------------------------------- finish --
+    def finish(self):
+        """Drain the walk: dispatch still-open buckets, wait on every
+        pending collective, write the reduced slices back, record
+        comm/overlap accounting. Returns the set of param ids synced."""
+        self._flush_closed()
+        for params in self._open.values():
+            self._dispatch(params)
+        self._open = {}
+        self._open_bytes = {}
+        t_join = time.perf_counter()
+        comm_ms = 0.0
+        overlapped_ms = 0.0
+        total_bytes = 0
+        n_buckets = 0
+        handles = _metrics_handles()
+        for params, vals, red, t_disp, nbytes in self._pending:
+            jax.block_until_ready(red)
+            t_done = time.perf_counter()
+            wall = (t_done - t_disp) * 1e3
+            comm_ms += wall
+            overlapped_ms += max(0.0, min(
+                wall, (t_join - t_disp) * 1e3))
+            off = 0
+            for p, v in zip(params, vals):
+                n = v.size
+                p.grad._write(red[off:off + n].reshape(v.shape))
+                off += n
+                self._synced_ids.add(id(p))
+            total_bytes += nbytes
+            n_buckets += 1
+            if handles:
+                handles[0].observe(wall)
+        self._pending = []
+        frac = (overlapped_ms / comm_ms) if comm_ms > 0 else 0.0
+        self.last = {
+            "buckets": n_buckets,
+            "comm_ms": round(comm_ms, 3),
+            "overlap_frac": round(frac, 4),
+            "bytes": total_bytes,
+            "ready_order": list(self.last_ready_order),
+        }
+        if handles and n_buckets:
+            _, g_frac, c_buckets, c_bytes = handles
+            g_frac.set(round(frac, 4))
+            c_buckets.inc(n_buckets)
+            c_bytes.inc(total_bytes)
+        synced = self._synced_ids
+        self._reset()
+        return synced
+
+    # ------------------------------------------------------------ pause --
+    def pause(self):
+        """Context: hooks stand down (gradient-accumulation micro-steps
+        under ``DataParallel.no_sync``)."""
+        sched = self
+
+        class _Pause:
+            def __enter__(self):
+                sched._paused += 1
+                return self
+
+            def __exit__(self, *exc):
+                sched._paused -= 1
+                return False
+
+        return _Pause()

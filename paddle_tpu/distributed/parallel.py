@@ -131,14 +131,28 @@ class DataParallel(Layer):
 
     def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
-                 group=None):
+                 group=None, overlap_grad_sync=None):
         super().__init__()
         self._layers = layers
         self.group = _coll._resolve(group)
         self.find_unused_parameters = find_unused_parameters
+        self.comm_buffer_size = comm_buffer_size
         mesh = Mesh(_np_devices(self.group), ("dp",))
         self._mesh = mesh
         self._replicate(mesh)
+        # overlap-scheduled bucketed grad sync (distributed/overlap.py):
+        # per-param hooks dispatch one psum-mean per size-capped bucket
+        # DURING backward; apply_collective_grads() drains it. Bitwise-
+        # identical to the serialized sync (same collective program,
+        # elementwise reduction). Default from the dp_overlap_grad_sync
+        # flag; nranks==1 needs no sync at all.
+        if overlap_grad_sync is None:
+            from ..core import state as _state
+            overlap_grad_sync = _state.get_flag("dp_overlap_grad_sync")
+        self._overlap = None
+        if overlap_grad_sync and self.group.nranks > 1:
+            from .overlap import OverlapGradSync
+            self._overlap = OverlapGradSync(self)
 
     def _replicate(self, mesh):
         repl = NamedSharding(mesh, P())
@@ -167,6 +181,12 @@ class DataParallel(Layer):
         return self._layers(*inputs, **kwargs)
 
     def no_sync(self):
+        """Under GSPMD there is no bucketed reducer to pause, so this is
+        a no-op — unless the overlap scheduler is on, in which case its
+        hooks stand down for the scope (gradient-accumulation
+        micro-steps must not trigger early bucket collectives)."""
+        if self._overlap is not None:
+            return self._overlap.pause()
         import contextlib
         return contextlib.nullcontext()
 
@@ -176,20 +196,10 @@ class DataParallel(Layer):
 
     def _psum_mean(self, flat):
         """ONE collective program: psum-mean of a replicated flat buffer
-        over the dp axis. The shard_map wrapper is built once and cached
-        so per-step sync calls hit jax's compile cache instead of
-        re-tracing a fresh closure every time."""
-        f = getattr(self, "_psum_mean_fn", None)
-        if f is None:
-            n = self.group.nranks
-            smap = getattr(jax, "shard_map", None)
-            if smap is None:  # older jax spells it jax.experimental
-                from jax.experimental.shard_map import shard_map as smap
-            f = jax.jit(smap(lambda a: jax.lax.psum(a, "dp") / n,
-                             mesh=self._mesh, in_specs=P(),
-                             out_specs=P()))
-            object.__setattr__(self, "_psum_mean_fn", f)
-        return f(flat)
+        over the group. Delegates to ``Group.psum_mean`` — the overlap
+        scheduler reduces through the SAME cached program, which is what
+        keeps the two sync schedules bitwise-identical."""
+        return self.group.psum_mean(flat)
 
     def apply_collective_grads(self):
         """Bucketed gradient synchronization: ONE collective per dtype
@@ -203,13 +213,27 @@ class DataParallel(Layer):
         (``optimizer/flat.py``) already holds the grads in flat buckets,
         those buffers are all-reduced DIRECTLY with zero repacking.
         ``self._last_sync_collectives`` reports how many collectives the
-        call issued (observability + tests)."""
+        call issued (observability + tests).
+
+        With the overlap scheduler on, most buckets were already
+        dispatched DURING backward — this call drains the pending
+        results (``OverlapGradSync.finish``) and runs the serialized
+        path only for parameters the scheduler did not cover (unused
+        params, tracer grads)."""
         params = [p for p in self._layers.parameters()
                   if not p.stop_gradient and p.grad is not None
                   and not getattr(p, "no_sync", False)]
         self._last_sync_collectives = 0
         if not params or self.group.nranks == 1:
+            if self._overlap is not None:
+                self._overlap.finish()
             return
+        if self._overlap is not None:
+            synced = self._overlap.finish()
+            self._last_sync_collectives += self._overlap.last["buckets"]
+            params = [p for p in params if id(p) not in synced]
+            if not params:
+                return
         remaining = []
         by_store: dict[int, tuple] = {}
         for p in params:

@@ -230,8 +230,18 @@ class GPTForCausalLMPipe(Layer):
     the tied LM head stay outside the pipelined region on their own
     shardings (dp over batch)."""
 
+    # canonical Megatron TP split of a STACKED [L, ...] GPT block
+    # (column-parallel qkv/fc1, row-parallel proj/fc2) — the tp_rules
+    # PipelinedBlocks.shard consumes for the pp x mp hybrid
+    TP_RULES = {
+        "attn.qkv.weight": 2, "attn.qkv.bias": 1,
+        "mlp.fc1.weight": 2, "mlp.fc1.bias": 1,
+        "attn.proj.weight": 1, "mlp.fc2.weight": 1,
+    }
+
     def __init__(self, cfg: GPTConfig, mesh, pp_axis: str = "pp",
-                 dp_axis=None, num_microbatches: int = 1, interleave=1):
+                 dp_axis=None, num_microbatches: int = 1, interleave=1,
+                 tp_axis=None, tp_rules=None):
         super().__init__()
         if cfg.dropout:
             raise NotImplementedError(
@@ -253,6 +263,12 @@ class GPTForCausalLMPipe(Layer):
                                       pp_axis=pp_axis,
                                       num_microbatches=num_microbatches,
                                       interleave=interleave)
+        if tp_axis is not None:
+            # Megatron TP inside the pipeline (pp x mp hybrid): re-shard
+            # the stacked leaves with the tensor-split placements; the
+            # pipeline's shard_map leaves tp_axis to GSPMD
+            self.blocks.shard(mesh, pp_axis, tp_axis=tp_axis,
+                              tp_rules=tp_rules or self.TP_RULES)
         self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
 
     def logits(self, input_ids) -> Tensor:

@@ -40,7 +40,8 @@ def _ring_attention_local(q, k, v, axis, causal, scale, remat=True,
                           mesh_axes=()):
     """Runs INSIDE shard_map: q/k/v are the local blocks [B, S_loc, H, D]
     (kv heads may be fewer — GQA repeats them)."""
-    n = lax.axis_size(axis)
+    from ...core.meshutil import axis_size as _axis_size
+    n = _axis_size(axis)
     i = lax.axis_index(axis)
     s_loc = q.shape[1]
     hq, hk = q.shape[2], k.shape[2]
@@ -126,8 +127,9 @@ def ring_flash_attention(query, key, value, mesh=None, sp_axis="sp",
         fn = partial(_ring_attention_local, axis=sp_axis, causal=is_causal,
                      scale=scale, remat=remat,
                      mesh_axes=tuple(jmesh.axis_names))
-        sm = jax.shard_map(fn, mesh=jmesh, in_specs=(spec, spec, spec),
-                           out_specs=spec)
+        from ...core.meshutil import shard_map as _shard_map
+        sm = _shard_map(fn, mesh=jmesh, in_specs=(spec, spec, spec),
+                        out_specs=spec)
         return sm(q, k, v)
 
     return apply("ring_flash_attention", impl, query, key, value)

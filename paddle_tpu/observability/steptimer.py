@@ -22,6 +22,12 @@ The timer records into the process-global ``"default"`` registry:
 ``train.fused_bucket_dispatches`` (flat-bucket kernel launches per
 fused step — the PR4 O(buckets) claim as a live counter).
 
+The overlap grad-sync scheduler (``distributed/overlap.py``, ISSUE 11)
+adds ``train.comm_ms`` (per-bucket collective wall histogram),
+``train.overlap_frac`` (fraction of collective time hidden under
+backward, last step), ``train.bucket_syncs`` and
+``train.overlap_bytes``.
+
 With ``PDTPU_METRICS=off`` every call is a flag check and return.  The
 optional one-line log (``metrics_log_every`` flag / ``log_every``
 kwarg) goes through the ``paddle_tpu.observability`` logger every N

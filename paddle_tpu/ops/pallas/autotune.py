@@ -8,8 +8,13 @@ times each candidate configuration on the real shapes the model runs
 and persists the winner per (device kind, op, shape signature) in a
 JSON cache so later processes skip the sweep.
 
-Tuned entries: ``flash_attention`` (block_q, block_k — see
-flash_attention._autotuned_blocks), ``paged_attention_ppb``
+Tuned entries: ``flash_attention`` (forward block_q, block_k — see
+flash_attention._autotuned_blocks), ``flash_attention_bwd`` (the FUSED
+backward kernel's block pair, tuned separately over backward-specific
+candidates — the backward's full-row q/do/dq VMEM buffers plus dk/dv
+accumulators admit different winners than the forward, and the old
+shared entry let the backward inherit forward-biased blocks — see
+flash_attention._autotuned_bwd_blocks), ``paged_attention_ppb``
 (pages_per_block of the ragged paged-KV serving kernel — see
 paged_attention.pick_pages_per_block; candidates are powers of two
 bounded by the block-table width and a VMEM cap, cache hits apply under

@@ -1,0 +1,206 @@
+"""Fused flash-attention BACKWARD parity suite (ISSUE 11).
+
+Two contracts, the quant_matmul discipline:
+
+1. BITWISE — the fused Pallas backward in interpret mode produces grads
+   bit-identical to ``flash_attention_bwd_jnp``, the unjitted twin that
+   replays the kernel's exact tile walk, on every tested geometry
+   (causal x GQA x segment-ids x padded tails x rectangles x bf16).
+2. ACCURATE — the same grads match ``jax.grad`` of the plain-XLA
+   reference attention within tolerance (the twin being bit-faithful to
+   a wrong kernel would pass contract 1 alone).
+
+Everything is model-free and runs tiny shapes; the suite is pinned in
+conftest's dense tier-1 window.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+
+def _rand(shape, dtype=jnp.float32, seed=0, scale=0.3):
+    return jnp.asarray(
+        np.random.default_rng(seed).normal(size=shape) * scale, dtype)
+
+
+def _pallas_bwd(q, k, v, do, causal, blocks, segment_ids=None):
+    """Interpret-mode fused backward grads via the real custom_vjp, plus
+    the forward residuals the twin needs."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    qt, kt, vt, dot = (jnp.swapaxes(x, 1, 2) for x in (q, k, v, do))
+    seg_q = seg_k = None
+    if segment_ids is not None:
+        seg_q, seg_k = segment_ids
+        seg_q = jnp.asarray(seg_q, jnp.int32)
+        seg_k = jnp.asarray(seg_k, jnp.int32)
+    o, vjp = jax.vjp(
+        lambda a, b, c: fa._flash_bhsd(a, b, c, seg_q, seg_k, scale,
+                                       causal, True, blocks, blocks),
+        qt, kt, vt)
+    dq, dk, dv = vjp(dot)
+    _, lse = fa._fwd(qt, kt, vt, seg_q, seg_k, scale, causal, True, blocks)
+    grads = tuple(jnp.swapaxes(g, 1, 2) for g in (dq, dk, dv))
+    return grads, jnp.swapaxes(o, 1, 2), lse, scale
+
+
+def _assert_bitwise(pallas_grads, twin_grads):
+    for name, a, b in zip(("dq", "dk", "dv"), pallas_grads, twin_grads):
+        assert a.dtype == b.dtype, name
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            f"{name} drifted from the jnp twin (max abs diff " \
+            f"{np.abs(np.asarray(a, np.float64) - np.asarray(b, np.float64)).max():.3e})"
+
+
+# geometry grid: (batch, hq, hk, sq, sk, d, causal, (bq, bk))
+# - multi-block walks in both grid dims (the accumulate paths)
+# - GQA head folding (rep > 1)
+# - padded q and k tails (sq/sk not multiples of the blocks)
+# - rectangles both ways (sk > sq streams extra k blocks; sq > sk has
+#   rows that attend nothing — the hi<=0 flush clamp)
+_GEOMETRIES = [
+    pytest.param(2, 4, 4, 64, 64, 16, True, (16, 16), id="causal-multiblock"),
+    pytest.param(2, 4, 4, 64, 64, 16, False, (16, 16), id="full-multiblock"),
+    pytest.param(1, 4, 2, 50, 50, 8, True, (16, 16), id="gqa-padded-tail"),
+    pytest.param(1, 6, 2, 40, 40, 8, False, (16, 16), id="gqa3-padded-full"),
+    pytest.param(1, 2, 2, 32, 64, 8, True, (16, 32), id="rect-sk-long"),
+    pytest.param(1, 2, 2, 64, 32, 8, True, (16, 16), id="rect-sq-long"),
+    pytest.param(1, 2, 2, 48, 80, 8, True, (16, 32), id="asym-blocks"),
+    pytest.param(1, 2, 2, 33, 47, 8, False, (16, 16), id="both-tails-padded"),
+]
+
+
+@pytest.mark.parametrize("b,hq,hk,sq,sk,d,causal,blocks", _GEOMETRIES)
+def test_fused_bwd_bitwise_vs_twin(b, hq, hk, sq, sk, d, causal, blocks):
+    q = _rand((b, sq, hq, d), seed=1)
+    k = _rand((b, sk, hk, d), seed=2)
+    v = _rand((b, sk, hk, d), seed=3)
+    do = _rand((b, sq, hq, d), seed=4)
+    grads, o, lse, scale = _pallas_bwd(q, k, v, do, causal, blocks)
+    twin = fa.flash_attention_bwd_jnp(q, k, v, do, o, lse, scale=scale,
+                                      causal=causal, blocks=blocks)
+    _assert_bitwise(grads, twin)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_bwd_bitwise_segments(causal):
+    """Varlen/packed segments (q and kv id vectors differ in length)."""
+    b, sq, sk, h, d = 1, 48, 64, 2, 8
+    rng = np.random.default_rng(7)
+    seg_q = np.sort(rng.integers(0, 3, (b, sq)), axis=1)
+    seg_k = np.sort(rng.integers(0, 3, (b, sk)), axis=1)
+    q = _rand((b, sq, h, d), seed=1)
+    k = _rand((b, sk, h, d), seed=2)
+    v = _rand((b, sk, h, d), seed=3)
+    do = _rand((b, sq, h, d), seed=4)
+    grads, o, lse, scale = _pallas_bwd(q, k, v, do, causal, (16, 16),
+                                       segment_ids=(seg_q, seg_k))
+    twin = fa.flash_attention_bwd_jnp(
+        q, k, v, do, o, lse, scale=scale, causal=causal,
+        segment_ids=(seg_q, seg_k), blocks=(16, 16))
+    _assert_bitwise(grads, twin)
+
+
+def test_fused_bwd_bitwise_bf16():
+    """bf16 inputs: f32 in-kernel accumulation, one final cast — the
+    cast order must match the twin bit-for-bit too."""
+    q = _rand((1, 64, 2, 16), jnp.bfloat16, seed=1)
+    k = _rand((1, 64, 2, 16), jnp.bfloat16, seed=2)
+    v = _rand((1, 64, 2, 16), jnp.bfloat16, seed=3)
+    do = _rand((1, 64, 2, 16), jnp.bfloat16, seed=4)
+    grads, o, lse, scale = _pallas_bwd(q, k, v, do, True, (16, 16))
+    assert grads[0].dtype == jnp.bfloat16
+    twin = fa.flash_attention_bwd_jnp(q, k, v, do, o, lse, scale=scale,
+                                      causal=True, blocks=(16, 16))
+    _assert_bitwise(grads, twin)
+
+
+def test_fused_bwd_bitwise_gqa_bf16_padded():
+    """The union of the hard paths in one geometry: GQA head-sum, bf16
+    casts, padded q tail, multi-k accumulation."""
+    q = _rand((2, 50, 4, 8), jnp.bfloat16, seed=11)
+    k = _rand((2, 50, 2, 8), jnp.bfloat16, seed=12)
+    v = _rand((2, 50, 2, 8), jnp.bfloat16, seed=13)
+    do = _rand((2, 50, 4, 8), jnp.bfloat16, seed=14)
+    grads, o, lse, scale = _pallas_bwd(q, k, v, do, True, (16, 16))
+    twin = fa.flash_attention_bwd_jnp(q, k, v, do, o, lse, scale=scale,
+                                      causal=True, blocks=(16, 16))
+    _assert_bitwise(grads, twin)
+
+
+# ---------------------------------------------------------------- ref --
+def _ref_sdpa(q, k, v, causal):
+    from paddle_tpu.nn.functional.attention import _sdpa_xla
+    return _sdpa_xla(q, k, v, causal=causal)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("hq,hk", [(2, 2), (4, 2)])
+def test_fused_bwd_matches_reference_grad(causal, hq, hk):
+    """Fused backward vs jax.grad of the plain-XLA attention (the
+    accuracy leg — bitwise-vs-twin alone can't catch a faithful replay
+    of wrong math)."""
+    q = _rand((1, 37, hq, 32), seed=4, scale=1.0)
+    k = _rand((1, 37, hk, 32), seed=5, scale=1.0)
+    v = _rand((1, 37, hk, 32), seed=6, scale=1.0)
+
+    def loss_pl(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=causal, interpret=True,
+                               blocks=(16, 16), bwd_blocks=(16, 16))
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(_ref_sdpa(q, k, v, causal)))
+
+    gp = jax.grad(loss_pl, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(a, b, atol=3e-5, rtol=3e-5)
+
+
+def test_fused_bwd_distinct_blocks_same_values():
+    """The bwd_blocks free parameter changes the tile walk, not the
+    math: grads across block choices agree to accumulation-order
+    tolerance, and each matches its own twin bitwise."""
+    q = _rand((1, 64, 2, 16), seed=21)
+    k = _rand((1, 64, 2, 16), seed=22)
+    v = _rand((1, 64, 2, 16), seed=23)
+    do = _rand((1, 64, 2, 16), seed=24)
+    ref = None
+    for blocks in ((16, 16), (32, 16), (16, 32), (64, 64)):
+        grads, o, lse, scale = _pallas_bwd(q, k, v, do, True, blocks)
+        twin = fa.flash_attention_bwd_jnp(q, k, v, do, o, lse,
+                                          scale=scale, causal=True,
+                                          blocks=blocks)
+        _assert_bitwise(grads, twin)
+        if ref is None:
+            ref = grads
+        else:
+            for a, b in zip(ref, grads):
+                np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_bwd_autotune_candidates_registered():
+    """The flash_attention_bwd entry exists with backward-specific
+    candidates bounded at 512 tiles (the vmem-footprint rationale) and
+    the public API threads bwd_blocks through."""
+    assert fa._TUNE_BWD_CANDIDATES
+    assert max(c[0] for c in fa._TUNE_BWD_CANDIDATES) <= 512
+    assert max(c[1] for c in fa._TUNE_BWD_CANDIDATES) <= 512
+    # a cached winner under the entry is honored on a later call
+    import paddle_tpu.ops.pallas.autotune as at
+    key = f"{at._device_kind()}|flash_attention_bwd|b1h2sq512sk512d16c1"
+    cache = at._load_cache()
+    old = dict(cache)
+    try:
+        cache[key] = [256, 256]
+        q = jnp.zeros((1, 2, 512, 16), jnp.float32)
+        got = fa._autotuned_bwd_blocks(q, q, 0.25, True, None)
+        assert got == (256, 256)
+    finally:
+        cache.clear()
+        cache.update(old)

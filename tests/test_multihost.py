@@ -27,9 +27,10 @@ def test_two_process_allreduce(tmp_path):
         rank = jax.process_index()
 
         mesh = Mesh(jax.devices(), ("x",))
-        f = jax.jit(jax.shard_map(lambda a: jax.lax.psum(a, "x"),
-                                  mesh=mesh, in_specs=P("x"),
-                                  out_specs=P()))
+        from paddle_tpu.core.meshutil import shard_map
+        f = jax.jit(shard_map(lambda a: jax.lax.psum(a, "x"),
+                              mesh=mesh, in_specs=P("x"),
+                              out_specs=P()))
         garr = multihost_utils.host_local_array_to_global_array(
             np.full((1,), float(rank + 1), np.float32), mesh, P("x"))
         out = f(garr)            # replicated result: read the local shard
