@@ -71,6 +71,16 @@ def _measure_gpt_3d(cfg, dp=2, pp=2, mp=1, batch_per_dp=2, seq=64,
     mesh = hcg.process_mesh()
     batch = batch_per_dp * dp
 
+    # compile accounting baseline: train.compile_ms is process-global
+    # (every _Executable.build in the process feeds it — earlier bench
+    # rows included), so the row reports the DELTA over its own run
+    from paddle_tpu.observability import metrics as _om
+    _comp_h = _om.registry().histogram(
+        "train.compile_ms",
+        "trace+lower wall time of captured programs",
+        _om.LATENCY_BUCKETS_MS)
+    comp0 = (_comp_h.count, _comp_h.sum)
+
     paddle.seed(0)
     pipe = GPTForCausalLMPipe(cfg, mesh, pp_axis="pp", dp_axis="dp",
                               num_microbatches=num_microbatches,
@@ -99,9 +109,19 @@ def _measure_gpt_3d(cfg, dp=2, pp=2, mp=1, batch_per_dp=2, seq=64,
     for _ in range(warmup):
         loss = step(*batch_fn())
     float(loss)
+    # feed train.step_ms the same steps the row times — into a PRIVATE
+    # registry (a fit/bench run earlier in the process would pollute
+    # the global one's cumulative histogram); the aggregator reads it
+    # through fleet_snapshot(registry=...)
+    from paddle_tpu.observability import StepTimer
+    from paddle_tpu.observability.metrics import Registry as _Registry
+    _row_reg = _Registry("gpt_3d_row")
+    st = StepTimer(registry=_row_reg)
+    st.mark()
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = step(*batch_fn())
+        st.step(tokens=batch * seq)
     final_loss = float(loss)  # sync
     dt = (time.perf_counter() - t0) / steps
     tok_s = batch * seq / dt
@@ -164,6 +184,30 @@ def _measure_gpt_3d(cfg, dp=2, pp=2, mp=1, batch_per_dp=2, seq=64,
     finally:
         _state.set_flags({"dp_overlap_grad_sync": old_flag})
 
+    # --- fleet columns (ISSUE 12): compile time + per-rank skew from
+    # the aggregator.  A single-controller host is one rank, so the
+    # local fleet_snapshot over the row's private registry degenerates
+    # to {rank: this row's metrics}; multi-host launches pass the
+    # launcher's TCP store + world_size and these same columns show the
+    # straggler.  compile_ms is the delta of the process-global
+    # train.compile_ms over THIS row's captures (step, ref_step,
+    # overlap segment).
+    from paddle_tpu.observability import aggregate as _agg
+    _row_reg.gauge("train.overlap_frac").set(
+        float(ov.get("overlap_frac", 0.0)))
+    fleet = _agg.fleet_snapshot(registry=_row_reg)
+    skew = fleet.get("skew", {}) if fleet else {}
+    rank_skew = {
+        "step_ms_p50": skew.get("p50_ms", {}),
+        "step_ms_spread_ms": skew.get("p50_spread_ms", 0.0),
+        "slowest_rank": skew.get("slowest_rank"),
+        "slowest_phase": skew.get("slowest_phase"),
+        "overlap_frac": skew.get("overlap_frac", {}),
+        "ranks_missing": fleet.get("missing", []) if fleet else [],
+    }
+    comp_cnt = _comp_h.count - comp0[0]
+    comp_sum = _comp_h.sum - comp0[1]
+
     flops_tok = ref.flops_per_token(seq)
     achieved = tok_s * flops_tok
     row = {
@@ -180,6 +224,11 @@ def _measure_gpt_3d(cfg, dp=2, pp=2, mp=1, batch_per_dp=2, seq=64,
         "scaling_x": round(scaling_x, 3),
         "overlap": ov,
         "pp_overlap_p2p": bool(_state.get_flag("pp_overlap_p2p")),
+        "compile_ms": {"count": int(comp_cnt),
+                       "total": round(float(comp_sum), 1),
+                       "mean": round(comp_sum / comp_cnt, 1)
+                       if comp_cnt else 0.0},
+        "rank_skew": rank_skew,
         "final_loss": round(final_loss, 4),
     }
     if peak_flops:
@@ -221,7 +270,11 @@ FILES = ["benchmarks/hybrid_bench.py",
          "paddle_tpu/distributed/collective.py",
          "paddle_tpu/core/meshutil.py",
          "paddle_tpu/ops/pallas/flash_attention.py",
-         "paddle_tpu/models/gpt.py"]
+         "paddle_tpu/models/gpt.py",
+         # the gpt_3d skew/compile_ms columns come from the aggregator
+         # (ISSUE 12): its merge/quantile math re-measures the row
+         "paddle_tpu/observability/aggregate.py",
+         "paddle_tpu/observability/tracing.py"]
 
 
 def main():

@@ -608,6 +608,23 @@ _LOG_SINK_METHODS = {"info", "debug", "warning", "error", "critical",
 _HOST_SYNC_LOOP_METHODS = {"item", "numpy", "tolist"}
 
 
+def _host_sync_desc(node):
+    """The device->host sync expression a log-call argument performs
+    (``float()`` / ``.item()`` / ``.numpy()`` / ``.tolist()``), or
+    None.  Shared by PDT112 and PDT115 so the two checks can never
+    disagree on what counts as a sync."""
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) \
+                and f.attr in _HOST_SYNC_LOOP_METHODS \
+                and not node.args and not node.keywords:
+            return f".{f.attr}()"
+        if isinstance(f, ast.Name) and f.id == "float" \
+                and len(node.args) == 1 and not node.keywords:
+            return "float()"
+    return None
+
+
 @register(
     "PDT112", "host-sync-in-loop", Severity.NOTE, "ast", scope="eager",
     example="""
@@ -640,19 +657,7 @@ def check_host_sync_in_loop(fndef, ctx):
     costs the loop nothing; syncs that feed control flow (early
     stopping on ``float(loss)``) are real data dependencies and are
     not flagged.  Note-level advice, not an error."""
-
-    def _sync_desc(node):
-        """The sync expression inside a log-call argument, or None."""
-        if isinstance(node, ast.Call):
-            f = node.func
-            if isinstance(f, ast.Attribute) \
-                    and f.attr in _HOST_SYNC_LOOP_METHODS \
-                    and not node.args and not node.keywords:
-                return f".{f.attr}()"
-            if isinstance(f, ast.Name) and f.id == "float" \
-                    and len(node.args) == 1 and not node.keywords:
-                return "float()"
-        return None
+    _sync_desc = _host_sync_desc
 
     for loop in _walk_fn(fndef):
         if not isinstance(loop, (ast.For, ast.While)):
@@ -922,3 +927,106 @@ def check_serialized_grad_sync(fndef, ctx):
                 "so bucket collectives dispatch as grads finalize "
                 "during backward and overlap the remaining compute; "
                 "results are bitwise-identical")
+
+
+# attribute/call spellings that read as "this rank's index" in a rank
+# conditional (dist.get_rank() == 0, env.local_rank == 0, hcg rank
+# getters) — the guard PDT115 looks for around per-rank logging
+_RANK_CALL_NAMES = {"get_rank", "get_local_rank", "get_data_parallel_rank",
+                    "get_model_parallel_rank", "get_stage_id"}
+_RANK_ATTR_NAMES = {"rank", "local_rank"}
+
+
+def _is_rank_conditional(test) -> bool:
+    """True when an ``if`` test reads this process's rank: a call like
+    ``dist.get_rank()`` or an attribute like ``env.local_rank``
+    anywhere in the expression."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Call):
+            name = (_dotted(sub.func) or "").split(".")[-1]
+            if name in _RANK_CALL_NAMES:
+                return True
+        elif isinstance(sub, ast.Attribute) \
+                and sub.attr in _RANK_ATTR_NAMES:
+            return True
+    return False
+
+
+@register(
+    "PDT115", "per-rank-metrics-leak", Severity.NOTE, "ast",
+    scope="eager",
+    example="""
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+def train(model, batches):
+    for x in batches:
+        loss = model(x).mean()
+        if dist.get_rank() == 0:
+            print("rank0 loss:", float(loss))
+""",
+    near_miss="""
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+def train(model, batches):
+    for step, x in enumerate(batches):
+        loss = model(x).mean()
+        if dist.get_rank() == 0:
+            print("step", step)       # python scalar: no device sync
+""")
+def check_per_rank_metrics_leak(fndef, ctx):
+    """``float(x)`` / ``.item()`` / ``.numpy()`` / ``.tolist()``
+    feeding a logging call inside a RANK-CONDITIONAL block
+    (``if dist.get_rank() == 0: print(float(loss))``) of a distributed
+    loop body: beyond PDT112's per-iteration device->host sync, this
+    pattern structurally LOSES the fleet view — only the printing
+    rank's value ever surfaces, so the cross-rank skew that the
+    conditional was hiding (the straggler, its phase) is exactly what
+    never gets logged.  Record into registry gauges/histograms on
+    EVERY rank (lazy reads, no loop cost) and call
+    ``observability.fleet_snapshot()`` for the merged view with
+    per-rank ``step_ms`` skew and slowest-rank attribution instead.
+    Note-level advice, not an error."""
+    for loop in _walk_fn(fndef):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        # own-scope walk (PDT108 contract): nested defs lint themselves
+        stack = list(ast.iter_child_nodes(loop))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(sub))
+            if not (isinstance(sub, ast.If)
+                    and _is_rank_conditional(sub.test)):
+                continue
+            for inner in sub.body:
+                for call in ast.walk(inner):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    fname = (_dotted(call.func) or "").split(".")[-1]
+                    is_sink = (fname in _LOG_SINK_BARE
+                               if isinstance(call.func, ast.Name)
+                               else fname in _LOG_SINK_METHODS)
+                    if not is_sink:
+                        continue
+                    for arg in call.args + [kw.value
+                                            for kw in call.keywords]:
+                        hit = next(
+                            (n for n in ast.walk(arg)
+                             if _host_sync_desc(n) is not None), None)
+                        if hit is not None:
+                            yield hit, (
+                                f"{_host_sync_desc(hit)} logged only "
+                                f"on one rank inside a distributed "
+                                f"loop: the synced value costs a "
+                                f"device round-trip per iteration AND "
+                                f"every other rank's number is thrown "
+                                f"away — record registry gauges/"
+                                f"histograms on all ranks (lazy reads) "
+                                f"and merge with observability."
+                                f"fleet_snapshot(), which also derives "
+                                f"per-rank step_ms skew and "
+                                f"slowest-rank attribution")
+                            break   # one finding per log call

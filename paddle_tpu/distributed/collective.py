@@ -64,7 +64,12 @@ class Group:
         scheduler (``distributed/overlap.py``) — one program is what
         makes the two paths bitwise-identical. The jitted shard_map
         wrapper is built once per group so per-step calls hit jax's
-        compile cache."""
+        compile cache.  Each ISSUANCE runs under a
+        ``collective.psum_mean`` tracing span (observability.tracing;
+        the dispatch is async, so the span brackets the launch — the
+        wait, if any, shows up in the caller's drain span)."""
+        from ..observability import tracing as _tracing
+
         f = getattr(self, "_psum_mean_fn", None)
         if f is None:
             from ..core.meshutil import shard_map as smap
@@ -75,7 +80,10 @@ class Group:
                 lambda a, _ax=ax, _n=n: jax.lax.psum(a, _ax) / _n,
                 mesh=self.mesh, in_specs=P(), out_specs=P()))
             self._psum_mean_fn = f
-        return f(flat)
+        with _tracing.span("collective.psum_mean", group=self.id,
+                           nranks=self.nranks,
+                           size=int(getattr(flat, "size", 0))):
+            return f(flat)
 
     def __repr__(self):
         return f"Group(id={self.id}, ranks={self.ranks})"

@@ -315,7 +315,15 @@ class PipelinedBlocks(Layer):
                              )(xm, *leaves)
             return out.reshape((b,) + xv.shape[1:])
 
-        return apply("pipelined_blocks", impl, x, *leaf_tensors)
+        # host-side tracing span around the whole pipelined dispatch
+        # (ISSUE 12): the ppermute hops themselves are in-program
+        # (XLA-scheduled), so the span brackets what the host can see —
+        # the dispatch that contains them, with the schedule knobs as
+        # attrs.  Under jit capture this runs once, at trace time.
+        from ...observability import tracing as _tracing
+        with _tracing.span("pp.forward", stages=pp, microbatches=M,
+                           overlap_p2p=_overlap_p2p()):
+            return apply("pipelined_blocks", impl, x, *leaf_tensors)
 
     def _forward_interleaved(self, x, batch_axes=None):
         """Interleaved virtual pipeline (reference
@@ -643,8 +651,14 @@ class PipelinedBlocks(Layer):
             op.defvjp(op_fwd, op_bwd)
             return op(xm, *leaves, *post_vals_in)
 
-        return apply("pipeline_1f1b", impl, x, target, *leaf_tensors,
-                     *post_params)
+        # span over the 1F1B dispatch (forward+backward hops inside);
+        # see the pp.forward note — hops are in-program, the span is
+        # the host-observable bracket around them
+        from ...observability import tracing as _tracing
+        with _tracing.span("pp.train_batch", stages=pp, microbatches=M,
+                           overlap_p2p=_overlap_p2p()):
+            return apply("pipeline_1f1b", impl, x, target,
+                         *leaf_tensors, *post_params)
 
 
 def _as_param(t: Tensor):

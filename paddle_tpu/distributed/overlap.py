@@ -33,6 +33,16 @@ Observability (PR8 registry):
 * ``train.bucket_syncs`` — bucket collectives issued
 * ``train.overlap_bytes``— gradient bytes synced through the scheduler
 
+Tracing (ISSUE 12): each bucket's async launch runs under a
+``dp.bucket_sync`` span (which nests a ``collective.psum_mean`` span
+from ``collective.Group.psum_mean``) and the blocking drain in
+:meth:`OverlapGradSync.finish` under ``dp.grad_sync_drain`` — so an
+exported Perfetto trace shows exactly which collectives launched
+during backward and how long the drain blocked.  ``fleet_snapshot``
+(``observability/aggregate.py``) surfaces ``overlap_frac`` PER RANK,
+labeled, so a straggling rank's unhidden communication is attributable
+from one merged view.
+
 The scheduler is EAGER-path machinery: under jit capture the whole step
 compiles into one program and XLA/GSPMD already schedules the grad
 psums into the backward — hooks see tracers and stand down.
@@ -47,6 +57,7 @@ import jax.numpy as jnp
 
 from ..core import tensor as _tm
 from ..core.tensor import Tensor
+from ..observability import tracing as _tracing
 
 __all__ = ["OverlapGradSync"]
 
@@ -165,8 +176,12 @@ class OverlapGradSync:
             vals.append(v)
         flat = jnp.concatenate([jnp.ravel(v) for v in vals]) \
             if len(vals) > 1 else jnp.ravel(vals[0])
-        red = self.dp._psum_mean(flat)   # async jax dispatch
         nbytes = sum(int(v.size) * v.dtype.itemsize for v in vals)
+        # span brackets the ASYNC launch (the overlapped half); the
+        # blocking half shows in finish()'s dp.grad_sync_drain span
+        with _tracing.span("dp.bucket_sync", params=len(params),
+                           bytes=nbytes):
+            red = self.dp._psum_mean(flat)   # async jax dispatch
         self._pending.append((params, vals, red, time.perf_counter(),
                               nbytes))
 
@@ -186,23 +201,25 @@ class OverlapGradSync:
         total_bytes = 0
         n_buckets = 0
         handles = _metrics_handles()
-        for params, vals, red, t_disp, nbytes in self._pending:
-            jax.block_until_ready(red)
-            t_done = time.perf_counter()
-            wall = (t_done - t_disp) * 1e3
-            comm_ms += wall
-            overlapped_ms += max(0.0, min(
-                wall, (t_join - t_disp) * 1e3))
-            off = 0
-            for p, v in zip(params, vals):
-                n = v.size
-                p.grad._write(red[off:off + n].reshape(v.shape))
-                off += n
-                self._synced_ids.add(id(p))
-            total_bytes += nbytes
-            n_buckets += 1
-            if handles:
-                handles[0].observe(wall)
+        with _tracing.span("dp.grad_sync_drain",
+                           pending=len(self._pending)):
+            for params, vals, red, t_disp, nbytes in self._pending:
+                jax.block_until_ready(red)
+                t_done = time.perf_counter()
+                wall = (t_done - t_disp) * 1e3
+                comm_ms += wall
+                overlapped_ms += max(0.0, min(
+                    wall, (t_join - t_disp) * 1e3))
+                off = 0
+                for p, v in zip(params, vals):
+                    n = v.size
+                    p.grad._write(red[off:off + n].reshape(v.shape))
+                    off += n
+                    self._synced_ids.add(id(p))
+                total_bytes += nbytes
+                n_buckets += 1
+                if handles:
+                    handles[0].observe(wall)
         self._pending = []
         frac = (overlapped_ms / comm_ms) if comm_ms > 0 else 0.0
         self.last = {

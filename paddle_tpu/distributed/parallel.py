@@ -220,10 +220,18 @@ class DataParallel(Layer):
         results (``OverlapGradSync.finish``) and runs the serialized
         path only for parameters the scheduler did not cover (unused
         params, tracer grads)."""
+        from ..observability import tracing as _tracing
+
         params = [p for p in self._layers.parameters()
                   if not p.stop_gradient and p.grad is not None
                   and not getattr(p, "no_sync", False)]
         self._last_sync_collectives = 0
+        with _tracing.span("dp.grad_sync", nranks=self.group.nranks,
+                           overlap=self._overlap is not None):
+            self._apply_collective_grads(params)
+
+    def _apply_collective_grads(self, params):
+        # body of apply_collective_grads, under its dp.grad_sync span
         if not params or self.group.nranks == 1:
             if self._overlap is not None:
                 self._overlap.finish()

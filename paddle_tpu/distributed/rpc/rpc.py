@@ -155,15 +155,29 @@ def _connect(info, timeout):
 
 
 def _invoke(to, fn, args, kwargs, timeout):
+    """One call on worker ``to``.  Under an active trace (ISSUE 12) the
+    call runs inside an ``rpc.client`` span and the callable ships
+    wrapped in :class:`tracing.RemoteTraceContext`, so the server's
+    spans land in the caller's trace — same ``(fn, args, kwargs)`` wire
+    frame, and with ``PDTPU_METRICS=off`` the payload goes out
+    unwrapped (bitwise pre-observability behavior)."""
+    from ...observability import tracing as _tracing
+
     info = get_worker_info(to)
-    conn = _connect(info, timeout)
-    if timeout and timeout > 0:
-        conn.settimeout(timeout)
-    try:
-        _send_frame(conn, (fn, tuple(args or ()), dict(kwargs or {})))
-        ok, value = _recv_frame(conn)
-    finally:
-        conn.close()
+    with _tracing.span("rpc.client", to=str(to),
+                       fn=getattr(fn, "__name__", str(fn))):
+        ctx = _tracing.inject()
+        if ctx is not None:
+            fn = _tracing.RemoteTraceContext(ctx, fn)
+        conn = _connect(info, timeout)
+        if timeout and timeout > 0:
+            conn.settimeout(timeout)
+        try:
+            _send_frame(conn,
+                        (fn, tuple(args or ()), dict(kwargs or {})))
+            ok, value = _recv_frame(conn)
+        finally:
+            conn.close()
     if not ok:
         raise value
     return value
@@ -177,12 +191,20 @@ def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
 
 def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
     """Non-blocking remote call returning a Future with ``wait()``
-    (reference ``rpc.py:183``)."""
+    (reference ``rpc.py:183``).  The caller's trace context is captured
+    HERE, on the calling thread — the worker thread's thread-local
+    context is empty, so without the re-attach the ``rpc.client`` span
+    would start a disconnected root trace instead of joining the
+    caller's (``attach(None)`` is a no-op when no span is open)."""
+    from ...observability import tracing as _tracing
+
+    ctx = _tracing.inject()
     fut = Future()
 
     def run():
         try:
-            fut.set_result(_invoke(to, fn, args, kwargs, timeout))
+            with _tracing.attach(ctx):
+                fut.set_result(_invoke(to, fn, args, kwargs, timeout))
         except Exception as e:
             fut.set_exception(e)
 

@@ -296,9 +296,31 @@ class Model:
         # retrace counter over the compiled train step — recorded into
         # the process-global registry; near-no-op with PDTPU_METRICS=off
         from ..observability import StepTimer
+        from ..observability import metrics as _obs_metrics
         self._step_timer = StepTimer(n_params=sum(
             int(np.prod([int(s) for s in p.shape]) or 1)
             for p in self.network.parameters()))
+        if _obs_metrics.enabled():
+            # HBM accounting (ISSUE 12): resident parameter bytes of
+            # the network this fit trains, read LAZILY at snapshot time
+            # (weakref: the gauge must not keep a finished fit's model
+            # alive); joins jit's hbm.program_state_bytes /
+            # hbm.live_bytes series
+            import weakref as _weakref
+            _net = _weakref.ref(self.network)
+
+            def _model_bytes(_net=_net):
+                net = _net()
+                if net is None:
+                    return 0
+                return int(sum(
+                    int(getattr(getattr(p, "_data", None), "nbytes", 0)
+                        or 0) for p in net.parameters()))
+
+            _obs_metrics.registry().gauge(
+                "hbm.model_param_bytes",
+                "parameter bytes of the network under fit (lazy)"
+            ).set_function(_model_bytes)
         try:
             cbks.on_train_begin()
             logs = {}

@@ -143,6 +143,15 @@ Observability (ISSUE 8; ``paddle_tpu.observability``):
   events.  Clean runs dump nothing; ``PDTPU_METRICS=off`` restores
   the pre-observability engine bitwise (serving_bench's
   ``metrics_overhead`` row pins the on state at <= 3% tokens/sec).
+* DISTRIBUTED TRACING (ISSUE 12; ``observability/tracing.py``) — every
+  dispatch runs under a ``serving.dispatch`` span whose begin/end pair
+  lands in the event ring, and the timeline's ``serving.dispatch``
+  event carries the active ``trace_id``/``parent_id`` — a trace
+  propagated in over ``distributed/rpc`` (disaggregated
+  prefill/decode handoff) threads through to the dispatches that
+  served it.  ``observability.export_trace(path)`` renders the ring
+  (lifecycle events per slot, dispatch spans, faults) as a Perfetto
+  trace, one track per engine slot.
 
 Speculative decoding (ISSUE 9; ``inference/speculative.py``,
 ``spec_decode`` kwarg / ``serving_spec_*`` flags, default off):
@@ -191,6 +200,7 @@ from ..core.tensor import Tensor
 from ..observability import Registry as _ObsRegistry
 from ..observability import flight as _flight
 from ..observability import metrics as _obs_metrics
+from ..observability import tracing as _tracing
 from ..observability.serving import RegistryCounters, ServingTimelines
 from ..resilience import faults
 from ..resilience.serving import (SITE_DRAFT_MISMATCH, SITE_DRAFT_NAN,
@@ -944,14 +954,22 @@ class ContinuousBatchingEngine:
         def _on_retry(_exc, _attempt):
             self._stats["retries"] += 1
         # dispatch_retries counts RETRIES (re-attempts after a
-        # transient), so N=0 disables retry and N=1 absorbs one fault
+        # transient), so N=0 disables retry and N=1 absorbs one fault.
+        # Each dispatch runs under a serving.dispatch tracing span
+        # (ISSUE 12): the span begin/end pair lands in the event ring
+        # for export_trace, and the serving.dispatch timeline event
+        # emitted INSIDE the span inherits its trace/parent ids — so a
+        # trace carried in over rpc (disaggregated prefill/decode
+        # handoff) threads through to the dispatch that served it.
         timed = _obs_metrics.enabled()
-        t0 = time.perf_counter() if timed else 0.0
-        res = dispatch_retry(kind, fn,
-                             max_attempts=self.dispatch_retries + 1,
-                             on_retry=_on_retry)
-        if timed:
-            self._tl.dispatch(kind, (time.perf_counter() - t0) * 1e3)
+        with _tracing.span("serving.dispatch", op=str(kind)):
+            t0 = time.perf_counter() if timed else 0.0
+            res = dispatch_retry(kind, fn,
+                                 max_attempts=self.dispatch_retries + 1,
+                                 on_retry=_on_retry)
+            if timed:
+                self._tl.dispatch(kind,
+                                  (time.perf_counter() - t0) * 1e3)
         return res
 
     # compiled serving programs cache ON the model (generate()'s

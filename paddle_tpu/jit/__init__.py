@@ -28,7 +28,9 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 import warnings
+import weakref
 from typing import Any, Callable
 
 import jax
@@ -40,6 +42,86 @@ from ..core import tensor as tensor_mod
 from ..core.tensor import Tensor
 
 logger = logging.getLogger("paddle_tpu.jit")
+
+
+# --- compile/HBM observability (ISSUE 12) ----------------------------------
+# Every built _Executable registers here (weak: programs die with their
+# StaticFunction cache) so lazy gauges can answer "how many bytes of
+# captured state do the live compiled programs pin" without any work on
+# the hot path — the gauges read at snapshot/render time only.
+_live_executables: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _program_state_bytes(fn_name=None) -> int:
+    """Captured-state bytes (params/opt state/RNG the program holds
+    strong refs to) across live executables — per ``fn_name`` when
+    given, process-total otherwise."""
+    total = 0
+    for exe in list(_live_executables):
+        if fn_name is not None \
+                and getattr(exe, "_fn_name", None) != fn_name:
+            continue
+        for t in exe.capt_state:
+            v = getattr(t, "_data", None)
+            nb = getattr(v, "nbytes", None)
+            if nb:
+                total += int(nb)
+    return total
+
+
+def _jax_live_bytes():
+    """Process-total bytes of live jax arrays (HBM residency on a real
+    device; host memory on CPU).  Read LAZILY at snapshot time."""
+    return int(sum(int(getattr(a, "nbytes", 0) or 0)
+                   for a in jax.live_arrays()))
+
+
+def _register_hbm_gauges(fn_name):
+    """Lazy HBM-accounting gauges in the default registry: one
+    ``hbm.program_state_bytes`` series per compiled-function name (the
+    process total is the sum over the ``fn`` label — a same-name
+    unlabeled twin would collide in ``snapshot()``'s nesting) plus the
+    ``hbm.live_bytes`` process total (the ISSUE 12 blind spot — pool
+    bytes were visible, program residency was not)."""
+    from ..observability import metrics as _obs
+    reg = _obs.registry()
+    reg.gauge("hbm.program_state_bytes", labels={"fn": str(fn_name)},
+              help="captured-state bytes pinned by live compiled "
+                   "programs (lazy; sum over fn = process total)"
+              ).set_function(lambda n=str(fn_name):
+                             _program_state_bytes(n))
+    reg.gauge("hbm.live_bytes",
+              "process-total live jax array bytes (lazy)"
+              ).set_function(_jax_live_bytes)
+
+
+def _note_retrace(exe, sig):
+    """Emit a ``compile.retrace`` ring event with a best-effort CAUSE:
+    which input positions changed signature since the first trace, or
+    — when the signature is identical — the cache-miss/scan-re-trace
+    class the jit guards warn about.  A steady-state stream of these
+    is the retrace regression ``train.retraces`` counts."""
+    from ..observability import events as _events
+    from ..observability import metrics as _obs
+    if not _obs.enabled():
+        return
+    base = getattr(exe, "_sig0", None)
+    if base is None or len(base) != len(sig):
+        cause = "input arity changed"
+    else:
+        diffs = [i for i, (a, b) in enumerate(zip(base, sig))
+                 if a != b]
+        if diffs:
+            changed = ", ".join(
+                f"arg{i}: {base[i][0]}/{base[i][1]} -> "
+                f"{sig[i][0]}/{sig[i][1]}" for i in diffs[:3])
+            cause = f"input signature changed ({changed})"
+        else:
+            cause = ("same signature (jit cache miss/eviction or "
+                     "scan/window re-trace)")
+    _events.emit("compile.retrace",
+                 fn=getattr(exe, "_fn_name", "step"),
+                 count=int(exe.trace_count), cause=cause)
 
 
 def _tree_signature(obj):
@@ -265,6 +347,15 @@ class _Executable:
 
         def pure(*vals):
             self.trace_count += 1
+            # signature of this trace's inputs: the retrace-cause diff
+            # (compile.retrace event) compares against the first one
+            sig = tuple((tuple(jnp.shape(v)),
+                         str(getattr(v, "dtype", type(v).__name__)))
+                        for v in vals)
+            if self.trace_count == 1:
+                self._sig0 = sig
+            else:
+                _note_retrace(self, sig)
             tr = _ReplayTracker(pos, vals)
             old = tensor_mod.set_tracker(tr)
             try:
@@ -303,16 +394,36 @@ class _Executable:
         # re-executes the function body, so host-side grad slots can be
         # clobbered (clear_grad() + backward() replaces a concrete step-0
         # grad with a tracer-backed Tensor): snapshot and restore them.
+        # The trace+lower runs under a "compile" tracing span (ISSUE 12)
+        # carrying the program geometry, and its wall time backs the
+        # train.compile_ms histogram — the single-process blind spot
+        # that made recompiles invisible in step timelines.
+        from ..observability import metrics as _obs_metrics
+        from ..observability import tracing as _obs_tracing
+        self._fn_name = getattr(self.fn, "__name__", "step")
         saved_grads = [(t, t._grad) for t in grad_owners]
+        t0 = time.perf_counter()
         try:
-            traced = self.compiled.trace(*[t._data for t in ordered])
-            self.jaxpr = traced.jaxpr
-            traced.lower()
+            with _obs_tracing.span("compile", fn=self._fn_name,
+                                   n_inputs=len(ordered),
+                                   n_state=len(written),
+                                   n_donated=len(donate)):
+                traced = self.compiled.trace(*[t._data for t in ordered])
+                self.jaxpr = traced.jaxpr
+                traced.lower()
         finally:
             _scrub_leaked_tracers(d)
             for t, g in saved_grads:
                 if t._grad is not g:
                     t._grad = g
+        _live_executables.add(self)
+        if _obs_metrics.enabled():
+            _obs_metrics.registry().histogram(
+                "train.compile_ms",
+                "trace+lower wall time of captured programs",
+                _obs_metrics.LATENCY_BUCKETS_MS).observe(
+                    (time.perf_counter() - t0) * 1e3)
+            _register_hbm_gauges(self._fn_name)
 
     def __call__(self, arg_tensors):
         for sync in self.discovery.host_syncs:

@@ -31,6 +31,21 @@ Pieces
   histogram, retrace counter over ``Executable.trace_count``,
   tokens/sec + MFU estimate gauges, fused-optimizer bucket dispatch
   counter) hooked into ``hapi.Model.fit`` and ``Optimizer.step``.
+* ``tracing``   — distributed tracing (ISSUE 12): :func:`span` /
+  :func:`traced` write ``span.begin``/``span.end`` pairs into the ring
+  with a propagatable trace context (``trace_id``/``span_id``/
+  ``parent_id``) carried through ``distributed/rpc`` calls
+  (:class:`tracing.RemoteTraceContext`) and stamped onto the engine's
+  dispatch events; :func:`export_trace` renders the ring — spans,
+  serving lifecycle, fault/guard/retry events — as Chrome/Perfetto
+  trace-event JSON, one track per rank/thread/engine slot.
+* ``aggregate`` — fleet-wide metrics (ISSUE 12):
+  :func:`fleet_snapshot` publishes/gathers every rank's registry
+  snapshot through the rendezvous ``TCPStore`` (straggler-tolerant
+  timeout), merges elementwise (Counter sums, ``Histogram.merge``
+  semantics, Gauges per-rank-labeled) and derives cross-rank skew —
+  ``train.step_ms`` p50 spread, slowest-rank + slowest-phase
+  attribution, ``overlap_frac`` per rank.
 
 Event schema
 ------------
@@ -59,9 +74,20 @@ Every event is one flat JSON-able dict::
     op                    name, dur_us                  (dispatch hook,
                                                          while profiling)
     flight.dump           reason, path                  (flight recorder)
+    span.begin            name, span_id, trace_id, tname,
+                          parent_id?, ...attrs          (tracing.span)
+    span.end              name, span_id, trace_id, dur_us, error?
+    compile.begin/end     (as span.begin/end, name="compile": fn,
+                          n_inputs, n_state, n_donated) (jit build)
+    compile.retrace       fn, count, cause          (jit._Executable)
+    rpc.client/rpc.server (as spans: fn, to/rank)   (distributed/rpc)
 
 Flight records are JSON files under ``PDTPU_FLIGHT_DIR`` (default
-``<tempdir>/paddle_tpu_flight``); see ``events.dump``.
+``<tempdir>/paddle_tpu_flight``); see ``events.dump``.  Flight-record
+SCHEMA v2 (ISSUE 12): dumps carry ``schema_version`` plus ``rank`` /
+``host`` identity fields so multi-rank dumps merge attributably; v1
+records are identified by the ABSENCE of ``schema_version``.
+``last_dump()`` semantics are unchanged.
 """
 from __future__ import annotations
 
@@ -73,6 +99,11 @@ from .metrics import (COUNT_BUCKETS, LATENCY_BUCKETS_MS,  # noqa: F401
                       registry, render_prometheus, snapshot)
 from .serving import RegistryCounters, ServingTimelines  # noqa: F401
 from .steptimer import StepTimer, device_peak_flops  # noqa: F401
+from . import tracing  # noqa: F401
+from .tracing import (export_trace, render_trace, span,  # noqa: F401
+                      traced)
+from . import aggregate  # noqa: F401
+from .aggregate import fleet_snapshot  # noqa: F401
 
 # events.dump is the flight recorder; keep a namespaced alias so call
 # sites read as what they do: flight.dump(...)
@@ -84,4 +115,6 @@ __all__ = [
     "COUNT_BUCKETS", "emit", "tail", "dump", "last_dump", "dump_dir",
     "flight", "events", "metrics", "ServingTimelines",
     "RegistryCounters", "StepTimer", "device_peak_flops",
+    "tracing", "span", "traced", "export_trace", "render_trace",
+    "aggregate", "fleet_snapshot",
 ]

@@ -25,15 +25,20 @@ and return, and :func:`dump` writes nothing), and every field must be a
 plain scalar/short string — events are recorded on the hot path and
 serialized only at dump time.
 
-Dump files are JSON ``{"reason", "error", "time", "pid", "extra",
-"events": [...]}`` written to ``PDTPU_FLIGHT_DIR`` (default
-``<tempdir>/paddle_tpu_flight``) as ``flight_<pid>_<seq>.json``;
-:func:`last_dump` returns the newest path this process wrote.
+Dump files are JSON ``{"schema_version", "reason", "error", "time",
+"pid", "rank", "host", "extra", "events": [...]}`` written to
+``PDTPU_FLIGHT_DIR`` (default ``<tempdir>/paddle_tpu_flight``) as
+``flight_<pid>_<seq>.json``; :func:`last_dump` returns the newest path
+this process wrote.  ``schema_version`` 2 (ISSUE 12) added the
+``rank``/``host`` identity fields so multi-rank flight dumps merge —
+a fleet postmortem concatenates every rank's record and still knows
+whose events are whose.
 """
 from __future__ import annotations
 
 import json
 import os
+import socket
 import tempfile
 import threading
 import time
@@ -41,9 +46,31 @@ import time
 from .metrics import enabled
 
 __all__ = ["emit", "tail", "clear", "capacity", "set_capacity",
-           "dump", "last_dump", "dump_dir", "EventRing"]
+           "dump", "last_dump", "dump_dir", "EventRing",
+           "SCHEMA_VERSION"]
+
+# flight-record schema: v1 = PR 8 (reason/error/time/pid/extra/events);
+# v2 = ISSUE 12 (adds schema_version itself + rank/host identity so
+# multi-rank dumps can be merged and attributed)
+SCHEMA_VERSION = 2
 
 _DEFAULT_CAPACITY = 512
+
+
+def _rank() -> int:
+    """Launcher rank for dump/trace attribution (``PADDLE_TRAINER_ID``,
+    0 when unset)."""
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def _host() -> str:
+    try:
+        return socket.gethostname()
+    except OSError:
+        return "unknown"
 
 
 class EventRing:
@@ -169,6 +196,9 @@ def dump(reason: str, *, error=None, extra=None, path=None):
             path = os.path.join(
                 d, f"flight_{os.getpid()}_{seq:04d}.json")
         rec = {
+            "schema_version": SCHEMA_VERSION,
+            "rank": _rank(),
+            "host": _host(),
             "reason": str(reason),
             "error": (None if error is None
                       else f"{type(error).__name__}: {error}"),

@@ -193,8 +193,14 @@ class ServingTimelines:
         if not enabled():
             return
         self._h_dispatch.observe(float(ms))
+        # stamp the active trace context (ISSUE 12): the engine opens a
+        # serving.dispatch span around each dispatch, so the timeline
+        # event carries trace_id/parent_id — the hop-level evidence a
+        # cross-worker trace (rpc-propagated) ends in
+        from . import tracing as _tracing
         _events.emit("serving.dispatch", name=str(kind),
-                     ms=round(float(ms), 3))
+                     ms=round(float(ms), 3),
+                     **_tracing.context_fields())
 
     def preempted(self, rid, tokens_done):
         if not enabled():

@@ -40,8 +40,11 @@ import pytest  # noqa: E402
 # when the balance shifts.
 # ---------------------------------------------------------------------------
 _TIER1_ORDER = [
-    # dense: hundreds of fast tests, ~270s total
-    "test_prefix_cache.py", "test_observability.py",
+    # dense: hundreds of fast tests, ~270s total.  test_tracing is the
+    # ISSUE-12 acceptance suite (trace export golden, fleet_snapshot
+    # merge, rpc propagation) — model-free except the export acceptance
+    # drill, which reuses the session serving_gpt
+    "test_prefix_cache.py", "test_observability.py", "test_tracing.py",
     # ISSUE-11 acceptance: fused-backward bitwise parity + overlap
     # grad-sync bitwise gates — model-free/tiny-model, ~80s combined
     "test_flash_bwd.py", "test_overlap.py",

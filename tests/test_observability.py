@@ -448,26 +448,34 @@ def test_metrics_overhead_row_smoke(gpt):
         "serving_bench_obs_smoke", path)
     sb = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(sb)
-    # the acceptance gate: metrics on costs <= 3% tokens/sec.  The
-    # MEASUREMENT interleaves off/on reps and takes best-of walls each
-    # way (drift charges both states equally); the TEST retries the
-    # whole measurement a few times because tiny-CPU serving walls
-    # carry ~8% per-run scheduler noise — a true <=3% overhead passes
-    # an attempt with high probability (one attempt usually suffices),
-    # while a real multi-x regression fails every attempt.  12
-    # requests x 16 tokens through the 2-slot geometry the serving
-    # suite already compiled keeps walls ~100ms so the gate measures
-    # metric cost, not timer resolution.
+    # De-flaked gate (ISSUE 12 satellite): the <= 3% claim belongs to
+    # the BENCH ROW (TPU, real model, ~us metric cost amortized over
+    # ~ms dispatches); this smoke drives a TINY CPU model whose
+    # dispatches are so short that scheduler noise alone swings the
+    # ratio by several percent — the old hard 3% gate passed isolated
+    # but flaked under tier-1 load (known since PR 11).  The
+    # MEASUREMENT still interleaves off/on reps and takes best-of
+    # walls each way (drift charges both states equally); the TEST
+    # gates on the BEST overhead fraction across attempts at a
+    # CPU-appropriate 10% threshold.  A real always-on regression
+    # (2x metric cost) fails every attempt by a wide margin; load
+    # noise clears one attempt.  12 requests x 16 tokens through the
+    # 2-slot geometry the serving suite already compiled keeps walls
+    # ~100ms so the gate measures metric cost, not timer resolution.
     row = None
+    fracs = []
     for _attempt in range(4):
         row = sb._measure_metrics_overhead(
             gpt.cfg, gpt, slots=2, prompt_len=8, new_tokens=16,
             page_size=8, max_seq_len=32, decode_window=4,
             prefill_chunk=8, q_block=2, reps=10, n_requests=12,
             warm=_attempt == 0)
-        if row["overhead_frac"] <= 0.03:
-            break
+        fracs.append(row["overhead_frac"])
+        if row["overhead_frac"] <= 0.10:   # break at the GATE, not
+            break                          # the bench row's 3% claim —
+        # or a steady ~5% CPU overhead would run all 4 measurements
+        # on every tier-1 pass
     assert row["requests"] == 12
     assert row["tokens_per_sec"] > 0 and row["tokens_per_sec_off"] > 0
     assert math.isfinite(row["overhead_frac"])
-    assert row["overhead_frac"] <= 0.03
+    assert min(fracs) <= 0.10, fracs
