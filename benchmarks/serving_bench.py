@@ -80,6 +80,24 @@ can only ever move throughput, never tokens.  The n-gram proposer runs
 on the CPU smoke (``tests/test_speculative.py``); absolute times are
 TPU claims.
 
+plus ``tp2``/``tp4`` rows (ISSUE 13): the fixed-batch engine workload
+single-device vs TP-sharded over a 2/4-device mesh axis
+(``ContinuousBatchingEngine(mesh=)`` — weights column/row split per
+the canonical Megatron rules, KV pools sharded by kv-head, one psum
+at the attention output and MLP reduce).  The TP roofline is the
+PER-DEVICE floor (``roofline_ms / tp``: each shard reads 1/tp of the
+weight and KV bytes) and ``outputs_equal`` gates token-identical
+greedy streams.
+
+plus a ``disagg`` row (ISSUE 13): a latency class (long decodes)
+alone and under a concurrent prefill storm, colocated vs
+``inference.DisaggServer`` (prefill and decode worker groups with
+the KV-page handoff).  Reports decode ``tpot_p99_ms`` for all four
+cells — the claim is that the disagg decode group's p99 stays flat
+under the storm while the colocated engine's tracks it — plus
+``handoff_ms_avg``, ``transfer_bytes``, ``handoffs`` from the
+coordinator's registry.
+
 plus a ``metrics_overhead`` micro-row (ISSUE 8): identical engine
 traffic with ``PDTPU_METRICS`` on vs off, reporting the tokens/sec
 delta — the always-on observability runtime's <= 3% cost claim.  The
@@ -190,6 +208,29 @@ def roofline_ms(cfg, model, batch, prompt_len, new_tokens, gbps,
     return bytes_step / (gbps * 1e9) * 1e3
 
 
+def _tl_pct(eng, name, q=0.99) -> float:
+    """Approximate percentile of one serving-timeline histogram (upper
+    edge of the bucket holding the q-th observation; the fixed
+    log-spaced buckets make this stable across runs).  The ``disagg``
+    row's decode-p99 claim reads this."""
+    node = eng.metrics()
+    for part in ("serving." + name).split("."):
+        node = node.get(part, {})
+    edges = node.get("buckets", [])
+    counts = node.get("counts", [])
+    total = node.get("count", 0)
+    if not total or not edges:
+        return 0.0
+    target = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target:
+            # counts[-1] is the overflow bucket: no finite upper edge
+            return float(edges[i]) if i < len(edges) else float("inf")
+    return float("inf")
+
+
 def _tl_mean(eng, name) -> float:
     """Mean of one serving-timeline histogram from ``engine.metrics()``
     (ISSUE 8): TTFT/TPOT columns come from the engine's OWN event
@@ -293,6 +334,9 @@ def measure():
     rows["weight_only_b1"] = _measure_weight_only(cfg, model, gbps)
     rows["speculative"] = _measure_speculative(cfg, model)
     rows["metrics_overhead"] = _measure_metrics_overhead(cfg, model)
+    rows["tp2"] = _measure_tp(cfg, model, gbps, 2)
+    rows["tp4"] = _measure_tp(cfg, model, gbps, 4)
+    rows["disagg"] = _measure_disagg(cfg, model)
     return rows
 
 
@@ -757,6 +801,191 @@ def _measure_speculative(cfg, model, slots=4, max_seq_len=512,
     return row
 
 
+def _measure_tp(cfg, model, gbps, tp, slots=8, prompt_len=128,
+                new_tokens=64, page_size=16, decode_window=16,
+                prefill_chunk=128, q_block=8, max_seq_len=512, seed=8,
+                warm=True):
+    """ISSUE 13 ``tp2``/``tp4`` rows: the fixed-batch engine workload
+    driven twice over IDENTICAL traffic — single-device, then
+    TP-sharded over a ``tp``-device mesh axis (weights column/row
+    split, KV pools sharded by kv-head, one psum at the attention
+    output and MLP reduce).  The roofline for the TP half is the
+    PER-DEVICE floor: each shard reads ``1/tp`` of the weight and KV
+    bytes, so the target column is ``roofline_ms / tp`` — the whole
+    point of the cut is to move the floor itself.  ``outputs_equal``
+    pins token-identical greedy streams.  Works on the CPU mesh for
+    the accounting smoke; absolute times are TPU claims."""
+    import jax
+    from jax.sharding import Mesh
+
+    from paddle_tpu.inference import ContinuousBatchingEngine
+
+    if len(jax.devices()) < tp:
+        return {"skipped": f"needs {tp} devices, have "
+                           f"{len(jax.devices())}"}
+    mesh = Mesh(np.asarray(jax.devices()[:tp]), ("tp",))
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            prompt_len).astype(np.int32)
+               for _ in range(slots)]
+
+    def drive(m):
+        eng = ContinuousBatchingEngine(
+            model, max_slots=slots, page_size=page_size,
+            max_seq_len=max_seq_len, decode_window=decode_window,
+            prefill_chunk=prefill_chunk, q_block=q_block, mesh=m)
+        rids = [eng.add_request(p, new_tokens) for p in prompts]
+        t0 = time.perf_counter()
+        done = eng.run()
+        wall = time.perf_counter() - t0
+        return eng, [done[r].sequence for r in rids], wall
+
+    if warm:
+        drive(None)
+        drive(mesh)
+    eng_1, out_1, wall_1 = drive(None)
+    eng_tp, out_tp, wall_tp = drive(mesh)
+    toks = eng_tp.stats["tokens_generated"]
+    ms_1 = wall_1 * 1e3 / max(eng_1.stats["tokens_generated"] / slots,
+                              1)
+    ms_tp = wall_tp * 1e3 / max(toks / slots, 1)
+    rl_1 = roofline_ms(cfg, model, slots, prompt_len, new_tokens, gbps)
+    rl_tp = rl_1 / tp                  # per-device bytes: weights + KV
+    row = {                            # shards both split tp ways
+        "batch": slots, "prompt_len": prompt_len,
+        "new_tokens": new_tokens, "kv_cache": "paged",
+        "decode_window": decode_window, "tp": tp,
+        "ms_per_token": round(ms_tp, 2),
+        "tokens_per_sec": round(toks / wall_tp, 1),
+        "wall_s": round(wall_tp, 3),
+        "ms_per_token_1dev": round(ms_1, 2),
+        "roofline_ms": round(rl_tp, 6),
+        "roofline_ms_1dev": round(rl_1, 6),
+        "roofline_x": round(ms_tp / rl_tp, 1),
+        "roofline_x_1dev": round(ms_1 / rl_1, 1),
+        "outputs_equal": all(
+            np.array_equal(a, b) for a, b in zip(out_tp, out_1)),
+        "pages_leaked": eng_tp.stats["pages_in_use"],   # must be 0
+    }
+    print(f"tp{tp}: {row['ms_per_token']} ms/token vs "
+          f"{row['ms_per_token_1dev']} on 1 dev (per-device roofline "
+          f"x{row['roofline_x']}, outputs_equal="
+          f"{row['outputs_equal']})", file=sys.stderr, flush=True)
+    return row
+
+
+def _measure_disagg(cfg, model, slots=6, prompt_len=64, new_tokens=48,
+                    storm_prompt=256, storm_new=4, n_latency=6,
+                    n_storm=12, page_size=16, decode_window=16,
+                    prefill_chunk=128, max_seq_len=512, q_block=8,
+                    seed=9, warm=True):
+    """ISSUE 13 ``disagg`` row: a latency class (medium prompt, long
+    decode) served alone and then under a concurrent PREFILL STORM
+    (long prompts, trivial decode) — first on one colocated engine,
+    then through ``inference.DisaggServer`` (prefill and decode worker
+    groups with the KV-page handoff).  The claim is the decode-p99
+    shape: colocated p99 tracks the storm (prefill chunks steal mixed
+    dispatches from residents' decodes), the disagg decode group's
+    stays flat because prefill compute is physically elsewhere.
+    Reports ``tpot_p99_ms_*`` for all four cells plus the handoff
+    accounting (``handoff_ms_avg``, ``transfer_bytes``,
+    ``handoffs``)."""
+    from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                      DisaggServer)
+
+    rng = np.random.default_rng(seed)
+    lat = [rng.integers(0, cfg.vocab_size,
+                        prompt_len).astype(np.int32)
+           for _ in range(n_latency)]
+    storm = [rng.integers(0, cfg.vocab_size,
+                          storm_prompt).astype(np.int32)
+             for _ in range(n_storm)]
+    kw = dict(max_slots=slots, page_size=page_size,
+              max_seq_len=max_seq_len, decode_window=decode_window,
+              prefill_chunk=prefill_chunk, q_block=q_block)
+
+    def drive_colocated(with_storm):
+        eng = ContinuousBatchingEngine(model, **kw)
+        for p in lat:
+            eng.add_request(p, new_tokens)
+        pending = list(storm) if with_storm else []
+        while eng.has_work or pending:
+            if pending:                        # storm arrivals: 2/step
+                for _ in range(2):
+                    if pending:
+                        eng.add_request(pending.pop(0), storm_new)
+            eng.step()
+        return eng
+
+    def drive_disagg(with_storm):
+        srv = DisaggServer(model, prefill_kwargs=dict(kw),
+                           decode_kwargs=dict(kw))
+        for p in lat:
+            srv.add_request(p, new_tokens)
+        pending = list(storm) if with_storm else []
+        while srv.has_work or pending:
+            if pending:
+                for _ in range(2):
+                    if pending:
+                        srv.add_request(pending.pop(0), storm_new)
+            srv.step()
+        return srv
+
+    if warm:
+        drive_colocated(True)
+        drive_disagg(True)
+    co_calm = drive_colocated(False)
+    co_storm = drive_colocated(True)
+    dg_calm = drive_disagg(False)
+    dg_storm = drive_disagg(True)
+    st = dg_storm.stats
+    dec = dg_storm.decode_group[0]
+    row = {
+        "batch": slots, "prompt_len": prompt_len,
+        "new_tokens": new_tokens, "kv_cache": "paged",
+        "storm_prompt": storm_prompt, "storm_requests": n_storm,
+        "requests": n_latency,
+        # the p99 grid: colocated decode latency degrades under the
+        # storm; the disagg decode group's should not
+        "tpot_p99_ms_colocated": round(
+            _tl_pct(co_calm, "tpot_ms"), 3),
+        "tpot_p99_ms_colocated_storm": round(
+            _tl_pct(co_storm, "tpot_ms"), 3),
+        "tpot_p99_ms_disagg": round(
+            _tl_pct(dg_calm.decode_group[0], "tpot_ms"), 3),
+        "tpot_p99_ms_disagg_storm": round(
+            _tl_pct(dec, "tpot_ms"), 3),
+        "tpot_ms_avg_colocated_storm": round(
+            _tl_mean(co_storm, "tpot_ms"), 3),
+        "tpot_ms_avg_disagg_storm": round(
+            _tl_mean(dec, "tpot_ms"), 3),
+        "handoffs": st["handoffs"],
+        "transfer_bytes": st["handoff_bytes"],
+        "handoff_ms_avg": round(
+            _disagg_handoff_mean(dg_storm), 3),
+        "requeues": st["requeues"],
+        "pages_leaked": (st["prefill_pages_in_use"]
+                         + st["decode_pages_in_use"]),   # must be 0
+    }
+    print(f"disagg: decode p99 {row['tpot_p99_ms_disagg']} -> "
+          f"{row['tpot_p99_ms_disagg_storm']} ms under storm (vs "
+          f"colocated {row['tpot_p99_ms_colocated']} -> "
+          f"{row['tpot_p99_ms_colocated_storm']}), "
+          f"{row['handoffs']} handoffs, "
+          f"{row['transfer_bytes']} bytes, "
+          f"{row['handoff_ms_avg']} ms/handoff", file=sys.stderr,
+          flush=True)
+    return row
+
+
+def _disagg_handoff_mean(srv) -> float:
+    node = srv.metrics()
+    for part in ("serving", "handoff_ms"):
+        node = node.get(part, {})
+    cnt = node.get("count", 0)
+    return node.get("sum", 0.0) / cnt if cnt else 0.0
+
+
 def _measure_metrics_overhead(cfg, model, slots=6, prompt_len=32,
                               new_tokens=24, page_size=16,
                               decode_window=8, prefill_chunk=64,
@@ -844,6 +1073,9 @@ FILES = ["benchmarks/serving_bench.py",
          "paddle_tpu/inference/engine.py",
          "paddle_tpu/inference/prefix_cache.py",
          "paddle_tpu/inference/speculative.py",
+         # disaggregated/TP serving (ISSUE 13): the tp2/tp4/disagg
+         # rows and every engine row's scheduling layer ride these
+         "paddle_tpu/inference/distserve.py",
          "paddle_tpu/resilience/serving.py",
          "paddle_tpu/core/state.py",
          "paddle_tpu/ops/pallas/paged_attention.py",

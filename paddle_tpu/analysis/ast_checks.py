@@ -1030,3 +1030,78 @@ def check_per_rank_metrics_leak(fndef, ctx):
                                 f"per-rank step_ms skew and "
                                 f"slowest-rank attribution")
                             break   # one finding per log call
+
+
+# constructor/call names that put a multi-device mesh "in scope" for
+# PDT116: a serving engine built single-device right next to one of
+# these is almost always an oversight, not a lab rig
+_MESH_EVIDENCE_CALLS = {"ProcessMesh", "Mesh", "device_count"}
+
+
+@register(
+    "PDT116", "single-device-engine-on-mesh", Severity.NOTE, "ast",
+    scope="eager",
+    example="""
+import jax
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.inference import ContinuousBatchingEngine
+
+def serve(model, prompts):
+    mesh = dist.ProcessMesh(np.arange(jax.device_count()), ["tp"])
+    eng = ContinuousBatchingEngine(model, max_slots=8)
+    for p in prompts:
+        eng.add_request(p, 32)
+    return eng.run()
+""",
+    near_miss="""
+import jax
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.inference import ContinuousBatchingEngine
+
+def serve(model, prompts):
+    mesh = dist.ProcessMesh(np.arange(jax.device_count()), ["tp"])
+    eng = ContinuousBatchingEngine(model, max_slots=8, mesh=mesh)
+    for p in prompts:
+        eng.add_request(p, 32)
+    return eng.run()
+""")
+def check_single_device_engine_on_mesh(fndef, ctx):
+    """A serving engine constructed WITHOUT ``mesh=``/``tp_axis=`` in
+    a function that is visibly mesh-aware (it builds a
+    ``ProcessMesh``/``Mesh`` or consults ``jax.device_count()``): the
+    engine will compile its two serving programs on ONE device while
+    the rest of the mesh idles — weights that could column/row-split
+    over the tensor-parallel axis (one psum at the attention output
+    and the MLP reduce; KV pools sharded by kv-head) are replicated
+    instead, capping both model size and decode throughput at a
+    single chip.  Pass ``mesh=``/``tp_axis=`` (or set the
+    ``serving_tp`` flag) — greedy outputs are token-identical to the
+    single-device engine, so sharding is free at the output level.
+    Single-device parity rigs are legitimate, hence note-level
+    advice, not an error."""
+    has_mesh_evidence = any(
+        isinstance(node, ast.Call)
+        and (_dotted(node.func) or "").split(".")[-1]
+        in _MESH_EVIDENCE_CALLS
+        for node in _walk_fn(fndef))
+    if not has_mesh_evidence:
+        return
+    for node in _walk_fn(fndef):
+        if not isinstance(node, ast.Call) \
+                or (_dotted(node.func) or "").split(".")[-1] \
+                != "ContinuousBatchingEngine":
+            continue
+        kws = {kw.arg for kw in node.keywords if kw.arg}
+        if "mesh" not in kws and "tp_axis" not in kws:
+            yield node, (
+                "serving engine built single-device while a "
+                "multi-device mesh is in scope (ProcessMesh/Mesh/"
+                "device_count in this function): pass mesh=/tp_axis= "
+                "so the serving programs shard over the "
+                "tensor-parallel axis — greedy outputs stay "
+                "token-identical and decode stops being capped at "
+                "one chip")

@@ -29,6 +29,20 @@ def axis_size(axis):
     return lax.psum(1, axis)
 
 
+def partial_auto_supported() -> bool:
+    """True when ``shard_map`` can leave some mesh axes to GSPMD
+    (``axis_names`` a strict subset).  The legacy experimental
+    shard_map (jax < 0.5) cannot: its eager impl raises
+    ``NotImplementedError`` outright when ``auto`` is non-empty, and
+    even under jit the old SPMD partitioner hard-crashes on
+    ``ppermute``/``all_gather`` inside a partial-auto region (a
+    ``PartitionId``/manual-subgroup CHECK failure) — so callers that
+    mix manual collectives with a GSPMD-owned TP axis must demote on
+    the legacy path instead of splitting the program."""
+    import jax
+    return hasattr(jax, "shard_map")
+
+
 def legacy_manual_vjp() -> bool:
     """True on the legacy experimental shard_map (jax < 0.5): its AD has
     no varying-axes (vma) type system, so a ``jax.vjp`` taken INSIDE the

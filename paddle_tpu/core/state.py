@@ -170,6 +170,37 @@ define_flag("serving_spec_rejection_sampling", False,
             "residual, so the output distribution is exactly the "
             "target's. Only meaningful with "
             "serving_spec_temperature > 0.")
+define_flag("serving_tp", 0,
+            "default tensor-parallel degree for serving engines "
+            "(ISSUE 13): a ContinuousBatchingEngine constructed "
+            "WITHOUT mesh= builds a 1-axis mesh over the first N "
+            "devices and shards its two compiled programs over it — "
+            "weights column/row split per the canonical Megatron "
+            "rules, KV page pools sharded by kv-head (GQA-aware), "
+            "block tables/lengths replicated, one psum at the "
+            "attention output and the MLP reduce. 0/1 = single-device "
+            "(today's engine, bitwise). Engine kwargs mesh=/tp_axis= "
+            "override per instance; greedy outputs are token-identical "
+            "to the single-device engine either way. PDT116 notes "
+            "engines built single-device while a multi-device mesh is "
+            "in scope.")
+define_flag("serving_disagg_prefill_workers", 1,
+            "default prefill-group size for inference.DisaggServer "
+            "(disaggregated prefill/decode serving): how many engine "
+            "instances admit + chunk-prefill new requests before the "
+            "KV-page handoff. DisaggServer kwarg prefill_workers "
+            "overrides.")
+define_flag("serving_disagg_decode_workers", 1,
+            "default decode-group size for inference.DisaggServer: "
+            "how many engine instances run the latency-bound decode "
+            "windows on handed-off KV pages. DisaggServer kwarg "
+            "decode_workers overrides.")
+define_flag("serving_disagg_handoff_retries", 3,
+            "bounded resilience.retry RE-attempts for one KV-page "
+            "handoff transfer (KVPageTransport.ship) after a "
+            "transient ConnectionError — incl. the injected "
+            "engine_handoff_transient fault site. N retries = N+1 "
+            "attempts; 0 disables retry.")
 define_flag("dp_overlap_grad_sync", False,
             "overlap-scheduled bucketed DP gradient sync "
             "(distributed/overlap.py): DataParallel registers per-param "

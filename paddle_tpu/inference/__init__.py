@@ -201,8 +201,11 @@ from .engine import ContinuousBatchingEngine  # noqa: E402
 from .prefix_cache import PrefixCache  # noqa: E402
 from .speculative import (DraftModelProposer, NGramProposer,  # noqa: E402
                           Proposer)
+from .distserve import (DisaggServer, KVPageTransport,  # noqa: E402
+                        register_decode_worker)
 
 __all__ = ["Config", "Predictor", "create_predictor",
            "ContinuousBatchingEngine", "CompletedRequest",
            "PrefixCache", "Proposer", "NGramProposer",
-           "DraftModelProposer"]
+           "DraftModelProposer", "DisaggServer", "KVPageTransport",
+           "register_decode_worker"]

@@ -183,6 +183,29 @@ Speculative decoding (ISSUE 9; ``inference/speculative.py``,
   ``spec_proposed`` / ``spec_accepted`` / ``spec_accept_rate``;
   timelines emit ``verify_window`` events and an
   accepted-tokens-per-step histogram.
+
+Tensor parallelism & disaggregation (ISSUE 13; ``mesh=``/``tp_axis=``
+kwargs / ``serving_tp`` flag; ``inference/distserve.py``):
+
+* TP-SHARDED PROGRAMS — with a mesh, the mixed/spec/decode-window
+  programs re-build over the TP axis (``models/generation.py`` TP
+  section): weights column/row-split per the canonical Megatron rules
+  (fused qkv re-laid-out head-major), KV data+scale pools sharded by
+  kv-head (GQA-aware: ``Hk < tp`` replicates the K/V side and each
+  shard attends a 1-head slice), block tables/lengths replicated, ONE
+  psum at the attention output and the MLP reduce.  The scheduling
+  layer is untouched — block tables and lengths are data either way —
+  and greedy outputs are token-identical to the single-device engine
+  (``tests/test_distserve.py``).
+* POOL EXPORT/IMPORT — :meth:`export_request` serializes a resident
+  request's live pages (+ scales) and scheduler state;
+  :meth:`import_request` remaps them into this engine's free list
+  (one compiled scatter per geometry; pages the prefix cache already
+  indexes for the same token prefix are RETAINED instead of
+  rewritten) and installs a decode slot.  ``DisaggServer`` builds the
+  prefill->handoff->decode pipeline on top, with
+  ``engine_handoff_transient`` / ``engine_decode_worker_lost`` drills
+  and per-handoff spans/metrics.
 """
 from __future__ import annotations
 
@@ -322,7 +345,8 @@ class ContinuousBatchingEngine:
                  default_deadline_ms=None, dispatch_retries=None,
                  prefix_cache=None, kv_quant=None, spec_decode=None,
                  spec_k=None, spec_proposer=None, spec_temperature=None,
-                 spec_rejection_sampling=None, spec_seed=0, clock=None):
+                 spec_rejection_sampling=None, spec_seed=0, clock=None,
+                 mesh=None, tp_axis=None):
         from ..core import state as _state
         from ..models.generation import (_decode_fn, _ragged_fn,
                                          _zero_pool)
@@ -331,6 +355,53 @@ class ContinuousBatchingEngine:
         model.eval()   # the engine owns its model: serving is eval-mode
         self._decode, _, self._hard_limit = _decode_fn(model)
         self._ragged = _ragged_fn(model)
+        # tensor parallelism (ISSUE 13): shard the two compiled serving
+        # programs over a mesh axis — weights column/row-split per the
+        # canonical Megatron rules, KV pools sharded by kv-head, block
+        # tables/lengths replicated; greedy outputs token-identical to
+        # the single-device engine (models/generation.py TP section).
+        # ``mesh=None`` with the ``serving_tp`` flag > 1 builds a
+        # default 1-axis mesh over the first ``serving_tp`` devices.
+        tp_deg = int(_state.get_flag("serving_tp"))
+        if mesh is None and tp_deg > 1:
+            import jax as _jax
+            devs = _jax.devices()
+            if len(devs) < tp_deg:
+                raise ValueError(
+                    f"serving_tp={tp_deg} but only {len(devs)} devices "
+                    "are visible")
+            from jax.sharding import Mesh as _Mesh
+            mesh = _Mesh(np.asarray(devs[:tp_deg]), ("tp",))
+        self._jmesh = None
+        self.tp_axis = None
+        self._tpp = None
+        if mesh is not None:
+            jmesh = getattr(mesh, "jmesh", mesh)   # ProcessMesh or Mesh
+            if tp_axis is None:
+                axes = tuple(jmesh.axis_names)
+                if len(axes) == 1:
+                    tp_axis = axes[0]
+                elif "tp" in axes:
+                    tp_axis = "tp"
+                else:
+                    raise ValueError(
+                        f"mesh has axes {axes}: pass tp_axis= to pick "
+                        "the tensor-parallel one")
+            from ..models.generation import tp_shard_params
+            # sharded param extraction is a read-only snapshot cached
+            # ON the model per (devices, axis): prefill/decode worker
+            # engines sharing one model share one copy of the shards
+            key = (tuple(d.id for d in jmesh.devices.flat),
+                   str(tp_axis))
+            tcache = model.__dict__.setdefault("_tp_params_cache", {})
+            tpp = tcache.get(key)
+            if tpp is None:
+                tpp = tp_shard_params(model, jmesh, tp_axis)
+                tcache[key] = tpp
+            self._jmesh = jmesh
+            self.tp_axis = str(tp_axis)
+            self._tpp = tpp
+        self.tp = 1 if self._tpp is None else self._tpp.meta["tp"]
         self.max_slots = int(max_slots)
         self.page_size = int(page_size)
         self.max_seq_len = int(max_seq_len or cfg.max_seq_len)
@@ -426,11 +497,22 @@ class ContinuousBatchingEngine:
         # so block tables, the prefix cache and preempt-requeue carry
         # the scales without knowing they exist.
         kv_dtype = "int8" if self.kv_quant else "float32"
-        self._caches = [Tensor(a) for a in _zero_pool(
-            shape, 2 * cfg.num_layers, kv_dtype)]
+        pools = list(_zero_pool(shape, 2 * cfg.num_layers, kv_dtype))
         if self.kv_quant:
-            self._caches += [Tensor(a) for a in _zero_pool(
-                shape[:3], 2 * cfg.num_layers, "float32")]
+            pools += list(_zero_pool(shape[:3], 2 * cfg.num_layers,
+                                     "float32"))
+        if self._tpp is not None:
+            # pools live sharded by kv-head over the TP axis (or fully
+            # replicated on the GQA Hk < tp path) — page ids and block
+            # tables are pool-wide either way
+            import jax as _jax
+            from jax.sharding import NamedSharding as _NS
+
+            from ..models.generation import tp_cache_spec
+            cspec = tp_cache_spec(self._tpp.meta, self.tp_axis)
+            pools = [_jax.device_put(p, _NS(self._jmesh, cspec))
+                     for p in pools]
+        self._caches = [Tensor(a) for a in pools]
         # bytes per page across all layers (data + scales): the
         # serving-roofline accounting the quant path halves
         itemsize = 1 if self.kv_quant else 4
@@ -455,6 +537,7 @@ class ContinuousBatchingEngine:
         self._mixed_fn = None
         self._spec_fn = None
         self._cow_fn = None
+        self._import_fn = None
         self._decode_exe = None
         # counters, RE-BACKED by a private observability registry
         # (ISSUE 8): the ``stats`` property reads the same keys/values
@@ -652,6 +735,215 @@ class ContinuousBatchingEngine:
                 f"raise max_steps or check admission (queue depth "
                 f"{len(self._queue)})", RuntimeWarning, stacklevel=2)
         return done
+
+    # ------------------------------------- pool export / import -------
+    # the KV-page handoff substrate of disaggregated prefill/decode
+    # serving (inference/distserve.py): export serializes ONLY a
+    # request's live pages (+ scale side-pools) and its scheduler
+    # state; import remaps them into this engine's free list and
+    # installs a resident decode slot.  Export is read-only (the
+    # source engine's publish-at-retire / prefix-cache discipline is
+    # untouched); import allocates through the prefix cache, so pages
+    # this engine already holds for the same token prefix are RETAINED
+    # instead of rewritten — cached prefixes survive handoff.
+
+    def export_request(self, rid):
+        """Serialize a resident decode-phase request for handoff.
+        Returns a payload dict (numpy KV bytes + state); the slot
+        stays resident — the caller decides when it retires."""
+        for s in self._slots:
+            if s.req is not None and s.req.rid == rid:
+                break
+        else:
+            raise KeyError(f"request {rid!r} is not resident")
+        if s.phase != "decode":
+            raise ValueError(
+                f"request {rid!r} is still prefilling — export after "
+                "its first token")
+        n = s.len_written
+        n_pages = -(-n // self.page_size)
+        pages = np.asarray(s.pages[:n_pages], np.int64)
+        pools = [np.asarray(c._read()[:, pages]) for c in self._caches]
+        return {
+            "rid": rid,
+            "prompt": np.asarray(s.req.prompt, np.int32),
+            "done_toks": [int(t) for t in s.out_toks],
+            "cur_tok": int(s.cur_tok),
+            "cur_pos": int(s.cur_pos),
+            "eos": int(s.eos),
+            "len_written": int(n),
+            "n_pages": int(n_pages),
+            "page_size": self.page_size,
+            "kv_quant": self.kv_quant,
+            "pools": pools,
+        }
+
+    def _get_import_fn(self):
+        if self._import_fn is None:
+            key = ("import", len(self._caches)) + self._geometry()
+            cache = self._program_cache()
+            self._import_fn = cache.get(key)
+        if self._import_fn is None:
+            n = len(self._caches)
+
+            def imp(idx, *args):
+                pools, payload = args[:n], args[n:]
+                return tuple(p.at[:, idx].set(pl.astype(p.dtype))
+                             for p, pl in zip(pools, payload))
+
+            kw = {}
+            if self._tpp is not None:
+                from jax.sharding import NamedSharding as _NS
+
+                from ..models.generation import tp_cache_spec
+                cspec = tp_cache_spec(self._tpp.meta, self.tp_axis)
+                kw["out_shardings"] = tuple(
+                    _NS(self._jmesh, cspec) for _ in range(n))
+            self._import_fn = jax.jit(
+                imp, donate_argnums=tuple(range(1, 1 + n)), **kw)
+            self._program_cache()[("import", len(self._caches))
+                                  + self._geometry()] = self._import_fn
+        return self._import_fn
+
+    def import_request(self, payload, max_new_tokens, request_id=None,
+                       deadline_ms=None):
+        """Install an exported (prefilled) request as a resident
+        DECODE slot: allocate pages, scatter the payload's KV bytes
+        into them (ONE compiled dispatch per geometry; the page-id
+        vector is traced data), and seed the slot's scheduler state.
+        Pages this engine's prefix cache already indexes for the same
+        token prefix are retained instead of scattered.  Returns the
+        request id, or ``None`` when no slot / not enough pages are
+        free right now (retry after a step)."""
+        if payload["page_size"] != self.page_size \
+                or payload["kv_quant"] != self.kv_quant \
+                or len(payload["pools"]) != len(self._caches):
+            raise ValueError(
+                "import_request: incompatible KV layout (page_size/"
+                "kv_quant/pool count must match the exporting engine)")
+        prompt = np.asarray(payload["prompt"], np.int32)
+        done = list(payload["done_toks"])
+        cur_pos = int(payload["cur_pos"])
+        stop = prompt.size + int(max_new_tokens)
+        if stop > self.max_seq_len:
+            raise ValueError(
+                f"request needs {stop} tokens > engine max_seq_len "
+                f"{self.max_seq_len}")
+        if len(done) >= int(max_new_tokens):
+            raise ValueError(
+                "import_request: request already complete — finalize "
+                "it on the coordinator instead of importing")
+        rid = payload["rid"] if request_id is None else request_id
+        if isinstance(rid, int):   # keep add_request's auto ids clear
+            self._next_rid = max(self._next_rid, rid + 1)
+        in_flight = {r.rid for r in self._queue} | {
+            s.req.rid for s in self._slots if s.req is not None}
+        if rid in in_flight:
+            raise ValueError(f"request_id {rid!r} already in flight")
+        need_full = -(-stop // self.page_size)
+        if need_full > self.total_pages - 1:
+            self._stats["rejected"] += 1
+            raise PageBudgetError(
+                f"request needs {need_full} pages but the pool only "
+                f"has {self.total_pages - 1} "
+                f"[{PageBudgetError.error_code}]")
+        for b, s in enumerate(self._slots):
+            if s.req is None:
+                break
+        else:
+            return None                       # no free slot: retry
+        n_imp = int(payload["n_pages"])
+        ps = self.page_size
+        target = max(cur_pos, min(cur_pos + 1, stop))
+        n_need = max(n_imp, max(1, -(-target // ps)))
+        # decode-side prefix reuse: full pages this engine already
+        # indexes for the written token prefix ride as-is (the bytes
+        # are identical by construction — KV content is a pure
+        # function of the token prefix)
+        ids_written = np.concatenate(
+            [prompt, np.asarray(done, np.int32)])[:cur_pos]
+        matched = self._cache.match(ids_written)[:n_imp]
+        self._cache.retain(matched)
+        n_alloc = n_need - len(matched)
+        if n_alloc > self._cache.available():
+            self._cache.release(matched)
+            return None                       # pool pressure: retry
+        alloc = [self._cache.acquire(key=str(rid))
+                 for _ in range(n_alloc)]
+        pages = matched + alloc
+        # scatter payload bytes into the FRESH page slots only
+        # (matched pages already hold them); page-id vector and
+        # payload pad to the table width so one program serves every
+        # import of this geometry
+        NP = self.np_per_seq
+        idx = np.zeros(NP, np.int32)
+        sel = np.zeros(NP, np.int64)          # payload page slot -> row
+        take = np.zeros(NP, bool)
+        for j in range(len(matched), n_imp):
+            idx[j] = pages[j]
+            sel[j] = j
+            take[j] = True
+        pads = []
+        for arr in payload["pools"]:
+            pad = np.zeros(arr.shape[:1] + (NP,) + arr.shape[2:],
+                           arr.dtype)
+            pad[:, take] = arr[:, sel[take]]
+            pads.append(pad)
+        if take.any():       # a full prefix-cache hit scatters nothing
+            fn = self._get_import_fn()
+            vals = [c._read() for c in self._caches]
+
+            def _import_call():
+                if any(getattr(v, "is_deleted", lambda: False)()
+                       for v in vals):
+                    raise RuntimeError(
+                        "import dispatch failed after its KV buffers "
+                        "were donated; a mid-execution transient is "
+                        "unrecoverable at this layer — re-create the "
+                        "engine and re-submit the pending requests")
+                return fn(jnp.asarray(idx), *vals,
+                          *[jnp.asarray(p) for p in pads])
+
+            try:
+                new = self._dispatch("import", _import_call)
+            except Exception:
+                # no slot owns these pages yet, so the _release_slot
+                # funnel can never return them — put every acquired
+                # AND retained reference back before propagating, or
+                # each caller retry would leak n_alloc pages
+                self._cache.release(pages)
+                raise
+            for t, v in zip(self._caches, new):
+                t._data = v
+                t._node = None
+        req = _Request(rid, prompt, int(max_new_tokens),
+                       int(payload["eos"]),
+                       (self._clock() + float(deadline_ms) / 1e3)
+                       if deadline_ms else None)
+        s.req = req
+        s.phase = "decode"
+        s.pages = pages
+        s.out_toks = done
+        s.cur_tok = int(payload["cur_tok"])
+        s.cur_pos = cur_pos
+        s.stop_len = stop
+        s.eos = int(payload["eos"])
+        s.admit_seq = self._admit_counter
+        self._admit_counter += 1
+        self._bt[b, :] = 0
+        self._bt[b, :len(pages)] = pages
+        self._stats["admitted"] += 1
+        self._stats["pages_allocated"] += len(alloc)
+        if matched:
+            self._stats["cache_hits"] += 1
+            self._stats["cache_hit_tokens"] += len(matched) * ps
+        self._tl.enqueued(rid, prompt.size, int(max_new_tokens))
+        self._tl.admitted(rid, b, cached_tokens=len(matched) * ps,
+                          resume_len=cur_pos)
+        for _ in done:      # tokens the prefill side already produced
+            self._tl.token(rid)
+        self._note_peak()
+        return rid
 
     # ------------------------------------------------- scheduling -----
     def _release_slot(self, b):
@@ -980,9 +1272,13 @@ class ContinuousBatchingEngine:
         return self.model.__dict__.setdefault("_serving_step_cache", {})
 
     def _geometry(self):
+        tp_key = None
+        if self._tpp is not None:
+            tp_key = (self.tp_axis,
+                      tuple(d.id for d in self._jmesh.devices.flat))
         return (self.max_slots, self.page_size, self.np_per_seq,
                 self.total_pages, self.token_budget, self.q_block,
-                self.pages_per_block, self.kv_quant)
+                self.pages_per_block, self.kv_quant, tp_key)
 
     # ------------------------------------------- copy-on-write --------
     def _get_cow_fn(self):
@@ -1030,12 +1326,37 @@ class ContinuousBatchingEngine:
             t._data = v
             t._node = None
 
+    # ---------------------------------------------- TP adapters -------
+    # the TP programs (models/generation.py make_tp_*) are plain jitted
+    # shard_map functions over (data vectors, *sharded params, *cache
+    # pools); these adapters give them the SAME call surface as the
+    # to_static-compiled single-device programs — Tensors in, Tensors
+    # out — so _run_mixed/_run_spec need no TP branch of their own
+    def _tp_wrap(self, jitted):
+        tpp = self._tpp
+        n_caches = len(self._caches)
+
+        def call(*args):
+            vals = [a._read() for a in args]
+            n_data = len(vals) - n_caches
+            outs = jitted(*vals[:n_data], *tpp.vals, *vals[n_data:])
+            return tuple(Tensor(o) for o in outs)
+
+        return call
+
     # ------------------------------------------------- mixed step -----
     def _get_mixed_fn(self):
         if self._mixed_fn is None:
             key = ("mixed", "guard") + self._geometry()
             cache = self._program_cache()
             self._mixed_fn = cache.get(key)
+        if self._mixed_fn is None and self._tpp is not None:
+            from ..models.generation import make_tp_mixed
+            self._mixed_fn = self._tp_wrap(make_tp_mixed(
+                self.model, self._tpp, self._jmesh, self.q_block,
+                self.pages_per_block, len(self._caches)))
+            self._program_cache()[("mixed", "guard")
+                                  + self._geometry()] = self._mixed_fn
         if self._mixed_fn is None:
             from .. import jit as jit_mod
             from .. import ops
@@ -1203,6 +1524,12 @@ class ContinuousBatchingEngine:
         cache = self._program_cache()
         if self._spec_fn is None:
             self._spec_fn = cache.get(key)
+        if self._spec_fn is None and self._tpp is not None:
+            from ..models.generation import make_tp_spec
+            self._spec_fn = self._tp_wrap(make_tp_spec(
+                self.model, self._tpp, self._jmesh, self.q_block,
+                self.pages_per_block, len(self._caches), need_lg))
+            cache[key] = self._spec_fn
         if self._spec_fn is None:
             from .. import jit as jit_mod
             from .. import ops
@@ -1460,6 +1787,14 @@ class ContinuousBatchingEngine:
         if not any(s.phase == "decode" for s in self._slots):
             return                      # everyone got preempted
         tok, pos, fin, eos, stop, rids = self._slot_vectors()
+        if self._tpp is not None:
+            # TP path: the scanned window program is self-contained
+            # (explicit sharded params, no captured executable state),
+            # so there is no first-scalar-dispatch bootstrap — every
+            # decode dispatch is a window.  Token streams are identical
+            # either way: the host replay of the stop rule is shared.
+            self._run_tp_window(tok, pos, fin, eos, stop, rids)
+            return
         step_fn = self._get_step_fn()
         if self._decode_exe is None:
             # a model-cache hit may hand us an already-compiled step
@@ -1550,8 +1885,6 @@ class ContinuousBatchingEngine:
 
         toks, bads, tokf, posf, finf, badf, cache_vals, cstate = \
             self._dispatch("window", _window_call)
-        toks = np.asarray(toks)                       # [K, B]
-        bads = np.asarray(bads)                       # [K, B] cumulative
         for i, v in zip(carry_idx, cstate):
             capt[i]._data = v
             capt[i]._node = None
@@ -1559,9 +1892,16 @@ class ContinuousBatchingEngine:
             t._data = v
             t._node = None
         self._stats["decode_dispatches"] += 1
-        # host replay of the device stop rule (identical predicate, so
-        # the accepted prefix matches the carried fin exactly); the
-        # first bad step fails the slot and discards its frozen tail
+        self._apply_window(np.asarray(toks), np.asarray(bads), fin, K)
+
+    def _apply_window(self, toks, bads, fin, K):
+        """Host replay of the device stop rule over one decode
+        window's stacked tokens [K, B] / cumulative bad flags [K, B]
+        (identical predicate, so the accepted prefix matches the
+        carried fin exactly); the first bad step fails the slot and
+        discards its frozen tail.  Shared by the single-device and TP
+        window paths — the bitwise claim between them rests on this
+        being ONE implementation."""
         live = accepted = 0
         for b, s in enumerate(self._slots):
             if s.phase != "decode" or fin[b]:
@@ -1578,6 +1918,50 @@ class ContinuousBatchingEngine:
                         or s.cur_pos + 1 >= s.stop_len:
                     break
         self._tl.decode_window(accepted, live)
+
+    def _get_tp_window(self, K):
+        key = ("tpwin", K) + self._geometry()
+        cache = self._program_cache()
+        runner = cache.get(key)
+        if runner is None:
+            from ..models.generation import make_tp_window
+            runner = make_tp_window(self.model, self._tpp, self._jmesh,
+                                    self.pages_per_block,
+                                    len(self._caches), K)
+            cache[key] = runner
+        return runner
+
+    def _run_tp_window(self, tok, pos, fin, eos, stop, rids):
+        """K scanned TP decode steps in one dispatch — the sharded
+        analog of :meth:`_run_window` (same carry discipline, same
+        donated-cache retry contract, same host replay)."""
+        K = self.decode_window
+        runner = self._get_tp_window(K)
+        cache_vals = [c._read() for c in self._caches]
+        poison = self._guard.poison(rids)
+
+        def _window_call():
+            if any(getattr(v, "is_deleted", lambda: False)()
+                   for v in cache_vals):
+                raise RuntimeError(
+                    "decode-window dispatch failed after its KV "
+                    "buffers were donated; a mid-execution transient "
+                    "is unrecoverable at this layer — re-create the "
+                    "engine and re-submit the pending requests")
+            return runner(
+                jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(fin),
+                jnp.asarray(np.zeros(self.max_slots, bool)),
+                jnp.asarray(eos), jnp.asarray(stop),
+                jnp.asarray(poison), jnp.asarray(self._bt),
+                *self._tpp.vals, *cache_vals)
+
+        res = self._dispatch("window", _window_call)
+        toks, bads = res[0], res[1]
+        for t, v in zip(self._caches, res[6:]):
+            t._data = v
+            t._node = None
+        self._stats["decode_dispatches"] += 1
+        self._apply_window(np.asarray(toks), np.asarray(bads), fin, K)
 
 
 def _make_slot_window(exe, K):

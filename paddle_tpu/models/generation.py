@@ -84,6 +84,57 @@ def paged_cache_attention(q, k_new, v_new, k_pages, v_pages, pos,
     return out[:, None].astype(q.dtype), k_pages, v_pages
 
 
+def _slot_page_write(kn, vn, k_pages, v_pages, bt, positions,
+                     k_scales=None, v_scales=None):
+    """Write one token per slot into its (page, slot): the ONE home of
+    the per-slot page-write discipline — :func:`paged_slot_attention`
+    AND the tensor-parallel decode path (``_tp_attend_decode``) both
+    write through here, so the 'identical bytes' invariants (prefix
+    cache, preempt-requeue, TP-replicated GQA pools) cannot drift
+    between them.  ``kn``/``vn`` are head-major ``[Hk, B, D]``;
+    scales switch on the int8 quantize-on-write path."""
+    from ..quantization import kv_quantize
+
+    p = positions.reshape(-1).astype(jnp.int32)             # [B]
+    b = p.shape[0]
+    ps = k_pages.shape[2]
+    page = bt[jnp.arange(b), jnp.minimum(p // ps, bt.shape[1] - 1)]
+    slot = p % ps
+    if k_scales is not None:
+        kn, k_sc = kv_quantize(kn)
+        vn, v_sc = kv_quantize(vn)
+        k_scales = k_scales.at[:, page, slot].set(k_sc)
+        v_scales = v_scales.at[:, page, slot].set(v_sc)
+    k_pages = k_pages.at[:, page, slot].set(kn.astype(k_pages.dtype))
+    v_pages = v_pages.at[:, page, slot].set(vn.astype(v_pages.dtype))
+    return k_pages, v_pages, k_scales, v_scales
+
+
+def _ragged_page_write(kn, vn, k_pages, v_pages, bt, tok_pos, tok_slot,
+                       tok_valid, k_scales=None, v_scales=None):
+    """Packed-token analog of :func:`_slot_page_write` (invalid tokens
+    route to the reserved null page 0) — shared by
+    :func:`ragged_paged_step` and the TP ragged path
+    (``_tp_attend_ragged``)."""
+    from ..quantization import kv_quantize
+
+    ps = k_pages.shape[2]
+    pos = tok_pos.astype(jnp.int32)
+    sl = tok_slot.astype(jnp.int32)
+    ok = tok_valid.astype(jnp.bool_)
+    page = jnp.where(
+        ok, bt[sl, jnp.minimum(pos // ps, bt.shape[1] - 1)], 0)
+    wslot = jnp.where(ok, pos % ps, 0)
+    if k_scales is not None:
+        kn, k_sc = kv_quantize(kn)
+        vn, v_sc = kv_quantize(vn)
+        k_scales = k_scales.at[:, page, wslot].set(k_sc)
+        v_scales = v_scales.at[:, page, wslot].set(v_sc)
+    k_pages = k_pages.at[:, page, wslot].set(kn.astype(k_pages.dtype))
+    v_pages = v_pages.at[:, page, wslot].set(vn.astype(v_pages.dtype))
+    return k_pages, v_pages, k_scales, v_scales
+
+
 @primitive
 def paged_slot_attention(q, k_new, v_new, k_pages, v_pages, positions,
                          block_tables, scale=None, pages_per_block=None,
@@ -105,7 +156,6 @@ def paged_slot_attention(q, k_new, v_new, k_pages, v_pages, positions,
     return alongside the data pools.
     """
     from ..ops.pallas.paged_attention import paged_decode_attention
-    from ..quantization import kv_quantize
 
     if (k_scales is None) != (v_scales is None):
         raise ValueError("paged_slot_attention: pass both k_scales "
@@ -113,19 +163,10 @@ def paged_slot_attention(q, k_new, v_new, k_pages, v_pages, positions,
     quant = k_scales is not None
     p = positions.reshape(-1).astype(jnp.int32)             # [B]
     bt = block_tables.astype(jnp.int32)
-    b = q.shape[0]
-    ps = k_pages.shape[2]
-    page = bt[jnp.arange(b), jnp.minimum(p // ps, bt.shape[1] - 1)]
-    slot = p % ps
     kn = jnp.swapaxes(k_new[:, 0], 0, 1)                    # [Hk, B, D]
     vn = jnp.swapaxes(v_new[:, 0], 0, 1)
-    if quant:
-        kn, k_sc = kv_quantize(kn)
-        vn, v_sc = kv_quantize(vn)
-        k_scales = k_scales.at[:, page, slot].set(k_sc)
-        v_scales = v_scales.at[:, page, slot].set(v_sc)
-    k_pages = k_pages.at[:, page, slot].set(kn.astype(k_pages.dtype))
-    v_pages = v_pages.at[:, page, slot].set(vn.astype(v_pages.dtype))
+    k_pages, v_pages, k_scales, v_scales = _slot_page_write(
+        kn, vn, k_pages, v_pages, bt, positions, k_scales, v_scales)
     out = paged_decode_attention(q[:, 0], k_pages, v_pages, bt, p + 1,
                                  scale=scale,
                                  pages_per_block=pages_per_block,
@@ -163,29 +204,17 @@ def ragged_paged_step(q, k_new, v_new, k_pages, v_pages, tok_pos,
     pools.
     """
     from ..ops.pallas.paged_attention import ragged_paged_attention
-    from ..quantization import kv_quantize
 
     if (k_scales is None) != (v_scales is None):
         raise ValueError("ragged_paged_step: pass both k_scales "
                          "and v_scales or neither")
     quant = k_scales is not None
     bt = block_tables.astype(jnp.int32)
-    ps = k_pages.shape[2]
-    pos = tok_pos.astype(jnp.int32)
-    sl = tok_slot.astype(jnp.int32)
-    ok = tok_valid.astype(jnp.bool_)
-    page = jnp.where(
-        ok, bt[sl, jnp.minimum(pos // ps, bt.shape[1] - 1)], 0)
-    wslot = jnp.where(ok, pos % ps, 0)
     kn = jnp.swapaxes(k_new, 0, 1)                          # [Hk, T, D]
     vn = jnp.swapaxes(v_new, 0, 1)
-    if quant:
-        kn, k_sc = kv_quantize(kn)
-        vn, v_sc = kv_quantize(vn)
-        k_scales = k_scales.at[:, page, wslot].set(k_sc)
-        v_scales = v_scales.at[:, page, wslot].set(v_sc)
-    k_pages = k_pages.at[:, page, wslot].set(kn.astype(k_pages.dtype))
-    v_pages = v_pages.at[:, page, wslot].set(vn.astype(v_pages.dtype))
+    k_pages, v_pages, k_scales, v_scales = _ragged_page_write(
+        kn, vn, k_pages, v_pages, bt, tok_pos, tok_slot, tok_valid,
+        k_scales, v_scales)
     out = ragged_paged_attention(q, k_pages, v_pages, bt,
                                  kv_lens.astype(jnp.int32),
                                  q_lens.astype(jnp.int32),
@@ -956,3 +985,677 @@ def _run_decode_windows(exe, out, t, remaining, decode_window,
         capt[i]._data = v
         capt[i]._node = None
     return t
+
+
+# ===================================================================
+# Tensor-parallel serving programs (ISSUE 13; ``inference/distserve``)
+# ===================================================================
+#
+# The serving engine's two compiled programs re-built for a mesh axis:
+# weights column/row-split per the canonical Megatron rules
+# (``GPTForCausalLMPipe.TP_RULES`` / ``shard_gpt``, re-laid-out
+# HEAD-MAJOR so a ``PartitionSpec`` can split the fused qkv projection
+# along heads instead of along its interleaved flat output dim), KV
+# page pools sharded by kv-head, block tables / lengths / packing
+# vectors replicated.  The program body runs under a fully-MANUAL
+# ``core.meshutil.shard_map`` (partial-auto is broken on legacy jax and
+# the Pallas ragged kernel cannot be GSPMD-partitioned anyway) with
+# exactly ONE ``psum`` at the attention output projection and one at
+# the MLP down-projection per layer — the textbook Megatron cut.
+#
+# GQA awareness: when ``Hk % tp == 0`` the K/V projections and pools
+# shard with the q heads (contiguous head blocks keep the q->kv GQA
+# mapping local).  When ``Hk < tp`` (and ``tp % Hk == 0``) the K/V
+# side REPLICATES: every shard computes and writes all kv heads
+# (identical bytes — the write is per-head deterministic), and each
+# shard attends its q heads against a 1-head dynamic slice of the
+# replicated pools (``tp/Hk`` consecutive shards serve one kv head).
+#
+# Greedy outputs are token-identical to the single-device engine: the
+# only numerical difference is the psum's split reduction order
+# (last-ulp on the logits), which the serving parity suite pins at the
+# token level.
+
+def _tp_axis_size(jmesh, axis):
+    sizes = dict(zip(jmesh.axis_names, jmesh.devices.shape))
+    if axis not in sizes:
+        raise ValueError(
+            f"tp_axis {axis!r} is not a mesh axis {jmesh.axis_names}")
+    return int(sizes[axis])
+
+
+class TPParams:
+    """A model's weights re-laid-out + device_put for manual TP.
+
+    ``names``/``vals``/``specs`` are parallel lists (the shard_map
+    inputs and their ``PartitionSpec``s); ``meta`` carries the local
+    geometry the program bodies need.  Extraction is a read-only
+    SNAPSHOT of the model (serving engines own eval-mode models; the
+    single-device engine sharing the model instance is untouched)."""
+
+    __slots__ = ("names", "vals", "specs", "meta")
+
+    def __init__(self, names, vals, specs, meta):
+        self.names = names
+        self.vals = vals
+        self.specs = specs
+        self.meta = meta
+
+
+def tp_shard_params(model, jmesh, tp_axis):
+    """Extract + shard a GPT/LLaMA's weights for the TP serving
+    programs.  See the section comment for the layout; raises on head
+    counts the cut cannot serve (``Hq % tp``, and for GQA
+    ``Hk % tp and tp % Hk``)."""
+    import jax as _jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .gpt import GPTForCausalLM
+    from .llama import LlamaForCausalLM
+
+    tp = _tp_axis_size(jmesh, tp_axis)
+    cfg = model.cfg
+    nh = cfg.num_heads
+    hd = cfg.head_dim
+    nhk = getattr(cfg, "num_kv_heads", nh)
+    if nh % tp:
+        raise ValueError(
+            f"serving TP: num_heads {nh} not divisible by tp={tp}")
+    if cfg.intermediate_size % tp:
+        raise ValueError(
+            f"serving TP: intermediate_size {cfg.intermediate_size} "
+            f"not divisible by tp={tp}")
+    shard_kv = nhk % tp == 0
+    if not shard_kv and tp % nhk:
+        raise ValueError(
+            f"serving TP: GQA kv heads {nhk} neither divisible by nor "
+            f"a divisor of tp={tp}")
+    names, vals, specs = [], [], []
+
+    def add(name, val, spec):
+        names.append(name)
+        vals.append(_jax.device_put(val, NamedSharding(jmesh, spec)))
+        specs.append(spec)
+
+    col = P(None, tp_axis)          # [h, out] split on out
+    row = P(tp_axis)                # leading dim split
+    rep = P()
+    if isinstance(model, GPTForCausalLM):
+        gpt = model.gpt
+        add("wte", gpt.wte.weight._read(), rep)
+        add("wpe", gpt.wpe.weight._read(), rep)
+        for li, blk in enumerate(gpt.blocks):
+            h = cfg.hidden_size
+            add(f"b{li}.ln1.w", blk.ln1.weight._read(), rep)
+            add(f"b{li}.ln1.b", blk.ln1.bias._read(), rep)
+            # fused qkv: flat out dim is (3, nh, hd)-interleaved — a
+            # contiguous column split would cut across q/k/v, so the
+            # weight reshapes head-major and shards the HEAD dim
+            add(f"b{li}.qkv.w",
+                blk.attn.qkv.weight._read().reshape(h, 3, nh, hd),
+                P(None, None, tp_axis))
+            add(f"b{li}.qkv.b",
+                blk.attn.qkv.bias._read().reshape(3, nh, hd),
+                P(None, tp_axis))
+            add(f"b{li}.proj.w",
+                blk.attn.proj.weight._read().reshape(nh, hd, h), row)
+            add(f"b{li}.proj.b", blk.attn.proj.bias._read(), rep)
+            add(f"b{li}.ln2.w", blk.ln2.weight._read(), rep)
+            add(f"b{li}.ln2.b", blk.ln2.bias._read(), rep)
+            add(f"b{li}.fc1.w", blk.mlp.fc1.weight._read(), col)
+            add(f"b{li}.fc1.b", blk.mlp.fc1.bias._read(), row)
+            add(f"b{li}.fc2.w", blk.mlp.fc2.weight._read(), row)
+            add(f"b{li}.fc2.b", blk.mlp.fc2.bias._read(), rep)
+        add("ln_f.w", gpt.ln_f.weight._read(), rep)
+        add("ln_f.b", gpt.ln_f.bias._read(), rep)
+        if model.lm_head is not None:
+            add("lm_head", model.lm_head.weight._read(), rep)
+        family = "gpt"
+    elif isinstance(model, LlamaForCausalLM):
+        lm = model.llama
+        add("wte", lm.embed_tokens.weight._read(), rep)
+        for li, layer in enumerate(lm.layers):
+            a = layer.attn
+            h = cfg.hidden_size
+            add(f"b{li}.in_norm.w", layer.input_norm.weight._read(),
+                rep)
+            add(f"b{li}.q.w",
+                a.q_proj.weight._read().reshape(h, nh, hd),
+                P(None, tp_axis))
+            add(f"b{li}.k.w",
+                a.k_proj.weight._read().reshape(h, nhk, hd),
+                P(None, tp_axis) if shard_kv else rep)
+            add(f"b{li}.v.w",
+                a.v_proj.weight._read().reshape(h, nhk, hd),
+                P(None, tp_axis) if shard_kv else rep)
+            add(f"b{li}.o.w",
+                a.o_proj.weight._read().reshape(nh, hd, h), row)
+            add(f"b{li}.post_norm.w", layer.post_norm.weight._read(),
+                rep)
+            add(f"b{li}.gate.w", layer.mlp.gate_proj.weight._read(),
+                col)
+            add(f"b{li}.up.w", layer.mlp.up_proj.weight._read(), col)
+            add(f"b{li}.down.w", layer.mlp.down_proj.weight._read(),
+                row)
+        add("norm.w", lm.norm.weight._read(), rep)
+        if model.lm_head is not None:
+            add("lm_head", model.lm_head.weight._read(), rep)
+        family = "llama"
+    else:
+        raise TypeError(
+            f"serving TP: unsupported model {type(model).__name__}")
+    meta = {
+        "family": family, "tp": tp, "axis": tp_axis,
+        "nh_loc": nh // tp,
+        "nhk_loc": nhk // tp if shard_kv else nhk,
+        "shard_kv": shard_kv, "hd": hd,
+        "shards_per_kv": 1 if shard_kv else tp // nhk,
+    }
+    return TPParams(names, vals, specs, meta)
+
+
+def tp_cache_spec(meta, tp_axis):
+    """PartitionSpec of one KV page pool (or scale side-pool) under
+    this TP layout: sharded on the kv-head dim when ``Hk % tp == 0``,
+    replicated otherwise (every shard writes all heads — identical
+    bytes by construction)."""
+    from jax.sharding import PartitionSpec as P
+    return P(tp_axis) if meta["shard_kv"] else P()
+
+
+def _tp_kv_slice(meta, pools, tp_axis):
+    """The kv-head slice of (replicated) ``pools`` this shard attends
+    with, or ``pools`` unchanged when they are head-sharded.  With
+    ``Hk < tp``, ``tp/Hk`` consecutive shards serve one kv head, so
+    the slice is ONE head at a traced per-shard offset."""
+    if meta["shard_kv"]:
+        return pools
+    from jax import lax as _lax
+    r = _lax.axis_index(meta["axis"])
+    head = r // meta["shards_per_kv"]
+    return [_lax.dynamic_slice_in_dim(p, head, 1, axis=0)
+            for p in pools]
+
+
+def _tp_attend_ragged(meta, q, kn, vn, kp, vp, tok_pos, tok_slot,
+                      tok_valid, kv_lens, q_lens, bt, q_block, ppb,
+                      ks=None, vs=None):
+    """One layer's packed-token page write + ragged attention under
+    TP.  Head-sharded pools go straight through
+    :func:`ragged_paged_step`'s jnp body; replicated pools (GQA
+    ``Hk < tp``) write ALL heads through the SAME
+    :func:`_ragged_page_write` home (bytes cannot drift between the
+    modes) and attend a 1-head slice."""
+    from ..ops.pallas.paged_attention import ragged_paged_attention
+
+    if meta["shard_kv"]:
+        outs = ragged_paged_step.raw(
+            q, kn, vn, kp, vp, tok_pos, tok_slot, tok_valid, kv_lens,
+            q_lens, bt, q_block=q_block, pages_per_block=ppb,
+            k_scales=ks, v_scales=vs)
+        if ks is not None:
+            att, kp, vp, ks, vs = outs
+            return att, kp, vp, ks, vs
+        att, kp, vp = outs
+        return att, kp, vp, None, None
+    bt_i = bt.astype(jnp.int32)
+    knn = jnp.swapaxes(kn, 0, 1)                   # [Hk, T, D] (full)
+    vnn = jnp.swapaxes(vn, 0, 1)
+    kp, vp, ks, vs = _ragged_page_write(
+        knn, vnn, kp, vp, bt_i, tok_pos, tok_slot, tok_valid, ks, vs)
+    kp_s, vp_s = _tp_kv_slice(meta, [kp, vp], meta["axis"])
+    sc_s = (_tp_kv_slice(meta, [ks, vs], meta["axis"])
+            if ks is not None else (None, None))
+    att = ragged_paged_attention(
+        q, kp_s, vp_s, bt_i, kv_lens.astype(jnp.int32),
+        q_lens.astype(jnp.int32), q_block=q_block,
+        pages_per_block=ppb, k_scales=sc_s[0], v_scales=sc_s[1])
+    return att.astype(q.dtype), kp, vp, ks, vs
+
+
+def _tp_attend_decode(meta, q, kn, vn, kp, vp, positions, bt, ppb,
+                      ks=None, vs=None):
+    """Per-slot decode-step analog of :func:`_tp_attend_ragged`
+    (replicated-KV writes go through :func:`_slot_page_write`, the
+    same home ``paged_slot_attention`` uses)."""
+    from ..ops.pallas.paged_attention import paged_decode_attention
+
+    if meta["shard_kv"]:
+        outs = paged_slot_attention.raw(
+            q, kn, vn, kp, vp, positions, bt, pages_per_block=ppb,
+            k_scales=ks, v_scales=vs)
+        if ks is not None:
+            att, kp, vp, ks, vs = outs
+            return att, kp, vp, ks, vs
+        att, kp, vp = outs
+        return att, kp, vp, None, None
+    p = positions.reshape(-1).astype(jnp.int32)
+    bt_i = bt.astype(jnp.int32)
+    knn = jnp.swapaxes(kn[:, 0], 0, 1)             # [Hk, B, D] (full)
+    vnn = jnp.swapaxes(vn[:, 0], 0, 1)
+    kp, vp, ks, vs = _slot_page_write(knn, vnn, kp, vp, bt_i,
+                                      positions, ks, vs)
+    kp_s, vp_s = _tp_kv_slice(meta, [kp, vp], meta["axis"])
+    sc_s = (_tp_kv_slice(meta, [ks, vs], meta["axis"])
+            if ks is not None else (None, None))
+    att = paged_decode_attention(
+        q[:, 0], kp_s, vp_s, bt_i, p + 1, pages_per_block=ppb,
+        k_scales=sc_s[0], v_scales=sc_s[1])
+    return att[:, None].astype(q.dtype), kp, vp, ks, vs
+
+
+def _gpt_tp_body(model, tpp, q_block, ppb):
+    """(ids, tok_pos, tok_slot, tok_valid, kv_lens, q_lens, bt, *flat)
+    -> (logits [T, V] tp-replicated, new caches local) — the packed
+    ragged forward under manual TP (shard_map body)."""
+    from jax import lax as _lax
+
+    from ..distributed.fleet.pipeline import functional_call
+    from ..nn.functional.activation import _gelu_impl
+
+    gpt = model.gpt
+    meta = tpp.meta
+    names = tpp.names
+    n_p = len(names)
+    L = len(gpt.blocks)
+    axis = meta["axis"]
+
+    def body(ids, tok_pos, tok_slot, tok_valid, kv_lens, q_lens, bt,
+             *flat):
+        pv = dict(zip(names, flat[:n_p]))
+        caches = list(flat[n_p:])
+        data, scales = _split_caches(caches, L)
+        t = ids.shape[1]
+        x = functional_call(gpt.wte, {"weight": pv["wte"]}, ids) \
+            + functional_call(gpt.wpe, {"weight": pv["wpe"]},
+                              tok_pos.reshape(1, -1))
+        new, new_sc = [], []
+        for li, blk in enumerate(gpt.blocks):
+            h = functional_call(
+                blk.ln1, {"weight": pv[f"b{li}.ln1.w"],
+                          "bias": pv[f"b{li}.ln1.b"]}, x)
+            h2 = h.reshape(t, -1)
+            wq = pv[f"b{li}.qkv.w"]          # [h, 3, nh_loc, hd]
+            qkv = (h2 @ wq.reshape(wq.shape[0], -1)
+                   + pv[f"b{li}.qkv.b"].reshape(-1))
+            qkv = qkv.reshape(t, 3, wq.shape[2], wq.shape[3])
+            q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            ks = scales[2 * li] if scales else None
+            vs = scales[2 * li + 1] if scales else None
+            att, kc, vc, ks, vs = _tp_attend_ragged(
+                meta, q, k, v, data[2 * li], data[2 * li + 1],
+                tok_pos, tok_slot, tok_valid, kv_lens, q_lens, bt,
+                q_block, ppb, ks, vs)
+            new.extend([kc, vc])
+            if ks is not None:
+                new_sc.extend([ks, vs])
+            wp = pv[f"b{li}.proj.w"]         # [nh_loc, hd, h]
+            prj = att.reshape(t, -1) @ wp.reshape(-1, wp.shape[-1])
+            prj = _lax.psum(prj, axis) + pv[f"b{li}.proj.b"]
+            x = x + prj.reshape(1, t, -1)
+            h = functional_call(
+                blk.ln2, {"weight": pv[f"b{li}.ln2.w"],
+                          "bias": pv[f"b{li}.ln2.b"]}, x)
+            f1 = h.reshape(t, -1) @ pv[f"b{li}.fc1.w"] \
+                + pv[f"b{li}.fc1.b"]
+            f1 = _gelu_impl.raw(f1, approximate=True)
+            f2 = f1 @ pv[f"b{li}.fc2.w"]
+            f2 = _lax.psum(f2, axis) + pv[f"b{li}.fc2.b"]
+            x = x + f2.reshape(1, t, -1)
+        hf = functional_call(
+            gpt.ln_f, {"weight": pv["ln_f.w"], "bias": pv["ln_f.b"]},
+            x).reshape(t, -1)
+        if model.lm_head is not None:
+            logits = hf @ pv["lm_head"]
+        else:
+            logits = hf @ pv["wte"].T
+        return logits, new + new_sc
+
+    return body
+
+
+def _gpt_tp_decode_body(model, tpp, ppb):
+    """(tok [B,1], pos [B], bt, *flat) -> (logits [B, V], new caches)
+    — the per-slot decode step under manual TP."""
+    from jax import lax as _lax
+
+    from ..distributed.fleet.pipeline import functional_call
+    from ..nn.functional.activation import _gelu_impl
+
+    gpt = model.gpt
+    meta = tpp.meta
+    names = tpp.names
+    n_p = len(names)
+    L = len(gpt.blocks)
+    axis = meta["axis"]
+
+    def body(tok, pos, bt, *flat):
+        pv = dict(zip(names, flat[:n_p]))
+        caches = list(flat[n_p:])
+        data, scales = _split_caches(caches, L)
+        b = tok.shape[0]
+        x = functional_call(gpt.wte, {"weight": pv["wte"]}, tok) \
+            + functional_call(gpt.wpe, {"weight": pv["wpe"]},
+                              pos.reshape(-1, 1))
+        new, new_sc = [], []
+        for li, blk in enumerate(gpt.blocks):
+            h = functional_call(
+                blk.ln1, {"weight": pv[f"b{li}.ln1.w"],
+                          "bias": pv[f"b{li}.ln1.b"]}, x)
+            h2 = h.reshape(b, -1)
+            wq = pv[f"b{li}.qkv.w"]
+            qkv = (h2 @ wq.reshape(wq.shape[0], -1)
+                   + pv[f"b{li}.qkv.b"].reshape(-1))
+            qkv = qkv.reshape(b, 1, 3, wq.shape[2], wq.shape[3])
+            q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+            ks = scales[2 * li] if scales else None
+            vs = scales[2 * li + 1] if scales else None
+            att, kc, vc, ks, vs = _tp_attend_decode(
+                meta, q, k, v, data[2 * li], data[2 * li + 1], pos,
+                bt, ppb, ks, vs)
+            new.extend([kc, vc])
+            if ks is not None:
+                new_sc.extend([ks, vs])
+            wp = pv[f"b{li}.proj.w"]
+            prj = att.reshape(b, -1) @ wp.reshape(-1, wp.shape[-1])
+            prj = _lax.psum(prj, axis) + pv[f"b{li}.proj.b"]
+            x = x + prj.reshape(b, 1, -1)
+            h = functional_call(
+                blk.ln2, {"weight": pv[f"b{li}.ln2.w"],
+                          "bias": pv[f"b{li}.ln2.b"]}, x)
+            f1 = h.reshape(b, -1) @ pv[f"b{li}.fc1.w"] \
+                + pv[f"b{li}.fc1.b"]
+            f1 = _gelu_impl.raw(f1, approximate=True)
+            f2 = f1 @ pv[f"b{li}.fc2.w"]
+            f2 = _lax.psum(f2, axis) + pv[f"b{li}.fc2.b"]
+            x = x + f2.reshape(b, 1, -1)
+        hf = functional_call(
+            gpt.ln_f, {"weight": pv["ln_f.w"], "bias": pv["ln_f.b"]},
+            x).reshape(b, -1)
+        if model.lm_head is not None:
+            logits = hf @ pv["lm_head"]
+        else:
+            logits = hf @ pv["wte"].T
+        return logits, new + new_sc
+
+    return body
+
+
+def _llama_tp_body(model, tpp, q_block, ppb):
+    from jax import lax as _lax
+
+    from ..distributed.fleet.pipeline import functional_call
+
+    lm = model.llama
+    meta = tpp.meta
+    names = tpp.names
+    n_p = len(names)
+    L = len(lm.layers)
+    axis = meta["axis"]
+
+    def body(ids, tok_pos, tok_slot, tok_valid, kv_lens, q_lens, bt,
+             *flat):
+        import jax as _jax
+        pv = dict(zip(names, flat[:n_p]))
+        caches = list(flat[n_p:])
+        data, scales = _split_caches(caches, L)
+        t = ids.shape[1]
+        x = functional_call(lm.embed_tokens, {"weight": pv["wte"]},
+                            ids)
+        new, new_sc = [], []
+        for li, layer in enumerate(lm.layers):
+            a = layer.attn
+            h = functional_call(
+                layer.input_norm,
+                {"weight": pv[f"b{li}.in_norm.w"]}, x)
+            h2 = h.reshape(t, -1)
+            wqq = pv[f"b{li}.q.w"]           # [h, nh_loc, hd]
+            wkk = pv[f"b{li}.k.w"]           # [h, nhk_loc|nhk, hd]
+            wvv = pv[f"b{li}.v.w"]
+            q = (h2 @ wqq.reshape(wqq.shape[0], -1)).reshape(
+                1, t, wqq.shape[1], wqq.shape[2])
+            k = (h2 @ wkk.reshape(wkk.shape[0], -1)).reshape(
+                1, t, wkk.shape[1], wkk.shape[2])
+            v = (h2 @ wvv.reshape(wvv.shape[0], -1)).reshape(
+                1, t, wvv.shape[1], wvv.shape[2])
+            q = rope_at.raw(q, tok_pos, theta=a.rope_theta)
+            k = rope_at.raw(k, tok_pos, theta=a.rope_theta)
+            ks = scales[2 * li] if scales else None
+            vs = scales[2 * li + 1] if scales else None
+            att, kc, vc, ks, vs = _tp_attend_ragged(
+                meta, q.reshape(t, wqq.shape[1], wqq.shape[2]),
+                k.reshape(t, wkk.shape[1], wkk.shape[2]),
+                v.reshape(t, wvv.shape[1], wvv.shape[2]),
+                data[2 * li], data[2 * li + 1], tok_pos, tok_slot,
+                tok_valid, kv_lens, q_lens, bt, q_block, ppb, ks, vs)
+            new.extend([kc, vc])
+            if ks is not None:
+                new_sc.extend([ks, vs])
+            wo = pv[f"b{li}.o.w"]            # [nh_loc, hd, h]
+            prj = att.reshape(t, -1) @ wo.reshape(-1, wo.shape[-1])
+            prj = _lax.psum(prj, axis)
+            x = x + prj.reshape(1, t, -1)
+            h = functional_call(
+                layer.post_norm,
+                {"weight": pv[f"b{li}.post_norm.w"]}, x)
+            h2 = h.reshape(t, -1)
+            f1 = _jax.nn.silu(h2 @ pv[f"b{li}.gate.w"]) \
+                * (h2 @ pv[f"b{li}.up.w"])
+            f2 = f1 @ pv[f"b{li}.down.w"]
+            f2 = _lax.psum(f2, axis)
+            x = x + f2.reshape(1, t, -1)
+        hf = functional_call(lm.norm, {"weight": pv["norm.w"]},
+                             x).reshape(t, -1)
+        if model.lm_head is not None:
+            logits = hf @ pv["lm_head"]
+        else:
+            logits = hf @ pv["wte"].T
+        return logits, new + new_sc
+
+    return body
+
+
+def _llama_tp_decode_body(model, tpp, ppb):
+    from jax import lax as _lax
+
+    from ..distributed.fleet.pipeline import functional_call
+
+    lm = model.llama
+    meta = tpp.meta
+    names = tpp.names
+    n_p = len(names)
+    L = len(lm.layers)
+    axis = meta["axis"]
+
+    def body(tok, pos, bt, *flat):
+        import jax as _jax
+        pv = dict(zip(names, flat[:n_p]))
+        caches = list(flat[n_p:])
+        data, scales = _split_caches(caches, L)
+        b = tok.shape[0]
+        x = functional_call(lm.embed_tokens, {"weight": pv["wte"]},
+                            tok)
+        new, new_sc = [], []
+        for li, layer in enumerate(lm.layers):
+            a = layer.attn
+            h = functional_call(
+                layer.input_norm,
+                {"weight": pv[f"b{li}.in_norm.w"]}, x)
+            h2 = h.reshape(b, -1)
+            wqq = pv[f"b{li}.q.w"]
+            wkk = pv[f"b{li}.k.w"]
+            wvv = pv[f"b{li}.v.w"]
+            q = (h2 @ wqq.reshape(wqq.shape[0], -1)).reshape(
+                b, 1, wqq.shape[1], wqq.shape[2])
+            k = (h2 @ wkk.reshape(wkk.shape[0], -1)).reshape(
+                b, 1, wkk.shape[1], wkk.shape[2])
+            v = (h2 @ wvv.reshape(wvv.shape[0], -1)).reshape(
+                b, 1, wvv.shape[1], wvv.shape[2])
+            q = rope_at.raw(q, pos, theta=a.rope_theta)
+            k = rope_at.raw(k, pos, theta=a.rope_theta)
+            ks = scales[2 * li] if scales else None
+            vs = scales[2 * li + 1] if scales else None
+            att, kc, vc, ks, vs = _tp_attend_decode(
+                meta, q, k, v, data[2 * li], data[2 * li + 1], pos,
+                bt, ppb, ks, vs)
+            new.extend([kc, vc])
+            if ks is not None:
+                new_sc.extend([ks, vs])
+            wo = pv[f"b{li}.o.w"]
+            prj = att.reshape(b, -1) @ wo.reshape(-1, wo.shape[-1])
+            prj = _lax.psum(prj, axis)
+            x = x + prj.reshape(b, 1, -1)
+            h = functional_call(
+                layer.post_norm,
+                {"weight": pv[f"b{li}.post_norm.w"]}, x)
+            h2 = h.reshape(b, -1)
+            f1 = _jax.nn.silu(h2 @ pv[f"b{li}.gate.w"]) \
+                * (h2 @ pv[f"b{li}.up.w"])
+            f2 = f1 @ pv[f"b{li}.down.w"]
+            f2 = _lax.psum(f2, axis)
+            x = x + f2.reshape(b, 1, -1)
+        hf = functional_call(lm.norm, {"weight": pv["norm.w"]},
+                             x).reshape(b, -1)
+        if model.lm_head is not None:
+            logits = hf @ pv["lm_head"]
+        else:
+            logits = hf @ pv["wte"].T
+        return logits, new + new_sc
+
+    return body
+
+
+def _tp_body_fns(model):
+    from .gpt import GPTForCausalLM
+    from .llama import LlamaForCausalLM
+    if isinstance(model, GPTForCausalLM):
+        return _gpt_tp_body, _gpt_tp_decode_body
+    if isinstance(model, LlamaForCausalLM):
+        return _llama_tp_body, _llama_tp_decode_body
+    raise TypeError(
+        f"serving TP: unsupported model {type(model).__name__}")
+
+
+def make_tp_mixed(model, tpp, jmesh, q_block, ppb, n_caches):
+    """The TP MIXED serving program: same call signature as the
+    single-device engine's compiled mixed step (packing vectors +
+    poison + block tables + cache pools), jitted over a fully-manual
+    shard_map of the TP forward, ``guarded_argmax`` running replicated
+    after the final psum so every shard returns the identical token
+    and bad-flag vectors."""
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..core.meshutil import shard_map
+    meta = tpp.meta
+    axis = meta["axis"]
+    ragged_body, _ = _tp_body_fns(model)
+    body = ragged_body(model, tpp, q_block, ppb)
+    cspec = tp_cache_spec(meta, axis)
+
+    def mixed(ids, tok_pos, tok_slot, tok_valid, kv_lens, q_lens,
+              last_idx, poison, bt, *flat):
+        logits, new = body(ids, tok_pos, tok_slot, tok_valid, kv_lens,
+                           q_lens, bt, *flat)
+        lg = logits[last_idx]                         # [B, V]
+        nxt, bad = guarded_argmax.raw(lg, poison)
+        return (nxt, bad) + tuple(new)
+
+    rep = P()
+    in_specs = (rep,) * 9 + tuple(tpp.specs) \
+        + (cspec,) * n_caches
+    out_specs = (rep, rep) + (cspec,) * n_caches
+    return _jax.jit(shard_map(mixed, jmesh, in_specs=in_specs,
+                              out_specs=out_specs))
+
+
+def make_tp_spec(model, tpp, jmesh, q_block, ppb, n_caches,
+                 need_logits):
+    """The TP speculative VERIFY program (``verify_argmax`` over the
+    packed logits; ``need_logits`` adds the gathered per-slot logits
+    rows the sampling acceptance rule consumes)."""
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..core.meshutil import shard_map
+    meta = tpp.meta
+    axis = meta["axis"]
+    ragged_body, _ = _tp_body_fns(model)
+    body = ragged_body(model, tpp, q_block, ppb)
+    cspec = tp_cache_spec(meta, axis)
+    rep = P()
+
+    if need_logits:
+        def spec(ids, tok_pos, tok_slot, tok_valid, kv_lens, q_lens,
+                 poison, gather_idx, bt, *flat):
+            logits, new = body(ids, tok_pos, tok_slot, tok_valid,
+                               kv_lens, q_lens, bt, *flat)
+            toks, bad = verify_argmax.raw(logits, tok_slot, tok_valid,
+                                          poison)
+            return (toks, bad, logits[gather_idx]) + tuple(new)
+        n_in, n_head = 9, 3
+    else:
+        def spec(ids, tok_pos, tok_slot, tok_valid, kv_lens, q_lens,
+                 poison, bt, *flat):
+            logits, new = body(ids, tok_pos, tok_slot, tok_valid,
+                               kv_lens, q_lens, bt, *flat)
+            toks, bad = verify_argmax.raw(logits, tok_slot, tok_valid,
+                                          poison)
+            return (toks, bad) + tuple(new)
+        n_in, n_head = 8, 2
+
+    in_specs = (rep,) * n_in + tuple(tpp.specs) + (cspec,) * n_caches
+    out_specs = (rep,) * n_head + (cspec,) * n_caches
+    return _jax.jit(shard_map(spec, jmesh, in_specs=in_specs,
+                              out_specs=out_specs))
+
+
+def make_tp_window(model, tpp, jmesh, ppb, n_caches, K):
+    """K scanned TP decode steps in ONE dispatch — the
+    ``_make_slot_window`` analog with explicit params instead of
+    captured executable state.  Same carry (token, position, finished,
+    guard-bad per slot + caches), same freeze rule, same stacked
+    per-step bad flags; cache pools are donated."""
+    import jax as _jax
+    from jax import lax as _lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..core.meshutil import shard_map
+    meta = tpp.meta
+    axis = meta["axis"]
+    _, decode_body_fn = _tp_body_fns(model)
+    step_body = decode_body_fn(model, tpp, ppb)
+    cspec = tp_cache_spec(meta, axis)
+    rep = P()
+    n_p = len(tpp.names)
+
+    def window(tok, pos, fin, bad, eos_ids, stop_lens, poison, bt,
+               *flat):
+        params = flat[:n_p]
+        caches = list(flat[n_p:])
+
+        def body(c, _):
+            tok, pos, fin, bad, caches = c
+            lg, new_caches = step_body(tok, pos, bt, *params, *caches)
+            lg = lg.astype(jnp.float32)
+            nxt_raw, row_bad = guarded_argmax.raw(lg, poison)
+            bad2 = bad | (row_bad & jnp.logical_not(fin))
+            adv = jnp.logical_not(fin | bad2)
+            nxt = jnp.where(adv, nxt_raw, tok[:, 0])
+            pos2 = jnp.where(adv, pos + 1, pos)
+            fin2 = fin | bad2 | ((eos_ids >= 0) & (nxt == eos_ids)) \
+                | (pos2 + 1 >= stop_lens)
+            return (nxt[:, None], pos2, fin2, bad2,
+                    list(new_caches)), (nxt, bad2)
+
+        (tok, pos, fin, bad, caches), (toks, bads) = _lax.scan(
+            body, (tok, pos, fin, bad, caches), None, length=K)
+        return (toks, bads, tok, pos, fin, bad) + tuple(caches)
+
+    in_specs = (rep,) * 8 + tuple(tpp.specs) + (cspec,) * n_caches
+    out_specs = (rep,) * 6 + (cspec,) * n_caches
+    fn = shard_map(window, jmesh, in_specs=in_specs,
+                   out_specs=out_specs)
+    # donate the cache pools (the last n_caches positional args)
+    donate = tuple(range(8 + n_p, 8 + n_p + n_caches))
+    return _jax.jit(fn, donate_argnums=donate)

@@ -46,6 +46,15 @@ sleeps or randomness:
   (tokens shifted mod vocab) before the verify dispatch, forcing the
   rejection path: outputs stay bitwise, accepted-draft counters drop.
   Key = the request id.
+* ``engine_handoff_transient`` — one disaggregated-serving KV-page
+  handoff (``inference.distserve.KVPageTransport.ship``) raises
+  ``InjectedConnectionError`` before the transfer; absorbed by the
+  bounded retry every handoff runs under. Key = the request id.
+* ``engine_decode_worker_lost`` — the decode worker is treated as
+  dead at handoff: the payload is discarded and the coordinator
+  requeues the request to the prefill group for a from-scratch
+  re-prefill (outputs bitwise; the ``requeues`` counter moves). Key =
+  the request id.
 
 Spec grammar (``;``-separated rules)::
 

@@ -39,6 +39,17 @@ Serving fault sites (``resilience.faults`` spec grammar):
   the 0-accept path: outputs stay bitwise (the acceptance rule is
   correct for ANY drafts), only the accept rate moves. Key = the
   request id.
+* ``engine_handoff_transient`` — one KV-page handoff transfer
+  (``inference.distserve.KVPageTransport.ship``) raises
+  ``InjectedConnectionError``; absorbed by the bounded
+  ``resilience.retry`` every transfer runs under
+  (``serving_disagg_handoff_retries``). Key = the request id.
+* ``engine_decode_worker_lost`` — the decode worker is treated as
+  dead at handoff time: the shipped payload is DISCARDED and the
+  coordinator requeues the request to the prefill group, which
+  re-prefills it from token zero — outputs stay bitwise (greedy
+  prefill+decode is deterministic), only ``requeues`` moves. Key =
+  the request id.
 """
 from __future__ import annotations
 
@@ -51,6 +62,7 @@ __all__ = [
     "FINISH_REASONS", "DecodeGuard", "dispatch_retry",
     "SITE_DISPATCH", "SITE_NAN_DECODE", "SITE_PAGE_PRESSURE",
     "SITE_CACHE_EVICT", "SITE_DRAFT_NAN", "SITE_DRAFT_MISMATCH",
+    "SITE_HANDOFF_TRANSIENT", "SITE_DECODE_WORKER_LOST",
 ]
 
 #: Every value ``CompletedRequest.finish_reason`` can take.
@@ -62,6 +74,8 @@ SITE_PAGE_PRESSURE = "engine_page_pressure"
 SITE_CACHE_EVICT = "engine_cache_evict"
 SITE_DRAFT_NAN = "engine_draft_nan"
 SITE_DRAFT_MISMATCH = "engine_draft_mismatch"
+SITE_HANDOFF_TRANSIENT = "engine_handoff_transient"
+SITE_DECODE_WORKER_LOST = "engine_decode_worker_lost"
 
 
 class DecodeGuard:

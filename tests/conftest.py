@@ -66,9 +66,11 @@ _TIER1_ORDER = [
     "test_rnn.py",
     # pinned acceptance block: kernels + serving parity (fp, quant,
     # speculative — test_speculative reuses the session model and the
-    # serving-engine geometries, so it rides the same compiled programs)
+    # serving-engine geometries, so it rides the same compiled
+    # programs; test_distserve is the ISSUE-13 TP/disagg acceptance
+    # suite and reuses the session serving_gpt + the same geometry)
     "test_pallas.py", "test_quant_serving.py", "test_serving_engine.py",
-    "test_speculative.py",
+    "test_speculative.py", "test_distserve.py",
     # <- unlisted files slot in here (rank _TIER1_DEFAULT)
     # medium density; the budget cutoff lands somewhere below
     "test_fft_signal_distribution.py", "test_op_tail.py",
