@@ -106,6 +106,13 @@ queue-time columns are derived from the engine's OWN event timelines
 (``engine.metrics()``, ``paddle_tpu/observability/serving.py``)
 instead of ad-hoc host timers: prefill chunks and decodes share one
 ragged dispatch, so phase attribution must come from engine events.
+Since ISSUE 14 the on half also arms the SLO guardrails + stall
+watchdog (``slo=``/``watchdog_ms=``), so the overhead claim covers
+judgment-layer cost too, and the ``continuous_mixed``/``overload``/
+``disagg`` rows carry ``slo_ok``/``budget_burn`` columns — the SLO
+engine's verdict (all objectives met; worst slow-window burn rate)
+on the traffic the row measured, from the SAME percentile math the
+report columns use (``observability.metrics.percentile_from_counts``).
 
 Results persist via benchmarks/measured_cache.py and surface as a
 compact ``serving`` entry in bench.py's enriched record and in
@@ -208,27 +215,24 @@ def roofline_ms(cfg, model, batch, prompt_len, new_tokens, gbps,
     return bytes_step / (gbps * 1e9) * 1e3
 
 
-def _tl_pct(eng, name, q=0.99) -> float:
-    """Approximate percentile of one serving-timeline histogram (upper
-    edge of the bucket holding the q-th observation; the fixed
-    log-spaced buckets make this stable across runs).  The ``disagg``
-    row's decode-p99 claim reads this."""
+def _tl_node(eng, name) -> dict:
     node = eng.metrics()
     for part in ("serving." + name).split("."):
         node = node.get(part, {})
-    edges = node.get("buckets", [])
-    counts = node.get("counts", [])
-    total = node.get("count", 0)
-    if not total or not edges:
-        return 0.0
-    target = q * total
-    cum = 0
-    for i, c in enumerate(counts):
-        cum += c
-        if cum >= target:
-            # counts[-1] is the overflow bucket: no finite upper edge
-            return float(edges[i]) if i < len(edges) else float("inf")
-    return float("inf")
+    return node
+
+
+def _tl_pct(eng, name, q=0.99) -> float:
+    """Percentile of one serving-timeline histogram — the SHARED
+    ``observability.metrics.percentile_from_counts`` implementation
+    (ISSUE 14: one home for the math, so the SLO engine's runtime
+    judgment and this report column can never disagree on what a p99
+    is).  The ``disagg`` row's decode-p99 claim reads this."""
+    from paddle_tpu.observability.metrics import percentile_from_counts
+    node = _tl_node(eng, name)
+    return percentile_from_counts(node.get("buckets", []),
+                                  node.get("counts", []),
+                                  node.get("count", 0), q)
 
 
 def _tl_mean(eng, name) -> float:
@@ -237,12 +241,30 @@ def _tl_mean(eng, name) -> float:
     timelines — the ragged mixed program batches prefill chunks and
     decodes of many requests into one dispatch, so host-side timer
     wrapping cannot attribute phases; the engine's scheduling events
-    can."""
-    node = eng.metrics()
-    for part in ("serving." + name).split("."):
-        node = node.get(part, {})
-    cnt = node.get("count", 0)
-    return node.get("sum", 0.0) / cnt if cnt else 0.0
+    can.  Reads the snapshot's own ``mean`` (computed sum/count inside
+    the histogram's locked ``_snap`` — the one implementation)."""
+    return _tl_node(eng, name).get("mean", 0.0)
+
+
+# default SLO objectives armed on the engine-driven rows (ISSUE 14):
+# generous CPU-smoke-safe thresholds — the slo_ok/budget_burn columns
+# REPORT the judgment layer's verdict on the measured traffic, they do
+# not gate the bench.  The metrics_overhead row arms the same spec plus
+# the stall watchdog, so its <= 3% claim covers guardrails-on serving.
+_SLO_SPEC = ("ttft_p95_ms=2000,tpot_p99_ms=500,queue_p95_ms=5000,"
+             "goodput=0.9")
+_WATCHDOG_MS = 30000.0
+
+
+def _slo_cols(eng) -> dict:
+    """``slo_ok`` / ``budget_burn`` columns from an engine's armed SLO
+    specs (all-ok verdict and the worst slow-window burn rate)."""
+    sts = eng.slo_status()
+    return {
+        "slo_ok": bool(all(s["ok"] for s in sts)) if sts else True,
+        "budget_burn": round(max((s["burn_slow"] for s in sts),
+                                 default=0.0), 4),
+    }
 
 
 def measure_launch_ms() -> float:
@@ -363,7 +385,7 @@ def _measure_continuous(cfg, model, gbps, launch, slots=8,
         eng = ContinuousBatchingEngine(
             model, max_slots=slots, page_size=page_size,
             max_seq_len=max_seq_len, decode_window=decode_window,
-            prefill_chunk=prefill_chunk)
+            prefill_chunk=prefill_chunk, slo=_SLO_SPEC)
         # staggered arrivals: half queued up front, the rest trickling
         # in while earlier requests decode (admissions mid-stream)
         pending = list(specs)
@@ -409,6 +431,8 @@ def _measure_continuous(cfg, model, gbps, launch, slots=8,
         "ttft_ms_avg": round(_tl_mean(eng, "ttft_ms"), 2),
         "tpot_ms_avg": round(_tl_mean(eng, "tpot_ms"), 2),
         "queue_ms_avg": round(_tl_mean(eng, "queue_ms"), 2),
+        # SLO judgment on the measured traffic (ISSUE 14)
+        **_slo_cols(eng),
     }
     print(f"continuous_mixed: {row['tokens_per_sec']} tok/s over "
           f"{row['requests']} staggered requests (TTFT "
@@ -441,7 +465,7 @@ def _measure_overload(cfg, model, slots=8, max_seq_len=512,
             model, max_slots=slots, page_size=page_size,
             max_seq_len=max_seq_len, total_pages=total_pages,
             decode_window=decode_window, prefill_chunk=prefill_chunk,
-            max_queue=max_queue, queue_policy="reject")
+            max_queue=max_queue, queue_policy="reject", slo=_SLO_SPEC)
         pending = list(enumerate(specs))
         done = {}
         rejected = 0
@@ -490,6 +514,9 @@ def _measure_overload(cfg, model, slots=8, max_seq_len=512,
         "ttft_ms_avg": round(_tl_mean(eng, "ttft_ms"), 2),
         "tpot_ms_avg": round(_tl_mean(eng, "tpot_ms"), 2),
         "queue_ms_avg": round(_tl_mean(eng, "queue_ms"), 2),
+        # the overload row is exactly where the SLO layer earns its
+        # keep: goodput burns budget as requests time out / shed
+        **_slo_cols(eng),
     }
     print(f"overload: {row['goodput_tokens_per_sec']} good tok/s "
           f"({row['completed_ok']}/{row['requests']} ok, "
@@ -918,8 +945,11 @@ def _measure_disagg(cfg, model, slots=6, prompt_len=64, new_tokens=48,
         return eng
 
     def drive_disagg(with_storm):
+        # the decode group carries the SLO spec: disaggregation exists
+        # to protect decode TPOT tails, so that is where the judgment
+        # layer watches (slo_ok/budget_burn columns below)
         srv = DisaggServer(model, prefill_kwargs=dict(kw),
-                           decode_kwargs=dict(kw))
+                           decode_kwargs=dict(kw, slo=_SLO_SPEC))
         for p in lat:
             srv.add_request(p, new_tokens)
         pending = list(storm) if with_storm else []
@@ -966,6 +996,8 @@ def _measure_disagg(cfg, model, slots=6, prompt_len=64, new_tokens=48,
         "requeues": st["requeues"],
         "pages_leaked": (st["prefill_pages_in_use"]
                          + st["decode_pages_in_use"]),   # must be 0
+        # decode-group SLO verdict under the storm (ISSUE 14)
+        **_slo_cols(dec),
     }
     print(f"disagg: decode p99 {row['tpot_p99_ms_disagg']} -> "
           f"{row['tpot_p99_ms_disagg_storm']} ms under storm (vs "
@@ -996,9 +1028,12 @@ def _measure_metrics_overhead(cfg, model, slots=6, prompt_len=32,
     delta.  The observability runtime's always-on claim is that the on
     state costs <= 3% tokens/sec on the serving hot loop — this row is
     the number behind that claim (best-of-``reps`` walls each way so
-    scheduler noise doesn't masquerade as metric cost).  Runs on the
-    CPU tiny models for the smoke test; the TPU measurement is the
-    claim of record."""
+    scheduler noise doesn't masquerade as metric cost).  Since
+    ISSUE 14 the engine runs with the SLO guardrails and the stall
+    watchdog ARMED, so the gate covers judgment-layer cost too (both
+    are metrics-flag-gated no-ops in the off half).  Runs on the CPU
+    tiny models for the smoke test; the TPU measurement is the claim
+    of record."""
     import paddle_tpu as paddle
     from paddle_tpu.inference import ContinuousBatchingEngine
 
@@ -1008,10 +1043,17 @@ def _measure_metrics_overhead(cfg, model, slots=6, prompt_len=32,
                for _ in range(n_requests or 2 * slots)]
 
     def drive():
+        # guardrails ARMED (ISSUE 14): the overhead claim covers SLO
+        # evaluation + the per-dispatch watchdog arm/disarm, not just
+        # bare metrics — they ride the existing event stream, so the
+        # row must prove they add no per-token host sync.  With
+        # metrics off both are no-ops, so the off half stays the
+        # pre-observability baseline.
         eng = ContinuousBatchingEngine(
             model, max_slots=slots, page_size=page_size,
             max_seq_len=max_seq_len, decode_window=decode_window,
-            prefill_chunk=prefill_chunk, q_block=q_block)
+            prefill_chunk=prefill_chunk, q_block=q_block,
+            slo=_SLO_SPEC, watchdog_ms=_WATCHDOG_MS)
         for p in prompts:
             eng.add_request(p, new_tokens)
         t0 = time.perf_counter()
@@ -1090,7 +1132,12 @@ FILES = ["benchmarks/serving_bench.py",
          "paddle_tpu/observability/serving.py",
          # dispatch tracing spans (ISSUE 12) ride every engine
          # dispatch: span cost is part of the metrics_overhead claim
-         "paddle_tpu/observability/tracing.py"]
+         "paddle_tpu/observability/tracing.py",
+         # SLO guardrails + stall watchdog (ISSUE 14) arm the
+         # metrics_overhead row and feed the slo_ok/budget_burn
+         # columns: their code must re-measure the serving rows
+         "paddle_tpu/observability/slo.py",
+         "paddle_tpu/observability/watchdog.py"]
 
 
 def cached_rows(dev):

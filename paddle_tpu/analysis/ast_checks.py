@@ -1105,3 +1105,72 @@ def check_single_device_engine_on_mesh(fndef, ctx):
                 "tensor-parallel axis — greedy outputs stay "
                 "token-identical and decode stops being capped at "
                 "one chip")
+
+
+# overload knobs that prove an engine expects real traffic, and the
+# judgment-layer kwargs that answer them — PDT117 fires on the first
+# set without the second.  dispatch_retries/prefix_cache are absent
+# from the trigger set deliberately: they tune mechanics, not load.
+_ENGINE_OVERLOAD_KWARGS = {"max_queue", "queue_policy",
+                           "default_deadline_ms"}
+_ENGINE_GUARD_KWARGS = {"slo", "watchdog_ms"}
+
+
+@register(
+    "PDT117", "no-slo-guard-under-load", Severity.NOTE, "ast",
+    scope="eager",
+    example="""
+import paddle_tpu as paddle
+from paddle_tpu.inference import ContinuousBatchingEngine
+
+def serve(model, prompts):
+    eng = ContinuousBatchingEngine(model, max_slots=8, max_queue=64,
+                                   queue_policy="reject",
+                                   default_deadline_ms=500.0)
+    for p in prompts:
+        eng.add_request(p, 32)
+    return eng.run()
+""",
+    near_miss="""
+import paddle_tpu as paddle
+from paddle_tpu.inference import ContinuousBatchingEngine
+
+def serve(model, prompts):
+    eng = ContinuousBatchingEngine(model, max_slots=8, max_queue=64,
+                                   queue_policy="reject",
+                                   default_deadline_ms=500.0,
+                                   slo="ttft_p95_ms=500,goodput=0.99",
+                                   watchdog_ms=2000.0)
+    for p in prompts:
+        eng.add_request(p, 32)
+    return eng.run()
+""")
+def check_no_slo_guard_under_load(fndef, ctx):
+    """A serving engine constructed WITH overload knobs
+    (``max_queue``/``queue_policy``/``default_deadline_ms`` — this
+    engine clearly expects heavy traffic) but with NO judgment layer:
+    no SLO spec (``slo=`` / ``serving_slo`` flag) and no stall
+    watchdog (``watchdog_ms`` / ``watchdog_stall_ms`` flag).  The
+    overload policies will shed and preempt correctly, but nothing
+    evaluates the latency histograms against objectives (a TTFT p95
+    burning its error budget is invisible until users complain) and a
+    hung dispatch hangs the caller forever instead of surfacing a
+    coded ``EngineStallError`` with thread stacks in a flight record.
+    Arm at least one of ``slo=``/``watchdog_ms=``.  Note-level
+    advice, not an error."""
+    for node in _walk_fn(fndef):
+        if not isinstance(node, ast.Call) \
+                or (_dotted(node.func) or "").split(".")[-1] \
+                != "ContinuousBatchingEngine":
+            continue
+        kws = {kw.arg for kw in node.keywords if kw.arg}
+        if kws & _ENGINE_OVERLOAD_KWARGS \
+                and not kws & _ENGINE_GUARD_KWARGS:
+            yield node, (
+                "engine has overload knobs (max_queue/queue_policy/"
+                "default_deadline_ms) but no SLO spec or watchdog "
+                "armed: pass slo= (or the serving_slo flag) so the "
+                "TTFT/TPOT/goodput histograms are judged against "
+                "objectives with burn-rate alerting, and watchdog_ms= "
+                "(or watchdog_stall_ms) so a hung dispatch dumps "
+                "stacks and fails coded instead of hanging")

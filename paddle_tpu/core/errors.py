@@ -146,6 +146,19 @@ class CacheIntegrityError(EnforceNotMet):
     error_code = "PDT-E019"
 
 
+class EngineStallError(EnforceNotMet, TimeoutError):
+    """A serving engine dispatch exceeded the stall-watchdog deadline
+    (``observability/watchdog.py``; ``watchdog_stall_ms`` flag /
+    ``watchdog_ms`` engine kwarg).  The watchdog captured every
+    thread's stack and dumped the flight record + Chrome trace before
+    interrupting the stalled dispatch thread, so the caller gets a
+    coded, postmortem-ready error instead of a hung ``step()``.  The
+    dispatch did not complete — its slot state is untouched, so the
+    next ``step()`` re-plans and re-dispatches it bitwise."""
+
+    error_code = "PDT-E020"
+
+
 def enforce(cond: bool, msg: str, exc=InvalidArgumentError):
     """PADDLE_ENFORCE: raise ``exc`` with ``msg`` unless ``cond``."""
     if not cond:

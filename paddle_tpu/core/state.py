@@ -230,6 +230,44 @@ define_flag("metrics", True,
             "pre-observability behavior bitwise; counters that back "
             "the serving engine's stats contract are created with "
             "always=True and keep recording either way.")
+define_flag("serving_slo", "",
+            "declarative latency/goodput objectives for serving "
+            "engines (ISSUE 14, observability/slo.py): a comma-"
+            "separated spec string like "
+            "'ttft_p95_ms=500,tpot_p99_ms=100,goodput=0.99' evaluated "
+            "over sliding windows of the engine's own timeline "
+            "histograms with multi-window burn-rate alerting; a "
+            "breach emits an slo.breach ring event and dumps a flight "
+            "record. '' (default) arms nothing; engine kwarg slo "
+            "overrides per instance (spec string or SLOSpec list). "
+            "PDT117 notes engines with overload knobs but no SLO "
+            "spec or watchdog.")
+define_flag("serving_slo_window_s", 60.0,
+            "slow/error-budget window for SLO burn-rate evaluation "
+            "(observability/slo.py); the fast confirmation window is "
+            "1/12 of it (the SRE two-window convention). SLOSpec "
+            "kwargs fast_window_s/slow_window_s override per spec.")
+define_flag("watchdog_stall_ms", 0.0,
+            "stall-watchdog deadline (observability/watchdog.py): "
+            "engine dispatches, DisaggServer handoffs, rpc invokes "
+            "and Model.fit steps armed past this many ms without "
+            "completing/heartbeating capture all thread stacks, dump "
+            "the flight record + Chrome trace and emit watchdog.stall "
+            "— the engine's dispatch additionally surfaces a coded "
+            "EngineStallError (PDT-E020) instead of hanging its "
+            "caller. 0 (default) = watchdog off; engine kwarg "
+            "watchdog_ms overrides per instance. No-op with "
+            "PDTPU_METRICS=off.")
+define_flag("watchdog_poll_ms", 20.0,
+            "stall-watchdog daemon-thread poll cadence; a stall is "
+            "detected within deadline + one poll interval.")
+define_flag("flight_keep", 40,
+            "keep-last-K retention for flight records in "
+            "PDTPU_FLIGHT_DIR (observability/events.py dump GC, "
+            "mirroring CheckpointManager's keep-last-K): every dump "
+            "deletes the oldest records (and their .trace.json/"
+            ".stacks.txt companions) past this count. 0 = unbounded "
+            "(the pre-ISSUE-14 behavior).")
 define_flag("metrics_log_every", 0,
             "training StepTimer one-line log cadence: every N train "
             "steps hapi.Model.fit logs step wall-time, tokens/sec, "

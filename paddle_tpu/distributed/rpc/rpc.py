@@ -161,23 +161,35 @@ def _invoke(to, fn, args, kwargs, timeout):
     spans land in the caller's trace — same ``(fn, args, kwargs)`` wire
     frame, and with ``PDTPU_METRICS=off`` the payload goes out
     unwrapped (bitwise pre-observability behavior)."""
+    from ...core import state as _core_state
     from ...observability import tracing as _tracing
+    from ...observability import watchdog as _watchdog
 
     info = get_worker_info(to)
-    with _tracing.span("rpc.client", to=str(to),
-                       fn=getattr(fn, "__name__", str(fn))):
-        ctx = _tracing.inject()
-        if ctx is not None:
-            fn = _tracing.RemoteTraceContext(ctx, fn)
-        conn = _connect(info, timeout)
-        if timeout and timeout > 0:
-            conn.settimeout(timeout)
-        try:
-            _send_frame(conn,
-                        (fn, tuple(args or ()), dict(kwargs or {})))
-            ok, value = _recv_frame(conn)
-        finally:
-            conn.close()
+    # stall watchdog (ISSUE 14): an invoke wedged past the deadline
+    # (dead peer mid-frame, socket timeout longer than anyone wants to
+    # wait blind) gets every thread's stack + a flight record — no
+    # interrupt; the socket timeout still owns cancellation
+    wd = _watchdog.arm("rpc.invoke",
+                       float(_core_state.get_flag("watchdog_stall_ms")),
+                       key=str(to))
+    try:
+        with _tracing.span("rpc.client", to=str(to),
+                           fn=getattr(fn, "__name__", str(fn))):
+            ctx = _tracing.inject()
+            if ctx is not None:
+                fn = _tracing.RemoteTraceContext(ctx, fn)
+            conn = _connect(info, timeout)
+            if timeout and timeout > 0:
+                conn.settimeout(timeout)
+            try:
+                _send_frame(conn,
+                            (fn, tuple(args or ()), dict(kwargs or {})))
+                ok, value = _recv_frame(conn)
+            finally:
+                conn.close()
+    finally:
+        wd.disarm()
     if not ok:
         raise value
     return value

@@ -297,9 +297,23 @@ class Model:
         # the process-global registry; near-no-op with PDTPU_METRICS=off
         from ..observability import StepTimer
         from ..observability import metrics as _obs_metrics
+        from ..observability import watchdog as _watchdog
         self._step_timer = StepTimer(n_params=sum(
             int(np.prod([int(s) for s in p.shape]) or 1)
             for p in self.network.parameters()))
+        # stall watchdog (ISSUE 14): with the watchdog_stall_ms flag
+        # set, this fit is armed and each completed step heartbeats it
+        # at the SAME sites the StepTimer records — a training loop
+        # wedged past the deadline (hung collective, dead tunnel)
+        # gets thread stacks + a flight record instead of silence.
+        # Size the deadline to cover eval/checkpoint gaps and (for
+        # fit(window=K)) one whole scanned window.  No interrupt: a
+        # mid-step injection could corrupt optimizer state.
+        from ..core import state as _core_state
+        self._fit_watchdog = _watchdog.arm(
+            "train.step",
+            float(_core_state.get_flag("watchdog_stall_ms")),
+            key="fit")
         if _obs_metrics.enabled():
             # HBM accounting (ISSUE 12): resident parameter bytes of
             # the network this fit trains, read LAZILY at snapshot time
@@ -331,6 +345,7 @@ class Model:
                 # re-arm the step clock: the gap since last epoch's end
                 # (eval pass, checkpoint write) is not a train step
                 self._step_timer.mark()
+                self._fit_watchdog.heartbeat()
                 for m in self._metrics:
                     m.reset()
                 logs = {}
@@ -401,6 +416,9 @@ class Model:
                 # weights 'final', and the extra save eats grace period
                 cbks.on_train_end(logs)
         finally:
+            # clean runs leave nothing armed: the fit's watchdog entry
+            # dies with the fit, success or not
+            self._fit_watchdog.disarm()
             interrupted = False
             if installed:
                 interrupted = (self._preempted and
@@ -630,6 +648,12 @@ class Model:
         st = getattr(self, "_step_timer", None)
         if st is None:
             return
+        # one completed step = one watchdog heartbeat (the null token
+        # makes this a no-op attribute call when the watchdog is off
+        # or metrics are off — today's behavior bitwise)
+        wd = getattr(self, "_fit_watchdog", None)
+        if wd is not None:
+            wd.heartbeat()
         from ..observability import metrics as _obs_metrics
         if not _obs_metrics.enabled():
             # honor the flag's near-no-op contract BEFORE the jit-cache

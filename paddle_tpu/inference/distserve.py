@@ -60,6 +60,7 @@ from ..core.state import get_flag as _get_flag
 from ..observability import Registry as _ObsRegistry
 from ..observability import events as _events
 from ..observability import tracing as _tracing
+from ..observability import watchdog as _watchdog
 from ..observability.metrics import LATENCY_BUCKETS_MS
 from ..resilience import faults
 from ..resilience.retry import retry_call
@@ -321,6 +322,21 @@ class DisaggServer:
         TTFT/TPOT story."""
         return self._registry.snapshot()
 
+    def slo_status(self) -> dict:
+        """Per-group SLO status (ISSUE 14): every worker engine's
+        :meth:`ContinuousBatchingEngine.slo_status` list, keyed
+        ``prefill``/``decode``.  Specs arm through the per-group
+        engine kwargs (``prefill_kwargs``/``decode_kwargs`` ``slo=``)
+        or the ``serving_slo`` flag — disaggregation exists to protect
+        decode TPOT tails, so the decode group is where the TPOT
+        objective normally lives."""
+        return {
+            "prefill": [s for e in self.prefill_group
+                        for s in e.slo_status()],
+            "decode": [s for e in self.decode_group
+                       for s in e.slo_status()],
+        }
+
     def step(self):
         """One coordinator tick: feed pending admissions to the
         prefill group, step it, export + hand off first-token slots,
@@ -534,6 +550,14 @@ class DisaggServer:
             t0 = time.perf_counter()
             with _tracing.span("serving.handoff", rid=str(rid),
                                pages=int(payload["n_pages"])):
+                # stall watchdog (ISSUE 14): a wedged transfer past
+                # the deadline gets thread stacks + a flight record
+                # (no interrupt — the payload stays parked and the
+                # next tick retries the handoff)
+                wd = _watchdog.arm(
+                    "serving.handoff",
+                    float(_get_flag("watchdog_stall_ms")),
+                    key=str(rid))
                 try:
                     got, nbytes = self.transport.ship(
                         payload, eng, r.max_new_tokens,
@@ -544,6 +568,8 @@ class DisaggServer:
                     # retries the handoff instead of stranding the rid
                     kept.append((rid, payload))
                     raise
+                finally:
+                    wd.disarm()
                 ms = (time.perf_counter() - t0) * 1e3
                 if got is None:
                     kept.append((rid, payload))   # no capacity yet

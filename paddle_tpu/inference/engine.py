@@ -143,6 +143,17 @@ Observability (ISSUE 8; ``paddle_tpu.observability``):
   events.  Clean runs dump nothing; ``PDTPU_METRICS=off`` restores
   the pre-observability engine bitwise (serving_bench's
   ``metrics_overhead`` row pins the on state at <= 3% tokens/sec).
+* SLO GUARDRAILS & STALL WATCHDOG (ISSUE 14) — ``slo=`` arms
+  declarative objectives (``observability/slo.py``) over the engine's
+  own timeline histograms, evaluated at step boundaries over sliding
+  windows with multi-window burn-rate alerting (``slo_status()``;
+  breach -> ``slo.breach`` event + flight dump; budget gauges in
+  ``render_prometheus()``); ``watchdog_ms=`` arms every dispatch with
+  a stall deadline (``observability/watchdog.py``) past which thread
+  stacks + the flight record + a Chrome trace are captured and a
+  coded ``EngineStallError`` (PDT-E020) surfaces from ``step()``
+  instead of a hang — drilled by the ``engine_stall`` fault site.
+  Both are metrics-flag-gated no-ops when off.
 * DISTRIBUTED TRACING (ISSUE 12; ``observability/tracing.py``) — every
   dispatch runs under a ``serving.dispatch`` span whose begin/end pair
   lands in the event ring, and the timeline's ``serving.dispatch``
@@ -217,13 +228,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.errors import (CacheIntegrityError, PageBudgetError,
-                           QueueFullError)
+from ..core.errors import (CacheIntegrityError, EngineStallError,
+                           PageBudgetError, QueueFullError)
 from ..core.tensor import Tensor
 from ..observability import Registry as _ObsRegistry
 from ..observability import flight as _flight
 from ..observability import metrics as _obs_metrics
+from ..observability import slo as _slo_mod
 from ..observability import tracing as _tracing
+from ..observability import watchdog as _watchdog
 from ..observability.serving import RegistryCounters, ServingTimelines
 from ..resilience import faults
 from ..resilience.serving import (SITE_DRAFT_MISMATCH, SITE_DRAFT_NAN,
@@ -335,8 +348,19 @@ class ContinuousBatchingEngine:
     bitwise fp path), ``spec_decode``/``spec_k``/``spec_proposer``/
     ``spec_temperature``/``spec_rejection_sampling`` drive speculative
     decoding (``serving_spec_*`` flags; greedy spec is bitwise vs
-    off).  ``clock`` (tests) replaces ``time.monotonic`` for
-    deterministic deadline drills."""
+    off), ``slo`` arms declarative latency/goodput objectives over
+    the engine's own timelines (``serving_slo`` flag; spec string or
+    ``SLOSpec`` list — see :meth:`slo_status`), ``watchdog_ms`` arms
+    the stall watchdog around every dispatch (``watchdog_stall_ms``
+    flag; a stalled dispatch surfaces ``EngineStallError`` PDT-E020
+    with a flight record instead of hanging).  SIZE ``watchdog_ms``
+    above the worst-case dispatch INCLUDING the first compile of each
+    program geometry: a deadline under compile time interrupts the
+    compile mid-flight, which never caches, so the next dispatch
+    recompiles and stalls again — a livelock the deadline caused.
+    Warm the geometry first (or arm after warmup) when tight
+    deadlines matter.  ``clock`` (tests) replaces ``time.monotonic``
+    for deterministic deadline drills."""
 
     def __init__(self, model, *, max_slots=8, page_size=16,
                  max_seq_len=None, total_pages=None, decode_window=8,
@@ -346,7 +370,7 @@ class ContinuousBatchingEngine:
                  prefix_cache=None, kv_quant=None, spec_decode=None,
                  spec_k=None, spec_proposer=None, spec_temperature=None,
                  spec_rejection_sampling=None, spec_seed=0, clock=None,
-                 mesh=None, tp_axis=None):
+                 mesh=None, tp_axis=None, slo=None, watchdog_ms=None):
         from ..core import state as _state
         from ..models.generation import (_decode_fn, _ragged_fn,
                                          _zero_pool)
@@ -574,6 +598,27 @@ class ContinuousBatchingEngine:
             lambda: len(self._queue))
         reg.gauge("serving.kv_page_bytes").set_function(
             lambda: self._page_bytes)
+        # SLO guardrails (ISSUE 14, observability/slo.py): declarative
+        # objectives over this engine's OWN timeline histograms,
+        # evaluated over sliding windows once per scheduling step
+        # (throttled — one clock compare when the interval hasn't
+        # elapsed).  A multi-window burn-rate breach emits slo.breach
+        # and dumps a flight record.  The stall watchdog
+        # (observability/watchdog.py) arms every dispatch when
+        # watchdog_ms > 0: a dispatch past the deadline gets its
+        # thread stacks + flight record captured and a coded
+        # EngineStallError injected instead of hanging step() forever.
+        wd_ms = float(_state.get_flag("watchdog_stall_ms")
+                      if watchdog_ms is None else watchdog_ms)
+        self.watchdog_ms = wd_ms if wd_ms > 0 else 0.0
+        slo_cfg = (_state.get_flag("serving_slo") if slo is None
+                   else slo)
+        specs = _slo_mod.parse_slo(slo_cfg)
+        self._slo = None
+        if specs:
+            self._slo = _slo_mod.SLOEngine(
+                self._registry, specs, clock=self._clock,
+                on_breach=self._on_slo_breach)
 
     # ------------------------------------------------------------ API --
     def _pages_in_use(self) -> int:
@@ -619,8 +664,26 @@ class ContinuousBatchingEngine:
         return self._registry.snapshot()
 
     def render_prometheus(self) -> str:
-        """This engine's metrics in Prometheus text format."""
+        """This engine's metrics in Prometheus text format (the SLO
+        budget-remaining / burn-rate gauges included when SLOs are
+        armed)."""
         return self._registry.render_prometheus()
+
+    def slo_status(self) -> list:
+        """Per-spec SLO status (``observability/slo.py``): name, the
+        windowed value vs target, fast/slow burn rates, error budget
+        remaining, and the multi-window ``breached`` verdict.  Empty
+        when no SLOs are armed (``serving_slo`` flag / ``slo=`` kwarg)
+        or metrics are off."""
+        if self._slo is None:
+            return []
+        return self._slo.status()
+
+    def _on_slo_breach(self, status):
+        """Breach hook: the SLOEngine already emitted ``slo.breach``
+        into the ring; dump the flight record so the postmortem holds
+        the minutes that burned the budget."""
+        _flight.dump("slo_breach", extra=dict(status))
 
     def add_request(self, prompt, max_new_tokens, eos_token_id=None,
                     request_id=None, deadline_ms=None):
@@ -1199,7 +1262,13 @@ class ContinuousBatchingEngine:
         if self._early:
             completed.extend(self._early)
             self._early.clear()
-        completed.extend(self._sweep(self._clock()))
+        now = self._clock()
+        completed.extend(self._sweep(now))
+        # SLO judgment rides the step boundary (throttled to the
+        # evaluation interval — one float compare most steps, never a
+        # per-token host sync)
+        if self._slo is not None:
+            self._slo.maybe_evaluate(now)
         self._admit()
         self._stats["steps"] += 1
         if self.spec_decode and any(
@@ -1253,15 +1322,60 @@ class ContinuousBatchingEngine:
         # emitted INSIDE the span inherits its trace/parent ids — so a
         # trace carried in over rpc (disaggregated prefill/decode
         # handoff) threads through to the dispatch that served it.
+        # With watchdog_ms > 0 the dispatch is also watchdog-armed
+        # (ISSUE 14): past the deadline the stall thread's stacks and
+        # the flight record are captured and EngineStallError is
+        # injected here.  A truly stalled call never ran to
+        # completion, so slot state is untouched and the next step()
+        # re-plans the same dispatch bitwise.  A dispatch that
+        # COMPLETES just past the deadline is the race case: its
+        # donated buffers are already consumed, so discarding the
+        # result would strand the engine — the completion cell below
+        # records the result the instant fn() returns, a late
+        # injection is swallowed and the real result used (the
+        # residual few-bytecode window before the cell append can
+        # still lose a result; the donated-buffer guards then fail
+        # the NEXT dispatch loudly rather than corrupting state).
         timed = _obs_metrics.enabled()
-        with _tracing.span("serving.dispatch", op=str(kind)):
-            t0 = time.perf_counter() if timed else 0.0
-            res = dispatch_retry(kind, fn,
-                                 max_attempts=self.dispatch_retries + 1,
-                                 on_retry=_on_retry)
-            if timed:
-                self._tl.dispatch(kind,
-                                  (time.perf_counter() - t0) * 1e3)
+        token = _watchdog.arm("serving.dispatch", self.watchdog_ms,
+                              key=str(kind),
+                              interrupt_exc=EngineStallError)
+        done_cell = []
+
+        def _fn_completing():
+            out = fn()
+            done_cell.append(out)
+            return out
+
+        try:
+            try:
+                with _tracing.span("serving.dispatch", op=str(kind)):
+                    t0 = time.perf_counter() if timed else 0.0
+                    res = dispatch_retry(
+                        kind, _fn_completing,
+                        max_attempts=self.dispatch_retries + 1,
+                        on_retry=_on_retry)
+                    token.disarm()   # close the injection window now —
+                    # timeline/span bookkeeping must not be chargeable
+                    if timed:
+                        self._tl.dispatch(
+                            kind, (time.perf_counter() - t0) * 1e3)
+            except EngineStallError as e:
+                if done_cell:
+                    # late injection: the program ran; the result is
+                    # real and its inputs are gone — keep it
+                    res = done_cell[-1]
+                else:
+                    where = (f"; flight record at {token.dump_path}"
+                             if token.dump_path else "")
+                    raise EngineStallError(
+                        f"engine dispatch {kind!r} stalled past the "
+                        f"{self.watchdog_ms:g} ms watchdog deadline — "
+                        f"thread stacks and the request timeline are "
+                        f"in the flight record{where} "
+                        f"[{EngineStallError.error_code}]") from e
+        finally:
+            token.disarm()
         return res
 
     # compiled serving programs cache ON the model (generate()'s

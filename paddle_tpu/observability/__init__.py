@@ -39,6 +39,31 @@ Pieces
   dispatch events; :func:`export_trace` renders the ring — spans,
   serving lifecycle, fault/guard/retry events — as Chrome/Perfetto
   trace-event JSON, one track per rank/thread/engine slot.
+* ``slo``       — SLO guardrails (ISSUE 14): declarative
+  :class:`~paddle_tpu.observability.slo.SLOSpec` objectives (TTFT/TPOT/
+  queue percentiles, goodput fraction, ``train.step_ms``) evaluated by
+  :class:`~paddle_tpu.observability.slo.SLOEngine` over SLIDING WINDOWS
+  of the existing histograms (cumulative-count deltas — nothing new on
+  the hot path) with multi-window error-budget burn-rate alerting;
+  breaches emit ``slo.breach`` and trigger a flight dump, and
+  ``slo.budget_remaining`` / ``slo.burn_rate`` gauges ride
+  ``render_prometheus``.  Armed on serving engines via the
+  ``serving_slo`` flag / ``slo=`` kwarg (``engine.slo_status()``).
+* ``watchdog``  — stall watchdog (ISSUE 14): daemon-thread heartbeat
+  monitor armed around engine dispatches, DisaggServer handoffs, rpc
+  invokes and ``Model.fit`` steps (``watchdog_stall_ms`` flag); past
+  the deadline it captures every thread's stack, dumps the flight
+  record + Chrome trace, emits ``watchdog.stall``, and (for the
+  engine) injects a coded ``EngineStallError`` (PDT-E020) into the
+  stalled dispatch instead of letting ``step()`` hang forever.
+* ``regress``   — bench-history regression sentinel (ISSUE 14):
+  ``python -m paddle_tpu.observability.regress`` judges the newest
+  ``BENCH_*``/``MULTICHIP_*`` round against noise-aware median/MAD
+  baselines over the prior rounds (tolerating the truncated records
+  real history contains, excluding ``cached`` stale subtrees), prints
+  a stable sorted report and exits nonzero on regression; ``bench.py``
+  calls :func:`regress.check_record` so every new round self-reports
+  ``regressions: [...]`` in its JSON tail.
 * ``aggregate`` — fleet-wide metrics (ISSUE 12):
   :func:`fleet_snapshot` publishes/gathers every rank's registry
   snapshot through the rendezvous ``TCPStore`` (straggler-tolerant
@@ -81,6 +106,10 @@ Every event is one flat JSON-able dict::
                           n_inputs, n_state, n_donated) (jit build)
     compile.retrace       fn, count, cause          (jit._Executable)
     rpc.client/rpc.server (as spans: fn, to/rank)   (distributed/rpc)
+    slo.breach            slo, metric, value, target, burn_fast,
+                          burn_slow                 (slo.SLOEngine)
+    slo.recovered         slo, metric               (slo.SLOEngine)
+    watchdog.stall        site, key, deadline_ms    (watchdog)
 
 Flight records are JSON files under ``PDTPU_FLIGHT_DIR`` (default
 ``<tempdir>/paddle_tpu_flight``); see ``events.dump``.  Flight-record
@@ -104,6 +133,10 @@ from .tracing import (export_trace, render_trace, span,  # noqa: F401
                       traced)
 from . import aggregate  # noqa: F401
 from .aggregate import fleet_snapshot  # noqa: F401
+from . import slo  # noqa: F401
+from .slo import SLOEngine, SLOSpec, parse_slo  # noqa: F401
+from . import watchdog  # noqa: F401
+from . import regress  # noqa: F401
 
 # events.dump is the flight recorder; keep a namespaced alias so call
 # sites read as what they do: flight.dump(...)
@@ -117,4 +150,5 @@ __all__ = [
     "RegistryCounters", "StepTimer", "device_peak_flops",
     "tracing", "span", "traced", "export_trace", "render_trace",
     "aggregate", "fleet_snapshot",
+    "slo", "SLOEngine", "SLOSpec", "parse_slo", "watchdog", "regress",
 ]

@@ -175,13 +175,55 @@ def dump_dir() -> str:
         os.path.join(tempfile.gettempdir(), "paddle_tpu_flight"))
 
 
+# companion suffixes a flight record may carry (the stall watchdog
+# writes a Chrome trace and a faulthandler stack file next to its
+# record); retention GC removes them with their record
+_COMPANION_SUFFIXES = (".trace.json", ".stacks.txt")
+
+
+def _gc_flight_dir(d):
+    """Keep-last-K retention over ``d``'s flight records (``flight_keep``
+    flag / ``PDTPU_FLIGHT_KEEP``, mirroring CheckpointManager's
+    keep-last-K discipline): without it every watchdog/SLO/NaN dump
+    grows the flight dir without bound.  Oldest records (by mtime) past
+    the cap are deleted together with their companion files; 0 keeps
+    everything (the pre-retention behavior)."""
+    try:
+        from ..core import state as _state
+        keep = int(_state.get_flag("flight_keep"))
+    except Exception:
+        return
+    if keep <= 0:
+        return
+    recs = []
+    for fname in os.listdir(d):
+        if not (fname.startswith("flight_") and fname.endswith(".json")) \
+                or fname.endswith(_COMPANION_SUFFIXES[0]):
+            continue
+        p = os.path.join(d, fname)
+        try:
+            recs.append((os.path.getmtime(p), p))
+        except OSError:
+            pass
+    recs.sort()
+    for _, p in recs[:-keep]:
+        stem = p[:-len(".json")]
+        for victim in (p,) + tuple(stem + s for s in _COMPANION_SUFFIXES):
+            try:
+                os.remove(victim)
+            except OSError:
+                pass
+
+
 def dump(reason: str, *, error=None, extra=None, path=None):
     """Write the ring's current contents as one JSON flight record.
 
     Returns the written path, or None when metrics are off (the off
     state must restore pre-observability behavior — no stray files) or
     the write itself fails (a flight recorder must never turn a
-    diagnosed failure into an IO failure).
+    diagnosed failure into an IO failure).  Auto-named records in the
+    default dir are retention-GC'd keep-last-K (``flight_keep`` flag);
+    an explicit ``path=`` is the caller's to manage.
     """
     global _last_dump, _dump_seq
     if not enabled():
@@ -190,11 +232,13 @@ def dump(reason: str, *, error=None, extra=None, path=None):
         with _dump_lock:
             _dump_seq += 1
             seq = _dump_seq
+        gc_dir = None
         if path is None:
             d = dump_dir()
             os.makedirs(d, exist_ok=True)
             path = os.path.join(
                 d, f"flight_{os.getpid()}_{seq:04d}.json")
+            gc_dir = d
         rec = {
             "schema_version": SCHEMA_VERSION,
             "rank": _rank(),
@@ -213,6 +257,8 @@ def dump(reason: str, *, error=None, extra=None, path=None):
             json.dump(rec, f, indent=1, default=str)
         _last_dump = path
         emit("flight.dump", reason=str(reason), path=path)
+        if gc_dir is not None:
+            _gc_flight_dir(gc_dir)
         return path
     except Exception:
         return None

@@ -35,7 +35,7 @@ from ..core import state as _state
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "registry", "snapshot",
     "render_prometheus", "enabled", "LATENCY_BUCKETS_MS",
-    "COUNT_BUCKETS",
+    "COUNT_BUCKETS", "percentile_from_counts",
 ]
 
 # the flags dict itself (not a copy): set_flags mutates it in place, so
@@ -56,6 +56,27 @@ LATENCY_BUCKETS_MS = tuple(
 # small-count buckets (tokens per window, preemptions per request, ...)
 COUNT_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
                  256.0, 1024.0, 4096.0)
+
+
+def percentile_from_counts(buckets, counts, count, q) -> float:
+    """Approximate percentile over fixed-bucket histogram state: the
+    upper edge of the bucket holding the q-th observation (the fixed
+    log-spaced buckets make this stable across runs).  ONE home for
+    the math — :meth:`Histogram.percentile`, the SLO engine's windowed
+    evaluation (``observability/slo.py``) and serving_bench's
+    ``_tl_pct`` all call here, so bench columns and runtime guardrails
+    can never disagree on what a p99 is.  The overflow bucket has no
+    finite upper edge, so a percentile landing there is ``inf``; an
+    empty histogram reads 0.0."""
+    if not count or not buckets:
+        return 0.0
+    target = q * count
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target:
+            return float(buckets[i]) if i < len(buckets) else float("inf")
+    return float("inf")
 
 
 class _Metric:
@@ -190,6 +211,14 @@ class Histogram(_Metric):
     @property
     def mean(self):
         return self.sum / self.count if self.count else 0.0
+
+    def percentile(self, q=0.99) -> float:
+        """Approximate q-th percentile of everything observed so far
+        (see :func:`percentile_from_counts` for the bucket semantics)."""
+        with self._lock:
+            counts = list(self.counts)
+            n = self.count
+        return percentile_from_counts(self.buckets, counts, n, q)
 
     def _snap(self):
         # under the lock: a concurrent observe must never yield a

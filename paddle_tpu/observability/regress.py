@@ -1,0 +1,273 @@
+"""Bench-history regression sentinel (ISSUE 14 tentpole, part 3).
+
+The repo checks in one ``BENCH_r<N>.json`` / ``MULTICHIP_r<N>.json``
+record per measurement round, but until now nothing READ them: a
+round-over-round throughput dip (the r05 ``vs_baseline`` 0.983 against
+r0x history) was invisible unless a human diffed JSON.  This module is
+the judge:
+
+* :func:`load_history` parses every round of both series.  Real
+  records are messy — the driver stores only the trailing bytes of
+  stdout, so some rounds have ``parsed: null`` and a beheaded JSON
+  tail (r01/r04 in the checked-in history) — so loading is tolerant:
+  ``parsed`` first, then the last parseable ``{"metric": ...}`` line
+  of ``tail``, else the round is reported as skipped, never a crash.
+* Records flatten to dotted numeric metrics (``value``,
+  ``extra.step_time_ms``, ``extra.mfu``, ...).  Subtrees carrying a
+  truthy ``cached`` marker are STALE — a re-embedded earlier
+  measurement, not fresh evidence — and are excluded, as are
+  config-shaped keys (batch sizes, sequence lengths) whose changes
+  are workload edits, not regressions.
+* Per metric, the baseline over PRIOR rounds is the MEDIAN and the
+  noise scale the MAD (floored at a fraction of the baseline so a
+  zero-MAD history cannot make microscopic jitter alarm).  The latest
+  round regresses when it sits more than ``k`` scaled-MADs on the BAD
+  side of the baseline (direction inferred from the metric name:
+  ``*_ms`` / ``*time*`` / ``wall_s`` are lower-better) AND the
+  relative move clears a 2% floor.  Metrics with fewer than 2 prior
+  observations are reported but never judged.
+* The report is SORTED, STABLE text (golden-testable, like
+  ``render_prometheus``); the CLI exits nonzero iff any metric
+  regressed — a CI tripwire::
+
+      python -m paddle_tpu.observability.regress [dir] [--k 3]
+
+* ``bench.py`` calls :func:`check_record` at the end of every round,
+  so each new record self-reports ``regressions: [...]`` in its own
+  JSON tail — the history judges the round that extends it.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+__all__ = ["load_history", "flatten_record", "analyze", "check_record",
+           "main", "DEFAULT_K"]
+
+DEFAULT_K = 3.0      # scaled-MAD multiplier
+MAD_SCALE = 1.4826   # MAD -> sigma under normal noise
+REL_FLOOR = 0.01     # MAD floor as a fraction of |baseline|
+MIN_REL = 0.02       # moves under 2% of baseline never flag
+MIN_PRIOR = 2        # rounds needed before a metric is judged
+
+# config-shaped keys: changes are workload edits, not perf evidence
+_SKIP_KEYS = frozenset((
+    "n", "rc", "cached", "code_version", "batch", "seq_len", "iters",
+    "params", "prompt_len", "new_tokens", "decode_window", "page_size",
+    "max_queue", "total_pages", "requests", "spec_k", "shared_len",
+    "storm_prompt", "storm_requests", "tp", "max_predictions",
+    "hit_rate_cfg", "kv_cache", "pid", "round", "warmup",
+))
+
+_LOWER_BETTER_RE = re.compile(
+    r"(_ms$|_ms_|ms_per|_s$|time|latency|overhead|retrace|"
+    r"pages_leaked|spread|burn|loss)")
+
+
+def lower_is_better(name: str) -> bool:
+    """Direction heuristic over the metric's leaf name: latencies,
+    wall times and overhead fractions regress UP; everything else
+    (throughput, MFU, ratios) regresses DOWN."""
+    return bool(_LOWER_BETTER_RE.search(name.rsplit(".", 1)[-1]))
+
+
+def flatten_record(rec, prefix="") -> dict:
+    """Dotted numeric leaves of one round's record, skipping stale
+    (``cached``) subtrees, config keys and non-numeric values."""
+    out = {}
+    if not isinstance(rec, dict):
+        return out
+    if rec.get("cached"):
+        return out               # a re-embedded earlier measurement
+    for k, v in rec.items():
+        if k in _SKIP_KEYS:
+            continue
+        name = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(flatten_record(v, name + "."))
+        elif isinstance(v, bool):
+            continue
+        elif isinstance(v, (int, float)):
+            out[name] = float(v)
+    return out
+
+
+def _parse_tail(tail: str):
+    """Best-effort record from a round's stored stdout tail: last
+    parseable ``{"metric": ...}`` line, else the last such JSON object
+    start (the driver keeps only trailing bytes, so the enriched line
+    may arrive beheaded — those rounds are skipped, not fatal)."""
+    for line in reversed((tail or "").strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            o = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(o, dict) and "metric" in o:
+            return o
+    i = (tail or "").rfind('{"metric"')
+    if i >= 0:
+        try:
+            o = json.loads(tail[i:])
+            if isinstance(o, dict):
+                return o
+        except ValueError:
+            pass
+    return None
+
+
+def load_round(path):
+    """One round file -> (record_or_None, note)."""
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, ValueError) as e:
+        return None, f"unreadable ({type(e).__name__})"
+    rec = d.get("parsed")
+    if isinstance(rec, dict) and "metric" in rec:
+        return rec, ""
+    rec = _parse_tail(d.get("tail", ""))
+    if rec is not None:
+        return rec, "recovered from tail"
+    return None, "no parseable record"
+
+
+def load_history(dirpath) -> dict:
+    """``{series: [(round_no, path, record_or_None, note), ...]}`` for
+    every ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` in ``dirpath``,
+    sorted by round number."""
+    out = {}
+    for series in ("BENCH", "MULTICHIP"):
+        rounds = []
+        for path in glob.glob(os.path.join(dirpath,
+                                           f"{series}_r*.json")):
+            m = re.search(r"_r(\d+)\.json$", path)
+            if not m:
+                continue
+            rec, note = load_round(path)
+            rounds.append((int(m.group(1)), path, rec, note))
+        rounds.sort()
+        if rounds:
+            out[series] = rounds
+    return out
+
+
+def _median(vals):
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def _fmt(v: float) -> str:
+    return f"{v:.6g}"
+
+
+def analyze(dirpath, k=DEFAULT_K, extra_latest=None) -> tuple:
+    """Judge the newest round of each series against its priors.
+
+    ``extra_latest`` (bench.py's hook) is a record treated as the
+    newest BENCH round, with everything on disk as history.  Returns
+    ``(report_text, regressed_metric_names)`` — the report is sorted
+    and stable for golden tests."""
+    history = load_history(dirpath)
+    lines = []
+    regressions = []
+    series_names = sorted(set(history) | ({"BENCH"} if extra_latest
+                                          else set()))
+    for series in series_names:
+        rounds = history.get(series, [])
+        for rn, path, rec, note in rounds:
+            if rec is None:
+                lines.append(f"# {series} r{rn:02d} skipped: {note}")
+        usable = [(rn, flatten_record(rec)) for rn, _p, rec, _n in rounds
+                  if rec is not None]
+        if extra_latest is not None and series == "BENCH":
+            usable.append((rounds[-1][0] + 1 if rounds else 1,
+                           flatten_record(extra_latest)))
+        if not usable:
+            lines.append(f"# {series}: no usable rounds")
+            continue
+        latest_rn, latest = usable[-1]
+        priors = usable[:-1]
+        lines.append(f"# {series}: judging r{latest_rn:02d} against "
+                     f"{len(priors)} prior round(s)")
+        for name in sorted(latest):
+            vals = [m[name] for _rn, m in priors if name in m]
+            if len(vals) < MIN_PRIOR:
+                lines.append(
+                    f"SKIP       {series}.{name} latest="
+                    f"{_fmt(latest[name])} priors={len(vals)}")
+                continue
+            baseline = _median(vals)
+            mad = _median([abs(v - baseline) for v in vals])
+            scale = max(MAD_SCALE * mad, REL_FLOOR * abs(baseline),
+                        1e-12)
+            cur = latest[name]
+            dev = (cur - baseline if lower_is_better(name)
+                   else baseline - cur)     # positive = worse
+            rel = dev / abs(baseline) if baseline else 0.0
+            z = dev / scale
+            bad = z > k and rel > MIN_REL
+            tag = "REGRESSION" if bad else "OK        "
+            lines.append(
+                f"{tag} {series}.{name} latest={_fmt(cur)} "
+                f"baseline={_fmt(baseline)} mad={_fmt(mad)} "
+                f"z={z:+.2f}")
+            if bad:
+                regressions.append(f"{series}.{name}")
+    return "\n".join(lines) + "\n", sorted(regressions)
+
+
+def check_record(record, history_dir, k=DEFAULT_K) -> list:
+    """bench.py's tail hook: judge ``record`` (the round being
+    emitted) against the on-disk history; returns the regressed
+    metric names (empty = clean).  BENCH-series names only — the
+    record IS a BENCH round, and a standing regression in the latest
+    on-disk MULTICHIP round belongs to that old round, not to the
+    record self-reporting its own tail."""
+    _report, regs = analyze(history_dir, k=k, extra_latest=record)
+    return [r for r in regs if r.startswith("BENCH.")]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.regress",
+        description="Judge the newest BENCH_*/MULTICHIP_* round "
+                    "against its history (median/MAD baselines); "
+                    "exits nonzero on any regression.")
+    p.add_argument("dir", nargs="?", default=".",
+                   help="directory holding the *_rNN.json history "
+                        "(default: cwd)")
+    p.add_argument("--k", type=float, default=DEFAULT_K,
+                   help=f"scaled-MAD regression threshold "
+                        f"(default {DEFAULT_K})")
+    p.add_argument("--latest", default=None,
+                   help="JSON file treated as the newest BENCH round "
+                        "(judged against everything on disk)")
+    args = p.parse_args(argv)
+    extra = None
+    if args.latest:
+        with open(args.latest) as f:
+            extra = json.load(f)
+        if isinstance(extra, dict) and isinstance(extra.get("parsed"),
+                                                  dict):
+            extra = extra["parsed"]
+    report, regs = analyze(args.dir, k=args.k, extra_latest=extra)
+    sys.stdout.write(report)
+    if regs:
+        sys.stdout.write(
+            f"regressions: {', '.join(regs)}\n")
+        return 1
+    sys.stdout.write("regressions: none\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,6 +55,13 @@ sleeps or randomness:
   requeues the request to the prefill group for a from-scratch
   re-prefill (outputs bitwise; the ``requeues`` counter moves). Key =
   the request id.
+* ``engine_stall``       — one serving dispatch hangs (bounded Python
+  spin) to drill the stall watchdog
+  (``observability/watchdog.py``): stacks + flight record + Chrome
+  trace are captured and a coded ``EngineStallError`` (PDT-E020) is
+  injected into the stalled dispatch; co-residents complete bitwise
+  on the re-dispatch. Key = dispatch kind (``mixed``/``decode``/
+  ``window``/``verify``).
 
 Spec grammar (``;``-separated rules)::
 

@@ -50,6 +50,14 @@ Serving fault sites (``resilience.faults`` spec grammar):
   re-prefills it from token zero — outputs stay bitwise (greedy
   prefill+decode is deterministic), only ``requeues`` moves. Key =
   the request id.
+* ``engine_stall`` — one engine dispatch HANGS (a bounded Python
+  spin standing in for a wedged device tunnel), drilling the stall
+  watchdog (``observability/watchdog.py``): past ``watchdog_ms`` the
+  watchdog captures thread stacks, dumps the flight record + Chrome
+  trace and injects ``EngineStallError`` (PDT-E020) into the spinning
+  dispatch, which surfaces coded from ``step()`` — co-resident
+  requests then complete bitwise on the re-dispatched plan. Key =
+  dispatch kind (``mixed``/``decode``/``window``/``verify``).
 """
 from __future__ import annotations
 
@@ -60,9 +68,11 @@ from . import faults
 
 __all__ = [
     "FINISH_REASONS", "DecodeGuard", "dispatch_retry",
+    "simulated_stall",
     "SITE_DISPATCH", "SITE_NAN_DECODE", "SITE_PAGE_PRESSURE",
     "SITE_CACHE_EVICT", "SITE_DRAFT_NAN", "SITE_DRAFT_MISMATCH",
     "SITE_HANDOFF_TRANSIENT", "SITE_DECODE_WORKER_LOST",
+    "SITE_STALL",
 ]
 
 #: Every value ``CompletedRequest.finish_reason`` can take.
@@ -76,6 +86,27 @@ SITE_DRAFT_NAN = "engine_draft_nan"
 SITE_DRAFT_MISMATCH = "engine_draft_mismatch"
 SITE_HANDOFF_TRANSIENT = "engine_handoff_transient"
 SITE_DECODE_WORKER_LOST = "engine_decode_worker_lost"
+SITE_STALL = "engine_stall"
+
+
+def simulated_stall(key: str, max_s: float = 30.0):
+    """The ``engine_stall`` drill body: when the site fires, spin in
+    Python (interpreter-visible, so the watchdog's injected
+    ``EngineStallError`` lands at the next bytecode boundary — a real
+    wedged C call could only be stack-dumped).  The spin is BOUNDED:
+    with no watchdog armed the drill raises after ``max_s`` instead of
+    hanging tier-1, which is the exact failure mode the watchdog
+    exists to prevent."""
+    import time as _time
+    if not faults.check(SITE_STALL, key=str(key)):
+        return
+    t0 = _time.monotonic()
+    while _time.monotonic() - t0 < max_s:
+        _time.sleep(0.002)
+    raise RuntimeError(
+        f"engine_stall drill (key={key!r}): no watchdog interrupted "
+        f"the stalled dispatch within {max_s}s — arm watchdog_ms / "
+        "the watchdog_stall_ms flag when drilling this site")
 
 
 class DecodeGuard:
@@ -138,6 +169,7 @@ def dispatch_retry(kind: str, fn, *, max_attempts=3, on_retry=None):
 
     def call():
         faults.maybe_raise(SITE_DISPATCH, kind)
+        simulated_stall(kind)
         return fn()
 
     return retry_call(call, max_attempts=max(1, int(max_attempts)),

@@ -44,7 +44,12 @@ _TIER1_ORDER = [
     # ISSUE-12 acceptance suite (trace export golden, fleet_snapshot
     # merge, rpc propagation) — model-free except the export acceptance
     # drill, which reuses the session serving_gpt
+    # test_slo_watchdog is the ISSUE-14 acceptance suite (burn-rate
+    # math, engine_stall drill, regress CLI) — model-free except the
+    # engine drills, which reuse the session serving_gpt + the
+    # serving-suite geometry
     "test_prefix_cache.py", "test_observability.py", "test_tracing.py",
+    "test_slo_watchdog.py",
     # ISSUE-11 acceptance: fused-backward bitwise parity + overlap
     # grad-sync bitwise gates — model-free/tiny-model, ~80s combined
     "test_flash_bwd.py", "test_overlap.py",
