@@ -237,6 +237,107 @@ def _measure_gpt_3d(cfg, dp=2, pp=2, mp=1, batch_per_dp=2, seq=64,
     return row
 
 
+def measure_recovery(world=2, num_iters=12, snapshot_every=3,
+                     death_at=6):
+    """Elastic recovery column (ISSUE 15): time-to-resume after an
+    injected ``rank_dead`` plus the buddy-snapshot overhead at cadence
+    ``snapshot_every``.  The rig is the host-side FleetSupervisor drill
+    (thread ranks over a loopback TCPStore — the same fabric a real
+    fleet's detector/snapshot/recovery path runs on; the device only
+    executes the train step), so the column measures the recovery
+    machinery itself on any platform: heartbeat-expiry detection, the
+    coded collective timeout, buddy restore and data fast-forward."""
+    import socket
+    import threading
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.observability import metrics as om
+    from paddle_tpu.resilience import FleetSupervisor, faults
+
+    class _Reg(paddle.io.Dataset):
+        def __init__(self, n=256):
+            rng = np.random.default_rng(0)
+            self.x = rng.normal(size=(n, 16)).astype("float32")
+            self.y = (self.x @ np.arange(1, 17, dtype="float32")[:, None]
+                      ).astype("float32")
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    def make_model():
+        paddle.seed(0)
+        net = paddle.nn.Linear(16, 1)
+        m = paddle.Model(net)
+        m.prepare(paddle.optimizer.SGD(parameters=net.parameters(),
+                                       learning_rate=0.01),
+                  paddle.nn.MSELoss())
+        return m
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    host = TCPStore("127.0.0.1", port, is_master=True)
+    reg = om.registry()
+    snap_h = reg.histogram("elastic.snapshot_ms")
+    snap0 = (snap_h.count, snap_h.sum)
+    data = _Reg()
+    models = [make_model() for _ in range(world)]
+    sups, results = [], {}
+    faults.clear()
+    faults.inject("rank_dead", str(world - 1), 1, death_at)
+    try:
+        for r in range(world):
+            sups.append(FleetSupervisor(
+                "127.0.0.1", port, f"rank{r}", world,
+                is_master=(r == 0), snapshot_every=snapshot_every,
+                collective_timeout_ms=2500.0,
+                heartbeat_interval=0.25, heartbeat_timeout=2.5,
+                recovery_timeout_s=45.0))
+
+        def worker(r):
+            results[r] = sups[r].fit(models[r], data, batch_size=4,
+                                     num_iters=num_iters, verbose=0)
+        t0 = time.perf_counter()
+        ts = [threading.Thread(target=worker, args=(r,), daemon=True)
+              for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+        wall_s = time.perf_counter() - t0
+    finally:
+        faults.clear()
+        for sup in sups:
+            sup.close()
+        host.close()
+    lr = next((s.last_recovery for s in sups
+               if s.last_recovery is not None), None)
+    snaps = snap_h.count - snap0[0]
+    return {
+        "world": world,
+        "snapshot_every": snapshot_every,
+        "death_at_step": death_at,
+        "recovered": lr is not None,
+        "restore_source": lr["source"] if lr else None,
+        "restored_step": lr["step"] if lr else None,
+        # membership-change -> training-resumable (the supervisor's
+        # elastic.recovery_ms for THIS recovery)
+        "recovery_ms": round(lr["ms"], 1) if lr else None,
+        # async capture->replicated wall per snapshot generation
+        "snapshot_ms_mean": round((snap_h.sum - snap0[1]) / snaps, 2)
+        if snaps else 0.0,
+        "snapshots": int(snaps),
+        "drill_wall_s": round(wall_s, 1),
+        "completed": all(results.get(r) is True
+                         for r in range(world - 1)),
+    }
+
+
 def bench_row(peak_flops=None, smoke=False):
     """The driver-facing row. ``smoke`` (CPU): tiny config, dp2 x pp2
     (x mp2 when partial-auto shard_map exists), accounting-only."""
@@ -245,9 +346,11 @@ def bench_row(peak_flops=None, smoke=False):
     if smoke:
         cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
                         num_heads=4, max_seq_len=64, dropout=0.0)
-        return _measure_gpt_3d(cfg, dp=2, pp=2, mp=2, batch_per_dp=2,
-                               seq=16, num_microbatches=2, steps=2,
-                               warmup=1, overlap_steps=2)
+        row = _measure_gpt_3d(cfg, dp=2, pp=2, mp=2, batch_per_dp=2,
+                              seq=16, num_microbatches=2, steps=2,
+                              warmup=1, overlap_steps=2)
+        row["recovery"] = measure_recovery()
+        return row
     cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                     num_heads=12, max_seq_len=1024, dropout=0.0,
                     recompute=False)
@@ -257,9 +360,14 @@ def bench_row(peak_flops=None, smoke=False):
     dp = 2 if ndev >= 4 else 1
     mp = 2 if ndev >= 8 else 1
     pp = 2 if ndev >= 4 else max(1, ndev)
-    return _measure_gpt_3d(cfg, dp=dp, pp=pp, mp=mp, batch_per_dp=8,
-                           seq=1024, num_microbatches=8, steps=10,
-                           warmup=2, peak_flops=peak_flops)
+    row = _measure_gpt_3d(cfg, dp=dp, pp=pp, mp=mp, batch_per_dp=8,
+                          seq=1024, num_microbatches=8, steps=10,
+                          warmup=2, peak_flops=peak_flops)
+    # elastic recovery column (ISSUE 15): host-side drill — the
+    # detector/snapshot/restore fabric under measurement is identical
+    # on TPU pods; only the train step itself is device-bound
+    row["recovery"] = measure_recovery()
+    return row
 
 
 FILES = ["benchmarks/hybrid_bench.py",
@@ -274,7 +382,11 @@ FILES = ["benchmarks/hybrid_bench.py",
          # the gpt_3d skew/compile_ms columns come from the aggregator
          # (ISSUE 12): its merge/quantile math re-measures the row
          "paddle_tpu/observability/aggregate.py",
-         "paddle_tpu/observability/tracing.py"]
+         "paddle_tpu/observability/tracing.py",
+         # the recovery column (ISSUE 15) re-measures when the elastic
+         # supervisor or the membership detector changes
+         "paddle_tpu/resilience/elastic_train.py",
+         "paddle_tpu/distributed/elastic.py"]
 
 
 def main():

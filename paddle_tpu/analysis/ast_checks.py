@@ -1174,3 +1174,97 @@ def check_no_slo_guard_under_load(fndef, ctx):
                 "objectives with burn-rate alerting, and watchdog_ms= "
                 "(or watchdog_stall_ms) so a hung dispatch dumps "
                 "stacks and fails coded instead of hanging")
+
+
+# constructs that prove a TRAINING function is fleet-aware (PDT118):
+# mesh/world evidence as for PDT116, plus the distributed-launch world
+# probes a multi-host fit reads before sharding its data
+_FLEET_EVIDENCE_CALLS = _MESH_EVIDENCE_CALLS | {
+    "get_world_size", "init_parallel_env"}
+# recovery arming that answers it: the elastic supervisor (buddy
+# snapshots + collective watchdog + detector-driven resume) or at
+# minimum the preemption hook (checkpoint-at-boundary + clean exit).
+# ``install`` is matched as the dotted suffix ``preempt.install`` —
+# a bare last-component match would let any unrelated ``x.install()``
+# silently suppress the diagnostic
+_FIT_GUARD_CALLS = {"FleetSupervisor"}
+
+
+def _arms_fit_guard(dotted):
+    return dotted.split(".")[-1] in _FIT_GUARD_CALLS \
+        or dotted == "preempt.install" \
+        or dotted.endswith(".preempt.install")
+
+
+@register(
+    "PDT118", "unsupervised-multihost-fit", Severity.NOTE, "ast",
+    scope="eager",
+    example="""
+import jax
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+def train(model, data):
+    world = jax.device_count()
+    mesh = dist.ProcessMesh(np.arange(world), ["dp"])
+    for epoch in range(10):
+        model.fit(data, batch_size=32, epochs=1)
+""",
+    near_miss="""
+import jax
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.resilience import preempt
+
+def train(model, data):
+    world = jax.device_count()
+    mesh = dist.ProcessMesh(np.arange(world), ["dp"])
+    with preempt.install():
+        for epoch in range(10):
+            model.fit(data, batch_size=32, epochs=1,
+                      save_dir="ckpt", resume=True)
+""")
+def check_unsupervised_multihost_fit(fndef, ctx):
+    """``Model.fit`` in a function that is visibly fleet-aware (it
+    builds a ``ProcessMesh``/``Mesh`` or consults ``device_count``/
+    ``get_world_size``/``init_parallel_env``) with NEITHER
+    ``resilience.FleetSupervisor`` NOR ``preempt.install()`` armed: at
+    fleet scale the dominant availability cost is the recovery, and an
+    unarmed fit pays it in full — a single dead rank hangs every
+    survivor inside the gradient psum (no collective watchdog, so no
+    coded ``CollectiveTimeoutError``), and the only way back is a full
+    restart from on-disk checkpoints instead of a buddy in-memory
+    restore at the last snapshot boundary.  Wrap the loop in
+    ``FleetSupervisor.fit`` (buddy snapshots + watchdog + elastic
+    resume) or at minimum arm ``preempt.install()`` so preemptions
+    checkpoint at a step boundary.  Single-device rigs are legitimate,
+    hence note-level advice."""
+    has_fleet_evidence = any(
+        isinstance(node, ast.Call)
+        and (_dotted(node.func) or "").split(".")[-1]
+        in _FLEET_EVIDENCE_CALLS
+        for node in _walk_fn(fndef))
+    if not has_fleet_evidence:
+        return
+    armed = any(
+        isinstance(node, ast.Call)
+        and _arms_fit_guard(_dotted(node.func) or "")
+        for node in _walk_fn(fndef))
+    if armed:
+        return
+    for node in _walk_fn(fndef):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute) \
+                or node.func.attr != "fit":
+            continue
+        yield node, (
+            "Model.fit in a fleet-aware function (ProcessMesh/Mesh/"
+            "device_count/get_world_size in scope) with neither "
+            "FleetSupervisor nor preempt.install() armed: a dead rank "
+            "hangs every survivor in the gradient psum and recovery "
+            "means a full on-disk restart — arm resilience."
+            "FleetSupervisor (buddy in-memory snapshots, collective "
+            "watchdog PDT-E021, detector-driven resume) or at least "
+            "preempt.install() for checkpoint-at-boundary exits")

@@ -159,6 +159,36 @@ class EngineStallError(EnforceNotMet, TimeoutError):
     error_code = "PDT-E020"
 
 
+class CollectiveTimeoutError(EnforceNotMet, TimeoutError):
+    """A collective (``Group.psum_mean``, ``DataParallel.
+    apply_collective_grads``, a pipeline ppermute dispatch, or the
+    elastic supervisor's store-backed gradient/state allreduce)
+    exceeded ``collective_timeout_ms`` without completing — the
+    signature of a dead or wedged peer rank, which would otherwise
+    hang every survivor forever inside the psum.  The collective
+    watchdog (``observability/watchdog.py``) captured every thread's
+    stack and dumped the flight record before interrupting the blocked
+    caller, so survivors fail coded and the elastic recovery path
+    (``resilience/elastic_train.py`` ``FleetSupervisor``) can quiesce,
+    reshard and resume instead of waiting on a rank that is never
+    coming back."""
+
+    error_code = "PDT-E021"
+
+
+class StoreTimeoutError(EnforceNotMet, TimeoutError):
+    """A TCPStore ``get``/``wait`` deadline expired: the key never
+    appeared within the timeout.  Distinguishes a store partition or a
+    peer that never published (retry/reshard territory — the elastic
+    supervisor treats it as a membership signal) from a programming
+    error; subclasses ``TimeoutError`` so existing callers that catch
+    the builtin keep working.  Retry/backoff behavior is unchanged —
+    a timeout is a SERVED answer ("not there yet"), not a transport
+    failure, so it is never retried by the store client."""
+
+    error_code = "PDT-E022"
+
+
 def enforce(cond: bool, msg: str, exc=InvalidArgumentError):
     """PADDLE_ENFORCE: raise ``exc`` with ``msg`` unless ``cond``."""
     if not cond:

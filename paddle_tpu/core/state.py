@@ -268,6 +268,33 @@ define_flag("flight_keep", 40,
             "deletes the oldest records (and their .trace.json/"
             ".stacks.txt companions) past this count. 0 = unbounded "
             "(the pre-ISSUE-14 behavior).")
+define_flag("collective_timeout_ms", 0.0,
+            "collective-watchdog deadline (resilience/elastic_train.py "
+            "+ observability/watchdog.py): Group.psum_mean, "
+            "DataParallel.apply_collective_grads, pipeline "
+            "forward/train_batch dispatches and the elastic "
+            "supervisor's store-backed allreduce armed past this many "
+            "ms raise a coded CollectiveTimeoutError (PDT-E021) with "
+            "thread stacks in a flight record instead of hanging every "
+            "survivor behind a dead peer. 0 (default) = off; size the "
+            "deadline above the worst case INCLUDING first compiles "
+            "(an interrupt landing mid-compile aborts work that would "
+            "have been cached). FleetSupervisor kwarg "
+            "collective_timeout_ms overrides per instance.")
+define_flag("elastic_snapshot_every", 50,
+            "buddy in-memory snapshot cadence (resilience/"
+            "elastic_train.py): every N optimizer steps each rank "
+            "snapshots model/optimizer/RNG state to host memory and "
+            "replicates it to its buddy rank asynchronously off the "
+            "step path. 0 = snapshots off (recovery falls back to the "
+            "newest COMPLETE CheckpointManager version); "
+            "FleetSupervisor kwarg snapshot_every overrides.")
+define_flag("elastic_buddy", 1,
+            "buddy offset for in-memory snapshot replication: rank r "
+            "replicates to rank (r + offset) % world "
+            "(resilience/elastic_train.py). The dead rank's state is "
+            "restored from its buddy's replica; only when the buddy is "
+            "also gone does recovery read the on-disk checkpoint.")
 define_flag("metrics_log_every", 0,
             "training StepTimer one-line log cadence: every N train "
             "steps hapi.Model.fit logs step wall-time, tokens/sec, "

@@ -12,6 +12,7 @@ generated pybind method table, ``paddle/fluid/pybind/eager_method.cc``).
 """
 from __future__ import annotations
 
+import threading
 from typing import Any, Optional
 
 import jax
@@ -24,14 +25,33 @@ from .dtype import Place, convert_dtype
 
 # Active capture tracker (set by paddle_tpu.jit); sees every read/write of
 # concrete tensors so whole train steps can be lifted into one XLA program.
-_tracker = None
+# THREAD-LOCAL (ISSUE 15): a capture intercepts only the capturing
+# thread's tensor traffic.  With a process-global slot, one rank-thread's
+# discovery pass recorded another thread's unrelated eager reads (and
+# routed those reads through the foreign tracker), so concurrent
+# training loops — the elastic supervisor's multi-rank CPU rig, or any
+# two fits in threads — failed nondeterministically with "op structure
+# is nondeterministic across calls".  Other modules keep reading
+# ``tensor_mod._tracker``; the module-level ``__getattr__`` below
+# resolves that name per thread.
+class _TrackerSlot(threading.local):
+    value = None
+
+
+_tracker_tls = _TrackerSlot()
 
 
 def set_tracker(tr):
-    global _tracker
-    old = _tracker
-    _tracker = tr
+    old = _tracker_tls.value
+    _tracker_tls.value = tr
     return old
+
+
+def __getattr__(name):
+    # PEP 562: ``tensor_mod._tracker`` stays the cross-module read API
+    if name == "_tracker":
+        return _tracker_tls.value
+    raise AttributeError(name)
 
 
 class Tensor:
@@ -76,8 +96,9 @@ class Tensor:
         # lazily-materialized cache to the flat array it was sliced from
         self._flat_view = None
         self._flat_src = None
-        if _tracker is not None:
-            _tracker.on_create(self)
+        tr = _tracker_tls.value
+        if tr is not None:
+            tr.on_create(self)
 
     # --- raw data access (all ops funnel through here; the jit capture
     # tracker hooks these, cf. SOT's eval-frame interception, SURVEY L9) ---
@@ -85,8 +106,9 @@ class Tensor:
         fv = self._flat_view
         if fv is not None:
             return fv[0].member_read(self, fv[1])
-        if _tracker is not None:
-            return _tracker.on_read(self)
+        tr = _tracker_tls.value
+        if tr is not None:
+            return tr.on_read(self)
         return self._data
 
     def _write(self, val):
@@ -94,8 +116,9 @@ class Tensor:
         if fv is not None:
             fv[0].member_write(self, fv[1], val)
             return
-        if _tracker is not None:
-            _tracker.on_write(self, val)
+        tr = _tracker_tls.value
+        if tr is not None:
+            tr.on_write(self, val)
             return
         self._data = val
 
@@ -128,7 +151,8 @@ class Tensor:
                     pass
             new_node.inputs = [ghost if t is self else t
                                for t in new_node.inputs]
-        self._write(other._data if _tracker is None else other._read())
+        self._write(other._data if _tracker_tls.value is None
+                    else other._read())
         self._node = new_node
         if new_node is not None:
             try:
@@ -256,8 +280,9 @@ class Tensor:
             acc = base + g
             self._grad._write(acc)
             self._grad._node = None
-        if _tracker is not None:
-            _tracker.on_grad_write(self)
+        tr = _tracker_tls.value
+        if tr is not None:
+            tr.on_grad_write(self)
 
     def register_hook(self, hook):
         self._hooks.append(hook)

@@ -67,8 +67,14 @@ class Group:
         compile cache.  Each ISSUANCE runs under a
         ``collective.psum_mean`` tracing span (observability.tracing;
         the dispatch is async, so the span brackets the launch — the
-        wait, if any, shows up in the caller's drain span)."""
+        wait, if any, shows up in the caller's drain span).  With the
+        ``collective_timeout_ms`` flag set the dispatch is additionally
+        armed on the collective watchdog (ISSUE 15): a dead/wedged peer
+        that wedges the launch raises a coded
+        ``CollectiveTimeoutError`` (PDT-E021) with thread stacks in a
+        flight record instead of hanging the caller."""
         from ..observability import tracing as _tracing
+        from ..observability import watchdog as _watchdog
 
         f = getattr(self, "_psum_mean_fn", None)
         if f is None:
@@ -82,7 +88,9 @@ class Group:
             self._psum_mean_fn = f
         with _tracing.span("collective.psum_mean", group=self.id,
                            nranks=self.nranks,
-                           size=int(getattr(flat, "size", 0))):
+                           size=int(getattr(flat, "size", 0))), \
+                _watchdog.arm_collective("collective.psum_mean",
+                                         key=f"pg_{self.id}"):
             return f(flat)
 
     def __repr__(self):

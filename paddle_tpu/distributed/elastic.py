@@ -52,6 +52,12 @@ _HB_KEY = "elastic/hb/{}"
 _GEN_LATEST = "elastic/gen_latest"
 _MEMBERS_KEY = "elastic/members/{}"
 _MASTER_HB = "elastic/master_hb"
+# master-maintained set of registration-slot indexes whose node has
+# departed (ISSUE 15 satellite: departed nodes' keys must not live
+# forever).  The slot KEYS are deleted; the scan skips retired indexes
+# without paying a blocking get on each deleted slot.
+_RETIRED_KEY = "elastic/reg_retired"
+_KEEP_GENS = 3  # elastic/members/<g> history kept for late waiters
 
 
 def report_progress(step=None):
@@ -93,6 +99,14 @@ class ElasticManager:
         # master's own clock (remote time.time() would make clock skew >
         # timeout look like death): nid -> (last value, local time seen)
         self._hb_seen: dict[str, tuple[bytes, float]] = {}
+        # GC bookkeeping (master role): nodes that ever appeared in a
+        # published generation — only THOSE are "departed" when they
+        # drop out (a freshly registered joiner whose first heartbeat
+        # is still in flight must never be collected); plus the nids
+        # already collected, whose heartbeat key is re-deleted each
+        # pass in case a partition-healed zombie recreated it
+        self._ever_members: set[str] = set()
+        self._gc_tombstones: set[str] = set()
 
     # -------------------------------------------------------------- join --
     def start(self):
@@ -101,6 +115,7 @@ class ElasticManager:
         Returns (generation, members)."""
         idx = self.store.add(_REG_COUNT, 1) - 1
         self.store.set(_REG_KEY.format(idx), self.node_id.encode())
+        self._reg_idx = idx
         self._beat()
         threading.Thread(target=self._hb_loop, daemon=True).start()
         # every agent runs the role loop: the designated master scans
@@ -144,24 +159,70 @@ class ElasticManager:
         while not self._stop.is_set():
             try:
                 self._beat()
+                self._ensure_registered()
             except OSError:
                 return  # store gone: the job is over
             self._stop.wait(self.hb_interval)
 
+    def _ensure_registered(self):
+        """Self-healing counterpart of the master's key GC: the
+        documented re-admission path ('dropped: wait to be re-seen',
+        ``launch/main.py``) relied on a dropped node's registration
+        slot living forever — its resumed heartbeat on the old slot
+        was enough for the scan to re-admit it.  The GC retires the
+        slot and tombstones the heartbeat key, so a transiently-
+        dropped but still-alive node must RE-REGISTER: whenever this
+        node is outside the current membership and its slot was
+        retired, append a fresh registration slot (only while dropped,
+        so the steady-state beat stays one store set)."""
+        with self._lock:
+            members = list(self._members)
+        if not members or self.node_id in members:
+            return
+        try:
+            if getattr(self, "_reg_idx", None) in self._retired():
+                idx = self.store.add(_REG_COUNT, 1) - 1
+                self.store.set(_REG_KEY.format(idx),
+                               self.node_id.encode())
+                self._reg_idx = idx
+        except OSError:
+            pass
+
     # ------------------------------------------------------- master scan --
-    def _registered(self):
-        """Ordered, deduped registration log (append-only; re-joins
-        re-append, order = first appearance). A slot whose value is not
-        yet set (joiner crashed between add and set) is skipped — it must
-        not kill the scan."""
+    def _retired(self):
+        """Slot indexes GC'd by a master (empty set when the key is
+        absent or unreadable — a stale read only costs one slow scan
+        pass, never correctness)."""
+        try:
+            return set(pickle.loads(
+                self.store.get(_RETIRED_KEY, timeout=0.25)))
+        except Exception:
+            return set()
+
+    def _reg_slots(self):
+        """[(slot, node_id)] of live registration slots in order. A slot
+        whose value is not yet set (joiner crashed between add and set)
+        is skipped — it must not kill the scan; retired slots (key GC'd)
+        are skipped WITHOUT a blocking get."""
         n = self.store.add(_REG_COUNT, 0)
-        seen, out = set(), []
+        retired = self._retired()
+        out = []
         for i in range(n):
+            if i in retired:
+                continue
             try:
                 nid = self.store.get(_REG_KEY.format(i),
                                      timeout=2.0).decode()
             except (TimeoutError, ValueError):
                 continue
+            out.append((i, nid))
+        return out
+
+    def _registered(self):
+        """Ordered, deduped registration log (append-only; re-joins
+        re-append, order = first appearance)."""
+        seen, out = set(), []
+        for _i, nid in self._reg_slots():
             if nid not in seen:
                 seen.add(nid)
                 out.append(nid)
@@ -204,7 +265,31 @@ class ElasticManager:
                 current = pickle.loads(
                     self.store.get(_MEMBERS_KEY.format(g), timeout=1.0))
         except Exception:
-            pass
+            g = 0
+        self._ever_members.update(current)
+        # a PROMOTED master must also learn nodes that departed under
+        # its predecessor, or their keys never qualify for GC: seed
+        # _ever_members from the retained membership history too.
+        # Generations older than the kept window are unknowable — that
+        # residue is bounded by one key set per pre-promotion departure
+        # beyond _KEEP_GENS churn events ago.
+        for hg in range(max(1, g - _KEEP_GENS), g):
+            try:
+                self._ever_members.update(pickle.loads(
+                    self.store.get(_MEMBERS_KEY.format(hg),
+                                   timeout=0.25)))
+            except Exception:
+                pass
+        # seed the retired-slot set so scans never pay the absent-key
+        # wait; only-if-absent (an unconditional set would wipe a
+        # previous master's retirements at promotion)
+        try:
+            self.store.get(_RETIRED_KEY, timeout=0.05)
+        except Exception:
+            try:
+                self.store.set(_RETIRED_KEY, pickle.dumps([]))
+            except OSError:
+                pass
         mseq = 0
         while not self._stop.is_set():
             if self._usurped():
@@ -214,6 +299,12 @@ class ElasticManager:
             try:
                 self.store.set(_MASTER_HB,
                                f"{self.node_id}:{mseq}".encode())
+                # a scanning master is alive by definition: beat our
+                # own node heartbeat from the scan thread too, so a
+                # scheduling stall of the hb thread alone can never
+                # make the master evict ITSELF from the membership it
+                # is publishing
+                self._beat()
             except OSError:
                 return  # store gone: the job is over
             try:
@@ -243,7 +334,70 @@ class ElasticManager:
                 else:
                     current = alive
                     published = True
+                    self._ever_members.update(alive)
+                    try:
+                        self._gc_departed(alive, gen)
+                    except Exception:
+                        pass  # GC must never kill the scanner
             self._stop.wait(self.hb_interval)
+
+    def _hb_alive_now(self, nid):
+        """Freshness re-check at GC time (shares the scan's
+        change-on-our-clock observations in ``_hb_seen``)."""
+        try:
+            val = self.store.get(_HB_KEY.format(nid), timeout=0.25)
+        except Exception:
+            return False
+        return self._fresh_value(("hb", nid), val)
+
+    def _gc_departed(self, members, gen):
+        """Master-side key GC (ISSUE 15 satellite): a departed node's
+        ``elastic/reg/<i>`` and ``elastic/hb/<nid>`` keys — and old
+        ``elastic/members/<g>`` history — otherwise live in the store
+        forever, so long-running elastic jobs leak one key set per
+        churn event. Runs once per published generation (bounded by the
+        registration log length). Only nodes that APPEARED in a
+        published generation are collected; a registered joiner whose
+        first heartbeat is still in flight is left alone. The retired
+        set is written BEFORE the slot keys are deleted so a concurrent
+        scan never pays the blocking get on a deleted slot for more
+        than one pass. Tombstoned heartbeat keys are re-deleted each
+        pass: a partition-healed zombie's heartbeat loop may recreate
+        its key, and re-admission requires a fresh registration
+        (``_ensure_registered`` — a dropped agent re-appends itself
+        when it finds its slot retired). A node whose heartbeat is
+        CURRENTLY fresh is never doomed: it either healed before its
+        slot was collected (the pre-GC re-admission path — the next
+        publish re-includes it) or just re-registered; dooming it in
+        the window between its recovery and the next publish would
+        strand a healthy agent."""
+        retired = self._retired()
+        slots = self._reg_slots()
+        live_nids = {nid for _i, nid in slots}
+        doomed = [(i, nid) for i, nid in slots
+                  if nid not in members and nid in self._ever_members
+                  and not self._hb_alive_now(nid)]
+        if doomed:
+            retired.update(i for i, _nid in doomed)
+            self.store.set(_RETIRED_KEY, pickle.dumps(sorted(retired)))
+            doomed_nids = {nid for _i, nid in doomed}
+            for i, _nid in doomed:
+                self.store.delete_key(_REG_KEY.format(i))
+            # every slot of a doomed nid is doomed together (same
+            # membership test), so its hb key has no live claimant
+            for nid in doomed_nids:
+                self.store.delete_key(_HB_KEY.format(nid))
+                self._hb_seen.pop(("hb", nid), None)
+            self._gc_tombstones.update(doomed_nids)
+        for nid in self._gc_tombstones - live_nids - set(members):
+            self.store.delete_key(_HB_KEY.format(nid))
+        # membership history: keep the last _KEEP_GENS generations for
+        # late wait_generation readers; the probe window below is
+        # bounded — older generations were pruned by earlier passes
+        # (a freshly promoted master may leave a few ancients behind)
+        for g in range(gen - _KEEP_GENS, max(0, gen - _KEEP_GENS - 20),
+                       -1):
+            self.store.delete_key(_MEMBERS_KEY.format(g))
 
     # --------------------------------------------------- standby master --
     def _master_hb_node(self):
@@ -328,9 +482,18 @@ class ElasticManager:
             if deadline is None:
                 time.sleep(self.hb_interval / 2)
             else:
-                time.sleep(0.05)
+                # never sleep past the caller's deadline: a 20ms-budget
+                # poll (the elastic supervisor probes once per train
+                # step) must not pay a full 50ms quantum
+                time.sleep(max(0.0, min(0.05, deadline - time.time())))
         if gen == 0:
             return 0, []
+        with self._lock:
+            if gen == self._gen and self._members:
+                # unchanged generation: serve the cached members and
+                # skip the store round-trip — hot-path polls cost one
+                # get, not three
+                return gen, list(self._members)
         members = pickle.loads(
             self.store.get(_MEMBERS_KEY.format(gen), timeout=5.0))
         with self._lock:

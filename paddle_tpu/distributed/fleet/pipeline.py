@@ -340,9 +340,14 @@ class PipelinedBlocks(Layer):
         # (XLA-scheduled), so the span brackets what the host can see —
         # the dispatch that contains them, with the schedule knobs as
         # attrs.  Under jit capture this runs once, at trace time.
+        # The collective watchdog (ISSUE 15, collective_timeout_ms
+        # flag) arms the same bracket: a ppermute ring wedged behind a
+        # dead stage raises PDT-E021 with stacks instead of hanging.
         from ...observability import tracing as _tracing
+        from ...observability import watchdog as _watchdog
         with _tracing.span("pp.forward", stages=pp, microbatches=M,
-                           overlap_p2p=_overlap_p2p()):
+                           overlap_p2p=_overlap_p2p()), \
+                _watchdog.arm_collective("pp.forward", key=self.pp_axis):
             return apply("pipelined_blocks", impl, x, *leaf_tensors)
 
     def _forward_interleaved(self, x, batch_axes=None):
@@ -673,10 +678,14 @@ class PipelinedBlocks(Layer):
 
         # span over the 1F1B dispatch (forward+backward hops inside);
         # see the pp.forward note — hops are in-program, the span is
-        # the host-observable bracket around them
+        # the host-observable bracket around them (the collective
+        # watchdog arms the same bracket, ISSUE 15)
         from ...observability import tracing as _tracing
+        from ...observability import watchdog as _watchdog
         with _tracing.span("pp.train_batch", stages=pp, microbatches=M,
-                           overlap_p2p=_overlap_p2p()):
+                           overlap_p2p=_overlap_p2p()), \
+                _watchdog.arm_collective("pp.train_batch",
+                                         key=self.pp_axis):
             return apply("pipeline_1f1b", impl, x, target,
                          *leaf_tensors, *post_params)
 

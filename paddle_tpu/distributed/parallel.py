@@ -221,13 +221,19 @@ class DataParallel(Layer):
         path only for parameters the scheduler did not cover (unused
         params, tracer grads)."""
         from ..observability import tracing as _tracing
+        from ..observability import watchdog as _watchdog
 
         params = [p for p in self._layers.parameters()
                   if not p.stop_gradient and p.grad is not None
                   and not getattr(p, "no_sync", False)]
         self._last_sync_collectives = 0
+        # collective watchdog (ISSUE 15): with collective_timeout_ms
+        # set, a grad sync wedged behind a dead peer raises PDT-E021
+        # with a flight dump instead of hanging the training loop
         with _tracing.span("dp.grad_sync", nranks=self.group.nranks,
-                           overlap=self._overlap is not None):
+                           overlap=self._overlap is not None), \
+                _watchdog.arm_collective("dp.grad_sync",
+                                         key=f"pg_{self.group.id}"):
             self._apply_collective_grads(params)
 
     def _apply_collective_grads(self, params):

@@ -186,9 +186,11 @@ class TCPStore:
                 break
             except OSError:
                 if time.time() > deadline:
-                    raise TimeoutError(
+                    from ..core.errors import StoreTimeoutError
+                    raise StoreTimeoutError(
                         f"TCPStore: no master at {self._addr} "
-                        f"after {timeout}s")
+                        f"after {timeout}s "
+                        f"[{StoreTimeoutError.error_code}]")
                 time.sleep(0.05)
 
     @property
@@ -266,11 +268,20 @@ class TCPStore:
                    struct.pack("!I", len(value)) + bytes(value))
 
     def get(self, key, timeout=None):
+        # deadline expiry is a SERVED answer ("key never appeared"),
+        # not a transport failure: it surfaces as the coded
+        # StoreTimeoutError (PDT-E022; TimeoutError subclass) so the
+        # elastic/supervisor paths can tell a partition or a peer that
+        # never published from a programming error — and it is never
+        # retried (retry/backoff stays reserved for ConnectionError)
+        from ..core.errors import StoreTimeoutError
         tmo = self._timeout if timeout is None else timeout
         ok, value = self._call(_OP_GET, key,
                                struct.pack("!q", int(tmo * 1000)))
         if not ok:
-            raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+            raise StoreTimeoutError(
+                f"TCPStore.get({key!r}) timed out after {tmo}s "
+                f"[{StoreTimeoutError.error_code}]")
         return value
 
     def add(self, key, amount=1):
@@ -282,6 +293,8 @@ class TCPStore:
         return value == b"\x01"
 
     def wait(self, keys, timeout=None):
+        """Block until every key exists; ``StoreTimeoutError``
+        (PDT-E022) past the deadline, like ``get``."""
         for k in keys:
             self.get(k, timeout)
 

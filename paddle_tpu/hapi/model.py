@@ -155,6 +155,27 @@ class Model:
         self._train_step_noupd = jit.to_static(make_train_step(False))
         self._eval_step = jit.to_static(eval_step)
 
+    def _reset_compiled_steps(self):
+        """Drop the cached compiled train/eval programs (ISSUE 15:
+        called by ``resilience.FleetSupervisor`` after an external
+        state restore).  A captured step holds its state tensors BY
+        IDENTITY — with the fused optimizer that is the flat dtype
+        buckets, and ``Optimizer.set_state_dict`` dissolves those
+        buckets ("they rebuild at the next step()" — but a CAPTURED
+        step never runs eagerly again, so a cached program would keep
+        training the orphaned bucket storage while the restored
+        per-param tensors sit frozen).  Clearing the caches makes the
+        first post-restore batch re-discover: buckets rebuild from the
+        restored values and a fresh program captures them."""
+        for fn in (self._train_step, self._train_step_noupd,
+                   self._eval_step):
+            if fn is None:
+                continue
+            for attr in ("_cache", "_fallback_keys", "_fallback_counts"):
+                c = getattr(fn, attr, None)
+                if c is not None:
+                    c.clear()
+
     # -- batch-level API (reference :944,:975,:1002) -------------------
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
@@ -239,7 +260,11 @@ class Model:
         (torn versions are skipped automatically) and continues from
         the recorded epoch/step; with no checkpoint yet it trains from
         scratch, so the same launch command works for attempt #1 and
-        every restart."""
+        every restart. ``resume=(epoch, steps_done, global_step)``
+        (ISSUE 15) is the in-memory variant: no disk restore happens —
+        the caller (``resilience.FleetSupervisor`` after a buddy-
+        snapshot restore) already placed the state and fit just starts
+        from that position."""
         assert self._optimizer is not None, "call prepare() before fit()"
         if accumulate_grad_batches != self._accumulate:
             self._accumulate = accumulate_grad_batches
@@ -262,7 +287,15 @@ class Model:
                if save_dir else None)
         start_epoch, skip_steps, it = 0, 0, 0
         self._preempted = False
-        if resume:
+        if isinstance(resume, (tuple, list)):
+            # in-memory resume (resilience.elastic_train
+            # FleetSupervisor): state restoration already happened
+            # host-side (buddy snapshot / disk fallback applied by the
+            # supervisor); fit only takes the position — (start_epoch,
+            # steps already done in that epoch, global step) — with no
+            # checkpoint directory involved
+            start_epoch, skip_steps, it = (int(v) for v in resume)
+        elif resume:
             if mgr is None:
                 raise ValueError("fit(resume=True) requires save_dir")
             pos = self._restore_resilient(mgr)

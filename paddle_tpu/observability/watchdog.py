@@ -52,7 +52,8 @@ from ..core import state as _state
 from . import events as _events
 from . import metrics as _metrics
 
-__all__ = ["arm", "armed", "thread_stacks", "Watchdog", "NULL_TOKEN"]
+__all__ = ["arm", "arm_collective", "armed", "thread_stacks",
+           "Watchdog", "NULL_TOKEN"]
 
 
 def thread_stacks() -> dict:
@@ -308,3 +309,29 @@ def arm(site, deadline_ms, *, key="", interrupt_exc=None,
 def armed() -> list:
     """Live armed entries — empty after every clean run."""
     return _WD.armed()
+
+
+def arm_collective(site, *, key="", deadline_ms=None, extra=None):
+    """Arm one collective dispatch against a dead-peer hang (ISSUE 15).
+
+    The deadline defaults to the ``collective_timeout_ms`` flag (0 =
+    off -> NULL token, today's behavior bitwise); past it the blocked
+    caller gets :class:`~paddle_tpu.core.errors.CollectiveTimeoutError`
+    (PDT-E021) injected, after stacks + flight record + Chrome trace
+    are captured — a dead rank surfaces as a coded, postmortem-ready
+    error instead of hanging every survivor inside the psum.  Armed
+    around ``Group.psum_mean``, ``DataParallel.apply_collective_grads``,
+    the pipeline forward/train_batch dispatches, and the elastic
+    supervisor's store-backed allreduce.  Size the deadline above the
+    operation's worst case INCLUDING first compiles (see the module
+    docstring's livelock note)."""
+    from ..core.errors import CollectiveTimeoutError
+
+    ms = deadline_ms
+    if ms is None:
+        try:
+            ms = float(_state.get_flag("collective_timeout_ms"))
+        except Exception:
+            ms = 0.0
+    return _WD.arm(site, ms, key=key,
+                   interrupt_exc=CollectiveTimeoutError, extra=extra)

@@ -28,18 +28,29 @@ stack:
   bounded-retry dispatch wrapper, and the ``engine_dispatch`` /
   ``engine_nan_decode`` / ``engine_page_pressure`` fault sites the
   serving drills fire.
+* ``elastic_train``     — elastic training recovery (ISSUE 15):
+  ``FleetSupervisor`` arms a fit loop with buddy in-memory snapshots
+  (replicated to rank ``(r+1) % W`` off the step path), a collective
+  watchdog (a dead peer surfaces as ``CollectiveTimeoutError``
+  PDT-E021 with a flight dump instead of an infinite hang), and
+  failure-detector-driven resume: quiesce survivors, reshard the DP
+  group, restore the dead rank's state from its buddy (disk
+  ``CheckpointManager`` fallback only when the buddy is also gone),
+  fast-forward the data position, continue ``fit``.
 """
+from . import elastic_train  # noqa: F401
 from . import faults  # noqa: F401
 from . import preempt  # noqa: F401
 from . import serving  # noqa: F401
 from .atomic import atomic_write, fsync_dir  # noqa: F401
 from .checkpoint import CheckpointManager  # noqa: F401
+from .elastic_train import FleetSupervisor  # noqa: F401
 from .guard import StepGuard  # noqa: F401
 from .retry import retry, retry_call  # noqa: F401
 from .serving import DecodeGuard  # noqa: F401
 
 __all__ = [
-    "faults", "preempt", "serving", "atomic_write", "fsync_dir",
-    "CheckpointManager", "StepGuard", "DecodeGuard", "retry",
-    "retry_call",
+    "faults", "preempt", "serving", "elastic_train", "atomic_write",
+    "fsync_dir", "CheckpointManager", "FleetSupervisor", "StepGuard",
+    "DecodeGuard", "retry", "retry_call",
 ]

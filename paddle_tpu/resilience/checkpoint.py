@@ -28,6 +28,10 @@ __all__ = ["CheckpointManager"]
 
 _MARKER = "COMPLETE"
 _VERSION_RE = re.compile(r"^step_(\d+)$")
+# atomic.py temp-file shape: a crash mid-commit strands
+# ".<name>.tmp.<pid>" next to the destination (by design — that is
+# what a power loss leaves); the keep-K GC sweeps them once aged
+_TMP_RE = re.compile(r"^\..*\.tmp\.\d+$")
 
 
 class CheckpointManager:
@@ -39,9 +43,14 @@ class CheckpointManager:
     validates. ``objs`` values are anything ``framework.save`` accepts.
     """
 
-    def __init__(self, root, keep_last_k=3):
+    def __init__(self, root, keep_last_k=3, tmp_ttl_s=3600.0):
         self.root = os.fspath(root)
         self.keep_last_k = max(1, int(keep_last_k))
+        # age gate for sweeping orphaned atomic_write temps: a LIVE
+        # writer's temp is seconds old, a crash's orphan only gets
+        # older — the gate is what makes the sweep safe to run while
+        # another process is mid-save into the same root
+        self.tmp_ttl_s = float(tmp_ttl_s)
 
     # ------------------------------------------------------------ paths --
     def version_dir(self, step: int) -> str:
@@ -167,8 +176,14 @@ class CheckpointManager:
         newest complete step (torn attempts a resumed run has already
         moved past). An incomplete version NEWER than every complete
         one is left alone — it may be another process mid-write; it
-        gets swept once a newer complete version lands."""
+        gets swept once a newer complete version lands.  Orphaned
+        ``atomic_write`` temp files (a crash mid-commit — the injected
+        ``torn_write`` fault included — strands ``.<name>.tmp.<pid>``
+        next to the destination) are swept too, age-gated by
+        ``tmp_ttl_s``, so repeated crash/resume cycles don't
+        accumulate garbage that the version-level GC can't see."""
         vs = self._scan()
+        self._sweep_tmp([self.root] + [d for _s, d, _m in vs])
         complete = [s for s, _d, m in vs if m is not None]
         if not complete:
             return
@@ -178,3 +193,23 @@ class CheckpointManager:
             if (m is not None and s not in keep) or (m is None
                                                      and s <= newest):
                 shutil.rmtree(d, ignore_errors=True)
+
+    def _sweep_tmp(self, dirs):
+        """Remove atomic_write orphans older than ``tmp_ttl_s`` from
+        the given directories (best effort — a temp that vanishes
+        mid-sweep was someone else's commit finishing)."""
+        cutoff = time.time() - self.tmp_ttl_s
+        for d in dirs:
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for name in names:
+                if not _TMP_RE.match(name):
+                    continue
+                p = os.path.join(d, name)
+                try:
+                    if os.path.getmtime(p) <= cutoff:
+                        os.remove(p)
+                except OSError:
+                    pass

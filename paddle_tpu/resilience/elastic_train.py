@@ -1,0 +1,1062 @@
+"""Elastic training recovery (ISSUE 15): failure-detector-driven
+resume with buddy in-memory snapshots and a collective watchdog.
+
+At fleet scale the dominant availability cost is not the crash but the
+recovery: a single dead rank hangs every survivor inside a psum, and
+the classic way back is a full restart from on-disk checkpoints.  The
+:class:`FleetSupervisor` arms a training loop against rank failure end
+to end:
+
+* **Buddy in-memory snapshots** — every ``snapshot_every`` optimizer
+  steps each rank snapshots model/optimizer/RNG state to host memory
+  (with the PR4 fused optimizer the state it reads is views over a
+  handful of contiguous flat dtype buckets, not thousands of tensors)
+  and replicates it to its buddy rank ``(r + elastic_buddy) % W``
+  asynchronously off the step path: the capture happens at the step
+  boundary, the chunked transfer rides a dedicated TCPStore connection
+  under bounded :func:`resilience.retry` in a background thread.  The
+  store is the *transport*, not the home: the buddy's receiver thread
+  pulls each generation into its own process memory (validating
+  per-chunk sizes + CRCs — a half-written replica is discarded and the
+  previous generation kept, the ``snapshot_torn`` drill) and the
+  writer deletes transfer keys beyond the last two generations, so
+  store footprint stays bounded and replicas die with their holder —
+  which is exactly what makes the buddy-also-dead disk fallback real.
+
+* **Collective watchdog** — the supervisor's store-backed allreduce
+  (and, via ``observability.watchdog.arm_collective``, the device
+  collectives ``Group.psum_mean`` / ``DataParallel.
+  apply_collective_grads`` / the pipeline ppermute dispatches) runs
+  under a ``collective_timeout_ms`` deadline: a dead peer surfaces as
+  a coded :class:`~paddle_tpu.core.errors.CollectiveTimeoutError`
+  (PDT-E021) with every thread's stack in a flight record, instead of
+  an infinite hang.  Metrics-off keeps a supervisor-side hard deadline
+  (no dump — observability off is observability off) so recovery still
+  functions.
+
+* **Elastic resume** — on a detected membership change (an
+  :class:`~paddle_tpu.distributed.elastic.ElasticManager` generation
+  bump at a step boundary, or PDT-E021 out of a blocked collective)
+  every survivor unwinds its ``Model.fit`` at the step boundary,
+  meets the others at a quiesce barrier, reshards the data-parallel
+  group to the new world size (rank/world re-derived from the new
+  membership; the batch-granular data shard re-strides), restores the
+  dead rank's state from its buddy's in-memory replica (falling back
+  to the newest COMPLETE ``CheckpointManager`` version only when the
+  buddy is also gone), fast-forwards the data position to the
+  snapshot's consumed-batch count, and re-enters ``fit`` — the
+  post-recovery loss trajectory equals an unfaulted run restarted at
+  that step on the same data order.
+
+Why survivors restore the snapshot instead of continuing their live
+state: the death is detected mid-step, after each survivor already
+applied its LOCAL update for the step whose sync never completed —
+survivor states have diverged from each other by exactly that unsynced
+step.  The snapshot is the newest provably-consistent point; rolling
+back to it is what makes the resumed trajectory well-defined.
+
+CPU-testable like ``tests/test_elastic.py`` / ``tests/test_rpc_store.py``:
+each "rank" is a thread with its own model, optimizer, data shard and
+TCPStore connections; the data-parallel sync is the supervisor's
+store-backed parameter allreduce (``sync_each_step=True``, the
+single-process stand-in for the cross-host psum — on a real pod the
+in-graph GSPMD psum owns gradient sync and ``sync_each_step`` stays
+off; the supervisor then adds only detection/snapshot/recovery around
+the compiled step).
+
+Fault sites (``resilience.faults`` grammar; key = the RANK for the
+first three, the source rank for ``snapshot_torn``):
+
+* ``rank_dead``       — the rank's worker dies at a step boundary:
+  heartbeats stop, its collective contribution never arrives.
+* ``slow_rank``       — the rank stalls ``slow_rank_s`` before its
+  contribution: a straggler, NOT a death — peers wait it out inside
+  the collective deadline and no recovery triggers (detector vs
+  straggler separation).
+* ``store_partition`` — one supervisor-level store operation (snapshot
+  push) raises ``InjectedConnectionError`` per firing; absorbed by the
+  bounded retry; past the budget that snapshot generation is skipped
+  (counter ``elastic.snapshot_push_failures``) and training continues.
+* ``snapshot_torn``   — the replica transfer writes half of one
+  chunk's bytes while the manifest records the full size/CRC (the
+  reordered-delivery / partial-receive failure a real transport can
+  produce): the buddy's validation rejects the generation and keeps
+  the previous one.
+
+Metrics (PR8 registry, ``render_prometheus()``-visible):
+``elastic.snapshots`` / ``elastic.snapshot_ms`` (capture->replicated
+wall) / ``elastic.snapshots_torn`` / ``elastic.snapshot_push_failures``
+/ ``elastic.recoveries`` / ``elastic.recovery_ms`` /
+``elastic.generation``.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from ..core.errors import (CheckpointNotFoundError,
+                           CollectiveTimeoutError, StoreTimeoutError)
+from . import faults
+from .retry import retry_call
+
+__all__ = ["FleetSupervisor", "MembershipChanged"]
+
+_P = "elastic_train"  # store-key namespace
+
+
+class MembershipChanged(Exception):
+    """Raised out of the fit loop at a step boundary when the
+    ElasticManager published a generation with different members —
+    the supervisor catches it and runs recovery."""
+
+    def __init__(self, gen, members):
+        super().__init__(f"generation {gen}: members {members}")
+        self.gen = gen
+        self.members = members
+
+
+class _RankDead(Exception):
+    """Internal: the ``rank_dead`` drill killed this worker."""
+
+
+class _TornReplica(Exception):
+    """Internal: a fetched replica failed size/CRC validation."""
+
+
+def _to_np(obj):
+    """Recursively convert a state-dict-shaped object to plain numpy /
+    scalars so it pickles without framework types."""
+    from ..core.tensor import Tensor
+    if isinstance(obj, Tensor):
+        return np.asarray(obj.numpy())
+    if isinstance(obj, dict):
+        return {k: _to_np(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_np(v) for v in obj]
+    return obj
+
+
+def _shard_view(data, batch_size, rank, world, offset_batches):
+    """Batch-granular shard of ``data``: local batch ``b`` of this rank
+    is the contiguous global batch ``offset + b*world + rank``, so a
+    resumed run at a NEW world size reconstructs the exact remaining
+    batch stream by carrying ``offset`` (consumed global batches)
+    forward — the property the loss-parity acceptance drill pins."""
+    from ..io import Dataset
+
+    bs = int(batch_size)
+    total_batches = len(data) // bs
+    avail = max(0, total_batches - int(offset_batches))
+    nbatches = avail // max(1, world)
+
+    class _Shard(Dataset):
+        def __len__(self):
+            return nbatches * bs
+
+        def __getitem__(self, j):
+            b, r = divmod(int(j), bs)
+            g = int(offset_batches) + b * world + rank
+            return data[g * bs + r]
+
+    return _Shard()
+
+
+def _supervisor_callback(sup, model):
+    from ..hapi.callbacks import Callback
+
+    class _Cb(Callback):
+        def on_train_batch_end(self, step, logs=None):
+            sup._on_step(model, logs)
+
+    return _Cb()
+
+
+class FleetSupervisor:
+    """Arms one rank's training for elastic recovery (module docstring).
+
+    One supervisor per rank.  ``host``/``port`` address the rendezvous
+    TCPStore (hosted by the launcher or externally — the supervisor
+    only connects; it opens separate connections for membership
+    heartbeats, blocking collectives and bulk snapshot transfer so a
+    blocked barrier can never starve the heartbeat).  ``node_id`` must
+    be unique per rank; the designated initial master (conventionally
+    rank 0) passes ``is_master=True`` — on its death the standby
+    election in ``distributed/elastic.py`` takes over scanning.
+
+    ``fit(model, data, ...)`` wraps ``hapi.Model.fit`` in the
+    join -> train -> (recover -> train)* loop and returns True when
+    training completed, False when this rank died (the ``rank_dead``
+    drill).  ``checkpoint_manager`` is the disk fallback used only
+    when no buddy replica survives.
+    """
+
+    def __init__(self, host, port, node_id, world_size, *,
+                 is_master=False, snapshot_every=None, buddy=None,
+                 collective_timeout_ms=None, sync_each_step=True,
+                 checkpoint_manager=None, heartbeat_interval=0.5,
+                 heartbeat_timeout=2.5, recovery_timeout_s=60.0,
+                 store_retries=3, chunk_bytes=1 << 20,
+                 slow_rank_s=0.25, keep_snapshots=2,
+                 recv_poll_s=0.05):
+        from ..core import state as _state
+        self.host, self.port = host, int(port)
+        self.node_id = str(node_id)
+        self.world_size = int(world_size)
+        self.is_master = bool(is_master)
+        if snapshot_every is None:
+            snapshot_every = _state.get_flag("elastic_snapshot_every")
+        self.snapshot_every = max(0, int(snapshot_every))
+        if buddy is None:
+            buddy = _state.get_flag("elastic_buddy")
+        self.buddy = max(1, int(buddy))
+        if collective_timeout_ms is None:
+            collective_timeout_ms = _state.get_flag(
+                "collective_timeout_ms")
+        # the supervisor NEEDS a deadline — the blocked collective IS
+        # its failure detector — so flag 0 means "default", not "off"
+        self.collective_timeout_ms = float(collective_timeout_ms) \
+            or 30000.0
+        self.sync_each_step = bool(sync_each_step)
+        self.mgr = checkpoint_manager
+        self.hb_interval = float(heartbeat_interval)
+        self.hb_timeout = float(heartbeat_timeout)
+        self.recovery_timeout_s = float(recovery_timeout_s)
+        self.store_retries = max(1, int(store_retries))
+        self.chunk_bytes = max(1024, int(chunk_bytes))
+        self.slow_rank_s = float(slow_rank_s)
+        self.keep_snapshots = max(1, int(keep_snapshots))
+        self.recv_poll_s = float(recv_poll_s)
+
+        # membership (set at _join / _recover)
+        self.rank = -1
+        self.world = 0
+        self._gen = 0
+        self._members: list[str] = []
+        # data position
+        self._gstep = 0       # optimizer steps completed (global)
+        self._consumed = 0    # global batches consumed by the fleet
+        self._epoch = 0
+        # collective-key epoch: bumped in lockstep at every completed
+        # recovery so rolled-back steps never reuse pre-crash ar tags
+        self._epoch_ar = 0
+        # snapshots: mine + the replicas I hold for the rank I buddy
+        self._replicas: dict[str, list] = {}   # node -> [(step, meta, payload)]
+        self._local: list = []                 # [(step, meta, payload)]
+        self._pushed: list[tuple[int, int, int]] = []  # [(epoch, step, nchunks)]
+        self._pending = None                   # latest-wins push queue
+        self._restored_gen = -1                # last gen whose restore applied
+        self._restored_info = None             # (meta, plan, dead) of it
+        self._gens_touched: set = set()        # recovery gens with our keys
+        self._restore_pushed: dict = {}        # gen -> nchunks we pushed
+        self._qlock = threading.Lock()
+        self._qev = threading.Event()
+        self._stop = threading.Event()
+        self._mgr_elastic = None
+        self._stores = None
+        self._threads = []
+        self._sync_cache = None                # (params, shapes, sizes)
+        self._last_ar_tags: list[str] = []
+        self.last_recovery = None
+        self.dead = False
+
+    # ------------------------------------------------------------ wiring --
+    def _connect(self):
+        from ..distributed.store import TCPStore
+        # three connections: heartbeats/membership must never queue
+        # behind a blocked barrier or a megabyte chunk transfer
+        self._store = TCPStore(self.host, self.port)    # membership
+        self._bstore = TCPStore(self.host, self.port)   # collectives
+        self._xstore = TCPStore(self.host, self.port)   # snapshots
+        self._stores = (self._store, self._bstore, self._xstore)
+
+    def _join(self):
+        from ..distributed.elastic import ElasticManager
+        if self._stores is None:
+            self._connect()
+        self._mgr_elastic = ElasticManager(
+            self._store, self.node_id, self.is_master,
+            heartbeat_interval=self.hb_interval,
+            heartbeat_timeout=self.hb_timeout,
+            min_nodes=self.world_size)
+        gen, members = self._mgr_elastic.start()
+        self._adopt(gen, members)
+        t1 = threading.Thread(target=self._replicator_loop,
+                              name=f"et-push-{self.node_id}",
+                              daemon=True)
+        t2 = threading.Thread(target=self._receiver_loop,
+                              name=f"et-recv-{self.node_id}",
+                              daemon=True)
+        self._threads = [t1, t2]
+        t1.start()
+        t2.start()
+
+    def _adopt(self, gen, members):
+        self._gen = int(gen)
+        # canonical (sorted) member order: the ElasticManager publishes
+        # members in REGISTRATION order, which is a race between
+        # concurrently joining ranks — every supervisor sorts the same
+        # list, so rank assignment, the buddy ring and the leader
+        # choice are deterministic functions of the node ids alone
+        self._members = sorted(members)
+        self.rank = self._members.index(self.node_id)
+        self.world = len(self._members)
+        # prune replica holdings to the node we now buddy for: the
+        # restore plan only ever consults the CURRENT buddy mapping, so
+        # holdings for former sources (dead ranks, reshard-shifted
+        # rings) are dead weight — full model+opt payloads that would
+        # otherwise stay resident for the life of the job
+        src = self._replica_source()
+        for k in [k for k in self._replicas if k != src]:
+            del self._replicas[k]
+        self._registry().gauge(
+            "elastic.generation",
+            "current elastic membership generation").set(self._gen)
+
+    def close(self):
+        """Stop heartbeats and background threads (idempotent)."""
+        self._stop.set()
+        self._qev.set()
+        if self._mgr_elastic is not None:
+            self._mgr_elastic.stop()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        for s in (self._stores or ()):
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._stores = None
+
+    def _registry(self):
+        from ..observability import metrics as om
+        return om.registry()
+
+    def _emit(self, kind, **fields):
+        try:
+            from ..observability import events
+            events.emit(kind, node=self.node_id, **fields)
+        except Exception:
+            pass
+
+    def _sop(self, fn):
+        """One supervisor-level store op under the ``store_partition``
+        fault site + bounded retry (the TCPStore client has its own
+        transport-level retry underneath; this budget is the
+        supervisor's give-up point for a real partition)."""
+        def attempt():
+            faults.maybe_raise("store_partition", self.node_id)
+            return fn()
+
+        return retry_call(attempt, max_attempts=self.store_retries,
+                          base_delay=0.02, max_delay=0.2,
+                          retry_on=(ConnectionError,))
+
+    # --------------------------------------------------------------- fit --
+    def fit(self, model, train_data, batch_size=1, num_iters=None,
+            callbacks=None, verbose=0, **fit_kw):
+        """Supervised ``Model.fit`` over this rank's shard of
+        ``train_data`` (deterministic order — the supervisor forces
+        ``shuffle=False``; sharding is batch-granular, see
+        ``_shard_view``).  Single-epoch stream semantics: the remaining
+        data after a recovery is treated as the current epoch.
+        Returns True on completion, False when this rank died."""
+        fit_kw.pop("epochs", None)
+        fit_kw.pop("shuffle", None)
+        if not self._members:
+            self._join()
+        try:
+            while True:
+                shard = _shard_view(train_data, batch_size, self.rank,
+                                    self.world, self._consumed)
+                cb = _supervisor_callback(self, model)
+                try:
+                    # single-epoch stream semantics: the shard already
+                    # excludes consumed batches, so the resumed run
+                    # ALWAYS starts at epoch 0 of the remaining data —
+                    # feeding a checkpoint's epoch >= 1 through resume
+                    # with epochs=1 would make fit's epoch range empty
+                    # and "complete" without training a step
+                    model.fit(shard, batch_size=batch_size, epochs=1,
+                              shuffle=False, verbose=verbose,
+                              num_iters=num_iters,
+                              callbacks=list(callbacks or []) + [cb],
+                              resume=((0, 0, self._gstep)
+                                      if self._gstep else False),
+                              **fit_kw)
+                    return True
+                except _RankDead:
+                    # the drill's simulated death: stop heartbeating
+                    # and vanish without cleanup — peers must detect us
+                    self.dead = True
+                    self._emit("elastic.rank_dead", rank=self.rank)
+                    self.close()
+                    return False
+                except (CollectiveTimeoutError, MembershipChanged) as e:
+                    try:
+                        self._recover(model, e)
+                    except _RankDead:
+                        # partitioned out during recovery: the fleet
+                        # moved on without us — same exit as the drill
+                        return False
+        except BaseException:
+            # terminal exit (recovery gave up, user train-step error):
+            # stop heartbeating before unwinding — a raised-but-still-
+            # beating rank is an undetectable zombie whose peers would
+            # burn the full collective deadline with its buddy replica
+            # unused, because the detector's premise (death stops
+            # heartbeats) is violated
+            self.close()
+            raise
+
+    def _on_step(self, model, logs):
+        """Step-boundary supervision hook (fires from the fit callback
+        after each optimizer update)."""
+        gs = self._gstep + 1
+        if faults.check("rank_dead", str(self.rank)):
+            raise _RankDead()
+        if faults.check("slow_rank", str(self.rank)):
+            # a straggler, not a death: heartbeats keep flowing (their
+            # thread is independent) and the stall stays well inside
+            # the collective deadline — peers wait, nobody recovers
+            time.sleep(self.slow_rank_s)
+        if self.sync_each_step and self.world > 1:
+            self._sync_state(model, gs)
+        self._gstep = gs
+        self._consumed += self.world
+        if self.snapshot_every and gs % self.snapshot_every == 0:
+            self._enqueue_snapshot(model, gs)
+        self._poll_membership()
+
+    def _poll_membership(self):
+        # timeout=0 -> one nonblocking gen probe (sub-ms on loopback);
+        # the step path must not absorb a sleep quantum per step
+        gen, members = self._mgr_elastic.wait_generation(self._gen,
+                                                         timeout=0.0)
+        if gen > self._gen:
+            if any(m not in members for m in self._members):
+                raise MembershipChanged(gen, sorted(members))
+            # flap re-publish or pure ADDITION: adopt the generation
+            # but keep training on the current member set — a joiner
+            # registers at gstep 0 and cannot partake in the lockstep
+            # allreduce mid-stream; integrating late joiners (catch-up
+            # from a snapshot) is a scale-up feature this supervisor
+            # does not provide, and wedging recovery on one would
+            # abort perfectly healthy training
+            self._gen = gen
+
+    # -------------------------------------------------- state collective --
+    def _sync_params(self, model):
+        cache = self._sync_cache
+        params = [p for p in model.network.parameters()]
+        if cache is None or len(cache[0]) != len(params):
+            shapes = [tuple(int(s) for s in p.shape) for p in params]
+            sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+            cache = self._sync_cache = (params, shapes, sizes)
+        return cache
+
+    def _sync_state(self, model, gs):
+        """The CPU-mesh DP stand-in: average the parameter state over
+        the fleet through the store (all ranks iterate members in the
+        same order, so the reduction is bitwise-identical everywhere).
+        On a real pod the in-graph psum owns gradient sync and this is
+        off."""
+        import jax.numpy as jnp
+        params, shapes, sizes = self._sync_params(model)
+        vec = np.concatenate(
+            [np.asarray(p.numpy(), np.float32).ravel() for p in params]) \
+            if params else np.zeros(0, np.float32)
+        mean = self._allreduce_mean(f"s{gs}", vec)
+        off = 0
+        for p, shp, n in zip(params, shapes, sizes):
+            p._write(jnp.asarray(mean[off:off + n].reshape(shp)))
+            off += n
+
+    def _allreduce_mean(self, tag, vec):
+        """Store-backed psum-mean over the current members, armed on
+        the collective watchdog: a peer that never contributes raises
+        ``CollectiveTimeoutError`` (PDT-E021) with stacks in a flight
+        record within ``collective_timeout_ms`` (+ one poll interval)
+        — with metrics off, a supervisor-side hard deadline raises the
+        same coded error without the dump."""
+        from ..observability import watchdog as _watchdog
+        # keyed by (recovery epoch, step tag), NOT the generation: the
+        # step sequence is globally unique under lockstep sync, while a
+        # transient generation disagreement (one rank adopted a flap
+        # re-publish one step before its peer) would partition the key
+        # namespace and deadlock ranks that are both alive.  The epoch
+        # exists because a recovery ROLLS BACK the step counter: with
+        # >1 survivor, re-run steps would otherwise reuse pre-crash
+        # tags and a fast reader could consume a peer's STALE pre-crash
+        # contribution before the peer re-sets it.  Unlike the
+        # generation, the epoch cannot transiently disagree — every
+        # survivor increments it at the same recovery barrier, and
+        # ranks that missed the recovery are no longer members.
+        base = f"{_P}/ar/e{self._epoch_ar}/{tag}"
+        self._bstore.set(f"{base}/{self.node_id}",
+                         vec.astype(np.float32, copy=False).tobytes())
+        ms = self.collective_timeout_ms
+        bufs = {}
+        with _watchdog.arm_collective(
+                "elastic.allreduce", key=str(tag),
+                deadline_ms=ms,
+                extra={"members": list(self._members)}):
+            hard = time.monotonic() + 2.0 * ms / 1e3
+            pending = list(self._members)
+            while pending:
+                node = pending[0]
+                try:
+                    # short server-side waits keep this loop at Python
+                    # bytecode boundaries, where the watchdog's
+                    # injected exception can land.  The catch below is
+                    # the STORE's "not there yet" answer specifically —
+                    # CollectiveTimeoutError is a TimeoutError too, and
+                    # swallowing the injection here would un-detect the
+                    # dead peer until the hard backstop
+                    bufs[node] = self._bstore.get(f"{base}/{node}",
+                                                  timeout=0.05)
+                    pending.pop(0)
+                except StoreTimeoutError:
+                    if time.monotonic() > hard:
+                        raise CollectiveTimeoutError(
+                            f"collective {tag!r} gen {self._gen}: no "
+                            f"contribution from {node!r} within "
+                            f"{ms:.0f}ms "
+                            f"[{CollectiveTimeoutError.error_code}]")
+        arrs = [np.frombuffer(bufs[n], np.float32)
+                for n in self._members]
+        out = arrs[0].astype(np.float32, copy=True)
+        for a in arrs[1:]:
+            out += a
+        out /= np.float32(len(arrs))
+        self._gc_ar(base)
+        return out
+
+    def _gc_ar(self, base):
+        """Bounded collective-key footprint: each rank deletes its own
+        contribution for the tag before last (the previous tag may
+        still be mid-read by a straggler)."""
+        self._last_ar_tags.append(base)
+        while len(self._last_ar_tags) > 2:
+            base = self._last_ar_tags.pop(0)
+            try:
+                self._bstore.delete_key(f"{base}/{self.node_id}")
+            except (ConnectionError, OSError):
+                pass
+
+    # ---------------------------------------------------------- snapshots --
+    def _capture(self, model, gs):
+        from ..core import state as core_state
+        net = model.network
+        msd = {k: np.asarray(v.numpy())
+               for k, v in net.state_dict().items()}
+        opt = getattr(model, "_optimizer", None)
+        osd = _to_np(opt.state_dict()) \
+            if opt is not None and hasattr(opt, "state_dict") else None
+        rng = core_state.default_rng
+        rng_arr = np.asarray(rng._key_var._read()) \
+            if rng._key_var is not None else None
+        meta = {"step": int(gs), "consumed": int(self._consumed),
+                "epoch": int(self._epoch), "node": self.node_id,
+                "world": self.world}
+        payload = pickle.dumps(
+            {"model": msd, "opt": osd, "rng": rng_arr, "meta": meta},
+            protocol=4)
+        return meta, payload
+
+    def _enqueue_snapshot(self, model, gs):
+        t0 = time.perf_counter()
+        meta, payload = self._capture(model, gs)
+        self._hold(self._local, gs, meta, payload)
+        self._registry().counter(
+            "elastic.snapshots",
+            "buddy in-memory snapshots captured").inc()
+        with self._qlock:
+            # latest-wins: a slow push never queues unbounded work.
+            # The recovery EPOCH is captured here, with the payload: a
+            # push that drains after a recovery bumped the epoch must
+            # land in the OLD epoch's (dead) keyspace, not mislabel
+            # pre-crash state as post-recovery
+            self._pending = (gs, meta, payload, t0, self._epoch_ar)
+        self._qev.set()
+
+    def _hold(self, store_list, step, meta, payload):
+        store_list[:] = [e for e in store_list if e[0] != step]
+        store_list.append((step, meta, payload))
+        store_list.sort(key=lambda e: e[0])
+        del store_list[:-self.keep_snapshots]
+
+    def _replicator_loop(self):
+        while not self._stop.is_set():
+            self._qev.wait(timeout=0.2)
+            with self._qlock:
+                item, self._pending = self._pending, None
+                self._qev.clear()
+            if item is None:
+                continue
+            gs, meta, payload, t0, epoch = item
+            try:
+                self._push_snapshot(gs, meta, payload, epoch)
+                self._registry().histogram(
+                    "elastic.snapshot_ms",
+                    "snapshot capture -> buddy-replicated wall time"
+                ).observe((time.perf_counter() - t0) * 1e3)
+            except Exception as e:
+                self._registry().counter(
+                    "elastic.snapshot_push_failures",
+                    "snapshot replications abandoned after retry"
+                ).inc()
+                self._emit("elastic.snapshot_push_failed", step=gs,
+                           error=f"{type(e).__name__}: {e}"[:200])
+
+    def _xfer_base(self, node, epoch):
+        # transfer keys are RECOVERY-EPOCH-namespaced: after a rollback
+        # the fleet re-runs step numbers it already snapshotted, and a
+        # buddy that kept pre-crash keys/holdings at the same bare step
+        # would silently serve state from the divergent pre-recovery
+        # trajectory on a second death.  The epoch bumps in lockstep at
+        # the recovery barrier, so writer and receiver agree on the
+        # keyspace whenever training (and thus snapshotting) runs
+        return f"{_P}/xfer/e{int(epoch)}/{node}"
+
+    def _push_snapshot(self, gs, meta, payload, epoch):
+        base = self._xfer_base(self.node_id, epoch)
+        # writer-side transfer-key GC: keep the last keep_snapshots
+        # generations in flight; the receiver pulls within a poll tick
+        while len(self._pushed) >= self.keep_snapshots:
+            self._drop_pushed(self._pushed.pop(0))
+        nchunks = self._push_payload(self._xstore, f"{base}/{gs}",
+                                     payload, meta,
+                                     torn_key=str(self.rank))
+        self._pushed.append((epoch, gs, nchunks))
+        self._sop(lambda: self._xstore.set(
+            f"{base}/latest",
+            pickle.dumps([s for e, s, _n in self._pushed
+                          if e == epoch])))
+        self._sop(lambda: self._xstore.add(f"{base}/seq", 1))
+
+    def _drop_pushed(self, entry):
+        epoch, old, nchunks = entry
+        base = self._xfer_base(self.node_id, epoch)
+        for i in range(nchunks):
+            try:
+                self._xstore.delete_key(f"{base}/{old}/c{i}")
+            except (ConnectionError, OSError):
+                pass
+        try:
+            self._xstore.delete_key(f"{base}/{old}/meta")
+        except (ConnectionError, OSError):
+            pass
+
+    def _push_payload(self, store, keybase, payload, meta_extra,
+                      torn_key=None):
+        cb = self.chunk_bytes
+        chunks = [payload[i:i + cb] for i in range(0, len(payload), cb)] \
+            or [b""]
+        torn = torn_key is not None and faults.check("snapshot_torn",
+                                                     torn_key)
+        for i, c in enumerate(chunks):
+            data = c[:max(1, len(c) // 2)] if torn and i == 0 else c
+            self._sop(lambda k=f"{keybase}/c{i}", d=data: store.set(k, d))
+        meta = dict(meta_extra)
+        meta.update({"nchunks": len(chunks),
+                     "sizes": [len(c) for c in chunks],
+                     "crcs": [zlib.crc32(c) for c in chunks],
+                     "bytes": len(payload)})
+        self._sop(lambda: store.set(f"{keybase}/meta",
+                                    pickle.dumps(meta)))
+        return len(chunks)
+
+    def _fetch_payload(self, store, keybase, timeout):
+        meta = pickle.loads(store.get(f"{keybase}/meta", timeout))
+        parts = []
+        for i in range(meta["nchunks"]):
+            c = store.get(f"{keybase}/c{i}", timeout)
+            if len(c) != meta["sizes"][i] \
+                    or zlib.crc32(c) != meta["crcs"][i]:
+                raise _TornReplica(f"{keybase} chunk {i}")
+            parts.append(c)
+        return meta, b"".join(parts)
+
+    def _replica_source(self):
+        """The node whose buddy I currently am (whose snapshots I
+        receive): ``members[(my_rank - buddy) % world]``."""
+        if self.world <= 1 or self.rank < 0:
+            return None
+        src = self._members[(self.rank - self.buddy) % self.world]
+        return None if src == self.node_id else src
+
+    def _receiver_loop(self):
+        # seen-seq is keyed by the epoch-namespaced base: each recovery
+        # epoch starts a fresh pull stream (seq counts from zero there)
+        seen: dict[str, int] = {}
+        while not self._stop.is_set():
+            src = self._replica_source()
+            if src is not None:
+                try:
+                    self._pull_from(src, seen)
+                except Exception:
+                    pass  # transient — next poll retries
+            self._stop.wait(self.recv_poll_s)
+
+    def _pull_from(self, src, seen):
+        epoch = self._epoch_ar
+        base = self._xfer_base(src, epoch)
+        seq = self._xstore.add(f"{base}/seq", 0)
+        if seq <= seen.get(base, 0):
+            return
+        steps = pickle.loads(self._xstore.get(f"{base}/latest",
+                                              timeout=1.0))
+        held = {s for s, _m, _p in self._replicas.get(src, [])}
+        for s in sorted(steps):
+            if s in held:
+                continue
+            try:
+                meta, payload = self._fetch_payload(
+                    self._xstore, f"{base}/{s}", timeout=1.0)
+            except _TornReplica:
+                # half-written replica: discard, keep the previous
+                # generation — the snapshot_torn acceptance drill
+                self._registry().counter(
+                    "elastic.snapshots_torn",
+                    "received replicas rejected by validation").inc()
+                self._emit("elastic.snapshot_torn", src=src, step=s)
+                continue
+            with self._qlock:
+                if self._epoch_ar != epoch:
+                    # a recovery bumped the epoch while this pull was
+                    # in flight: the payload belongs to the abandoned
+                    # trajectory — holding it would undo the rollback
+                    # prune (the prune runs post-bump under this lock)
+                    return
+                self._hold(self._replicas.setdefault(src, []), s,
+                           meta, payload)
+        seen[base] = seq
+
+    # ----------------------------------------------------------- recovery --
+    def _recover(self, model, cause):
+        """Quiesce -> reshard -> restore -> fast-forward (module
+        docstring).  Raises the original ``cause`` when membership
+        never changes within ``recovery_timeout_s`` (a genuine hang
+        with no detected death must stay a coded failure).
+
+        Cascade-safe: a SECOND death mid-recovery (the quiesce barrier
+        or the plan exchange waits on a rank that just died) surfaces
+        as ``StoreTimeoutError`` from the short-deadline store ops —
+        the attempt is abandoned and retried, preferring a newer
+        generation (where the new corpse is out of the member list)
+        but falling back to the SAME one: two survivors whose staggered
+        observation of near-simultaneous deaths made them miss each
+        other's barrier window must converge without any further
+        membership event.  Re-entry is safe because the recovery
+        barriers are idempotent per-node arrival keys (not counters)
+        and the dead set derives from ``old_members``, the membership
+        at the START of the episode — stable across attempts even when
+        an earlier attempt already adopted the new generation."""
+        deadline = time.monotonic() + self.recovery_timeout_s
+        old_members = list(self._members)
+        gen_floor = self._gen
+        retry = None            # (gen, members) of the abandoned attempt
+        while True:
+            if retry is None:
+                gen, members = self._wait_membership_change(
+                    gen_floor, deadline)
+                if members is None:
+                    raise cause
+            else:
+                gen, members = retry
+                # brief probe for an even newer generation (a cascade
+                # death publishes one); keep the current target when
+                # the change is a flap or a pure addition
+                g2, m2 = self._mgr_elastic.wait_generation(
+                    gen, timeout=0.5)
+                if g2 > gen:
+                    m2s = sorted(m2)
+                    if any(m not in m2s for m in members):
+                        gen, members = g2, m2s
+            if self.node_id not in members:
+                # partitioned out: our heartbeat lapsed and the fleet
+                # moved on — this rank must not keep training on stale
+                # membership
+                self.dead = True
+                self.close()
+                raise _RankDead()
+            try:
+                self._recover_at(model, gen, members, old_members,
+                                 cause)
+                return
+            except StoreTimeoutError:
+                retry = (gen, members)
+                if time.monotonic() > deadline:
+                    raise cause
+
+    def _arrive_barrier(self, name, nodes, tmo):
+        """Idempotent store barrier: arrival is a per-node key, and the
+        wait is for every named node's key.  Unlike a counting barrier,
+        re-entry after an abandoned attempt just re-sets the arrival —
+        a retry can never double-count and release peers early — which
+        is what lets ``_recover`` retry the SAME generation.  A node
+        that never arrives surfaces as ``StoreTimeoutError`` from the
+        short-deadline get (the cascade signal)."""
+        self._bstore.set(f"{name}/{self.node_id}", b"1")
+        deadline = time.monotonic() + tmo
+        for n in nodes:
+            left = max(0.05, deadline - time.monotonic())
+            self._bstore.get(f"{name}/{n}", timeout=left)
+
+    def _recover_at(self, model, gen, members, old_members, cause):
+        """One recovery attempt against generation ``gen``.  Every
+        blocking store op uses a deadline short enough that a cascade
+        (second death) bounces us back to the membership poll instead
+        of eating the whole recovery budget.  ``members`` may include
+        JOINERS (a respawned replacement registering concurrently with
+        the death) — recovery runs over the SURVIVORS of
+        ``old_members``, the training membership when the episode
+        started (stable across retry attempts); joiners cannot reach
+        the quiesce barrier (they have no recovery to run) and cannot
+        partake in the lockstep stream mid-run (see
+        ``_poll_membership``)."""
+        t0 = time.perf_counter()
+        dead = [n for n in old_members if n not in members]
+        survivors = [n for n in old_members if n in members]
+        tmo = max(4.0 * self.hb_timeout, 5.0)
+        self._gens_touched.add(gen)
+        if self._restored_gen == gen:
+            # retry of an attempt that already restored (it timed out
+            # at the release barrier): do NOT re-run the restore — the
+            # holder GCs the restore keys the moment its own release
+            # barrier passes, so a re-fetch could find nothing — just
+            # re-join the release handshake below
+            meta, plan, dead = self._restored_info
+        else:
+            self._emit("elastic.recovering", gen=gen, dead=dead,
+                       survivors=survivors)
+            # 1. quiesce: every survivor reaches a step boundary
+            self._arrive_barrier(f"{_P}/q/{gen}", survivors, tmo)
+            # 2. inventory: what buddy replicas do I hold for the dead?
+            inv = {}
+            for d in dead:
+                i = old_members.index(d)
+                holder = old_members[(i + self.buddy)
+                                     % len(old_members)]
+                if holder == self.node_id:
+                    inv[d] = [s for s, _m, _p
+                              in self._replicas.get(d, [])]
+            self._bstore.set(f"{_P}/inv/{gen}/{self.node_id}",
+                             pickle.dumps(inv))
+            # 3. leader (first survivor) picks the restore source
+            if survivors[0] == self.node_id:
+                plan = self._make_plan(gen, old_members, survivors,
+                                       dead, tmo)
+                self._bstore.set(f"{_P}/plan/{gen}",
+                                 pickle.dumps(plan))
+            plan = pickle.loads(self._bstore.get(f"{_P}/plan/{gen}",
+                                                 timeout=tmo))
+            # 4. restore the dead rank's state (buddy replica / disk)
+            obj, meta = self._execute_plan(plan, gen, tmo)
+            self._apply_payload(model, obj)
+            self._gstep = int(meta["step"])
+            self._consumed = int(meta["consumed"])
+            self._epoch = int(meta.get("epoch", 0))
+            self._sync_cache = None
+            self._restored_gen = gen
+            self._restored_info = (dict(meta), dict(plan), list(dead))
+        self._adopt(gen, survivors)
+        with self._qlock:
+            # a queued pre-crash push dies here (a push already in
+            # flight lands in the old epoch's dead keyspace — the
+            # epoch rides the queue entry)
+            self._pending = None
+        # 5. release: the holder may GC its restore keys once everyone
+        # is done reading them, and the collective-key epoch bumps in
+        # lockstep — rolled-back steps must not reuse pre-crash ar tags
+        self._arrive_barrier(f"{_P}/qd/{gen}", survivors, tmo)
+        self._epoch_ar += 1
+        with self._qlock:
+            # AFTER the epoch bump (the receiver re-checks the epoch
+            # under this lock before holding a pulled replica, so an
+            # in-flight old-epoch pull can't repopulate post-prune):
+            # snapshots beyond the restored step came from the
+            # abandoned (divergent) trajectory and must never serve a
+            # later restore
+            cut = int(meta["step"])
+            self._local[:] = [e for e in self._local if e[0] <= cut]
+            for lst in self._replicas.values():
+                lst[:] = [e for e in lst if e[0] <= cut]
+        for entry in self._pushed:
+            self._drop_pushed(entry)
+        self._pushed = []
+        if plan.get("holder") == self.node_id:
+            base = f"{_P}/restore/{gen}"
+            for i in range(plan.get("nchunks", 0)):
+                try:
+                    self._bstore.delete_key(f"{base}/c{i}")
+                except (ConnectionError, OSError):
+                    pass
+            try:
+                self._bstore.delete_key(f"{base}/meta")
+            except (ConnectionError, OSError):
+                pass
+        self._gc_recovery_keys(gen)
+        ms = (time.perf_counter() - t0) * 1e3
+        reg = self._registry()
+        reg.counter("elastic.recoveries",
+                    "elastic recoveries completed").inc()
+        reg.histogram("elastic.recovery_ms",
+                      "membership-change -> training-resumable wall "
+                      "time").observe(ms)
+        self.last_recovery = {
+            "source": plan["source"], "step": int(meta["step"]),
+            "consumed": int(meta["consumed"]), "dead": dead,
+            "gen": gen, "ms": ms,
+            "cause": type(cause).__name__,
+        }
+        self._emit("elastic.recovered", **{
+            k: v for k, v in self.last_recovery.items() if k != "ms"})
+
+    def _gc_recovery_keys(self, done_gen):
+        """Deferred coordination-key GC (the ``_gc_ar`` pattern): once
+        the recovery at ``done_gen`` completed, no rank can revisit an
+        EARLIER generation's episode (retry targets only move forward,
+        and completion required every survivor to pass this
+        generation's barriers), so each rank deletes its own
+        arrival/inventory keys — and the shared plan plus any restore
+        payload it pushed — for every older generation it touched.
+        The just-completed generation's keys stay until the NEXT
+        completed recovery: a slower peer may still be reading them."""
+        for g in sorted(self._gens_touched):
+            if g >= done_gen:
+                continue
+            for k in (f"{_P}/q/{g}/{self.node_id}",
+                      f"{_P}/qd/{g}/{self.node_id}",
+                      f"{_P}/inv/{g}/{self.node_id}",
+                      f"{_P}/plan/{g}"):
+                try:
+                    self._bstore.delete_key(k)
+                except (ConnectionError, OSError):
+                    pass
+            n = self._restore_pushed.pop(g, 0)
+            base = f"{_P}/restore/{g}"
+            for i in range(n):
+                try:
+                    self._bstore.delete_key(f"{base}/c{i}")
+                except (ConnectionError, OSError):
+                    pass
+            if n:
+                try:
+                    self._bstore.delete_key(f"{base}/meta")
+                except (ConnectionError, OSError):
+                    pass
+            self._gens_touched.discard(g)
+
+    def _wait_membership_change(self, gen_floor, deadline):
+        g = max(gen_floor, self._gen)
+        while time.monotonic() < deadline:
+            gen, members = self._mgr_elastic.wait_generation(
+                g, timeout=1.0)
+            if gen > g:
+                if any(m not in members for m in self._members):
+                    return gen, sorted(members)
+                g = gen  # flap or pure addition: not a death, keep waiting
+        return None, None
+
+    def _make_plan(self, gen, old_members, members, dead, tmo):
+        """Leader: walk dead ranks ascending; the first whose buddy
+        survives AND holds a COMPLETE replica wins.  Only when no buddy
+        replica exists anywhere does the plan fall to the newest
+        COMPLETE on-disk CheckpointManager version."""
+        for d in sorted(dead, key=old_members.index):
+            i = old_members.index(d)
+            holder = old_members[(i + self.buddy) % len(old_members)]
+            if holder not in members:
+                continue  # the buddy died with its ward
+            raw = self._bstore.get(f"{_P}/inv/{gen}/{holder}",
+                                   timeout=tmo)
+            steps = pickle.loads(raw).get(d) or []
+            if steps:
+                return {"source": "buddy", "holder": holder,
+                        "dead": d, "step": max(steps)}
+        if self.mgr is not None:
+            lc = self.mgr.latest_complete()
+            if lc is not None:
+                return {"source": "disk", "step": int(lc[0])}
+        return {"source": "none"}
+
+    def _execute_plan(self, plan, gen, tmo):
+        """Returns ``(payload_obj, position_meta)``; zero disk reads on
+        the buddy path."""
+        if plan["source"] == "buddy":
+            base = f"{_P}/restore/{gen}"
+            held = [e for e in self._replicas.get(plan.get("dead"), [])
+                    if e[0] == plan["step"]] \
+                if plan.get("holder") == self.node_id else []
+            if held:
+                _s, meta, payload = held[0]
+                plan["nchunks"] = self._push_payload(
+                    self._bstore, base, payload, meta)
+                self._restore_pushed[gen] = plan["nchunks"]
+                # publish the chunk count so non-holders' plan copy
+                # matches ours is unnecessary — only the holder GCs
+            else:
+                # non-holder, or a holder whose holding was pruned by
+                # an earlier attempt of this episode: the pushed copy
+                # in the store is the source of truth
+                meta, payload = self._fetch_payload(self._bstore, base,
+                                                    tmo)
+            obj = pickle.loads(payload)
+            return obj, obj["meta"]
+        if plan["source"] == "disk":
+            if self.mgr is None:
+                raise CheckpointNotFoundError(
+                    "elastic recovery: no buddy replica and no "
+                    "CheckpointManager for disk fallback "
+                    f"[{CheckpointNotFoundError.error_code}]")
+            step, objs, meta = self.mgr.load(step=plan["step"])
+            rng_v = objs.get("rng")
+            if rng_v is not None and hasattr(rng_v, "numpy"):
+                rng_v = rng_v.numpy()  # Tensor-shaped; _resilient_save
+                # writes a plain ndarray, which needs no conversion
+            obj = {"model": _to_np(objs.get("model", {})),
+                   "opt": _to_np(objs["opt"]) if "opt" in objs else None,
+                   "rng": (np.asarray(rng_v)
+                           if rng_v is not None else None)}
+            pos = {"step": int(meta.get("global_step", step)),
+                   "consumed": int(meta.get(
+                       "consumed",
+                       int(meta.get("global_step", step))
+                       * max(1, len(self._members)))),
+                   "epoch": int(meta.get("epoch", 0))}
+            return obj, pos
+        raise CheckpointNotFoundError(
+            "elastic recovery: no buddy replica survives and no "
+            "COMPLETE disk checkpoint exists "
+            f"[{CheckpointNotFoundError.error_code}]")
+
+    def _apply_payload(self, model, obj):
+        from ..core import state as core_state
+        from ..core.tensor import Tensor
+        model.network.set_state_dict(
+            {k: Tensor(np.asarray(v)) for k, v in obj["model"].items()})
+        opt = getattr(model, "_optimizer", None)
+        if obj.get("opt") is not None and opt is not None \
+                and hasattr(opt, "set_state_dict"):
+            opt.set_state_dict(obj["opt"])
+        if obj.get("rng") is not None:
+            import jax.numpy as jnp
+            rng = core_state.default_rng
+            if rng._key_var is None:
+                rng.seed(0)
+            rng._key_var._write(jnp.asarray(obj["rng"]))
+        # a captured train step holds its state by IDENTITY — the
+        # restore above may have replaced accumulator tensors and
+        # dissolved fused-optimizer flat buckets (set_state_dict
+        # defuses; buckets rebuild at the next EAGER step, which a
+        # cached program never runs).  Replaying a stale program would
+        # keep training the orphaned bucket storage while the restored
+        # tensors sit frozen: drop the compiled-step caches so the
+        # first post-recovery batch re-discovers over restored state.
+        if hasattr(model, "_reset_compiled_steps"):
+            model._reset_compiled_steps()

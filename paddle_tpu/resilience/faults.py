@@ -62,6 +62,27 @@ sleeps or randomness:
   injected into the stalled dispatch; co-residents complete bitwise
   on the re-dispatch. Key = dispatch kind (``mixed``/``decode``/
   ``window``/``verify``).
+* ``rank_dead``          — an elastic-training rank
+  (``resilience/elastic_train.py`` ``FleetSupervisor``) dies at a
+  step boundary: heartbeats stop, its collective contribution never
+  arrives — survivors get ``CollectiveTimeoutError`` (PDT-E021),
+  the membership generation bumps, and recovery restores the dead
+  rank's state from its buddy's in-memory replica. Key = the rank.
+* ``slow_rank``          — one elastic rank stalls ``slow_rank_s``
+  before contributing (a straggler, NOT a death): heartbeats keep
+  flowing and peers absorb the wait inside ``collective_timeout_ms``
+  — NO recovery triggers (detector vs straggler separation). Key =
+  the rank.
+* ``store_partition``    — one supervisor-level store operation
+  (snapshot replication push) raises ``InjectedConnectionError`` per
+  firing; absorbed by the supervisor's bounded retry; past the
+  budget that snapshot generation is skipped (training continues,
+  ``elastic.snapshot_push_failures`` moves). Key = the node id.
+* ``snapshot_torn``      — a buddy-snapshot replica transfer writes
+  half of one chunk's bytes while the manifest records the full
+  size/CRC: the receiving buddy's validation rejects the generation
+  and keeps the previous COMPLETE one, which recovery then restores.
+  Key = the source rank.
 
 Spec grammar (``;``-separated rules)::
 

@@ -58,6 +58,11 @@ _TIER1_ORDER = [
     "test_tensor.py", "test_geometric_namespaces.py",
     "test_optimizer.py", "test_optimizer_fused.py",
     "test_control_flow.py", "test_resilience.py",
+    # ISSUE-15 acceptance: elastic recovery drills (buddy restore loss
+    # parity, PDT-E021 flight dump, store-key GC) — tiny-model thread
+    # fleets over loopback TCPStores, ~2 min wall dominated by the
+    # deliberate heartbeat/collective deadlines
+    "test_elastic_train.py",
     "test_dist_checkpoint.py", "test_dy2static.py",
     "test_text_audio.py", "test_datasets_transforms_breadth.py",
     "test_autotune.py", "test_nn.py",

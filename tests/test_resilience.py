@@ -6,6 +6,7 @@ non-finite step guard, and preemption -> checkpoint ->
 manager + checkpoint manifests (SURVEY D23)."""
 import os
 import shutil
+import time
 import warnings
 
 import numpy as np
@@ -295,6 +296,57 @@ def test_manager_torn_version_falls_back(tmp_path):
     assert step == 20
     np.testing.assert_array_equal(
         np.asarray(objs["state"]["v"]._read()), np.full((3,), 21.0))
+
+
+def test_manager_gc_sweeps_orphaned_tmp_files(tmp_path):
+    """ISSUE 15 satellite: keep-last-K GC also sweeps orphaned
+    ``atomic_write`` temp files (a crash mid-commit — the injected
+    ``torn_write`` included — strands ``.<name>.tmp.<pid>``), age-gated
+    so a LIVE writer's seconds-old temp is never touched.  Repeated
+    crash/resume cycles must not accumulate garbage the version-level
+    GC can't see."""
+    mgr = rs.CheckpointManager(tmp_path / "ck", keep_last_k=2,
+                               tmp_ttl_s=3600.0)
+    _mgr_save(mgr, 10, 10)
+    # a real crash mid-save: torn_write leaves the temp behind
+    faults.inject("torn_write", "*step_20*")
+    with pytest.raises(faults.InjectedCrash):
+        _mgr_save(mgr, 20, 20)
+    root = str(tmp_path / "ck")
+
+    def tmps():
+        out = []
+        for d, _dirs, names in os.walk(root):
+            out += [os.path.join(d, n) for n in names
+                    if n.startswith(".") and ".tmp." in n]
+        return out
+
+    orphans = tmps()
+    assert orphans, "torn_write should strand a temp file"
+    # age the orphans past the TTL; plant a FRESH one (another process
+    # mid-save into the same root) that must survive the sweep
+    old = time.time() - 7200
+    for p in orphans:
+        os.utime(p, (old, old))
+    fresh = os.path.join(root, ".live.pdparams.tmp.99999")
+    with open(fresh, "wb") as f:
+        f.write(b"x")
+    _mgr_save(mgr, 30, 30)  # save -> gc -> sweep
+    left = tmps()
+    assert fresh in left, "a fresh temp (live writer) was swept"
+    assert left == [fresh], f"aged orphans survived: {left}"
+    # crash/resume cycles stay garbage-free: another torn attempt, aged,
+    # swept by the next complete version
+    faults.inject("torn_write", "*step_40*")
+    with pytest.raises(faults.InjectedCrash):
+        _mgr_save(mgr, 40, 40)
+    for p in tmps():
+        if p != fresh:
+            os.utime(p, (old, old))
+    _mgr_save(mgr, 50, 50)
+    assert tmps() == [fresh]
+    step, _objs, _meta = mgr.load()
+    assert step == 50
 
 
 def test_manager_explicit_step_and_empty(tmp_path):
