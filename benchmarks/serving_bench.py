@@ -298,6 +298,11 @@ def measure():
     rng = np.random.default_rng(0)
     rows = {}
 
+    # whole-program audit bookkeeping (ISSUE 16): count findings only
+    # from the serving programs this bench compiles
+    from paddle_tpu import analysis as _analysis
+    _analysis.audit_counts(reset=True)
+
     def finish(name, row, batch, prompt_len, new_tokens, window,
                n_dispatch):
         rl = roofline_ms(cfg, model, batch, prompt_len, new_tokens, gbps)
@@ -359,6 +364,10 @@ def measure():
     rows["tp2"] = _measure_tp(cfg, model, gbps, 2)
     rows["tp4"] = _measure_tp(cfg, model, gbps, 4)
     rows["disagg"] = _measure_disagg(cfg, model)
+    # per-code finding counts from every serving program compiled above
+    # (engine caches, decode windows, TP wrappers); the regression
+    # sentinel judges PDT* leaves lower-is-better
+    rows["analysis"] = {"findings": _analysis.audit_counts()}
     return rows
 
 

@@ -33,18 +33,26 @@ from .registry import (  # noqa: E402,F401
     REGISTRY, CheckSpec, Diagnostic, Severity, pragma_suppressed,
     register, register_runtime, spec, suppress)
 from . import ast_checks  # noqa: E402,F401  (registers PDT1xx)
-from . import ir_checks   # noqa: E402,F401  (registers PDT2xx)
+from . import ir_checks   # noqa: E402,F401  (registers PDT20x)
+from . import program     # noqa: E402,F401  (registers PDT22x/23x/24x)
 from .engine import (  # noqa: E402,F401
     LintWarning, analyze_file, analyze_source, check_executable,
     check_function, check_jaxpr, check_traced, collect, exercise,
     lint_callable, lint_executable, mode, report, report_runtime,
     reset_reported)
+from .program import (  # noqa: E402,F401
+    AuditResult, CollectiveOp, audit_counts, audit_executable,
+    audit_jaxpr, audit_jitted, collective_schedule, live_ranges,
+    schedule_hash, static_peak_bytes, verify_schedule)
 
 __all__ = [
-    "REGISTRY", "CheckSpec", "Diagnostic", "Severity", "LintWarning",
-    "analyze_file", "analyze_source", "check_executable",
-    "check_function", "check_jaxpr", "check_traced", "collect",
-    "exercise", "lint_callable", "lint_executable", "mode",
-    "pragma_suppressed", "register", "register_runtime", "report",
-    "report_runtime", "reset_reported", "spec", "suppress",
+    "REGISTRY", "AuditResult", "CheckSpec", "CollectiveOp", "Diagnostic",
+    "Severity", "LintWarning", "analyze_file", "analyze_source",
+    "audit_counts", "audit_executable", "audit_jaxpr", "audit_jitted",
+    "check_executable", "check_function", "check_jaxpr", "check_traced",
+    "collect", "collective_schedule", "exercise", "lint_callable",
+    "lint_executable", "live_ranges", "mode", "pragma_suppressed",
+    "register", "register_runtime", "report", "report_runtime",
+    "reset_reported", "schedule_hash", "spec", "static_peak_bytes",
+    "suppress", "verify_schedule",
 ]

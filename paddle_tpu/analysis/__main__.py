@@ -1,20 +1,31 @@
 """CLI: ``python -m paddle_tpu.analysis <paths...>`` — repo-wide graph
-lint over the AST front-end.
+lint (AST front-end) and whole-program audit (IR front-end).
 
 Walks ``.py`` files, lints every ``to_static``-decorated function (every
 function under ``--assume-jit``), prints findings as
-``file:line:col: CODE [severity] message``, and exits non-zero when any
-finding reaches the gate severity (``error`` by default, ``warn`` under
-``--strict``). ``--list-codes`` prints the registry catalog.
+``file:line:col: CODE [severity] message``, and exits with a stable
+code: **0** no gating findings, **1** findings at or above the gate
+severity (``error`` by default, ``warn`` under ``--strict``), **2**
+usage or import error. ``--format json`` emits machine-readable
+findings for CI/editors. ``--programs mod:callable`` imports and runs
+an entry point, collecting the compile-time whole-program audit
+findings (PDT2xx) from every program it compiles. ``--list-codes``
+prints the registry catalog (``--format markdown`` renders the README
+code table).
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
 from .engine import analyze_file
-from .registry import REGISTRY, Severity
+from .registry import REGISTRY, Diagnostic, Severity
+
+EXIT_CLEAN = 0     # no gating findings
+EXIT_FINDINGS = 1  # findings at/above the gate severity
+EXIT_USAGE = 2     # bad invocation / unreadable input / import failure
 
 
 def _iter_py_files(paths):
@@ -33,19 +44,90 @@ def _iter_py_files(paths):
             print(f"warning: no such path: {p}", file=sys.stderr)
 
 
-def _list_codes() -> int:
+def _one_line(doc: str) -> str:
+    return " ".join(doc.split())
+
+
+def code_table_markdown() -> str:
+    """The registry rendered as a markdown table — the single source
+    for the README "Static analysis" code table (a doc test keeps the
+    README block in sync with this output)."""
+    rows = ["| code | name | severity | front-end | flags |",
+            "|------|------|----------|-----------|-------|"]
+    for code in sorted(REGISTRY):
+        s = REGISTRY[code]
+        summary = _one_line(s.doc).split(". ")[0].rstrip(".")
+        summary = summary.replace("|", "\\|")
+        rows.append(f"| {code} | {s.name} | {s.severity} | "
+                    f"{s.frontend} | {summary}. |")
+    return "\n".join(rows)
+
+
+def _list_codes(fmt: str) -> int:
+    if fmt == "markdown":
+        print(code_table_markdown())
+        return EXIT_CLEAN
+    if fmt == "json":
+        print(json.dumps({
+            code: {"name": s.name, "severity": str(s.severity),
+                   "frontend": s.frontend, "doc": _one_line(s.doc)}
+            for code, s in sorted(REGISTRY.items())}, indent=2))
+        return EXIT_CLEAN
     for code in sorted(REGISTRY):
         s = REGISTRY[code]
         print(f"{code}  {s.name:<32} {str(s.severity):<5} [{s.frontend}]")
-        doc = " ".join(s.doc.split())
-        print(f"        {doc}")
-    return 0
+        print(f"        {_one_line(s.doc)}")
+    return EXIT_CLEAN
+
+
+def _run_programs(entries) -> tuple[list[Diagnostic], int]:
+    """Import and call each ``module:callable`` entry under a collect
+    sink; the compile-time whole-program audits (jit capture, engine
+    program caches, pipeline dispatch) report into it. Returns the
+    findings and an exit code (EXIT_USAGE on import/call failure)."""
+    import importlib
+
+    from . import collect
+
+    diags: list[Diagnostic] = []
+    for entry in entries:
+        mod_name, _, attr = entry.partition(":")
+        try:
+            mod = importlib.import_module(mod_name)
+            fn = getattr(mod, attr) if attr else None
+        except (ImportError, AttributeError) as e:
+            print(f"error: cannot load --programs entry {entry!r}: {e}",
+                  file=sys.stderr)
+            return diags, EXIT_USAGE
+        try:
+            with collect() as sink:
+                if fn is not None:
+                    fn()
+            diags.extend(sink)
+        except Exception as e:
+            print(f"error: --programs entry {entry!r} raised "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return diags, EXIT_USAGE
+    return diags, EXIT_CLEAN
+
+
+def _emit_json(diags, n_files, counts, gating) -> None:
+    print(json.dumps({
+        "findings": [
+            {"path": d.file, "line": d.line, "col": d.col,
+             "code": d.code, "severity": str(d.severity),
+             "message": d.message} for d in diags],
+        "summary": {"files": n_files,
+                    "error": counts[Severity.ERROR],
+                    "warn": counts[Severity.WARN],
+                    "note": counts[Severity.NOTE],
+                    "gating": gating}}, indent=2))
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
-        description="paddle_tpu graph lint (AST front-end)")
+        description="paddle_tpu graph lint & whole-program audit")
     ap.add_argument("paths", nargs="*", help=".py files or directories")
     ap.add_argument("--assume-jit", action="store_true",
                     help="lint every function, not only @to_static ones")
@@ -53,6 +135,13 @@ def main(argv=None) -> int:
                     help="exit non-zero on warn-severity findings too")
     ap.add_argument("--select", default="",
                     help="comma-separated codes to restrict to")
+    ap.add_argument("--format", default="text",
+                    choices=("text", "json", "markdown"),
+                    help="output format (markdown: --list-codes only)")
+    ap.add_argument("--programs", action="append", default=[],
+                    metavar="MODULE:CALLABLE",
+                    help="import and run an entry point, auditing every "
+                         "program it compiles (repeatable)")
     ap.add_argument("--list-codes", action="store_true",
                     help="print the diagnostic catalog and exit")
     ap.add_argument("-q", "--quiet", action="store_true",
@@ -60,35 +149,53 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.list_codes:
-        return _list_codes()
-    if not args.paths:
-        ap.error("no paths given (or use --list-codes)")
+        return _list_codes(args.format)
+    if not args.paths and not args.programs:
+        ap.error("no paths given (or use --programs / --list-codes)")
 
     select = {c.strip().upper() for c in args.select.split(",") if c.strip()}
     gate = Severity.WARN if args.strict else Severity.ERROR
     n_files = 0
     counts = {Severity.NOTE: 0, Severity.WARN: 0, Severity.ERROR: 0}
     gating = 0
+    kept: list[Diagnostic] = []
+
+    all_diags: list[tuple[str, list[Diagnostic]]] = []
     for path in _iter_py_files(args.paths):
         n_files += 1
         try:
-            diags = analyze_file(path, force_jit=args.assume_jit)
+            all_diags.append((path, analyze_file(
+                path, force_jit=args.assume_jit)))
         except OSError as e:
             print(f"warning: cannot read {path}: {e}", file=sys.stderr)
             continue
+
+    rc_programs = EXIT_CLEAN
+    if args.programs:
+        prog_diags, rc_programs = _run_programs(args.programs)
+        all_diags.append(("<programs>", prog_diags))
+
+    for _, diags in all_diags:
         for d in diags:
             if select and d.code not in select:
                 continue
             counts[d.severity] += 1
             if d.severity >= gate:
                 gating += 1
-            if not args.quiet:
+            kept.append(d)
+            if not args.quiet and args.format == "text":
                 print(d.format())
-    total = sum(counts.values())
-    print(f"{total} finding(s) ({counts[Severity.ERROR]} error, "
-          f"{counts[Severity.WARN]} warn, {counts[Severity.NOTE]} note) "
-          f"in {n_files} file(s)")
-    return 1 if gating else 0
+
+    if args.format == "json":
+        _emit_json(kept, n_files, counts, gating)
+    else:
+        total = sum(counts.values())
+        print(f"{total} finding(s) ({counts[Severity.ERROR]} error, "
+              f"{counts[Severity.WARN]} warn, {counts[Severity.NOTE]} "
+              f"note) in {n_files} file(s)")
+    if rc_programs != EXIT_CLEAN:
+        return rc_programs
+    return EXIT_FINDINGS if gating else EXIT_CLEAN
 
 
 if __name__ == "__main__":

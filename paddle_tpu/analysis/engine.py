@@ -143,6 +143,27 @@ def _decorator_suppressions(fndef):
     return out
 
 
+def _span_suppressed(lines: list[str], lo: int, hi: int, code: str) -> bool:
+    """True when any line of the 1-based inclusive span ``lo..hi``
+    carries a noqa pragma covering ``code``. Suppression anchors to the
+    STATEMENT's full line span, not a single line — a pragma anywhere on
+    a decorated def (decorator lines included) or a multiline statement
+    suppresses findings anchored anywhere in it."""
+    lo = max(1, lo)
+    hi = min(len(lines), hi)
+    return any(pragma_suppressed(lines[i - 1], code)
+               for i in range(lo, hi + 1))
+
+
+def _def_span(fndef) -> tuple[int, int]:
+    """Line span of a function's HEADER: first decorator line through
+    the end of the signature (the line before the first body
+    statement). A pragma anywhere in it opts the whole function out."""
+    lo = min([d.lineno for d in fndef.decorator_list] + [fndef.lineno])
+    hi = fndef.body[0].lineno - 1 if fndef.body else fndef.lineno
+    return lo, max(lo, hi)
+
+
 def analyze_source(source: str, filename: str = "<string>", *,
                    force_jit: bool = False, line_offset: int = 0,
                    extra_suppress: frozenset = frozenset()
@@ -164,8 +185,7 @@ def analyze_source(source: str, filename: str = "<string>", *,
     for fndef, decorated, in_jit in _iter_functions(tree, force_jit):
         ctx = _AstCtx(filename=filename, lines=lines,
                       line_offset=line_offset, decorated=decorated)
-        def_line = lines[fndef.lineno - 1] if fndef.lineno <= len(lines) \
-            else ""
+        def_lo, def_hi = _def_span(fndef)
         dec_sup = _decorator_suppressions(fndef)
         if dec_sup is None:
             continue  # bare @suppress(): whole function opted out
@@ -183,9 +203,11 @@ def analyze_source(source: str, filename: str = "<string>", *,
                 if key in seen:
                     continue
                 seen.add(key)
-                src_line = lines[rel - 1] if 0 < rel <= len(lines) else ""
-                if pragma_suppressed(src_line, spec.code) or \
-                        pragma_suppressed(def_line, spec.code):
+                end = getattr(node, "end_lineno", None) or rel
+                if _span_suppressed(lines, rel, max(rel, end),
+                                    spec.code) or \
+                        _span_suppressed(lines, def_lo, def_hi,
+                                         spec.code):
                     continue
                 out.append(Diagnostic(
                     code=spec.code, severity=spec.severity,

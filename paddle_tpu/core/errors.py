@@ -189,6 +189,22 @@ class StoreTimeoutError(EnforceNotMet, TimeoutError):
     error_code = "PDT-E022"
 
 
+class CollectiveScheduleError(EnforceNotMet):
+    """Ranks disagree on the collective schedule for the upcoming
+    session: the whole-program analyzer (``analysis/program.py``)
+    hashed each rank's ordered collective schedule — every psum /
+    ppermute / all_gather with axis, shape and dtype — and the
+    store-backed cross-check at group setup (``verify_schedule``) found
+    a mismatch.  Raised *before* the first collective is issued, so the
+    divergence fails fast and coded instead of hanging every rank until
+    the PDT-E021 watchdog timeout mid-step.  Usual causes: a
+    rank-dependent branch around a collective (PDT221 flags the static
+    form), or config skew between nodes (different bucket sizes,
+    gradient-sync settings, or model shapes)."""
+
+    error_code = "PDT-E023"
+
+
 def enforce(cond: bool, msg: str, exc=InvalidArgumentError):
     """PADDLE_ENFORCE: raise ``exc`` with ``msg`` unless ``cond``."""
     if not cond:

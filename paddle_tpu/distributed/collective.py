@@ -86,6 +86,11 @@ class Group:
                 lambda a, _ax=ax, _n=n: jax.lax.psum(a, _ax) / _n,
                 mesh=self.mesh, in_specs=P(), out_specs=P()))
             self._psum_mean_fn = f
+            # whole-program audit (collective schedule etc.) once per
+            # group program, at the call that first compiles it
+            from .. import analysis as _analysis
+            _analysis.audit_jitted(f, (flat,),
+                                   where=f"collective.psum_mean.g{self.id}")
         with _tracing.span("collective.psum_mean", group=self.id,
                            nranks=self.nranks,
                            size=int(getattr(flat, "size", 0))), \

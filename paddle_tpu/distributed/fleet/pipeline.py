@@ -248,6 +248,20 @@ class PipelinedBlocks(Layer):
         tp = getattr(self, "_tp_axis", None)
         return frozenset(n for n in names if n != tp)
 
+    def _audit_impl(self, name, impl, args):
+        """Whole-program audit (analysis/program.py) of a pipeline
+        shard_map body: the ppermute ring + psum schedule is exactly
+        what PDT22x reasons about. Once per (pipeline, schedule name),
+        at the dispatch that first compiles it — compile-time only."""
+        done = self.__dict__.setdefault("_pp_audit_done", set())
+        if name in done:
+            return
+        done.add(name)
+        from ... import analysis as _analysis
+        from ...core.tensor import Tensor as _T
+        vals = tuple(a._read() if isinstance(a, _T) else a for a in args)
+        _analysis.audit_jitted(impl, vals, where=f"pipeline.{name}")
+
     # -- the schedules -------------------------------------------------
     def forward(self, x, batch_axes=None):
         if self._mesh is None:
@@ -348,6 +362,8 @@ class PipelinedBlocks(Layer):
         with _tracing.span("pp.forward", stages=pp, microbatches=M,
                            overlap_p2p=_overlap_p2p()), \
                 _watchdog.arm_collective("pp.forward", key=self.pp_axis):
+            self._audit_impl("pipelined_blocks", impl,
+                             (x, *leaf_tensors))
             return apply("pipelined_blocks", impl, x, *leaf_tensors)
 
     def _forward_interleaved(self, x, batch_axes=None):
@@ -447,6 +463,7 @@ class PipelinedBlocks(Layer):
                              )(xm, *leaves)
             return out.reshape((b,) + xv.shape[1:])
 
+        self._audit_impl("pipelined_blocks_vpp", impl, (x, *leaf_tensors))
         return apply("pipelined_blocks_vpp", impl, x, *leaf_tensors)
 
     def train_batch(self, x, target, loss_fn, batch_axes=None,
@@ -686,6 +703,8 @@ class PipelinedBlocks(Layer):
                            overlap_p2p=_overlap_p2p()), \
                 _watchdog.arm_collective("pp.train_batch",
                                          key=self.pp_axis):
+            self._audit_impl("pipeline_1f1b", impl,
+                             (x, target, *leaf_tensors, *post_params))
             return apply("pipeline_1f1b", impl, x, target,
                          *leaf_tensors, *post_params)
 

@@ -176,6 +176,11 @@ class DisaggServer:
     side without a handoff; prefill-side failures/timeouts surface as
     final results.  ``engine_decode_worker_lost`` requeues to the
     prefill group (bitwise re-prefill).
+
+    Both groups inherit the engine's compile-time program audit: every
+    cached program (import scatter, decode windows, TP wrappers) runs
+    through the whole-program jaxpr analyzer once per geometry at
+    first compile (``analysis/program.py``; ``PDTPU_ANALYSIS``-gated).
     """
 
     def __init__(self, model, *, prefill_workers=None,

@@ -217,6 +217,16 @@ kwargs / ``serving_tp`` flag; ``inference/distserve.py``):
   prefill->handoff->decode pipeline on top, with
   ``engine_handoff_transient`` / ``engine_decode_worker_lost`` drills
   and per-handoff spans/metrics.
+
+Compile-time program audit (ISSUE 16; ``analysis/program.py``):
+
+* Every program the engine caches — the import scatter, COW page
+  copy, decode windows, TP wrappers — is audited ONCE per (name,
+  geometry) by the whole-program jaxpr analyzer at first compile
+  (collective schedule consistency, donation/live-range HBM,
+  recompile risk; see ``_audit_program``).  Gated by
+  ``PDTPU_ANALYSIS`` (off = zero work) and never on the dispatch
+  path.
 """
 from __future__ import annotations
 
@@ -955,6 +965,11 @@ class ContinuousBatchingEngine:
         if take.any():       # a full prefix-cache hit scatters nothing
             fn = self._get_import_fn()
             vals = [c._read() for c in self._caches]
+            self._audit_program(
+                "import", fn,
+                (jnp.asarray(idx), *vals,
+                 *[jnp.asarray(p) for p in pads]),
+                donated=tuple(range(1, 1 + len(vals))))
 
             def _import_call():
                 if any(getattr(v, "is_deleted", lambda: False)()
@@ -1394,6 +1409,26 @@ class ContinuousBatchingEngine:
                 self.total_pages, self.token_budget, self.q_block,
                 self.pages_per_block, self.kv_quant, tp_key)
 
+    def _audit_program(self, name, fn, args, donated=()):
+        """Whole-program audit (analysis/program.py) of a raw-jitted
+        serving program: collective schedule, donation/HBM, recompile
+        risk. Once per (program, geometry) — the audit runs at the
+        dispatch that first compiles the program and never again, so
+        steady-state dispatches do zero analysis work. The to_static
+        programs (mixed/decode steps) are audited by the jit capture
+        itself; this covers the ``jax.jit`` sites that bypass it."""
+        from .. import analysis as _analysis
+        if _analysis.mode() == "off":
+            return
+        done = self.model.__dict__.setdefault("_serving_audit_done",
+                                              set())
+        key = (name,) + self._geometry()
+        if key in done:
+            return
+        done.add(key)
+        _analysis.audit_jitted(fn, args, where=f"engine.{name}",
+                               donated=donated)
+
     # ------------------------------------------- copy-on-write --------
     def _get_cow_fn(self):
         if self._cow_fn is None:
@@ -1421,6 +1456,11 @@ class ContinuousBatchingEngine:
         written, so the recompute that follows stays bitwise."""
         fn = self._get_cow_fn()
         vals = [c._read() for c in self._caches]
+        self._audit_program(
+            "cow", fn,
+            (jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32),
+             *vals),
+            donated=tuple(range(2, 2 + len(vals))))
 
         def _cow_call():
             # donated inputs: only retry while they are still alive
@@ -1446,14 +1486,16 @@ class ContinuousBatchingEngine:
     # pools); these adapters give them the SAME call surface as the
     # to_static-compiled single-device programs — Tensors in, Tensors
     # out — so _run_mixed/_run_spec need no TP branch of their own
-    def _tp_wrap(self, jitted):
+    def _tp_wrap(self, jitted, name="tp"):
         tpp = self._tpp
         n_caches = len(self._caches)
 
         def call(*args):
             vals = [a._read() for a in args]
             n_data = len(vals) - n_caches
-            outs = jitted(*vals[:n_data], *tpp.vals, *vals[n_data:])
+            full = (*vals[:n_data], *tpp.vals, *vals[n_data:])
+            self._audit_program(name, jitted, full)
+            outs = jitted(*full)
             return tuple(Tensor(o) for o in outs)
 
         return call
@@ -1468,7 +1510,8 @@ class ContinuousBatchingEngine:
             from ..models.generation import make_tp_mixed
             self._mixed_fn = self._tp_wrap(make_tp_mixed(
                 self.model, self._tpp, self._jmesh, self.q_block,
-                self.pages_per_block, len(self._caches)))
+                self.pages_per_block, len(self._caches)),
+                name="tp_mixed")
             self._program_cache()[("mixed", "guard")
                                   + self._geometry()] = self._mixed_fn
         if self._mixed_fn is None:
@@ -1642,7 +1685,8 @@ class ContinuousBatchingEngine:
             from ..models.generation import make_tp_spec
             self._spec_fn = self._tp_wrap(make_tp_spec(
                 self.model, self._tpp, self._jmesh, self.q_block,
-                self.pages_per_block, len(self._caches), need_lg))
+                self.pages_per_block, len(self._caches), need_lg),
+                name="tp_spec")
             cache[key] = self._spec_fn
         if self._spec_fn is None:
             from .. import jit as jit_mod
@@ -1974,6 +2018,12 @@ class ContinuousBatchingEngine:
         const_state = [capt[i]._read() for i in const_idx]
         poison = self._guard.poison(rids)
         runner = self._get_window_runner(K)
+        self._audit_program(
+            ("window", K), runner,
+            (jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(fin),
+             jnp.asarray(np.zeros(self.max_slots, bool)),
+             jnp.asarray(eos), jnp.asarray(stop), jnp.asarray(poison),
+             jnp.asarray(self._bt), cache_vals, cstate, const_state))
         donated = cache_vals + cstate    # runner donate_argnums=(8, 9)
 
         def _window_call():
@@ -2053,6 +2103,12 @@ class ContinuousBatchingEngine:
         runner = self._get_tp_window(K)
         cache_vals = [c._read() for c in self._caches]
         poison = self._guard.poison(rids)
+        self._audit_program(
+            ("tpwin", K), runner,
+            (jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(fin),
+             jnp.asarray(np.zeros(self.max_slots, bool)),
+             jnp.asarray(eos), jnp.asarray(stop), jnp.asarray(poison),
+             jnp.asarray(self._bt), *self._tpp.vals, *cache_vals))
 
         def _window_call():
             if any(getattr(v, "is_deleted", lambda: False)()

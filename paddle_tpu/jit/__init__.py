@@ -23,6 +23,14 @@ How it works (see also ``core/tensor.py`` ``_tracker``):
 3. Cached invocations read the current values of the captured input tensors,
    run the compiled program, and write state outputs back — no Python op
    dispatch at all in steady state.
+
+Every capture is audited ONCE by the whole-program jaxpr analyzer
+(``analysis/program.py``: collective-schedule consistency, donation/
+live-range HBM with a static peak estimate surfaced as the
+``hbm.static_peak_bytes{fn}`` gauge, recompile risk — the cache also
+reports PDT242 when >= 3 variants differ only in input shapes) before
+the jaxpr is released; gated by ``PDTPU_ANALYSIS``, zero per-dispatch
+work.
 """
 from __future__ import annotations
 
@@ -76,6 +84,19 @@ def _jax_live_bytes():
                    for a in jax.live_arrays()))
 
 
+def _static_peak_bytes(fn_name):
+    """Largest static peak-HBM estimate among live executables of
+    ``fn_name`` (stamped by ``analysis.audit_executable`` at capture;
+    max, not sum — each executable is one program's peak, and shape
+    variants of one function share buffers across dispatches)."""
+    peak = 0
+    for exe in list(_live_executables):
+        if getattr(exe, "_fn_name", None) != fn_name:
+            continue
+        peak = max(peak, int(getattr(exe, "static_peak_bytes", 0) or 0))
+    return peak
+
+
 def _register_hbm_gauges(fn_name):
     """Lazy HBM-accounting gauges in the default registry: one
     ``hbm.program_state_bytes`` series per compiled-function name (the
@@ -93,6 +114,12 @@ def _register_hbm_gauges(fn_name):
     reg.gauge("hbm.live_bytes",
               "process-total live jax array bytes (lazy)"
               ).set_function(_jax_live_bytes)
+    reg.gauge("hbm.static_peak_bytes", labels={"fn": str(fn_name)},
+              help="static peak-HBM estimate from the whole-program "
+                   "audit's live-range sweep (analysis/program.py; "
+                   "compare against the measured program_state_bytes)"
+              ).set_function(lambda n=str(fn_name):
+                             _static_peak_bytes(n))
 
 
 def _note_retrace(exe, sig):
@@ -627,17 +654,50 @@ class StaticFunction:
                     f"({type(e).__name__}: {e})")
             return out
         self._fallback_counts.pop(key, None)
-        # post-capture IR lint (PDT2xx) over the traced program. Runs
-        # BEFORE caching: under PDTPU_ANALYSIS=error a blocking finding
-        # leaves the key uncached, so every call re-captures and raises
-        # again until the finding is fixed or suppressed. The jaxpr is
-        # only needed here — release it so cached executables of large
-        # models don't pin the whole trace for the process lifetime.
+        # post-capture whole-program audit (PDT2xx: collective
+        # consistency, donation/HBM with the static peak estimate,
+        # recompile risk) over the traced program. Runs BEFORE caching:
+        # under PDTPU_ANALYSIS=error a blocking finding leaves the key
+        # uncached, so every call re-captures and raises again until the
+        # finding is fixed or suppressed. The jaxpr is only needed here
+        # — release it so cached executables of large models don't pin
+        # the whole trace for the process lifetime (the audit stashes
+        # ``static_peak_bytes``/``schedule_hash`` on the exe first).
         from .. import analysis as _analysis
-        _analysis.lint_executable(exe, where=self.__name__, fn=self.fn)
+        _analysis.audit_executable(exe, where=self.__name__, fn=self.fn)
         exe.jaxpr = None
         self._cache[key] = exe
+        self._check_shape_fork(key)
         return out  # discovery pass already produced step-0 results
+
+    def _check_shape_fork(self, key):
+        """PDT242: >= SHAPE_FORK_LIMIT cached variants differing ONLY in
+        input shapes means a length/batch/table is baked as a static
+        dim — every new size recompiles. Compile-time-only work (runs
+        once per new cache entry) sharing the ``compile.retrace`` cause
+        vocabulary with the runtime classifier."""
+        from ..analysis import program as _program
+        try:
+            stripped = _program.strip_shapes(key)
+            variants = sum(1 for k in self._cache
+                           if _program.strip_shapes(k) == stripped)
+        except Exception:
+            return
+        if variants < _program.SHAPE_FORK_LIMIT:
+            return
+        from .. import analysis as _analysis
+        cause = (f"shape-as-data: {variants} compiled variants of "
+                 f"{self.__name__} differ only in input shapes")
+        _analysis.report_runtime(
+            "PDT242",
+            f"{cause} — a traced length/table is baked as a static dim "
+            f"(every new size recompiles); pad to bucketed shapes or "
+            f"pass the length as data", file=f"<jit:{self.__name__}>")
+        from ..observability import events as _events
+        from ..observability import metrics as _obs
+        if _obs.enabled():
+            _events.emit("compile.retrace", fn=self.__name__,
+                         count=int(variants), cause=cause)
 
     def concrete_program(self, *args, **kwargs):
         return self._cache.get(self._cache_key(args, kwargs))

@@ -113,6 +113,16 @@ def _run_window(exe, runner, stacks, per_step_idx=(), per_step_vals=()):
     from . import _state_write
     carry_vals = [capt[i]._read() for i in carry_idx]
     const_vals = [capt[i]._read() for i in const_idx]
+    # whole-program audit of the window once per runner (compile-time
+    # only; make_jaxpr does not consume the soon-to-be-donated carry)
+    audited = exe.__dict__.setdefault("_window_audit_done", set())
+    if id(runner) not in audited:
+        audited.add(id(runner))
+        from .. import analysis as _analysis
+        _analysis.audit_jitted(
+            runner,
+            (carry_vals, const_vals, tuple(per_step_vals)) + tuple(stacks),
+            where=f"multi_step.{getattr(exe, '_fn_name', 'window')}")
     final_carry, rets = runner(carry_vals, const_vals,
                                tuple(per_step_vals), *stacks)
     for i, v in zip(carry_idx, final_carry):

@@ -967,6 +967,14 @@ def _run_decode_windows(exe, out, t, remaining, decode_window,
     if runner is None:
         runner = _make_decode_window(exe, K, temperature, top_p, has_eos)
         runners[rkey] = runner
+        # whole-program audit once per window program (compile time
+        # only; tracing does not consume the donated cache buffers)
+        from .. import analysis as _analysis
+        _analysis.audit_jitted(
+            runner,
+            (tok, pos, cache_vals, cstate, const_state, fin, eos_id,
+             key),
+            where=f"decode_window.{getattr(exe, '_fn_name', 'step')}")
     while remaining > 0:
         toks, tok, pos, cache_vals, cstate, fin, key = runner(
             tok, pos, cache_vals, cstate, const_state, fin, eos_id, key)

@@ -65,7 +65,7 @@ _SKIP_KEYS = frozenset((
 
 _LOWER_BETTER_RE = re.compile(
     r"(_ms$|_ms_|ms_per|_s$|time|latency|overhead|retrace|"
-    r"pages_leaked|spread|burn|loss)")
+    r"pages_leaked|spread|burn|loss|^PDT\d)")
 
 
 def lower_is_better(name: str) -> bool:

@@ -368,6 +368,7 @@ class FleetSupervisor:
         fit_kw.pop("shuffle", None)
         if not self._members:
             self._join()
+        self._verify_schedule(model)
         try:
             while True:
                 shard = _shard_view(train_data, batch_size, self.rank,
@@ -449,6 +450,37 @@ class FleetSupervisor:
             self._gen = gen
 
     # -------------------------------------------------- state collective --
+    def _verify_schedule(self, model):
+        """PDT223 guard at group setup: hash this rank's collective
+        schedule for the upcoming session — the store-backed psum-mean
+        over the flat parameter vector, i.e. the concatenated param
+        shapes/sizes that determine every ``_allreduce_mean`` payload —
+        and cross-check the hash against every peer via the store
+        (``analysis.verify_schedule``). A rank with skewed config
+        (different model shapes, a divergent branch) fails fast and
+        coded (``CollectiveScheduleError``, PDT-E023) here instead of
+        hanging to the PDT-E021 watchdog timeout mid-step. Peers that
+        have not published yet are skipped — late joiners are the
+        elastic manager's business, not a divergence."""
+        from .. import analysis as _analysis
+        if _analysis.mode() == "off":
+            return
+        try:
+            params, shapes, sizes = self._sync_params(model)
+            sched = [_analysis.CollectiveOp(
+                prim="psum_mean", axes=("store",),
+                shape=(int(sum(sizes)),), dtype="float32")]
+            h = _analysis.schedule_hash(sched)
+        except Exception:
+            return
+        try:
+            self._emit("elastic.schedule_hash", hash=h, gen=self._gen)
+            _analysis.verify_schedule(
+                self._bstore, f"{_P}/g{self._gen}", self.node_id,
+                self._members, h, timeout=0.5)
+        except (ConnectionError, OSError):
+            pass  # store hiccup: the verifier is best-effort
+
     def _sync_params(self, model):
         cache = self._sync_cache
         params = [p for p in model.network.parameters()]

@@ -54,7 +54,11 @@ _TIER1_ORDER = [
     # grad-sync bitwise gates — model-free/tiny-model, ~80s combined
     "test_flash_bwd.py", "test_overlap.py",
     "test_profiler_device.py",
-    "test_native_io.py", "test_analysis.py", "test_autograd.py",
+    # ISSUE-16 acceptance: whole-program jaxpr analyzer (collective
+    # schedule hash/verify, donation provenance, shape-fork PDT242) —
+    # model-free tiny jaxprs, a few seconds total
+    "test_native_io.py", "test_analysis.py", "test_analysis_program.py",
+    "test_autograd.py",
     "test_tensor.py", "test_geometric_namespaces.py",
     "test_optimizer.py", "test_optimizer_fused.py",
     "test_control_flow.py", "test_resilience.py",

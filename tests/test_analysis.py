@@ -121,6 +121,52 @@ def test_pragma_on_def_line_covers_function():
     assert not analysis.analyze_source(src)
 
 
+def test_pragma_on_decorator_line_covers_function():
+    """Regression (ISSUE 16 satellite): on a decorated def the pragma
+    anchors to the full def header span — decorator lines included —
+    not just the ``def`` line."""
+    src = _HOSTILE_SRC.replace(
+        "@paddle.jit.to_static",
+        "@paddle.jit.to_static  # pdtpu: noqa[PDT101]")
+    assert not analysis.analyze_source(src)
+    # a pragma for an unrelated code on the decorator changes nothing
+    other = _HOSTILE_SRC.replace(
+        "@paddle.jit.to_static",
+        "@paddle.jit.to_static  # pdtpu: noqa[PDT106]")
+    assert [d.code for d in analysis.analyze_source(other)] == ["PDT101"]
+
+
+_MULTILINE_SRC = """
+import paddle_tpu as paddle
+
+@paddle.jit.to_static
+def step(x):
+    y = (x
+         .numpy())
+    return y
+"""
+
+
+def test_pragma_anchors_to_multiline_statement_span():
+    """Regression (ISSUE 16 satellite): suppression covers the full
+    line span of a multiline statement, wherever the pragma sits in
+    it — not only the line the AST node starts on."""
+    assert [d.code for d in analysis.analyze_source(_MULTILINE_SRC)] \
+        == ["PDT101"]
+    on_last = _MULTILINE_SRC.replace(".numpy())",
+                                     ".numpy())  # pdtpu: noqa[PDT101]")
+    assert not analysis.analyze_source(on_last)
+    on_first = _MULTILINE_SRC.replace("y = (x",
+                                      "y = (x  # pdtpu: noqa[PDT101]")
+    assert not analysis.analyze_source(on_first)
+
+
+def test_pragma_outside_statement_span_does_not_suppress():
+    after = _MULTILINE_SRC.replace(
+        "    return y", "    # pdtpu: noqa[PDT101]\n    return y")
+    assert [d.code for d in analysis.analyze_source(after)] == ["PDT101"]
+
+
 def test_suppress_context_manager():
     assert analysis.analyze_source(_HOSTILE_SRC)
     with analysis.suppress("PDT101"):
@@ -566,3 +612,50 @@ def test_cli_list_codes(capsys):
     out = capsys.readouterr().out
     for code in analysis.REGISTRY:
         assert code in out
+
+
+def test_cli_list_codes_markdown(capsys):
+    from paddle_tpu.analysis.__main__ import main
+    assert main(["--list-codes", "--format", "markdown"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("| code |")
+    for code in analysis.REGISTRY:
+        assert f"| {code} |" in out
+
+
+def test_cli_format_json(tmp_path, capsys):
+    """Satellite: machine-readable findings with the stable exit codes
+    (0 clean / 1 gating findings / 2 usage error)."""
+    import json as _json
+
+    from paddle_tpu.analysis.__main__ import main
+    _write(tmp_path, "bad.py", """
+        import paddle_tpu as paddle
+
+        @paddle.jit.to_static
+        def step(x):
+            return x.numpy()
+        """)
+    rc = main([str(tmp_path), "--format", "json"])
+    doc = _json.loads(capsys.readouterr().out)
+    assert rc == 0  # warn severity does not gate by default
+    assert doc["summary"] == {"files": 1, "error": 0, "warn": 1,
+                              "note": 0, "gating": 0}
+    (f,) = doc["findings"]
+    assert f["code"] == "PDT101" and f["path"].endswith("bad.py")
+    assert f["severity"] == "warn" and f["line"] > 0 and f["col"] >= 0
+    assert "numpy" in f["message"]
+
+    rc = main([str(tmp_path), "--format", "json", "--strict"])
+    doc = _json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["summary"]["gating"] == 1
+
+
+def test_cli_programs_entry_and_usage_exit(capsys):
+    from paddle_tpu.analysis.__main__ import main
+    # a harmless entry point: imports, runs, audits clean
+    assert main(["--programs", "paddle_tpu.analysis:mode"]) == 0
+    capsys.readouterr()
+    # import failures are usage errors (exit 2), not findings
+    assert main(["--programs", "no_such_module:thing"]) == 2
+    assert "cannot load" in capsys.readouterr().err
