@@ -364,6 +364,7 @@ def measure():
     rows["tp2"] = _measure_tp(cfg, model, gbps, 2)
     rows["tp4"] = _measure_tp(cfg, model, gbps, 4)
     rows["disagg"] = _measure_disagg(cfg, model)
+    rows["fleet"] = _measure_fleet(cfg, model)
     # per-code finding counts from every serving program compiled above
     # (engine caches, decode windows, TP wrappers); the regression
     # sentinel judges PDT* leaves lower-is-better
@@ -1019,6 +1020,180 @@ def _measure_disagg(cfg, model, slots=6, prompt_len=64, new_tokens=48,
     return row
 
 
+def _merged_tl_pct(engines, name, q=0.95) -> float:
+    """Percentile of one timeline histogram MERGED across replicas:
+    the fixed log-spaced buckets are identical on every registry, so
+    fleet-wide tails are a bucket-count sum away (the same shared
+    ``percentile_from_counts`` math as the single-engine columns)."""
+    from paddle_tpu.observability.metrics import percentile_from_counts
+    buckets, counts, total = [], [], 0
+    for eng in engines:
+        node = _tl_node(eng, name)
+        if not node.get("count"):
+            continue
+        if not buckets:
+            buckets = list(node["buckets"])
+            counts = [0] * len(node["counts"])
+        counts = [a + b for a, b in zip(counts, node["counts"])]
+        total += node["count"]
+    return percentile_from_counts(buckets, counts, total, q)
+
+
+def _measure_fleet(cfg, model, slots=4, prompt_len=64, new_tokens=24,
+                   shared_groups=4, group_size=4, n_light=4,
+                   light_new=8, page_size=16, decode_window=16,
+                   prefill_chunk=64, max_seq_len=256, q_block=8,
+                   kill_step=3, seed=11, warm=True):
+    """ISSUE 17 ``fleet`` row: the multi-replica router's three claims
+    measured on one skewed-tenant workload (a ``storm`` tenant flooding
+    shared-prefix groups plus a light ``interactive`` tenant).
+
+    * CAPACITY — the same traffic through 4 routed replicas vs 1:
+      fleet TTFT p95 (merged replica histograms) and goodput drop
+      with fleet width.
+    * AFFINITY — prefix-cache-aware placement vs round-robin on the
+      same shared-prefix storm: fleet-wide cache-hit token fraction
+      (affinity concentrates each group where its pages live; RR
+      scatters them, so every replica re-prefills the prefix).
+    * RECOVERY — a 3-replica fleet with one replica killed mid-decode:
+      ``recover_ms`` (kill -> every affected request completed on a
+      survivor), ``requeued``, ``outputs_equal`` vs the unfaulted run
+      (greedy decode is batch-invariant, so this must be True) and
+      ``pages_leaked`` on the survivors (must be 0)."""
+    from paddle_tpu.inference import FleetRouter, TenantSpec
+    from paddle_tpu.resilience import faults
+
+    rng = np.random.default_rng(seed)
+    prefix_len = prompt_len // 2
+    groups = []
+    for _ in range(shared_groups):
+        prefix = rng.integers(0, cfg.vocab_size,
+                              prefix_len).astype(np.int32)
+        groups.append([np.concatenate([
+            prefix, rng.integers(0, cfg.vocab_size,
+                                 prompt_len - prefix_len)
+            .astype(np.int32)]) for _ in range(group_size)])
+    # leaders warm each group's prefix onto SOME replica; the storm is
+    # the remaining members interleaved across groups (consecutive
+    # arrivals from different groups — the placement decision affinity
+    # must get right and round-robin gets right only by luck)
+    leaders = [g[0] for g in groups]
+    storm = [g[i] for i in range(1, group_size) for g in groups]
+    light = [rng.integers(0, cfg.vocab_size,
+                          prompt_len // 4).astype(np.int32)
+             for _ in range(n_light)]
+    kw = dict(max_slots=slots, page_size=page_size,
+              max_seq_len=max_seq_len, decode_window=decode_window,
+              prefill_chunk=prefill_chunk, q_block=q_block)
+    tenants = [TenantSpec("storm", weight=1.0),
+               TenantSpec("interactive", weight=4.0, priority=0)]
+
+    def drive(n_replicas, affinity, kill=None):
+        faults.clear()
+        r = FleetRouter(model, replicas=n_replicas, replica_kwargs=kw,
+                        tenants=tenants, affinity=affinity)
+        done = {}
+        # warm phase: the trie publishes pages at retirement, so each
+        # group's leader runs to completion first — its prefix lands
+        # on SOME replica's cache, which is the steady-state a fleet
+        # front-end lives in (system prompts already resident)
+        for p in leaders:
+            r.add_request(p, new_tokens, tenant="storm")
+        done.update(r.run())
+        # storm phase: the rest arrive staggered 2/step
+        pending = [(p, new_tokens, "storm") for p in storm]
+        for i, p in enumerate(light):
+            pending.insert(3 * i + 1, (p, light_new, "interactive"))
+        affected, t_kill, t_rec, step = None, None, None, 0
+        while r.has_work or pending:
+            for _ in range(2):
+                if pending:
+                    p, n, t = pending.pop(0)
+                    r.add_request(p, n, tenant=t)
+            if kill is not None and step == kill:
+                affected = set(r._by_name("r1").rids)
+                faults.inject("router_replica_lost", "r1")
+                t_kill = time.perf_counter()
+            for c in r.step():
+                done[c.request_id] = c
+            if (affected is not None and t_rec is None
+                    and affected <= set(done)):
+                t_rec = time.perf_counter()
+            step += 1
+            assert step < 100000, "fleet bench wedged"
+        rec_ms = ((t_rec - t_kill) * 1e3
+                  if t_kill is not None and t_rec is not None else 0.0)
+        return r, done, rec_ms, (len(affected) if affected else 0)
+
+    if warm:
+        drive(1, True)
+    t0 = time.perf_counter()
+    r4, d4, _, _ = drive(4, True)
+    wall4 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    r1, d1, _, _ = drive(1, True)
+    wall1 = time.perf_counter() - t0
+    rrr, drr, _, _ = drive(4, False)
+
+    def live_engines(r):
+        return [rep.engine for rep in r._replicas
+                if rep.state != "dead"]
+
+    def hit_frac(r):
+        hit = req = 0
+        for e in live_engines(r):
+            s = e.stats
+            hit += s["cache_hit_tokens"]
+            req += s["prefill_tokens_requested"]
+        return hit / req if req else 0.0
+
+    def goodput(r, done):
+        ok = sum(1 for c in done.values()
+                 if c.finish_reason in ("stop", "length"))
+        return ok / len(done) if done else 0.0
+
+    # recovery drill: 3 replicas, kill r1 mid-decode, compare to the
+    # unfaulted 3-replica run request-by-request
+    r3c, d3c, _, _ = drive(3, True)
+    r3f, d3f, rec_ms, requeued = drive(3, True, kill=kill_step)
+    outputs_equal = (sorted(d3c) == sorted(d3f) and all(
+        np.array_equal(d3c[k].tokens, d3f[k].tokens) for k in d3c))
+    leaked = sum(e.stats["pages_in_use"] for e in live_engines(r3f))
+
+    row = {
+        "replicas": 4, "batch": slots, "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "requests": len(storm) + len(light), "kv_cache": "paged",
+        "ttft_p95_ms_fleet4": round(
+            _merged_tl_pct(live_engines(r4), "ttft_ms", 0.95), 3),
+        "ttft_p95_ms_fleet1": round(
+            _merged_tl_pct(live_engines(r1), "ttft_ms", 0.95), 3),
+        "goodput_fleet4": round(goodput(r4, d4), 4),
+        "goodput_fleet1": round(goodput(r1, d1), 4),
+        "tokens_per_sec_fleet4": round(
+            sum(e.stats["tokens_generated"]
+                for e in live_engines(r4)) / wall4, 1),
+        "tokens_per_sec_fleet1": round(
+            sum(e.stats["tokens_generated"]
+                for e in live_engines(r1)) / wall1, 1),
+        "cache_hit_frac_affinity": round(hit_frac(r4), 4),
+        "cache_hit_frac_rr": round(hit_frac(rrr), 4),
+        "recover_ms": round(rec_ms, 3),
+        "requeued": requeued,
+        "deaths": r3f.stats["deaths"],
+        "outputs_equal": bool(outputs_equal),
+        "pages_leaked": int(leaked),   # must be 0
+    }
+    print(f"fleet: ttft p95 {row['ttft_p95_ms_fleet1']} -> "
+          f"{row['ttft_p95_ms_fleet4']} ms at 4 replicas, cache-hit "
+          f"{row['cache_hit_frac_rr']:.0%} (rr) -> "
+          f"{row['cache_hit_frac_affinity']:.0%} (affinity), "
+          f"replica kill: {row['requeued']} requeued, recovered in "
+          f"{row['recover_ms']} ms, outputs_equal="
+          f"{row['outputs_equal']}", file=sys.stderr, flush=True)
+    return row
+
+
 def _disagg_handoff_mean(srv) -> float:
     node = srv.metrics()
     for part in ("serving", "handoff_ms"):
@@ -1127,6 +1302,9 @@ FILES = ["benchmarks/serving_bench.py",
          # disaggregated/TP serving (ISSUE 13): the tp2/tp4/disagg
          # rows and every engine row's scheduling layer ride these
          "paddle_tpu/inference/distserve.py",
+         # fleet router (ISSUE 17): the fleet row's placement, QoS and
+         # replica-kill recovery all ride it
+         "paddle_tpu/inference/router.py",
          "paddle_tpu/resilience/serving.py",
          "paddle_tpu/core/state.py",
          "paddle_tpu/ops/pallas/paged_attention.py",

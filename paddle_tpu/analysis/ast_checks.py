@@ -1268,3 +1268,76 @@ def check_unsupervised_multihost_fit(fndef, ctx):
             "FleetSupervisor (buddy in-memory snapshots, collective "
             "watchdog PDT-E021, detector-driven resume) or at least "
             "preempt.install() for checkpoint-at-boundary exits")
+
+
+# replica-pool constructors PDT119 counts, and the front-end that
+# proves the pool is routed.  RpcReplica is deliberately included in
+# the pool set: N hand-held rpc proxies without a router have the
+# same failure mode as N hand-held engines.
+_REPLICA_POOL_CALLS = {"ContinuousBatchingEngine", "DisaggServer",
+                       "RpcReplica"}
+_ROUTER_CALLS = {"FleetRouter"}
+
+
+@register(
+    "PDT119", "unrouted-replica-pool", Severity.NOTE, "ast",
+    scope="eager",
+    example="""
+import paddle_tpu as paddle
+from paddle_tpu.inference import ContinuousBatchingEngine
+
+def serve(model, prompts):
+    engines = [ContinuousBatchingEngine(model, max_slots=8),
+               ContinuousBatchingEngine(model, max_slots=8)]
+    for i, p in enumerate(prompts):
+        engines[i % 2].add_request(p, 32)
+    return [e.run() for e in engines]
+""",
+    near_miss="""
+import paddle_tpu as paddle
+from paddle_tpu.inference import ContinuousBatchingEngine, FleetRouter
+
+def serve(model, prompts):
+    router = FleetRouter(replicas=[
+        ContinuousBatchingEngine(model, max_slots=8),
+        ContinuousBatchingEngine(model, max_slots=8)])
+    for p in prompts:
+        router.add_request(p, 32)
+    return router.run()
+""")
+def check_unrouted_replica_pool(fndef, ctx):
+    """TWO OR MORE serving replicas (``ContinuousBatchingEngine`` /
+    ``DisaggServer`` / ``RpcReplica``) constructed in one function
+    with no ``FleetRouter`` in sight: the pool is being spread by
+    hand.  Hand-spreading gets none of the fleet layer — no
+    prefix-cache-aware placement (shared-prefix traffic scatters, so
+    every replica re-prefills what another already cached), no
+    tenant fair share, and above all no failure handling: a replica
+    that dies mid-decode takes its queued and in-flight requests with
+    it, where the router would requeue them to survivors
+    bitwise-identically under one coded PDT-E024 flight record.
+    Wrap the pool: ``FleetRouter(replicas=[...])`` — or pass
+    ``replicas=N`` and let the router build them.  Note-level advice;
+    deliberately independent pools (A/B harnesses, test rigs) are
+    legitimate."""
+    if any(isinstance(node, ast.Call)
+           and (_dotted(node.func) or "").split(".")[-1]
+           in _ROUTER_CALLS
+           for node in _walk_fn(fndef)):
+        return
+    seen = 0
+    for node in _walk_fn(fndef):
+        if not isinstance(node, ast.Call) \
+                or (_dotted(node.func) or "").split(".")[-1] \
+                not in _REPLICA_POOL_CALLS:
+            continue
+        seen += 1
+        if seen == 2:
+            yield node, (
+                "two or more serving replicas built here with no "
+                "FleetRouter: hand-spread pools lose cache-aware "
+                "placement, tenant fair share, and dead-replica "
+                "requeue (a replica loss drops its in-flight "
+                "requests instead of re-serving them bitwise from "
+                "survivors under a coded PDT-E024 record) — wrap "
+                "the pool in FleetRouter(replicas=[...])")

@@ -205,6 +205,22 @@ class CollectiveScheduleError(EnforceNotMet):
     error_code = "PDT-E023"
 
 
+class ReplicaLostError(EnforceNotMet, ConnectionError):
+    """A fleet-serving replica (``inference.router.FleetRouter``) was
+    declared dead — a failed heartbeat, an exhausted placement retry
+    budget, a stalled step past the watchdog deadline, or the
+    ``router_replica_lost`` drill.  The router bumps the fleet
+    generation, writes one coded flight record, and requeues the dead
+    replica's queued AND in-flight requests to the surviving replicas
+    (from-scratch re-prefill; greedy decode is deterministic and
+    batch-invariant, so the requeued outputs are bitwise-identical to
+    an unfaulted run).  Callers normally never see this raised — a
+    lost replica costs latency, not requests; it only surfaces when
+    the LAST replica dies with work still queued."""
+
+    error_code = "PDT-E024"
+
+
 def enforce(cond: bool, msg: str, exc=InvalidArgumentError):
     """PADDLE_ENFORCE: raise ``exc`` with ``msg`` unless ``cond``."""
     if not cond:

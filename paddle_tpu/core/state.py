@@ -201,6 +201,64 @@ define_flag("serving_disagg_handoff_retries", 3,
             "transient ConnectionError — incl. the injected "
             "engine_handoff_transient fault site. N retries = N+1 "
             "attempts; 0 disables retry.")
+define_flag("serving_fleet_replicas", 2,
+            "default live-replica count for inference.FleetRouter "
+            "when replicas= is an int or omitted: how many "
+            "ContinuousBatchingEngine workers the router builds over "
+            "the shared model (compiled serving programs cache on the "
+            "model, so N same-geometry replicas compile once). "
+            "FleetRouter kwarg replicas overrides.")
+define_flag("serving_fleet_affinity", True,
+            "prefix-cache-aware placement for inference.FleetRouter: "
+            "route each prompt to the replica whose radix prefix "
+            "cache reports the longest page-aligned hit "
+            "(cached_prefix_tokens), spilling to the least-loaded "
+            "replica when no replica holds the prefix. False = "
+            "deterministic round-robin over the live replicas. "
+            "FleetRouter kwarg affinity overrides.")
+define_flag("serving_fleet_heartbeat_ms", 0.0,
+            "fleet-router replica heartbeat timeout (ms): a live "
+            "replica whose last successful step is older than this is "
+            "declared dead (generation bump, coded flight record, "
+            "queued + in-flight requests requeued to survivors). 0 "
+            "disables the timeout detector — in-process replicas beat "
+            "synchronously, so the timeout matters for rpc-backed "
+            "replicas. FleetRouter kwarg heartbeat_timeout_ms "
+            "overrides.")
+define_flag("serving_fleet_dispatch_retries", 3,
+            "bounded resilience.retry RE-attempts for one fleet-"
+            "router placement dispatch (replica add_request) after a "
+            "transient ConnectionError — incl. the injected "
+            "router_dispatch_transient fault site. Exhausting the "
+            "budget declares the replica dead and requeues the "
+            "request. N retries = N+1 attempts; 0 disables retry. "
+            "FleetRouter kwarg dispatch_retries overrides.")
+define_flag("serving_fleet_scaleout_timeout_ms", 0.0,
+            "watchdog deadline (ms) for admitting a standby replica "
+            "on a sustained fleet-SLO burn-rate breach: past it the "
+            "admission surfaces EngineStallError (PDT-E020) with a "
+            "flight record and the fleet degrades gracefully on the "
+            "live replicas. 0 disarms the watchdog (the "
+            "router_scaleout_stall drill then raises after its "
+            "bounded spin). FleetRouter kwarg scaleout_timeout_ms "
+            "overrides.")
+define_flag("serving_fleet_scalein_hold_s", 30.0,
+            "how long the fleet SLO must stay recovered (no breached "
+            "spec) before the fleet router drains a scaled-out "
+            "standby back: the replica stops taking placements and "
+            "returns to standby once idle. FleetRouter kwarg "
+            "scalein_hold_s overrides.")
+define_flag("serving_fleet_slo", "",
+            "fleet-wide objectives for the serving router "
+            "(inference/router.py): same spec grammar as serving_slo "
+            "('queue_p95_ms=200,goodput=0.99'), evaluated over the "
+            "ROUTER's registry (admission-queue wait, fleet finish "
+            "reasons) rather than any one replica's. A sustained "
+            "burn-rate breach admits a standby replica (scale-out); "
+            "holding recovered for serving_fleet_scalein_hold_s "
+            "drains it back. '' (default) arms nothing — no "
+            "SLO-driven scaling; FleetRouter kwarg fleet_slo "
+            "overrides.")
 define_flag("dp_overlap_grad_sync", False,
             "overlap-scheduled bucketed DP gradient sync "
             "(distributed/overlap.py): DataParallel registers per-param "

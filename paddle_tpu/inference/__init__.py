@@ -203,9 +203,12 @@ from .speculative import (DraftModelProposer, NGramProposer,  # noqa: E402
                           Proposer)
 from .distserve import (DisaggServer, KVPageTransport,  # noqa: E402
                         register_decode_worker)
+from .router import (FleetRouter, RpcReplica, TenantSpec,  # noqa: E402
+                     register_replica_worker)
 
 __all__ = ["Config", "Predictor", "create_predictor",
            "ContinuousBatchingEngine", "CompletedRequest",
            "PrefixCache", "Proposer", "NGramProposer",
            "DraftModelProposer", "DisaggServer", "KVPageTransport",
-           "register_decode_worker"]
+           "register_decode_worker", "FleetRouter", "TenantSpec",
+           "RpcReplica", "register_replica_worker"]

@@ -235,7 +235,7 @@ class DisaggServer:
 
     # ------------------------------------------------------------ API --
     def add_request(self, prompt, max_new_tokens, eos_token_id=None,
-                    request_id=None, deadline_ms=None):
+                    request_id=None, deadline_ms=None, requeue=False):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         # eager validation against the DECODE group's budget — the
         # group that must hold the full sequence.  The prefill group
@@ -279,8 +279,14 @@ class DisaggServer:
                 raise ValueError(f"request_id {rid!r} already in flight")
         deadline = (self._clock() + float(deadline_ms) / 1e3) \
             if deadline_ms else None
-        self._reqs[rid] = _DisaggReq(rid, prompt, max_new_tokens,
-                                     eos_token_id, deadline)
+        r = _DisaggReq(rid, prompt, max_new_tokens,
+                       eos_token_id, deadline)
+        if requeue:
+            # a fleet-router requeue: the request's prefill demand was
+            # already counted on its first admission — mark it so
+            # _submit_pending's engine admission skips the re-count
+            r.requeues = 1
+        self._reqs[rid] = r
         self._pending.append(rid)
         return rid
 
@@ -298,6 +304,20 @@ class DisaggServer:
             or bool(self._finalized) or any(
                 e.has_work for e in self.prefill_group
                 + self.decode_group)
+
+    def cached_prefix_tokens(self, ids) -> int:
+        """Fleet-router affinity query: the longest page-aligned
+        prefix of ``ids`` any PREFILL engine already holds (re-prefill
+        lands on the prefill group, so that is where a routed prompt's
+        cached pages pay off)."""
+        return max(e.cached_prefix_tokens(ids)
+                   for e in self.prefill_group)
+
+    def pending_requests(self):
+        """Request ids still in flight across the coordinator and both
+        groups — the fleet router's live-load gauge."""
+        return [rid for rid, r in self._reqs.items()
+                if r.state != "done"]
 
     @property
     def stats(self):
@@ -438,9 +458,15 @@ class DisaggServer:
                           key=lambda e: len(e._queue))
                 try:
                     # prefill side generates exactly the FIRST token;
-                    # the real budget rides the payload to decode
+                    # the real budget rides the payload to decode.
+                    # requeues (worker-lost, router requeue) re-admit
+                    # a request whose demand is already counted —
+                    # requeue=True keeps prefill_tokens_requested a
+                    # once-per-request demand figure while computed
+                    # meters the actual (net-of-cache) recompute
                     eng.add_request(r.prompt, 1, eos_token_id=r.eos,
-                                    request_id=rid, deadline_ms=rem)
+                                    request_id=rid, deadline_ms=rem,
+                                    requeue=r.requeues > 0)
                 except Exception:
                     kept.append(rid)      # keep: retry next tick
                     raise

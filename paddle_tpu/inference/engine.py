@@ -260,7 +260,8 @@ __all__ = ["ContinuousBatchingEngine", "CompletedRequest"]
 
 class _Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
-                 "done_toks", "deadline", "preemptions")
+                 "done_toks", "deadline", "preemptions",
+                 "requested_counted")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
                  deadline=None):
@@ -271,6 +272,13 @@ class _Request:
         self.done_toks: list[int] = []  # generated before a preemption
         self.deadline = deadline        # absolute clock() seconds | None
         self.preemptions = 0
+        # prefill_tokens_requested counts each request's demand ONCE:
+        # re-admissions (preempt resume, worker-lost / replica-lost
+        # requeue via add_request(requeue=True)) must not re-count it,
+        # or the shared_prefix/disagg bench's prefill_saved_frac
+        # denominator inflates with retry traffic while
+        # prefill_tokens_computed keeps metering the actual recompute
+        self.requested_counted = False
 
 
 class CompletedRequest:
@@ -696,7 +704,7 @@ class ContinuousBatchingEngine:
         _flight.dump("slo_breach", extra=dict(status))
 
     def add_request(self, prompt, max_new_tokens, eos_token_id=None,
-                    request_id=None, deadline_ms=None):
+                    request_id=None, deadline_ms=None, requeue=False):
         prompt = np.asarray(
             prompt.numpy() if isinstance(prompt, Tensor) else prompt,
             np.int32).reshape(-1)
@@ -746,9 +754,14 @@ class ContinuousBatchingEngine:
         dl_ms = (self.default_deadline_ms
                  if deadline_ms is None else float(deadline_ms))
         deadline = (self._clock() + dl_ms / 1e3) if dl_ms else None
-        self._queue.append(_Request(
+        req = _Request(
             rid, prompt, max_new_tokens,
-            -1 if eos_token_id is None else int(eos_token_id), deadline))
+            -1 if eos_token_id is None else int(eos_token_id), deadline)
+        # requeue=True: a coordinator re-submitting a request it
+        # already counted (disagg worker-lost, fleet replica-lost) —
+        # its demand is already in prefill_tokens_requested
+        req.requested_counted = bool(requeue)
+        self._queue.append(req)
         self._tl.enqueued(rid, prompt.size, max_new_tokens)
         return rid
 
@@ -779,6 +792,19 @@ class ContinuousBatchingEngine:
         out = [s.req.rid for s in self._slots if s.req is not None]
         out.extend(r.rid for r in self._queue)
         return out
+
+    def cached_prefix_tokens(self, ids) -> int:
+        """Longest page-aligned prefix of ``ids`` already indexed in
+        this engine's radix prefix cache, in TOKENS (0 with caching
+        off) — the fleet router's affinity-placement query
+        (``inference/router.py``): route a prompt to the replica whose
+        trie already holds its prefix and admission maps those pages
+        instead of recomputing them.  Read-only apart from refreshing
+        the matched path's LRU recency; never changes outputs."""
+        ids = np.asarray(
+            ids.numpy() if isinstance(ids, Tensor) else ids,
+            np.int32).reshape(-1)
+        return len(self._cache.match(ids)) * self.page_size
 
     @property
     def has_work(self):
@@ -1194,7 +1220,13 @@ class ContinuousBatchingEngine:
                 self._cow_page(cow_src, alloc[0])
             self._stats["admitted"] += 1
             self._stats["pages_allocated"] += len(alloc)
-            self._stats["prefill_tokens_requested"] += resume
+            # demand is counted once per request: preempt resumes and
+            # coordinator requeues re-admit the same logical request,
+            # and re-counting them would report retry traffic as
+            # prefill "savings" (computed stays net of cache restores)
+            if not req.requested_counted:
+                self._stats["prefill_tokens_requested"] += resume
+                req.requested_counted = True
             self._tl.admitted(req.rid, b, cached_tokens=prefill_off,
                               resume_len=resume)
             if prefill_off:

@@ -1,0 +1,1006 @@
+"""Fleet-scale serving: a fault-tolerant multi-replica router.
+
+One engine — even TP-sharded (ISSUE 13) and disaggregated — is not
+"millions of users".  :class:`FleetRouter` is the front-end that
+spreads traffic over N replica workers (:class:`~paddle_tpu.inference.
+engine.ContinuousBatchingEngine` or :class:`~paddle_tpu.inference.
+distserve.DisaggServer`, in-process or over ``distributed/rpc`` via
+:class:`RpcReplica`), composing the pieces the stack already has —
+queryable radix prefix caches (ISSUE 6), the SLO burn-rate engine
+(ISSUE 14), the elastic heartbeat/generation detector (ISSUE 15) and
+the ``engine_decode_worker_lost`` requeue path (ISSUE 13) — into a
+survivable fleet:
+
+* PREFIX-CACHE-AWARE PLACEMENT — each prompt is routed to the replica
+  whose radix trie reports the longest page-aligned prefix hit
+  (``cached_prefix_tokens``: the trie makes hit-length queryable
+  without hashing heuristics), so shared-prefix traffic concentrates
+  where its KV pages already live; prompts no replica has cached spill
+  to the least-loaded replica (live in-flight gauge, deterministic
+  index tie-break).  ``affinity=False`` restores deterministic
+  round-robin — placement never changes outputs (greedy decode is
+  batch-invariant), only cache-hit tokens move.
+* PER-TENANT QoS — :class:`TenantSpec` declares priority class,
+  fair-share weight, an optional per-tenant queue bound and per-tenant
+  ``SLOSpec`` objectives.  Admission is strict-priority across
+  classes and weighted stride scheduling (virtual-time fair queueing)
+  within a class, so a storm tenant cannot starve a light tenant
+  below its weight share.  Queue bounds surface the engine's own
+  coded policies: ``reject`` raises ``QueueFullError`` (PDT-E017),
+  ``block`` steps the fleet until room frees; requests that can never
+  fit ANY replica's page pool fail eagerly with ``PageBudgetError``
+  (PDT-E016).
+* REPLICA FAILURE HANDLING (the robustness core) — every replica
+  carries a heartbeat (refreshed by each successful step) and a
+  generation number; each step is watchdog-armed so a HUNG replica
+  surfaces ``EngineStallError`` (PDT-E020) with a flight record
+  instead of wedging the router.  A dead replica — heartbeat timeout,
+  stalled step, exhausted placement retries, or the
+  ``router_replica_lost`` drill — bumps the fleet generation, writes
+  exactly one coded flight record (``ReplicaLostError`` PDT-E024) and
+  requeues its queued AND in-flight requests to the survivors at the
+  front of their tenant queues: a from-scratch re-prefill that
+  restores from the survivors' prefix caches where pages match.
+  Greedy decode is deterministic and batch-invariant, so the requeued
+  outputs are bitwise-identical to an unfaulted run — a lost replica
+  costs latency, never a request.
+* ELASTIC SCALE-OUT/IN — ``fleet_slo=`` arms the ISSUE-14 SLO engine
+  over the router's own registry (``queue_p95_ms`` latency and
+  ``goodput`` ratio shorthands are fed by the router); a sustained
+  multi-window burn-rate breach admits a standby replica (warm model
+  — compiled serving programs cache on the shared model, so the
+  standby compiles nothing; cold cache), and a recovered SLO held for
+  ``scalein_hold_s`` drains it back to standby.  If every live
+  replica dies, a standby is admitted immediately (failover needs no
+  SLO verdict).
+
+Observability: the router owns a ``serving_router`` registry —
+always-on counters (the ``stats`` contract), ``serving.queue_ms`` /
+``serving.finished`` fed per completion (the fleet SLO's inputs),
+per-replica labeled load/state/generation gauges, and
+``router.place`` / ``router.step`` / ``router.scaleout`` tracing
+spans.  With ``PDTPU_METRICS=off`` everything degrades to the engine
+contract: outputs bitwise-identical, ``stats`` still counts, SLO
+judgment (and therefore SLO-driven scaling) is off.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+
+import numpy as np
+
+from ..core.errors import (EngineStallError, PageBudgetError,
+                           QueueFullError, ReplicaLostError)
+from ..core.tensor import Tensor
+from ..observability import Registry as _ObsRegistry
+from ..observability import events as _events
+from ..observability import flight as _flight
+from ..observability import metrics as _obs_metrics
+from ..observability import slo as _slo_mod
+from ..observability import tracing as _tracing
+from ..observability import watchdog as _watchdog
+from ..observability.metrics import LATENCY_BUCKETS_MS
+from ..observability.serving import RegistryCounters
+from ..resilience import faults
+from ..resilience.retry import retry_call
+from ..resilience.serving import (SITE_ROUTER_DISPATCH_TRANSIENT,
+                                  SITE_ROUTER_REPLICA_LOST,
+                                  SITE_ROUTER_SCALEOUT_STALL,
+                                  simulated_stall)
+from .engine import CompletedRequest, ContinuousBatchingEngine
+
+__all__ = ["FleetRouter", "TenantSpec", "RpcReplica",
+           "register_replica_worker", "rpc_replica_call"]
+
+
+# --------------------------------------------------------------- rpc --
+# Same shape as distserve's decode-worker registry: the worker process
+# registers its engine under a name after rpc.init_rpc, and the router
+# holds an RpcReplica proxy that forwards the replica surface.
+
+_REPLICA_WORKERS: dict = {}
+
+
+def register_replica_worker(name: str, engine) -> None:
+    """Expose ``engine`` to rpc-backed fleet routing under ``name``
+    (call on the replica worker process after ``rpc.init_rpc``)."""
+    _REPLICA_WORKERS[str(name)] = engine
+
+
+def rpc_replica_call(name: str, method: str, args: tuple, kwargs: dict):
+    """Server-side half of an rpc replica: dispatch ``method`` on the
+    registered engine.  ``fleet_limits`` is synthesized here so the
+    router can size eager admission without a remote attribute
+    protocol."""
+    eng = _REPLICA_WORKERS.get(str(name))
+    if eng is None:
+        raise KeyError(f"no replica worker registered as {name!r}")
+    if method == "fleet_limits":
+        return _probe_limits(eng)
+    out = getattr(eng, method)
+    if callable(out):
+        return out(*args, **kwargs)
+    return out   # property surface (stats, has_work)
+
+
+class RpcReplica:
+    """Client-side proxy: the replica surface over ``distributed/rpc``.
+
+    ``to`` is the rpc peer; ``worker`` the name the engine was
+    registered under (defaults to ``to``).  Results (completions,
+    stats dicts, hit lengths) come back pickled by the rpc layer; a
+    dead peer raises ``ConnectionError``, which the router treats as a
+    lost replica.
+    """
+
+    def __init__(self, to: str, worker: str = None, timeout: float = None):
+        self.to = str(to)
+        self.worker = str(worker or to)
+        self.timeout = timeout
+
+    def _call(self, method, *args, **kwargs):
+        from ..distributed.rpc import rpc_sync
+        kw = {} if self.timeout is None else {"timeout": self.timeout}
+        return rpc_sync(self.to, rpc_replica_call,
+                        args=(self.worker, method, args, kwargs), **kw)
+
+    def fleet_limits(self) -> dict:
+        return self._call("fleet_limits")
+
+    def add_request(self, prompt, max_new_tokens, eos_token_id=None,
+                    request_id=None, deadline_ms=None, requeue=False):
+        return self._call(
+            "add_request", np.asarray(prompt, np.int32),
+            int(max_new_tokens), eos_token_id=eos_token_id,
+            request_id=request_id, deadline_ms=deadline_ms,
+            requeue=requeue)
+
+    def step(self):
+        return self._call("step")
+
+    def cancel(self, rid):
+        return self._call("cancel", rid)
+
+    def cached_prefix_tokens(self, ids) -> int:
+        return int(self._call("cached_prefix_tokens",
+                              np.asarray(ids, np.int32)))
+
+    def pending_requests(self):
+        return self._call("pending_requests")
+
+    def metrics(self):
+        return self._call("metrics")
+
+    def slo_status(self):
+        return self._call("slo_status")
+
+    @property
+    def stats(self):
+        return self._call("stats")
+
+    @property
+    def has_work(self):
+        return bool(self._call("has_work"))
+
+
+def _probe_limits(engine) -> dict:
+    """The capacity facts eager admission and placement need, for any
+    replica kind.  DisaggServer sizes against its DECODE group — the
+    group that must hold the full sequence (its own add_request
+    validates the same way)."""
+    if isinstance(engine, RpcReplica) or hasattr(engine, "fleet_limits"):
+        return dict(engine.fleet_limits())
+    if hasattr(engine, "decode_group"):
+        dec = engine.decode_group[0]
+        return {"max_seq_len": int(dec.max_seq_len),
+                "page_size": int(dec.page_size),
+                "total_pages": int(dec.total_pages),
+                "max_slots": sum(int(e.max_slots)
+                                 for e in engine.decode_group)}
+    return {"max_seq_len": int(engine.max_seq_len),
+            "page_size": int(engine.page_size),
+            "total_pages": int(engine.total_pages),
+            "max_slots": int(engine.max_slots)}
+
+
+# ------------------------------------------------------------ tenants --
+class TenantSpec:
+    """One tenant's QoS contract.
+
+    ``priority`` is a strict class (lower serves first — an admission
+    from class 0 always beats class 1); ``weight`` is the fair share
+    WITHIN the class (stride scheduling: a weight-3 tenant gets ~3x
+    the admissions of a weight-1 tenant under contention, and an idle
+    tenant's share is redistributed, not banked).  ``max_queue``
+    bounds this tenant's router queue (0 = unbounded; ``reject``
+    surfaces ``QueueFullError`` PDT-E017).  ``slo`` arms per-tenant
+    objectives (spec string or ``SLOSpec`` list) over the tenant's own
+    registry, judged from the router-observed queue wait and finish
+    reasons — read them back via ``FleetRouter.slo_status()``.
+    """
+
+    def __init__(self, name, *, weight=1.0, priority=0, max_queue=0,
+                 queue_policy="reject", slo=None):
+        self.name = str(name)
+        self.weight = float(weight)
+        if self.weight <= 0:
+            raise ValueError(f"tenant {name!r}: weight must be > 0, "
+                             f"got {weight}")
+        self.priority = int(priority)
+        self.max_queue = int(max_queue)
+        self.queue_policy = str(queue_policy)
+        if self.queue_policy not in ("reject", "block"):
+            raise ValueError(f"tenant {name!r}: queue_policy must be "
+                             f"'reject' or 'block', got {queue_policy!r}")
+        self.slo = slo
+
+
+class _RouterReq:
+    __slots__ = ("rid", "tenant", "prompt", "max_new_tokens", "eos",
+                 "deadline", "state", "replica", "requeues", "enq_t",
+                 "cost")
+
+    def __init__(self, rid, tenant, prompt, max_new_tokens, eos,
+                 deadline, enq_t):
+        self.rid = rid
+        self.tenant = tenant
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos = eos
+        self.deadline = deadline      # absolute clock() seconds | None
+        self.state = "pending"        # pending | placed | done
+        self.replica = None
+        self.requeues = 0
+        self.enq_t = enq_t
+        # stride-scheduling cost: service demand in tokens
+        self.cost = int(prompt.size) + int(max_new_tokens)
+
+
+class _Replica:
+    """Router-side handle: engine + membership state.
+
+    ``rids`` is an insertion-ordered dict used as an ordered set — on
+    death the requeue preserves original placement order, which keeps
+    the drill deterministic."""
+
+    __slots__ = ("name", "engine", "index", "state", "gen", "last_beat",
+                 "rids", "scaled_out", "limits")
+
+    def __init__(self, name, engine, index, state, limits):
+        self.name = str(name)
+        self.engine = engine
+        self.index = int(index)
+        self.state = state            # live | standby | draining | dead
+        self.gen = 0
+        self.last_beat = 0.0
+        self.rids: dict = {}          # rid -> True, insertion-ordered
+        self.scaled_out = False
+        self.limits = limits
+
+
+_STATE_CODE = {"standby": 0, "live": 1, "draining": 2, "dead": 3}
+
+
+class FleetRouter:
+    """Spread serving traffic over N engine replicas; survive any of
+    them dying.  See the module docstring for the placement, QoS,
+    failure and scaling semantics.
+
+    ``replicas`` is an int (the router builds that many
+    ``ContinuousBatchingEngine(model, **replica_kwargs)`` workers —
+    same-geometry replicas share the model's compiled serving
+    programs) or a list of prebuilt engines / ``DisaggServer`` /
+    ``RpcReplica`` objects; ``standby`` likewise (kwargs default to
+    ``replica_kwargs``).  Policy kwargs follow the engine convention:
+    ``None`` falls back to the ``serving_fleet_*`` flags.  ``clock``
+    (tests) replaces ``time.monotonic`` for deterministic deadline /
+    heartbeat / SLO drills."""
+
+    def __init__(self, model=None, *, replicas=None, replica_kwargs=None,
+                 standby=0, standby_kwargs=None, tenants=None,
+                 default_tenant="default", affinity=None,
+                 fleet_slo=None, heartbeat_timeout_ms=None,
+                 dispatch_retries=None, scaleout_timeout_ms=None,
+                 scalein_hold_s=None, watchdog_ms=None,
+                 max_queue=None, queue_policy=None,
+                 default_deadline_ms=None, clock=None):
+        from ..core import state as _state
+        self._clock = time.monotonic if clock is None else clock
+        self.affinity = bool(_state.get_flag("serving_fleet_affinity")
+                             if affinity is None else affinity)
+        hb = (_state.get_flag("serving_fleet_heartbeat_ms")
+              if heartbeat_timeout_ms is None else heartbeat_timeout_ms)
+        self.heartbeat_timeout_ms = float(hb)
+        dr = (_state.get_flag("serving_fleet_dispatch_retries")
+              if dispatch_retries is None else dispatch_retries)
+        self.dispatch_retries = int(dr)
+        so = (_state.get_flag("serving_fleet_scaleout_timeout_ms")
+              if scaleout_timeout_ms is None else scaleout_timeout_ms)
+        self.scaleout_timeout_ms = float(so)
+        sh = (_state.get_flag("serving_fleet_scalein_hold_s")
+              if scalein_hold_s is None else scalein_hold_s)
+        self.scalein_hold_s = float(sh)
+        self.watchdog_ms = float(_state.get_flag("watchdog_stall_ms")
+                                 if watchdog_ms is None else watchdog_ms)
+        self.max_queue = int(_state.get_flag("serving_max_queue")
+                             if max_queue is None else max_queue)
+        self.queue_policy = str(_state.get_flag("serving_queue_policy")
+                                if queue_policy is None else queue_policy)
+        self.default_deadline_ms = float(
+            _state.get_flag("serving_deadline_ms")
+            if default_deadline_ms is None else default_deadline_ms)
+
+        # ------------------------------------------------- replicas --
+        if replicas is None:
+            replicas = int(_state.get_flag("serving_fleet_replicas"))
+        rkw = dict(replica_kwargs or {})
+
+        def _build(n, kw):
+            if not n:
+                return []
+            if model is None:
+                raise ValueError(
+                    "FleetRouter needs model= to build replicas from "
+                    "an int; pass prebuilt engines otherwise")
+            return [ContinuousBatchingEngine(model, **kw)
+                    for _ in range(int(n))]
+
+        live = (_build(replicas, rkw) if isinstance(replicas, int)
+                else list(replicas))
+        if not live:
+            raise ValueError("FleetRouter needs at least one replica")
+        skw = dict(standby_kwargs if standby_kwargs is not None else rkw)
+        stand = (_build(standby, skw) if isinstance(standby, int)
+                 else list(standby))
+        self._replicas: list[_Replica] = []
+        now = self._clock()
+        for i, eng in enumerate(live + stand):
+            rep = _Replica(f"r{i}", eng, i,
+                           "live" if i < len(live) else "standby",
+                           _probe_limits(eng))
+            rep.last_beat = now
+            self._replicas.append(rep)
+        self._base_live = len(live)
+
+        # -------------------------------------------------- tenants --
+        self.default_tenant = str(default_tenant)
+        self._tenants: dict = {}
+        self._torder: dict = {}
+        for spec in (tenants or []):
+            self._add_tenant(spec)
+        if self.default_tenant not in self._tenants:
+            self._add_tenant(TenantSpec(self.default_tenant))
+        self._tq: dict = {n: deque() for n in self._tenants}
+        self._vt: dict = {n: 0.0 for n in self._tenants}
+
+        # -------------------------------------------- observability --
+        self._registry = _ObsRegistry("serving_router")
+        self._c = RegistryCounters(self._registry, (
+            "admitted", "placed", "finished", "rejected", "timeouts",
+            "requeues", "retries", "deaths", "scaleouts", "scaleins",
+            "scaleout_failures", "affinity_hits", "affinity_spills"),
+            prefix="router")
+        self._h_queue = self._registry.histogram(
+            "serving.queue_ms", "router-queue wait: admission -> "
+            "placement on a replica", buckets=LATENCY_BUCKETS_MS)
+        self._fin_c: dict = {}
+        for rep in self._replicas:
+            self._reg_replica_gauges(rep)
+        self._g_live = self._registry.gauge(
+            "router.replicas_live", "replicas taking placements")
+        self._g_live.set_function(
+            lambda: sum(1 for r in self._replicas if r.state == "live"))
+        self._g_queue = self._registry.gauge(
+            "router.queue_depth", "requests waiting for placement")
+        self._g_queue.set_function(
+            lambda: sum(len(q) for q in self._tq.values()))
+
+        # per-tenant registries + SLO engines (fed by _finish)
+        self._treg: dict = {}
+        self._tslo: dict = {}
+        self._tfin: dict = {}
+        self._th_queue: dict = {}
+        for name, spec in self._tenants.items():
+            reg = _ObsRegistry(f"serving_router_tenant_{name}")
+            self._treg[name] = reg
+            self._th_queue[name] = reg.histogram(
+                "serving.queue_ms", "tenant router-queue wait",
+                buckets=LATENCY_BUCKETS_MS)
+            self._tfin[name] = {}
+            if spec.slo is not None:
+                self._tslo[name] = _slo_mod.SLOEngine(
+                    reg, _slo_mod.parse_slo(spec.slo),
+                    clock=self._clock)
+
+        # fleet SLO -> scale-out trigger
+        self._fleet_slo = None
+        if fleet_slo is None:
+            fleet_slo = _state.get_flag("serving_fleet_slo")
+        if fleet_slo:
+            self._fleet_slo = _slo_mod.SLOEngine(
+                self._registry, _slo_mod.parse_slo(fleet_slo),
+                clock=self._clock, on_breach=self._on_fleet_breach)
+
+        # ------------------------------------------------- bookkeeping
+        self._reqs: dict = {}
+        self._finalized: list = []
+        self._next_rid = 0
+        self._gen = 0
+        self._rr = -1                 # round-robin cursor
+        self._breached = False
+        self._last_breach_t = None
+        self._next_scaleout_t = float("-inf")
+        self._scaleout_cooldown_s = 1.0
+        self._tick = 0
+
+    # ------------------------------------------------------ tenants --
+    def _add_tenant(self, spec):
+        if not isinstance(spec, TenantSpec):
+            spec = TenantSpec(str(spec))
+        self._tenants[spec.name] = spec
+        self._torder[spec.name] = len(self._torder)
+
+    # ---------------------------------------------------- admission --
+    def add_request(self, prompt, max_new_tokens, eos_token_id=None,
+                    tenant=None, request_id=None, deadline_ms=None):
+        """Queue one request under ``tenant``'s QoS contract; returns
+        the request id.  Eagerly rejects what no replica could ever
+        serve (``PageBudgetError`` PDT-E016) and what the queue bounds
+        refuse (``QueueFullError`` PDT-E017, policy ``reject``)."""
+        prompt = np.asarray(
+            prompt.numpy() if isinstance(prompt, Tensor) else prompt,
+            np.int32).reshape(-1)
+        tname = self.default_tenant if tenant is None else str(tenant)
+        if tname not in self._tenants:
+            # unknown tenants ride the default contract under their
+            # own name (fair share still separates them)
+            self._add_tenant(TenantSpec(tname))
+            self._tq[tname] = deque()
+            self._vt[tname] = 0.0
+            reg = _ObsRegistry(f"serving_router_tenant_{tname}")
+            self._treg[tname] = reg
+            self._th_queue[tname] = reg.histogram(
+                "serving.queue_ms", "tenant router-queue wait",
+                buckets=LATENCY_BUCKETS_MS)
+            self._tfin[tname] = {}
+        spec = self._tenants[tname]
+        total = prompt.size + int(max_new_tokens)
+        if not any(self._fits_limits(rep.limits, prompt.size,
+                                     max_new_tokens)
+                   for rep in self._replicas if rep.state != "dead"):
+            self._c["rejected"] += 1
+            lim = max((rep.limits["total_pages"] - 1)
+                      * rep.limits["page_size"]
+                      for rep in self._replicas if rep.state != "dead")
+            raise PageBudgetError(
+                f"request needs {total} tokens but no fleet replica "
+                f"can hold more than {lim}; raise total_pages or "
+                f"lower max_new_tokens [{PageBudgetError.error_code}]")
+        qlen = sum(len(q) for q in self._tq.values())
+        if (self.max_queue and qlen >= self.max_queue) or (
+                spec.max_queue
+                and len(self._tq[tname]) >= spec.max_queue):
+            policy = (spec.queue_policy if spec.max_queue
+                      and len(self._tq[tname]) >= spec.max_queue
+                      else self.queue_policy)
+            if policy == "reject":
+                self._c["rejected"] += 1
+                raise QueueFullError(
+                    f"router admission queue full (fleet {qlen}, "
+                    f"tenant {tname!r} {len(self._tq[tname])}); shed "
+                    f"load or use queue_policy='block' "
+                    f"[{QueueFullError.error_code}]")
+            for _ in range(1_000_000):
+                room = (not self.max_queue or sum(
+                    len(q) for q in self._tq.values()) < self.max_queue)
+                troom = (not spec.max_queue
+                         or len(self._tq[tname]) < spec.max_queue)
+                if (room and troom) or not self.has_work:
+                    break
+                self._finalized.extend(self.step())
+            else:
+                raise RuntimeError("queue_policy='block': fleet made "
+                                   "no progress draining the queue")
+        if request_id is None:
+            rid = self._next_rid
+            self._next_rid += 1
+        else:
+            rid = request_id
+            if isinstance(rid, int):
+                self._next_rid = max(self._next_rid, rid + 1)
+            if rid in self._reqs and self._reqs[rid].state != "done":
+                raise ValueError(f"request_id {rid!r} already in flight")
+        dl_ms = (self.default_deadline_ms
+                 if deadline_ms is None else float(deadline_ms))
+        now = self._clock()
+        deadline = (now + dl_ms / 1e3) if dl_ms else None
+        rs = _RouterReq(rid, tname, prompt, max_new_tokens,
+                        eos_token_id, deadline, now)
+        self._reqs[rid] = rs
+        if not self._tq[tname]:
+            # start-time fairness: a returning tenant joins at the
+            # current virtual time instead of cashing in banked lag
+            active = [self._vt[t] for t, q in self._tq.items() if q]
+            if active:
+                self._vt[tname] = max(self._vt[tname], min(active))
+        self._tq[tname].append(rs)
+        self._c["admitted"] += 1
+        _events.emit("router.enqueued", rid=rid, tenant=tname,
+                     prompt_len=int(prompt.size))
+        return rid
+
+    def cancel(self, rid) -> bool:
+        """Cancel a queued or placed request; the ``cancelled``
+        completion surfaces from the next :meth:`step`."""
+        rs = self._reqs.get(rid)
+        if rs is None or rs.state == "done":
+            return False
+        if rs.state == "pending":
+            self._tq[rs.tenant].remove(rs)
+            self._finalize_local(rs, "cancelled")
+            return True
+        rep = self._by_name(rs.replica)
+        if rep is not None and rep.state != "dead":
+            return bool(rep.engine.cancel(rid))
+        return False
+
+    # ------------------------------------------------------ stepping --
+    def step(self):
+        """One fleet tick: failure detection -> QoS placement ->
+        watchdog-armed replica steps -> SLO-driven scaling.  Returns
+        the completions that surfaced this tick."""
+        now = self._clock()
+        self._tick += 1
+        out = list(self._finalized)
+        self._finalized.clear()
+        self._check_replicas(now)
+        out.extend(self._place(now))
+        for rep in list(self._replicas):
+            if rep.state not in ("live", "draining"):
+                continue
+            token = _watchdog.arm("router.step", self.watchdog_ms,
+                                  key=rep.name,
+                                  interrupt_exc=EngineStallError)
+            try:
+                with _tracing.span("router.step", replica=rep.name):
+                    cs = rep.engine.step()
+            except EngineStallError as e:
+                # the watchdog already captured stacks + the flight
+                # record; the death record is that one, not a second
+                self._kill(rep, "stall", error=e, flight=False)
+                continue
+            except ConnectionError as e:
+                self._kill(rep, "connection", error=e)
+                continue
+            finally:
+                token.disarm()
+            rep.last_beat = self._clock()
+            for c in cs:
+                done = self._finish(c, rep, now)
+                if done is not None:
+                    out.append(done)
+        self._maybe_scale(now)
+        return out
+
+    def run(self, max_steps=10000):
+        """Drain: step until every request completes.  Returns
+        ``{request_id: CompletedRequest}`` in completion order."""
+        import warnings
+        done = {}
+        for _ in range(max_steps):
+            if not self.has_work:
+                break
+            for c in self.step():
+                done[c.request_id] = c
+        if self.has_work:
+            warnings.warn(
+                f"FleetRouter.run: step budget ({max_steps}) exhausted "
+                f"with requests still in flight",
+                RuntimeWarning, stacklevel=2)
+        return done
+
+    @property
+    def has_work(self):
+        return (any(self._tq.values()) or bool(self._finalized)
+                or any(rep.rids or (rep.state in ("live", "draining")
+                                    and rep.engine.has_work)
+                       for rep in self._replicas
+                       if rep.state != "dead"))
+
+    # ---------------------------------------------- failure handling --
+    def _check_replicas(self, now):
+        for rep in list(self._replicas):
+            if rep.state not in ("live", "draining"):
+                continue
+            if faults.check(SITE_ROUTER_REPLICA_LOST, key=rep.name):
+                self._kill(rep, "fault_drill")
+            elif (self.heartbeat_timeout_ms
+                  and (now - rep.last_beat) * 1e3
+                  > self.heartbeat_timeout_ms):
+                self._kill(rep, "heartbeat_timeout")
+
+    def _kill(self, rep, reason, error=None, flight=True):
+        """Declare ``rep`` dead: generation bump, ONE coded flight
+        record, queued + in-flight requests requeued to survivors at
+        the front of their tenant queues (original placement order
+        preserved — with greedy decode that makes the faulted run
+        bitwise vs unfaulted)."""
+        if rep.state == "dead":
+            return
+        rep.state = "dead"
+        self._gen += 1
+        rep.gen = self._gen
+        affected = []
+        for rid in rep.rids:
+            rs = self._reqs.get(rid)
+            if rs is None or rs.state != "placed":
+                continue
+            rs.state = "pending"
+            rs.replica = None
+            rs.requeues += 1
+            affected.append(rs)
+        rep.rids.clear()
+        for rs in reversed(affected):
+            self._tq[rs.tenant].appendleft(rs)
+        self._c["requeues"] += len(affected)
+        self._c["deaths"] += 1
+        err = error if error is not None else ReplicaLostError(
+            f"replica {rep.name!r} declared dead ({reason}); "
+            f"{len(affected)} request(s) requeued to survivors "
+            f"[{ReplicaLostError.error_code}]")
+        if flight:
+            _flight.dump("router_replica_lost", error=err, extra={
+                "replica": rep.name, "reason": reason,
+                "generation": self._gen, "requeued": len(affected)})
+        _events.emit("router.replica_dead", replica=rep.name,
+                     reason=reason, requeued=len(affected),
+                     generation=self._gen)
+
+    # ----------------------------------------------------- placement --
+    def _remaining_ms(self, rs):
+        if rs.deadline is None:
+            return None
+        return (rs.deadline - self._clock()) * 1e3
+
+    @staticmethod
+    def _fits_limits(lim, prompt_len, max_new_tokens):
+        total = int(prompt_len) + int(max_new_tokens)
+        if total > lim["max_seq_len"]:
+            return False
+        need = -(-total // lim["page_size"])
+        return need <= lim["total_pages"] - 1
+
+    def _cap(self, rep):
+        """Placement budget this tick: resident slots plus a one-deep
+        admission queue per slot — enough to keep the mixed step fed
+        without parking whole tenants on one replica's queue (parked
+        requests cannot be fair-share reordered)."""
+        return max(0, 2 * rep.limits["max_slots"] - len(rep.rids))
+
+    def _live(self):
+        return [r for r in self._replicas if r.state == "live"]
+
+    def _pick_tenant(self):
+        """Strict priority across classes, weighted virtual-time fair
+        share within a class, admission order as the final tie."""
+        best = None
+        for name, q in self._tq.items():
+            if not q:
+                continue
+            spec = self._tenants[name]
+            key = (spec.priority, self._vt[name], self._torder[name])
+            if best is None or key < best[0]:
+                best = (key, name)
+        return None if best is None else best[1]
+
+    def _pick_replica(self, rs, caps):
+        cands = [rep for rep in self._live()
+                 if caps.get(rep.name, 0) > 0
+                 and self._fits_limits(rep.limits, rs.prompt.size,
+                                       rs.max_new_tokens)]
+        if not cands:
+            return None
+        if not self.affinity:
+            self._rr += 1
+            return cands[self._rr % len(cands)]
+        hits = {}
+        for rep in cands:
+            try:
+                hits[rep.name] = int(
+                    rep.engine.cached_prefix_tokens(rs.prompt))
+            except ConnectionError:
+                hits[rep.name] = 0   # suspect replica: heartbeat will
+                # time out / its step will fail; scoring must not kill
+        best = max(cands, key=lambda rep: (hits[rep.name],
+                                           -len(rep.rids), -rep.index))
+        if hits[best.name] > 0:
+            self._c["affinity_hits"] += 1
+            return best
+        self._c["affinity_spills"] += 1
+        return min(cands, key=lambda rep: (len(rep.rids), rep.index))
+
+    def _place(self, now):
+        out = []
+        if not self._live() and any(self._tq.values()):
+            # total fleet loss: failover to a standby immediately (no
+            # SLO verdict needed), else fail coded instead of hanging
+            if not self._scale_out(now, reason="failover"):
+                if not any(r.state in ("live", "draining")
+                           for r in self._replicas):
+                    raise ReplicaLostError(
+                        "every fleet replica is dead with requests "
+                        "still queued; add standby replicas for "
+                        f"failover [{ReplicaLostError.error_code}]")
+        caps = {rep.name: self._cap(rep) for rep in self._live()}
+        total = sum(caps.values())
+        while total > 0:
+            tname = self._pick_tenant()
+            if tname is None:
+                break
+            rs = self._tq[tname].popleft()
+            if rs.deadline is not None and now >= rs.deadline:
+                out.append(self._finalize_local(rs, "timeout"))
+                continue
+            rep = self._pick_replica(rs, caps)
+            if rep is None:
+                self._tq[tname].appendleft(rs)
+                break
+            if self._dispatch_place(rep, rs):
+                caps[rep.name] -= 1
+                total -= 1
+                self._vt[tname] += rs.cost / self._tenants[tname].weight
+            else:
+                # placement killed the replica; requeue at the front
+                # and re-derive the budget from the survivors
+                self._tq[tname].appendleft(rs)
+                caps = {rep.name: self._cap(rep)
+                        for rep in self._live()}
+                total = sum(caps.values())
+        return out
+
+    def _dispatch_place(self, rep, rs):
+        def call():
+            faults.maybe_raise(SITE_ROUTER_DISPATCH_TRANSIENT,
+                               str(rs.rid))
+            return rep.engine.add_request(
+                rs.prompt, rs.max_new_tokens, eos_token_id=rs.eos,
+                request_id=rs.rid, deadline_ms=self._remaining_ms(rs),
+                requeue=rs.requeues > 0)
+
+        def on_retry(_exc, _attempt):
+            self._c["retries"] += 1
+
+        try:
+            with _tracing.span("router.place", rid=str(rs.rid),
+                               replica=rep.name):
+                call_out = retry_call(
+                    call, max_attempts=self.dispatch_retries + 1,
+                    base_delay=0.005, max_delay=0.05,
+                    retry_on=(ConnectionError,), on_retry=on_retry)
+        except ConnectionError as e:
+            self._kill(rep, "dispatch", error=e)
+            return False
+        del call_out
+        rs.state = "placed"
+        rs.replica = rep.name
+        rep.rids[rs.rid] = True
+        self._c["placed"] += 1
+        if _obs_metrics.enabled():
+            wait = (self._clock() - rs.enq_t) * 1e3
+            self._h_queue.observe(wait)
+            self._th_queue[rs.tenant].observe(wait)
+        _events.emit("router.placed", rid=rs.rid, replica=rep.name,
+                     tenant=rs.tenant, requeue=rs.requeues)
+        return True
+
+    # -------------------------------------------------- completions --
+    def _finish(self, c, rep, now):
+        rs = self._reqs.get(c.request_id)
+        rep.rids.pop(c.request_id, None)
+        if rs is None or rs.state == "done":
+            return None    # late echo of a request finalized elsewhere
+        rs.state = "done"
+        self._c["finished"] += 1
+        self._observe_finish(rs, c.finish_reason)
+        return c
+
+    def _finalize_local(self, rs, reason):
+        """Finalize a request the replicas never completed (timeout in
+        the router queue, cancel while pending)."""
+        rs.state = "done"
+        self._c["finished"] += 1
+        if reason == "timeout":
+            self._c["timeouts"] += 1
+        self._observe_finish(rs, reason)
+        return CompletedRequest(rs.rid, rs.prompt,
+                                np.zeros(0, np.int32), reason)
+
+    def _fin_counter(self, cache, registry, reason):
+        c = cache.get(reason)
+        if c is None:
+            c = registry.counter(
+                "serving.finished", "requests retired by reason",
+                labels={"reason": reason}, always=True)
+            cache[reason] = c
+        return c
+
+    def _observe_finish(self, rs, reason):
+        self._fin_counter(self._fin_c, self._registry, reason).inc()
+        self._fin_counter(self._tfin[rs.tenant], self._treg[rs.tenant],
+                          reason).inc()
+        tslo = self._tslo.get(rs.tenant)
+        if tslo is not None:
+            tslo.maybe_evaluate(self._clock())
+        _events.emit("router.finished", rid=rs.rid, tenant=rs.tenant,
+                     reason=reason, requeues=rs.requeues)
+
+    # ------------------------------------------------------- scaling --
+    def _on_fleet_breach(self, status):
+        """Breach hook (fires once per not-breached -> breached
+        transition): the postmortem flight record, like the engine's
+        — the scale-out decision itself rides the latched status."""
+        _flight.dump("fleet_slo_breach", extra=dict(status))
+
+    def _maybe_scale(self, now):
+        if self._fleet_slo is not None:
+            st = self._fleet_slo.maybe_evaluate(now)
+            if st is not None:
+                self._breached = any(s["breached"] for s in st)
+        if self._breached:
+            self._last_breach_t = now
+            if (now >= self._next_scaleout_t
+                    and not any(r.state == "draining"
+                                for r in self._replicas)):
+                self._scale_out(now, reason="slo_breach")
+        elif (self._last_breach_t is not None
+              and now - self._last_breach_t >= self.scalein_hold_s):
+            self._scale_in(now)
+        # drain completion: a draining replica with no work returns
+        # to standby (cache intact — a re-admission is part-warm)
+        for rep in self._replicas:
+            if (rep.state == "draining" and not rep.rids
+                    and not rep.engine.has_work):
+                rep.state = "standby"
+                rep.scaled_out = False
+                self._gen += 1
+                rep.gen = self._gen
+                self._c["scaleins"] += 1
+                _events.emit("router.scalein", replica=rep.name,
+                             generation=self._gen)
+
+    def _scale_out(self, now, reason):
+        rep = next((r for r in self._replicas if r.state == "standby"),
+                   None)
+        if rep is None:
+            self._next_scaleout_t = now + self._scaleout_cooldown_s
+            _events.emit("router.scaleout_exhausted", reason=reason)
+            return False
+        token = _watchdog.arm("router.scaleout",
+                              self.scaleout_timeout_ms, key=rep.name,
+                              interrupt_exc=EngineStallError)
+        try:
+            with _tracing.span("router.scaleout", replica=rep.name,
+                               reason=reason):
+                # the drill body: a wedged standby (hung weight load,
+                # dead host) must surface coded, not hang the router
+                simulated_stall(rep.name,
+                                site=SITE_ROUTER_SCALEOUT_STALL)
+                rep.state = "live"
+                rep.scaled_out = reason != "failover"
+                rep.last_beat = self._clock()
+                self._gen += 1
+                rep.gen = self._gen
+        except Exception as e:
+            token.disarm()
+            self._c["scaleout_failures"] += 1
+            self._next_scaleout_t = now + self._scaleout_cooldown_s
+            _events.emit(
+                "router.scaleout_failed", replica=rep.name,
+                reason=reason, error=f"{type(e).__name__}: {e}",
+                code=getattr(type(e), "error_code", None),
+                flight=token.dump_path)
+            return False
+        finally:
+            token.disarm()
+        self._c["scaleouts"] += 1
+        self._next_scaleout_t = now + self._scaleout_cooldown_s
+        _events.emit("router.scaleout", replica=rep.name,
+                     reason=reason, generation=self._gen)
+        return True
+
+    def _scale_in(self, now):
+        rep = next((r for r in reversed(self._replicas)
+                    if r.state == "live" and r.scaled_out), None)
+        if rep is None:
+            return
+        if len(self._live()) <= max(1, self._base_live):
+            return
+        rep.state = "draining"
+        _events.emit("router.draining", replica=rep.name)
+
+    # ------------------------------------------------ observability --
+    def _reg_replica_gauges(self, rep):
+        g = self._registry.gauge(
+            "router.replica_load", "router-known in-flight requests",
+            labels={"replica": rep.name})
+        g.set_function(lambda rep=rep: len(rep.rids))
+        g = self._registry.gauge(
+            "router.replica_state",
+            "0=standby 1=live 2=draining 3=dead",
+            labels={"replica": rep.name})
+        g.set_function(lambda rep=rep: _STATE_CODE[rep.state])
+        g = self._registry.gauge(
+            "router.replica_generation",
+            "fleet generation at this replica's last state change",
+            labels={"replica": rep.name})
+        g.set_function(lambda rep=rep: rep.gen)
+
+    def _by_name(self, name):
+        for rep in self._replicas:
+            if rep.name == name:
+                return rep
+        return None
+
+    @property
+    def stats(self):
+        """Router counters plus live fleet gauges (always on — the
+        ``stats`` contract survives ``PDTPU_METRICS=off``)."""
+        d = self._c.as_dict()
+        d["queue_depth"] = sum(len(q) for q in self._tq.values())
+        d["replicas_live"] = sum(
+            1 for r in self._replicas if r.state == "live")
+        d["replicas_standby"] = sum(
+            1 for r in self._replicas if r.state == "standby")
+        d["replicas_draining"] = sum(
+            1 for r in self._replicas if r.state == "draining")
+        d["replicas_dead"] = sum(
+            1 for r in self._replicas if r.state == "dead")
+        d["generation"] = self._gen
+        d["tenants"] = {
+            name: {"queued": len(self._tq[name]),
+                   "weight": self._tenants[name].weight,
+                   "priority": self._tenants[name].priority}
+            for name in self._tenants}
+        return d
+
+    def metrics(self) -> dict:
+        """The router registry snapshot: counters, the fleet queue-ms
+        histogram, per-replica labeled gauges.  Per-request timelines
+        live on the replica engines (``router.replica('r0').
+        metrics()``)."""
+        return self._registry.snapshot()
+
+    def tenant_metrics(self, tenant) -> dict:
+        """One tenant's registry snapshot (queue-ms + finish reasons —
+        the inputs its per-tenant SLO is judged from)."""
+        return self._treg[str(tenant)].snapshot()
+
+    def render_prometheus(self) -> str:
+        return self._registry.render_prometheus()
+
+    def replica(self, name):
+        """The replica engine registered under ``name`` (``r0``...)."""
+        rep = self._by_name(str(name))
+        return None if rep is None else rep.engine
+
+    def replica_states(self) -> dict:
+        return {rep.name: rep.state for rep in self._replicas}
+
+    def slo_status(self) -> dict:
+        """Fleet-wide SLO picture: the fleet specs (scale-out's
+        inputs), per-tenant specs, and every replica's own engine SLO
+        status, keyed by replica name."""
+        out = {"fleet": ([] if self._fleet_slo is None
+                         else self._fleet_slo.status()),
+               "tenants": {name: eng.status()
+                           for name, eng in self._tslo.items()},
+               "replicas": {}}
+        for rep in self._replicas:
+            if rep.state == "dead":
+                continue
+            try:
+                out["replicas"][rep.name] = rep.engine.slo_status()
+            except (ConnectionError, AttributeError):
+                out["replicas"][rep.name] = []
+        return out

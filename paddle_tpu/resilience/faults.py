@@ -62,6 +62,23 @@ sleeps or randomness:
   injected into the stalled dispatch; co-residents complete bitwise
   on the re-dispatch. Key = dispatch kind (``mixed``/``decode``/
   ``window``/``verify``).
+* ``router_replica_lost`` — one fleet-serving replica
+  (``inference.router.FleetRouter``) is declared dead mid-decode:
+  the router requeues its queued AND in-flight requests to the
+  surviving replicas (from-scratch re-prefill, restoring from the
+  survivors' prefix caches where pages match) — outputs stay
+  bitwise, the ``deaths``/``requeues`` counters move, and exactly
+  one coded flight record (``ReplicaLostError`` PDT-E024) is
+  written. Key = the replica name.
+* ``router_dispatch_transient`` — one router->replica placement
+  raises ``InjectedConnectionError``; absorbed by the bounded retry
+  every placement runs under (``serving_fleet_dispatch_retries``;
+  the router ``retries`` counter moves). Key = the request id.
+* ``router_scaleout_stall`` — one standby-replica admission
+  (SLO-breach scale-out) hangs; past the scale-out watchdog deadline
+  it surfaces ``EngineStallError`` (PDT-E020) + a flight record and
+  the fleet degrades gracefully (standby stays parked, live replicas
+  keep serving). Key = the standby replica name.
 * ``rank_dead``          — an elastic-training rank
   (``resilience/elastic_train.py`` ``FleetSupervisor``) dies at a
   step boundary: heartbeats stop, its collective contribution never

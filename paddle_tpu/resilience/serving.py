@@ -58,6 +58,28 @@ Serving fault sites (``resilience.faults`` spec grammar):
   dispatch, which surfaces coded from ``step()`` — co-resident
   requests then complete bitwise on the re-dispatched plan. Key =
   dispatch kind (``mixed``/``decode``/``window``/``verify``).
+* ``router_replica_lost`` — one fleet replica
+  (``inference.router.FleetRouter``) is declared dead mid-decode:
+  its queued AND in-flight requests requeue to the surviving
+  replicas, which re-prefill them from token zero (restoring from
+  their own prefix caches where pages match) — outputs stay bitwise
+  (greedy decode is deterministic and batch-invariant), only
+  ``requeues``/``deaths`` move and exactly one coded flight record
+  (``ReplicaLostError`` PDT-E024) is written. Key = the replica
+  name.
+* ``router_dispatch_transient`` — one router->replica placement
+  dispatch raises ``InjectedConnectionError``; absorbed by the
+  bounded ``resilience.retry`` every placement runs under
+  (``serving_fleet_dispatch_retries``), only the router ``retries``
+  counter moves. Exhausting the retry budget is treated as a dead
+  replica (the request requeues, the replica is killed). Key = the
+  request id.
+* ``router_scaleout_stall`` — one standby-replica admission
+  (SLO-breach scale-out) HANGS, drilling the scale-out watchdog:
+  past ``serving_fleet_scaleout_timeout_ms`` the admission surfaces
+  ``EngineStallError`` (PDT-E020) with a flight record and the fleet
+  DEGRADES GRACEFULLY — the standby stays parked and the live
+  replicas keep serving. Key = the standby replica name.
 """
 from __future__ import annotations
 
@@ -72,7 +94,8 @@ __all__ = [
     "SITE_DISPATCH", "SITE_NAN_DECODE", "SITE_PAGE_PRESSURE",
     "SITE_CACHE_EVICT", "SITE_DRAFT_NAN", "SITE_DRAFT_MISMATCH",
     "SITE_HANDOFF_TRANSIENT", "SITE_DECODE_WORKER_LOST",
-    "SITE_STALL",
+    "SITE_STALL", "SITE_ROUTER_REPLICA_LOST",
+    "SITE_ROUTER_DISPATCH_TRANSIENT", "SITE_ROUTER_SCALEOUT_STALL",
 ]
 
 #: Every value ``CompletedRequest.finish_reason`` can take.
@@ -87,24 +110,28 @@ SITE_DRAFT_MISMATCH = "engine_draft_mismatch"
 SITE_HANDOFF_TRANSIENT = "engine_handoff_transient"
 SITE_DECODE_WORKER_LOST = "engine_decode_worker_lost"
 SITE_STALL = "engine_stall"
+SITE_ROUTER_REPLICA_LOST = "router_replica_lost"
+SITE_ROUTER_DISPATCH_TRANSIENT = "router_dispatch_transient"
+SITE_ROUTER_SCALEOUT_STALL = "router_scaleout_stall"
 
 
-def simulated_stall(key: str, max_s: float = 30.0):
+def simulated_stall(key: str, max_s: float = 30.0, site: str = SITE_STALL):
     """The ``engine_stall`` drill body: when the site fires, spin in
     Python (interpreter-visible, so the watchdog's injected
     ``EngineStallError`` lands at the next bytecode boundary — a real
     wedged C call could only be stack-dumped).  The spin is BOUNDED:
     with no watchdog armed the drill raises after ``max_s`` instead of
     hanging tier-1, which is the exact failure mode the watchdog
-    exists to prevent."""
+    exists to prevent.  ``site`` lets the other stall drills
+    (``router_scaleout_stall``) reuse the same body."""
     import time as _time
-    if not faults.check(SITE_STALL, key=str(key)):
+    if not faults.check(site, key=str(key)):
         return
     t0 = _time.monotonic()
     while _time.monotonic() - t0 < max_s:
         _time.sleep(0.002)
     raise RuntimeError(
-        f"engine_stall drill (key={key!r}): no watchdog interrupted "
+        f"{site} drill (key={key!r}): no watchdog interrupted "
         f"the stalled dispatch within {max_s}s — arm watchdog_ms / "
         "the watchdog_stall_ms flag when drilling this site")
 

@@ -85,6 +85,10 @@ _TIER1_ORDER = [
     # suite and reuses the session serving_gpt + the same geometry)
     "test_pallas.py", "test_quant_serving.py", "test_serving_engine.py",
     "test_speculative.py", "test_distserve.py",
+    # test_router is the ISSUE-17 fleet-routing acceptance suite; it
+    # reuses the session serving_gpt + the same geometry, so every
+    # replica engine rides the already-compiled serving programs
+    "test_router.py",
     # <- unlisted files slot in here (rank _TIER1_DEFAULT)
     # medium density; the budget cutoff lands somewhere below
     "test_fft_signal_distribution.py", "test_op_tail.py",
