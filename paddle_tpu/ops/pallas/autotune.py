@@ -20,9 +20,14 @@ paged_attention.pick_pages_per_block; candidates are powers of two
 bounded by the block-table width and a VMEM cap, cache hits apply under
 a trace, sweeps run on synthetic decode shapes when enabled),
 ``fused_optimizer_rows`` (row-block of the fused optimizer update —
-fused_optimizer.pick_rows) and ``quant_matmul_blocks`` ((bm, bn) output
+fused_optimizer.pick_rows), ``quant_matmul_blocks`` ((bm, bn) output
 tiling of the fused weight-only int8 matmul —
-quant_matmul.pick_blocks).
+quant_matmul.pick_blocks), ``fused_decode_qkv_rows`` (row block of the
+decode megakernel's norm+QKV+rope+paged-append ingress kernel —
+fused_decode_qkv.pick_qkv_rows; candidates VMEM-capped, default one
+block covering the whole decode batch) and ``fused_decode_mlp_rows``
+(row block of the megakernel's out-proj+residual+MLP egress kernel —
+fused_decode_mlp.pick_mlp_rows).
 
 LIMITATION (measured, round 4): the sweep times candidates in an
 isolated chained program; the winner inside a REAL train step can

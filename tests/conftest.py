@@ -84,6 +84,12 @@ _TIER1_ORDER = [
     # programs; test_distserve is the ISSUE-13 TP/disagg acceptance
     # suite and reuses the session serving_gpt + the same geometry)
     "test_pallas.py", "test_quant_serving.py", "test_serving_engine.py",
+    # test_decode_megakernel is the ISSUE-18 acceptance suite (fused
+    # decode kernels bitwise vs twins, engine on/off bitwise over the
+    # serving workloads); it reuses the session serving_gpt + the
+    # serving-suite geometry, so the unfused halves of its comparisons
+    # ride the already-compiled programs
+    "test_decode_megakernel.py",
     "test_speculative.py", "test_distserve.py",
     # test_router is the ISSUE-17 fleet-routing acceptance suite; it
     # reuses the session serving_gpt + the same geometry, so every
