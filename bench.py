@@ -81,6 +81,12 @@ _HARNESS_FILES = [
     # row's dominant backward kernel: its code must cold the training
     # caches so the rebuilt backward re-measures on the next TPU run
     "paddle_tpu/ops/pallas/flash_attention.py",
+    # the fused residual+norm glue kernels and the prefetch/remat train
+    # loop (ISSUE 19) sit inside every training row's step: glue-kernel
+    # or fit-loop code changes must cold the training caches so the
+    # rows re-measure with the current chain on the next TPU run
+    "paddle_tpu/ops/pallas/fused_residual_norm.py",
+    "paddle_tpu/hapi/model.py",
     "paddle_tpu/amp/__init__.py",
     "paddle_tpu/nn/functional/norm.py",
     # distributed tracing + fleet aggregation (ISSUE 12) ride the

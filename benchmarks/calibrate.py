@@ -305,6 +305,261 @@ def measure_decode_dispatches(hidden=32, heads=4, vocab=96,
     return out
 
 
+def _scanned_glue(rows, hidden, reps, bwd, fused):
+    """One jit program running ``reps`` residual-add+layer-norm glue
+    chains (optionally + input/weight/bias grads), index-perturbed like
+    the matmul scan. ``fused`` picks the ISSUE-19 single-dispatch
+    kernel; unfused is the dispatch chain the training blocks emit
+    today (add, then the Pallas layer_norm). Both consume the residual
+    AND the normed output so neither branch can be elided."""
+    from paddle_tpu.ops.pallas import fused_residual_norm as frn
+    from paddle_tpu.ops.pallas import norms
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(rows, hidden)) * 0.1, jnp.float32)
+    y = jnp.asarray(rng.normal(size=(rows, hidden)) * 0.1, jnp.float32)
+    w = jnp.ones((hidden,), jnp.float32)
+    b = jnp.zeros((hidden,), jnp.float32)
+
+    if fused:
+        def one(x, y, w, b):
+            res, o = frn.fused_residual_layer_norm(x, y, w, b)
+            return res + o
+    else:
+        def one(x, y, w, b):
+            res = x + y
+            return res + norms.layer_norm(res, w, b)
+
+    if not bwd:
+        @jax.jit
+        def f(x, y, w, b):
+            def body(c, i):
+                return c + one(x + i.astype(x.dtype) * 1e-6, y, w, b), None
+            return jax.lax.scan(body, jnp.zeros_like(x),
+                                jnp.arange(reps))[0]
+    else:
+        grad = jax.grad(lambda x, y, w, b: one(x, y, w, b).sum(),
+                        argnums=(0, 1, 2, 3))
+
+        @jax.jit
+        def f(x, y, w, b):
+            def body(c, i):
+                dx, dy, dw, db = grad(x + i.astype(x.dtype) * 1e-6,
+                                      y, w, b)
+                return c + dx + dy + (dw.sum() + db.sum()), None
+            return jax.lax.scan(body, jnp.zeros_like(x),
+                                jnp.arange(reps))[0]
+
+    return f, (x, y, w, b)
+
+
+def measure_glue(rows, hidden, r1=16, r2=96):
+    """Fused vs unfused training-glue kernel ms (fwd and bwd) via the
+    two-R slope."""
+    res = {}
+    for kind, fused in (("fused", True), ("unfused", False)):
+        res[kind] = {}
+        for tag, bwd in (("fwd", False), ("bwd", True)):
+            f1, a1 = _scanned_glue(rows, hidden, r1, bwd, fused)
+            f2, a2 = _scanned_glue(rows, hidden, r2, bwd, fused)
+            per_op = max((_time_call(f2, *a2) - _time_call(f1, *a1))
+                         / (r2 - r1), 1e-9)
+            res[kind][tag] = {"ms": round(per_op * 1e3, 4)}
+    return res
+
+
+def measure_train_glue_dispatches(hidden=32, heads=4, vocab=96, seq=16,
+                                  batch=2):
+    """Per-layer TRAINING-forward dispatch count of the GPT block
+    chain, glue fusion off vs on (ISSUE 19) — counted exactly by the
+    profiler op-hook at L=1/L=2 like ``measure_decode_dispatches``; the
+    difference isolates the per-layer chain from embedding/final-norm
+    constants. Forward-only by construction: the backward replays
+    inside ``jax.vjp`` and never re-enters the dispatcher, so its cost
+    shows up in the ``measure_glue`` scan-slope ms, not here. The
+    ``glue_*`` counts are the norm/residual subset (add, layer_norm,
+    rms_norm, fused_residual_norm) of the totals."""
+    import paddle_tpu as pp
+    from paddle_tpu.core import dispatch as _dispatch
+    from paddle_tpu.core import state as _state
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.gpt import GPTConfig, GPTModel
+
+    GLUE_OPS = ("add", "layer_norm", "rms_norm", "fused_residual_norm")
+
+    def count_ops(layers, fused):
+        pp.seed(0)
+        cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                        num_layers=layers, num_heads=heads,
+                        max_seq_len=seq, dropout=0.0,
+                        use_flash_attention=False)
+        model = GPTModel(cfg)
+        model.train()
+        ids = Tensor(np.zeros((batch, seq), np.int32))
+        n, g = [0], [0]
+
+        def hook(name, t0, t1):
+            n[0] += 1
+            if name in GLUE_OPS:
+                g[0] += 1
+
+        # flag hygiene: entry flag restored on ANY exit (the PR4
+        # setup-inside-the-try rule) — a crashed count must not leave
+        # glue fusion flipped for the rest of the process
+        old = _state.get_flag("train_glue_fusion")
+        _dispatch._profile_hook = hook
+        try:
+            _state.set_flags({"train_glue_fusion": fused})
+            with pp.no_grad():
+                model(ids)
+        finally:
+            _dispatch._profile_hook = None
+            _state.set_flags({"train_glue_fusion": old})
+        return n[0], g[0]
+
+    u1, gu1 = count_ops(1, False)
+    u2, gu2 = count_ops(2, False)
+    f1, gf1 = count_ops(1, True)
+    f2, gf2 = count_ops(2, True)
+    out = {
+        "method": "op-hook dispatch count of one eager TRAIN forward "
+                  "(L=2 minus L=1 isolates the per-layer chain; "
+                  "backward runs inside jax.vjp, not counted)",
+        "unfused_per_layer": u2 - u1,
+        "fused_per_layer": f2 - f1,
+        "glue_unfused_per_layer": gu2 - gu1,
+        "glue_fused_per_layer": gf2 - gf1,
+    }
+    _log(f"train glue dispatches/layer: {out['unfused_per_layer']} -> "
+         f"{out['fused_per_layer']} (glue subset "
+         f"{out['glue_unfused_per_layer']} -> "
+         f"{out['glue_fused_per_layer']})")
+    return out
+
+
+def measure_remat_fraction(hidden=32, heads=4, vocab=96, seq=16,
+                           batch=2, layers=2,
+                           policy="dots_and_kernels_saveable"):
+    """Recompute fraction of selective remat, as an exact program-size
+    count: flattened jaxpr eqns of the captured train step with remat
+    on minus off, over the forward-only eqn count — 'what share of the
+    forward does the backward replay'. Uses the analyzer's
+    ``jaxpr_eqn_count`` stamp (``analysis.flat_eqn_count`` recursing
+    into remat sub-jaxprs), so it needs PDTPU_ANALYSIS != off; returns
+    None fractions when the stamp is unavailable."""
+    import paddle_tpu as pp
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    def eqns(remat, fwd_only=False):
+        pp.seed(0)
+        cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                        num_layers=layers, num_heads=heads,
+                        max_seq_len=seq, dropout=0.0,
+                        use_flash_attention=False)
+        m = GPTForCausalLM(cfg)
+        if remat:
+            for blk in m.gpt.blocks:
+                blk._recompute = True
+                blk._recompute_policy = policy
+        m.train()
+        opt = pp.optimizer.SGD(learning_rate=0.01,
+                               parameters=m.parameters())
+
+        if fwd_only:
+            @pp.jit.to_static(full_graph=True)
+            def step(ids, labels):
+                return m(ids, labels)
+        else:
+            @pp.jit.to_static(full_graph=True)
+            def step(ids, labels):
+                loss = m(ids, labels)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss
+        ids = pp.to_tensor(np.zeros((batch, seq), np.int32))
+        step(ids, ids)
+        exe = next(iter(step._cache.values()))
+        return int(getattr(exe, "jaxpr_eqn_count", 0) or 0)
+
+    fwd = eqns(False, fwd_only=True)
+    off = eqns(False)
+    on = eqns(True)
+    frac = round((on - off) / fwd, 3) if fwd and off and on else None
+    out = {
+        "method": "flattened jaxpr eqn count of the captured train "
+                  "step (analysis.flat_eqn_count), remat on minus off "
+                  "over the forward-only count",
+        "policy": policy,
+        "fwd_eqns": fwd,
+        "step_eqns": off,
+        "step_eqns_remat": on,
+        "recompute_fraction": frac,
+    }
+    _log(f"remat recompute fraction [{policy}]: {frac} "
+         f"(fwd {fwd} eqns, step {off} -> {on})")
+    return out
+
+
+def train_batch_headroom(budget_gb=16.0, hidden=768, layers=4, heads=12,
+                         vocab=1024, seq=256, batches=(1, 2, 4, 8, 16),
+                         remat=None):
+    """Walk doubling batch sizes against the PR16 static-peak gauge:
+    capture the full train step (fwd+bwd+optimizer) at each batch size
+    and read the analyzer's ``static_peak_bytes`` off the executable —
+    the same number the ``hbm.static_peak_bytes{fn}`` gauge exports.
+    ``remat`` (a fleet.recompute policy name) prices the selective-
+    remat headroom: the largest batch whose static peak fits the
+    budget is the train-batch headroom of the config. A CAPTURE-only
+    walk — nothing trains; rows after the first over-budget batch are
+    skipped (the peak is monotone in batch)."""
+    import paddle_tpu as pp
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    budget = int(budget_gb * (1 << 30))
+    rows, max_fit = [], None
+    for bs in batches:
+        pp.seed(0)
+        cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                        num_layers=layers, num_heads=heads,
+                        max_seq_len=seq, dropout=0.0,
+                        use_flash_attention=False)
+        m = GPTForCausalLM(cfg)
+        if remat:
+            for blk in m.gpt.blocks:
+                blk._recompute = True
+                blk._recompute_policy = remat
+        m.train()
+        opt = pp.optimizer.SGD(learning_rate=0.01,
+                               parameters=m.parameters())
+
+        @pp.jit.to_static(full_graph=True)
+        def step(ids, labels):
+            loss = m(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        ids = pp.to_tensor(np.zeros((bs, seq), np.int32))
+        step(ids, ids)
+        exe = next(iter(step._cache.values()))
+        peak = int(getattr(exe, "static_peak_bytes", 0) or 0)
+        fits = bool(peak and peak <= budget)
+        rows.append({"batch": bs, "static_peak_bytes": peak,
+                     "fits": fits})
+        _log(f"headroom: batch {bs} static peak "
+             f"{peak / (1 << 20):.0f} MiB "
+             f"({'fits' if fits else 'OVER'} {budget_gb} GiB)"
+             + (f" [remat={remat}]" if remat else ""))
+        if fits:
+            max_fit = bs
+        elif peak:
+            break  # monotone: larger batches only get worse
+    return {"budget_bytes": budget, "remat": remat,
+            "max_batch_fits": max_fit, "rows": rows}
+
+
 def kernel_breakdown(batch=8, seq=1024, hidden=768, heads=12, layers=12,
                      n_params=None, att=None):
     """Per-kernel fwd/bwd breakdown at the bench GPT-124M shapes —
@@ -339,12 +594,29 @@ def kernel_breakdown(batch=8, seq=1024, hidden=768, heads=12, layers=12,
         # decode layer, unfused vs fused — the serving-latency lever
         # the serving_bench launch_share column prices out
         "decode_dispatches": measure_decode_dispatches(),
+        # training glue share (ISSUE 19): norm/residual dispatch count
+        # per TRAIN layer (fused vs unfused) plus the fused-vs-unfused
+        # glue chain ms, fwd and bwd — the per-step glue budget the
+        # train_glue_fusion flag buys back
+        "glue": dict(measure_train_glue_dispatches(),
+                     **{"chain": dict(measure_glue(batch * seq, hidden),
+                                      shape=[batch * seq, hidden])}),
+        # selective-remat recompute share (ISSUE 19): exact program-
+        # size fraction the backward replays under the default policy
+        "remat": measure_remat_fraction(),
     }
+    glue_ms = out["glue"]["chain"]
     _log(f"kernels: attn fwd {att['fwd']['ms']} ms / bwd "
          f"{att['bwd']['ms']} ms (ratio {out['attention_bwd_fwd_ratio']}"
          f"), ln fwd {out['layernorm']['fwd']['ms']} / bwd "
          f"{out['layernorm']['bwd']['ms']} ms, fused-opt "
          f"{out['fused_optimizer']['ms']} ms")
+    _log(f"glue chain: fused fwd {glue_ms['fused']['fwd']['ms']} / bwd "
+         f"{glue_ms['fused']['bwd']['ms']} ms vs unfused fwd "
+         f"{glue_ms['unfused']['fwd']['ms']} / bwd "
+         f"{glue_ms['unfused']['bwd']['ms']} ms; "
+         f"remat recompute fraction "
+         f"{out['remat']['recompute_fraction']}")
     return out
 
 

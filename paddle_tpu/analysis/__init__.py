@@ -42,15 +42,16 @@ from .engine import (  # noqa: E402,F401
     reset_reported)
 from .program import (  # noqa: E402,F401
     AuditResult, CollectiveOp, audit_counts, audit_executable,
-    audit_jaxpr, audit_jitted, collective_schedule, live_ranges,
-    schedule_hash, static_peak_bytes, verify_schedule)
+    audit_jaxpr, audit_jitted, collective_schedule, flat_eqn_count,
+    live_ranges, schedule_hash, static_peak_bytes, verify_schedule)
 
 __all__ = [
     "REGISTRY", "AuditResult", "CheckSpec", "CollectiveOp", "Diagnostic",
     "Severity", "LintWarning", "analyze_file", "analyze_source",
     "audit_counts", "audit_executable", "audit_jaxpr", "audit_jitted",
     "check_executable", "check_function", "check_jaxpr", "check_traced",
-    "collect", "collective_schedule", "exercise", "lint_callable",
+    "collect", "collective_schedule", "exercise", "flat_eqn_count",
+    "lint_callable",
     "lint_executable", "live_ranges", "mode", "pragma_suppressed",
     "register", "register_runtime", "report", "report_runtime",
     "reset_reported", "schedule_hash", "spec", "static_peak_bytes",

@@ -1431,3 +1431,101 @@ def check_unfused_decode_serving(fndef, ctx):
                 "flag) for the fused ~3-kernel decode path; token "
                 "streams are bitwise-identical, only "
                 "dispatches-per-token moves")
+
+
+# batch-staging calls a custom train loop pays synchronously per step:
+# to_tensor / Tensor() host->device conversion and jax device_put. The
+# .numpy() direction (device->host readback of the loss) already has
+# its own coded finding (PDT101 inside jit); here it marks the loop as
+# feeding the device from host data, same as the converters.
+_INPUT_STAGE_CALLS = {"to_tensor", "device_put", "Tensor", "asarray"}
+
+
+def _loop_stages_and_steps(loop):
+    """Does ONE loop body both stage host batches and run a train
+    step?  Staging = a conversion call from ``_INPUT_STAGE_CALLS``;
+    a step = a ``.backward()`` call (the unambiguous train marker) or
+    a ``train_batch``/``step`` method call."""
+    stages = steps = False
+    for node in ast.walk(loop):
+        if not isinstance(node, ast.Call):
+            continue
+        name = (_dotted(node.func) or "").split(".")[-1]
+        if name in _INPUT_STAGE_CALLS:
+            stages = True
+        elif name in ("backward", "train_batch"):
+            steps = True
+        if stages and steps:
+            return True
+    return False
+
+
+@register(
+    "PDT121", "eager-input-feed", Severity.NOTE, "ast",
+    scope="eager",
+    example="""
+import paddle_tpu as paddle
+
+def train(model, opt, loader, loss_fn):
+    for batch in loader:
+        ids = paddle.to_tensor(batch[0])
+        lab = paddle.to_tensor(batch[1])
+        loss = loss_fn(model(ids), lab)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+""",
+    near_miss="""
+import paddle_tpu as paddle
+
+def train(model, opt, loader, loss_fn):
+    staged = None
+    for batch in loader:
+        ids, lab = staged if staged else (paddle.to_tensor(batch[0]),
+                                          paddle.to_tensor(batch[1]))
+        staged = None  # prefetch: next batch staged under the step
+        loss = loss_fn(model(ids), lab)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+""")
+def check_eager_input_feed(fndef, ctx):
+    """A hand-written train loop that stages its batches SYNCHRONOUSLY
+    inside the step loop — ``to_tensor``/``device_put`` conversion in
+    the same loop body as the ``backward()`` — with no prefetch knob
+    anywhere in scope.  Every step then serializes host->device
+    transfer with device compute: the chip idles for the full staging
+    time, per step.  ``hapi.Model.fit`` double-buffers this for free
+    (the ``train_prefetch`` flag: batch N+1 stages while step N is in
+    flight, bitwise-identical loss trajectory, the wait surfaces as
+    ``train.input_wait_ms``); custom loops can do the same by staging
+    the next batch between the step's dispatch and its loss readback.
+    Note-level advice: profile-time rigs that want the synchronous
+    cost visible are legitimate.  Suppressed when anything named
+    ``*prefetch*`` is in scope (a knob or a hand-rolled feed) or the
+    loop is already double-buffered through a ``staged``/``queue``
+    variable the loop consumes."""
+    src_names = set()
+    for node in _walk_fn(fndef):
+        if isinstance(node, ast.Name):
+            src_names.add(node.id.lower())
+        elif isinstance(node, ast.Attribute):
+            src_names.add(node.attr.lower())
+        elif isinstance(node, ast.Constant) and isinstance(node.value,
+                                                          str):
+            src_names.add(node.value.lower())
+    if any("prefetch" in n or n == "staged" for n in src_names):
+        return
+    for node in _walk_fn(fndef):
+        if isinstance(node, (ast.For, ast.While)) \
+                and _loop_stages_and_steps(node):
+            yield node, (
+                "batches are staged synchronously inside the step "
+                "loop (to_tensor/device_put in the same body as "
+                "backward()): host->device transfer serializes with "
+                "device compute every step — use hapi.Model.fit's "
+                "train_prefetch double-buffering (bitwise-identical "
+                "loss trajectory; the residual wait surfaces as "
+                "train.input_wait_ms), or stage batch N+1 between "
+                "the step's dispatch and its loss readback")
+            return

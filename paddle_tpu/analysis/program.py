@@ -792,6 +792,28 @@ class AuditResult:
     where: str = "<jaxpr>"
 
 
+def flat_eqn_count(jaxpr) -> int:
+    """Total equation count of a jaxpr INCLUDING every call-like
+    sub-jaxpr (pjit, remat/checkpoint, scan, custom_vjp, ...) — the
+    denominator-independent size measure ``calibrate.
+    measure_remat_fraction`` uses: a remat region's recomputed forward
+    lives in a ``remat``-primitive sub-jaxpr, invisible to a top-level
+    count."""
+    from jax import core as _jcore  # noqa: F401  (import parity)
+    total = 0
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)  # ClosedJaxpr -> Jaxpr
+    for eqn in jaxpr.eqns:
+        total += 1
+        for v in eqn.params.values():
+            if hasattr(v, "eqns") or hasattr(v, "jaxpr"):
+                total += flat_eqn_count(v)
+            elif isinstance(v, (tuple, list)):
+                for item in v:
+                    if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                        total += flat_eqn_count(item)
+    return total
+
+
 # process-level per-code tally (bench round records; regression sentinel)
 _audit_counts: dict[str, int] = {}
 
@@ -870,6 +892,13 @@ def audit_executable(exe, *, where: str = "", fn=None
         return None
     exe.static_peak_bytes = res.peak_bytes
     exe.schedule_hash = res.schedule_hash
+    # flattened program size, stashed before the jaxpr is released:
+    # remat A/Bs read it off cached executables (the recompute fraction
+    # is extra eqns / baseline eqns — see calibrate.py)
+    try:
+        exe.jaxpr_eqn_count = flat_eqn_count(closed)
+    except Exception:
+        exe.jaxpr_eqn_count = 0
     _engine.report(res.diags, where=where)
     return res
 

@@ -92,7 +92,7 @@ class PyLayer(metaclass=PyLayerMeta):
 
             node = Node(
                 cls.__name__, vjp_fn, inputs=diff_inputs,
-                out_ids=[id(o) for o in outs],
+                out_ids=[o._uid for o in outs],
                 out_avals=[jax.ShapeDtypeStruct(tuple(o.shape), o.dtype)
                            for o in outs],
                 seq_type=None if single else tuple)

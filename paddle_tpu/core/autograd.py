@@ -35,7 +35,8 @@ class Node:
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = inputs        # diff-input Tensors (strong refs = TensorWrapper)
-        self.out_ids = out_ids      # id() of each output tensor
+        self.out_ids = out_ids      # ._uid of each output tensor (never
+                                    # reused, unlike id() of a freed one)
         self.out_avals = out_avals  # ShapeDtypeStruct per output
         self.pure = pure            # primal fn of the diff inputs (for create_graph)
         self.seq_type = seq_type    # None | tuple | list: primal output pytree
@@ -119,7 +120,8 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, accumulate=True
     final, accumulate leaf grads.
 
     If ``accumulate`` write ``.grad`` on leaves; always returns a dict
-    ``id(tensor) -> grad array`` for tensors in ``inputs`` (paddle.grad path).
+    ``tensor._uid -> grad array`` for tensors in ``inputs`` (paddle.grad
+    path).
     """
     from .tensor import Tensor
 
@@ -148,8 +150,8 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, accumulate=True
             g = g._read() if isinstance(g, Tensor) else jnp.asarray(g)
         if create_graph:
             g = Tensor(g, stop_gradient=True)
-        _accum(grad_buf, id(t), g)
-        keepalive[id(t)] = t
+        _accum(grad_buf, t._uid, g)
+        keepalive[t._uid] = t
 
     # --- build reachable node set (walk producers through inputs) ---
     reachable: set[int] = set()
@@ -169,12 +171,12 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, accumulate=True
             if ti._node is not None:
                 stack.append(ti._node)
 
-    # consumer_count[tensor_id] = reachable nodes consuming that tensor
+    # consumer_count[tensor_uid] = reachable nodes consuming that tensor
     consumer_count: dict[int, int] = {}
     for n in nodes.values():
         for ti in n.inputs:
-            consumer_count[id(ti)] = consumer_count.get(id(ti), 0) + 1
-            keepalive[id(ti)] = ti
+            consumer_count[ti._uid] = consumer_count.get(ti._uid, 0) + 1
+            keepalive[ti._uid] = ti
 
     # node_wait[node] = its outputs that still have pending consumers
     node_wait: dict[int, int] = {}
@@ -245,15 +247,15 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, accumulate=True
             if cot is not None and not (
                     not isinstance(cot, _T) and hasattr(cot, "dtype")
                     and cot.dtype == jax.dtypes.float0):
-                _accum(grad_buf, id(ti), cot)
-            consumer_count[id(ti)] -= 1
-            if consumer_count[id(ti)] == 0:
-                finalize(id(ti))
+                _accum(grad_buf, ti._uid, cot)
+            consumer_count[ti._uid] -= 1
+            if consumer_count[ti._uid] == 0:
+                finalize(ti._uid)
 
     # Seed tensors with no reachable consumers are final too (leaf seeds).
     for t in tensors:
-        if consumer_count.get(id(t), 0) == 0:
-            finalize(id(t))
+        if consumer_count.get(t._uid, 0) == 0:
+            finalize(t._uid)
 
     if not retain_graph:
         for n in processed:
@@ -264,7 +266,7 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, accumulate=True
             n.consumed = True
 
     if inputs is not None:
-        return {id(t): grad_buf.get(id(t)) for t in inputs}
+        return {t._uid: grad_buf.get(t._uid) for t in inputs}
     return None
 
 
@@ -291,7 +293,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                        create_graph=create_graph)
     grads = []
     for t in inputs:
-        g = res.get(id(t))
+        g = res.get(t._uid)
         if g is None:
             if not allow_unused:
                 raise RuntimeError(

@@ -173,7 +173,7 @@ def _apply(name: str, fn: Callable, *args, **kwargs):
     node = Node(
         name, None,
         inputs=[tensors[i] for i in diff_idx],
-        out_ids=[id(o) for o in node_outs],
+        out_ids=[o._uid for o in node_outs],
         out_avals=[jax.ShapeDtypeStruct(o._data.shape, o._data.dtype)
                    for o in node_outs],
         pure=pure,

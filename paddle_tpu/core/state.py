@@ -297,6 +297,54 @@ define_flag("pp_overlap_p2p", True,
             "under compute. Pure reordering of independent ops — "
             "values are bitwise-identical either way; off restores the "
             "send-last order for A/B timing.")
+define_flag("train_glue_fusion", False,
+            "fused residual-add+norm training glue kernels (ISSUE 19, "
+            "ops/pallas/fused_residual_norm.py): GPT/LLaMA training "
+            "forwards thread a pending-branch through the block stack "
+            "so every (residual add, pre-norm) pair — and the final "
+            "norm — runs as ONE fused fwd/bwd Pallas dispatch; BERT's "
+            "post-LN pairs fuse in place. Train-mode only (eval/serving "
+            "keep the unfused path and its numerics). Default off: the "
+            "standalone Pallas LN measured as a fusion BARRIER "
+            "in-context (+6 ms/step on the GPT-124M bench, see "
+            "nn/functional/norm.py) — the fused glue path ships dark "
+            "until the TPU round prices it end-to-end, the "
+            "serving_megakernel precedent. Numerics differ from the "
+            "unfused chain by norm-formula ulps (two-pass variance vs "
+            "E[x^2]-E[x]^2), so this is an A/B knob, not a "
+            "bitwise-neutral toggle.")
+# Spellings for the glue-fusion knob (same strict convention as
+# kv_quant/megakernel: dispatch count is a measured claim, so an
+# unrecognized spelling must raise, never silently pick a path).
+GLUE_FUSION_OFF_SPELLINGS = KV_QUANT_OFF_SPELLINGS
+GLUE_FUSION_ON_SPELLINGS = KV_QUANT_ON_SPELLINGS
+define_flag("train_remat", "",
+            "default selective-remat policy for hapi.Model training "
+            "(ISSUE 19): when Model.prepare(remat=None) and this flag "
+            "is non-empty, every remat-capable transformer block of "
+            "the network gets activation recompute with this "
+            "jax.checkpoint policy ('full', 'dots_saveable', "
+            "'dots_and_kernels_saveable', 'transformer_saveable'; an "
+            "on-spelling like '1'/'true' means "
+            "'dots_and_kernels_saveable' — keep matmul/flash outputs, "
+            "recompute the cheap elementwise/norm chain). Gradients "
+            "are bitwise-identical remat on/off; only the saved-"
+            "residual set (static_peak_bytes) and the backward's "
+            "recompute fraction move. '' = off (the model config's own "
+            "recompute field still applies).")
+define_flag("train_prefetch", True,
+            "double-buffered host->device input staging in Model.fit "
+            "(ISSUE 19): batch N+1 is split and device_put while step "
+            "N is still in flight (the hook runs between the step's "
+            "dispatch and its blocking loss readback), so the transfer "
+            "hides under device compute instead of extending the step "
+            "loop. Loss trajectories are bitwise-identical to the "
+            "synchronous feed — only WHEN the conversion happens "
+            "moves. train.input_wait_ms / train.input_overlap_frac "
+            "surface through the observability registry; off restores "
+            "the synchronous convert-inside-the-step feed. PDT121 "
+            "notes custom train loops that stage batches synchronously "
+            "with no prefetch knob in scope.")
 define_flag("metrics", True,
             "observability runtime (paddle_tpu.observability): metrics "
             "registry recording, structured-event ring buffer, serving "

@@ -12,6 +12,7 @@ generated pybind method table, ``paddle/fluid/pybind/eager_method.cc``).
 """
 from __future__ import annotations
 
+import itertools
 import threading
 from typing import Any, Optional
 
@@ -54,10 +55,18 @@ def __getattr__(name):
     raise AttributeError(name)
 
 
+# process-unique tensor ids for the grad tape (autograd keys grad
+# buffers by these).  id() is NOT usable there: a discarded op output
+# (e.g. the unused half of a (res, normed) pair) is freed at forward
+# time and its id() gets reused by a LATER tensor — whose seeded
+# cotangent would then alias onto the dead output's tape slot.
+_uid_counter = itertools.count(1)
+
+
 class Tensor:
     __slots__ = ("_data", "_stop_gradient", "_grad", "_node", "_hooks",
                  "_retain_grad", "name", "_dist", "_flat_view",
-                 "_flat_src", "__weakref__")
+                 "_flat_src", "_uid", "__weakref__")
 
     def __init__(self, data, dtype=None, place=None, stop_gradient=True,
                  name=None):
@@ -84,6 +93,7 @@ class Tensor:
         elif dtype is not None and data.dtype != dtype:
             data = data.astype(dtype)
         self._data = data
+        self._uid = next(_uid_counter)
         self._stop_gradient = bool(stop_gradient)
         self._grad: Optional[Tensor] = None
         self._node = None
@@ -134,6 +144,7 @@ class Tensor:
         if new_node is not None and any(t is self for t in new_node.inputs):
             ghost = Tensor.__new__(Tensor)
             ghost._data = self._data
+            ghost._uid = next(_uid_counter)
             ghost._stop_gradient = self._stop_gradient
             ghost._grad = None
             ghost._node = self._node
@@ -145,8 +156,8 @@ class Tensor:
             ghost._flat_src = None
             if self._node is not None:
                 try:
-                    i = self._node.out_ids.index(id(self))
-                    self._node.out_ids[i] = id(ghost)
+                    i = self._node.out_ids.index(self._uid)
+                    self._node.out_ids[i] = ghost._uid
                 except ValueError:
                     pass
             new_node.inputs = [ghost if t is self else t
@@ -156,8 +167,8 @@ class Tensor:
         self._node = new_node
         if new_node is not None:
             try:
-                idx = new_node.out_ids.index(id(other))
-                new_node.out_ids[idx] = id(self)
+                idx = new_node.out_ids.index(other._uid)
+                new_node.out_ids[idx] = self._uid
             except ValueError:
                 pass
         self._stop_gradient = other._stop_gradient

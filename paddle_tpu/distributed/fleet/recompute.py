@@ -174,6 +174,16 @@ def _transformer_saveable(prim, *a, **k):
 _POLICIES = {
     None: None,
     "full": None,  # rematerialize everything (reference behavior)
+    # save EVERY residual — zero recompute work in backward. The
+    # checkpoint region still exists, which makes this the remat-OFF
+    # anchor for bitwise A/B: policies differ only in which residuals
+    # the backward reads saved vs recomputes, never in the math, so
+    # grads across the whole spectrum (everything_saveable .. full)
+    # are bitwise-identical (tests/test_train_perf.py). The eager
+    # per-op tape sits OUTSIDE this family: its cotangent accumulation
+    # order differs from a region vjp by ~1e-10 ulps (test_models.py
+    # compares it at tolerance for that reason).
+    "everything_saveable": "everything_saveable",
     # save MXU matmul outputs, recompute only elementwise ops — trades a
     # little HBM for skipping the expensive half of the re-forward
     "dots_saveable": "dots_saveable",

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import signal
+import time
 
 import numpy as np
 
@@ -40,6 +41,105 @@ def _to_tensors(batch):
     return out
 
 
+def _prefetch_metrics():
+    from ..observability import metrics as m
+    if not m.enabled():
+        return None
+    reg = m.registry()
+    return (
+        reg.histogram("train.input_wait_ms",
+                      "time the train loop blocked waiting for the next "
+                      "batch to stage (a prefetch miss pays the full "
+                      "host->device stage)", m.LATENCY_BUCKETS_MS),
+        reg.gauge("train.input_overlap_frac",
+                  "fraction of input staging time overlapped with "
+                  "in-flight train steps (this fit so far)"),
+    )
+
+
+class _PrefetchFeed:
+    """Double-buffered host->device input staging (ISSUE 19).
+
+    Wraps a loader so the fit loops consume pre-staged ``(step, inputs,
+    labels)`` triples: while step N's compiled program is in flight
+    (dispatched but not yet read back), ``advance()`` — installed as
+    ``Model._prefetch_hook`` and fired from ``train_batch`` between the
+    async dispatch and the blocking ``float(loss)`` — pulls batch N+1
+    from the loader, splits it, and stages it to device. The loop's
+    next ``__next__`` then serves the staged batch with ~zero wait.
+
+    Staging is exactly the synchronous path's ``_split_batch`` +
+    ``_to_tensors`` on the same batches in the same order — only WHEN
+    the host does the work moves, so the loss trajectory is bitwise
+    identical to ``train_prefetch=off`` (asserted in
+    tests/test_train_perf.py). Misses (first batch of an epoch, a
+    loader slower than the step) fall back to an in-line synchronous
+    fetch and show up in ``train.input_wait_ms``;
+    ``train.input_overlap_frac`` tracks how much staging time hid
+    behind device execution.
+    """
+
+    def __init__(self, loader, split, skip=0, enabled=True):
+        self._it = iter(loader)
+        self._split = split
+        self._skip = int(skip)
+        self._step = 0
+        self._staged = None
+        self._done = False
+        self.enabled = bool(enabled)
+        self.wait_ms = 0.0
+        self.overlap_ms = 0.0
+        self._handles = _prefetch_metrics()
+
+    def _fetch(self):
+        while self._skip > 0:  # resume fast-forward: never staged
+            self._skip -= 1
+            self._step += 1
+            next(self._it)
+        batch = next(self._it)
+        inputs, labels = self._split(batch)
+        return _to_tensors(inputs), _to_tensors(labels)
+
+    def _gauge(self):
+        if self._handles is None:
+            return
+        total = self.wait_ms + self.overlap_ms
+        self._handles[1].set(self.overlap_ms / total if total else 0.0)
+
+    def advance(self):
+        """Stage the next batch while the current step is in flight."""
+        if self._done or self._staged is not None:
+            return
+        t0 = time.perf_counter()
+        try:
+            self._staged = self._fetch()
+        except StopIteration:
+            self._done = True
+            return
+        self.overlap_ms += (time.perf_counter() - t0) * 1000.0
+        self._gauge()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._staged is not None:
+            pair, self._staged = self._staged, None
+            wait = 0.0
+        else:
+            if self._done:
+                raise StopIteration
+            t0 = time.perf_counter()
+            pair = self._fetch()  # miss: pay the stage in-line
+            wait = (time.perf_counter() - t0) * 1000.0
+        self.wait_ms += wait
+        if self._handles is not None:
+            self._handles[0].observe(wait)
+        self._gauge()
+        step, self._step = self._step, self._step + 1
+        return step, pair[0], pair[1]
+
+
 class Model:
     """High-level model wrapper: ``prepare`` -> ``fit``/``evaluate``/
     ``predict`` (reference ``hapi/model.py:872``)."""
@@ -59,15 +159,29 @@ class Model:
         self._step_guard = None
         self._preempted = False
         self._preempt_position = None
+        self._prefetch_hook = None
 
     # -- setup ---------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None, step_guard=None):
+                amp_configs=None, step_guard=None, remat=None):
         """``step_guard`` (TPU extension): a ``resilience.StepGuard`` —
         or ``True`` for the defaults — makes every non-finite train
         step a bitwise no-op inside the compiled step and raises a
         coded ``NonFiniteStepError`` only after the guard's
-        consecutive-bad-step budget is spent."""
+        consecutive-bad-step budget is spent.
+
+        ``remat`` (TPU extension, ISSUE 19): selective activation
+        rematerialization for the compiled train step. ``True`` (or the
+        ``train_remat`` flag set to an on-spelling) selects the
+        ``dots_and_kernels_saveable`` policy — matmul and Pallas-kernel
+        outputs (flash attention) stay saved, cheap elementwise/norm
+        glue is recomputed in the backward pass; any
+        ``fleet.recompute`` policy name selects that policy. The saving
+        is peak-HBM only: grads are BITWISE identical remat on/off
+        (recompute replays the same ops on the same values), proven in
+        tests/test_train_perf.py and measurable via the captured step's
+        ``static_peak_bytes``. ``None`` defers to the ``train_remat``
+        flag; ``False``/"" disables."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
@@ -79,6 +193,9 @@ class Model:
             from ..resilience import StepGuard
             step_guard = StepGuard()
         self._step_guard = step_guard or None
+        policy = self._resolve_remat(remat)
+        if policy is not None:
+            self._apply_remat(policy)
         self._amp_level = None
         if isinstance(amp_configs, str):
             self._amp_level = amp_configs
@@ -87,6 +204,56 @@ class Model:
         self._build_steps()
         self._lint_network()
         return self
+
+    def _resolve_remat(self, remat):
+        """Normalize ``prepare(remat=)`` / the ``train_remat`` flag to a
+        ``fleet.recompute`` policy name, or None for off."""
+        from ..core import state as _state
+        from ..distributed.fleet.recompute import _POLICIES
+        if remat is None:
+            remat = _state.get_flag("train_remat")
+        if remat is None or remat is False or remat == "":
+            return None
+        if remat is True:
+            return "dots_and_kernels_saveable"
+        name = str(remat).strip().lower()
+        if name in _state.KV_QUANT_ON_SPELLINGS:
+            return "dots_and_kernels_saveable"
+        if name in _state.KV_QUANT_OFF_SPELLINGS:
+            return None
+        if name not in _POLICIES or name == "none":
+            raise ValueError(
+                f"prepare(remat={remat!r}): unknown remat policy; "
+                f"expected one of "
+                f"{sorted(k for k in _POLICIES if isinstance(k, str))} "
+                f"or an on/off spelling")
+        return name
+
+    def _apply_remat(self, policy):
+        """Flip every remat-capable block of the network (any sublayer
+        carrying the ``_recompute`` attr — GPTBlock, LlamaDecoderLayer,
+        BertLayer, and user blocks following the same convention) to
+        recompute with ``policy``. 'full' maps to the policy-less
+        jax.checkpoint (save nothing but inputs)."""
+        pol = None if policy == "full" else policy
+        n = 0
+        for layer in self.network.sublayers(include_self=True):
+            if not hasattr(layer, "_recompute"):
+                continue
+            layer._recompute = True
+            # the block families disagree on the policy attr name
+            # (GPTBlock: _recompute_policy; llama/bert: _policy) —
+            # set whichever the block defines
+            for attr in ("_recompute_policy", "_policy"):
+                if hasattr(layer, attr):
+                    setattr(layer, attr, pol)
+            n += 1
+        if n == 0:
+            import warnings
+            warnings.warn(
+                "prepare(remat=...): no remat-capable blocks found "
+                "(no sublayer defines _recompute) — remat is a no-op "
+                "for this network", RuntimeWarning)
 
     def _lint_network(self):
         """Pre-compile tracer-safety lint (graph lint, PDT1xx) over the
@@ -193,6 +360,12 @@ class Model:
                 if not p.stop_gradient and p.grad is None:
                     p.grad = zeros_like(p)
         loss, outputs = step_fn(*args)
+        # the step is dispatched (device-side, async) but not yet read
+        # back: the window between here and float(loss) is where input
+        # prefetch hides the next batch's host->device stage (ISSUE 19)
+        hook = self._prefetch_hook
+        if hook is not None:
+            hook()
         loss_val = float(loss)
         if self._step_guard is not None and update:
             self._step_guard.observe(loss_val)
@@ -388,35 +561,51 @@ class Model:
                         loader, cbks, window, it, num_iters, wstate,
                         skip=skip, epoch=epoch, mgr=mgr)
                 else:
-                    for step, batch in enumerate(loader):
-                        if step < skip:
-                            continue  # fast-forward to the resume point
-                        cbks.on_train_batch_begin(step)
-                        inputs, labels = self._split_batch(batch)
-                        inputs = self._maybe_poison(inputs, it + 1)
-                        update = ((step + 1) % self._accumulate == 0
-                                  or (steps is not None
-                                      and step + 1 == steps))
-                        res = self.train_batch(inputs, labels,
-                                               update=update)
-                        logs = self._make_logs(res)
-                        cbks.on_train_batch_end(step, logs)
-                        self._note_train_step(inputs)
-                        it += 1
-                        if update:
-                            if self._maybe_preempt(mgr, epoch, step + 1,
-                                                   it, epoch_steps=steps):
+                    feed = _PrefetchFeed(
+                        loader, self._split_batch, skip=skip,
+                        enabled=bool(
+                            _core_state.get_flag("train_prefetch")))
+                    self._prefetch_hook = (feed.advance if feed.enabled
+                                           else None)
+                    warmed = False
+                    try:
+                        for step, inputs, labels in feed:
+                            if not warmed:
+                                # the first fetch is the double-buffer
+                                # warm-up fill (synchronous by nature):
+                                # re-mark so it isn't billed to step
+                                # 0's train.step_ms (ISSUE 19)
+                                self._step_timer.mark()
+                                warmed = True
+                            cbks.on_train_batch_begin(step)
+                            inputs = self._maybe_poison(inputs, it + 1)
+                            update = ((step + 1) % self._accumulate == 0
+                                      or (steps is not None
+                                          and step + 1 == steps))
+                            res = self.train_batch(inputs, labels,
+                                                   update=update)
+                            logs = self._make_logs(res)
+                            cbks.on_train_batch_end(step, logs)
+                            self._note_train_step(inputs)
+                            it += 1
+                            if update:
+                                if self._maybe_preempt(
+                                        mgr, epoch, step + 1, it,
+                                        epoch_steps=steps):
+                                    break
+                            else:
+                                # mid-accumulation: the partially summed
+                                # grads are not checkpointable, so only
+                                # deliver the synthetic signal here —
+                                # the request is honored (checkpoint +
+                                # exit) at the next update boundary
+                                self._fire_synthetic_preempt(mgr, it)
+                            if (num_iters is not None
+                                    and it >= num_iters):
+                                self.stop_training = True
                                 break
-                        else:
-                            # mid-accumulation: the partially summed
-                            # grads are not checkpointable, so only
-                            # deliver the synthetic signal here — the
-                            # request is honored (checkpoint + exit) at
-                            # the next update boundary
-                            self._fire_synthetic_preempt(mgr, it)
-                        if num_iters is not None and it >= num_iters:
-                            self.stop_training = True
-                            break
+                    finally:
+                        self._prefetch_hook = None
                 if self._preempted:
                     # exit fast — the position is already checkpointed.
                     # The epoch-boundary callbacks (ModelCheckpoint's
@@ -480,8 +669,9 @@ class Model:
         batches; preemption is honored at step boundaries (window
         flushes observe it after the window completes)."""
         from .. import jit
+        from ..core import state as _core_state
 
-        logs, step = {}, 0
+        logs, step = {}, int(skip)
         esteps = len(loader) if hasattr(loader, "__len__") else None
 
         def plain(inputs, labels):
@@ -532,6 +722,11 @@ class Model:
             ps = [peek_lrs()] if wstate.get("lr_slot") else None
             rets = runner.run(*stacks, outputs="stacked",
                               per_step_vals=ps)
+            # the window is dispatched but not yet read back: stage the
+            # next batch under the K in-flight steps (ISSUE 19)
+            hook = self._prefetch_hook
+            if hook is not None:
+                hook()
             for k, (loss, outputs) in enumerate(
                     runner.rebuild_host(rets)):
                 cbks.on_train_batch_begin(step)
@@ -554,47 +749,56 @@ class Model:
             self._maybe_preempt(mgr, epoch, step, it, epoch_steps=esteps,
                                 fire=False)
 
+        feed = _PrefetchFeed(
+            loader, self._split_batch, skip=skip,
+            enabled=bool(_core_state.get_flag("train_prefetch")))
+        self._prefetch_hook = feed.advance if feed.enabled else None
+        warmed = False
         buf = []
-        for batch in loader:
-            if skip > 0:
-                skip -= 1  # resume fast-forward
-                step += 1
-                continue
-            if self.stop_training or (num_iters is not None
-                                      and it >= num_iters):
-                self.stop_training = True
-                break
-            inputs, labels = self._split_batch(batch)
-            if wstate["runner"] is None:
-                plain(inputs, labels)  # compile trigger + step 1
-                wstate["runner"] = self._make_window_runner(
-                    inputs, labels, window, wstate)
-                continue
-            if wstate["runner"] is False:
+        try:
+            for _, inputs, labels in feed:
+                if not warmed:
+                    # double-buffer warm-up fill: not step 1's time
+                    self._step_timer.mark()
+                    warmed = True
+                if self.stop_training or (num_iters is not None
+                                          and it >= num_iters):
+                    self.stop_training = True
+                    break
+                if wstate["runner"] is None:
+                    plain(inputs, labels)  # compile trigger + step 1
+                    wstate["runner"] = self._make_window_runner(
+                        inputs, labels, window, wstate)
+                    continue
+                if wstate["runner"] is False:
+                    plain(inputs, labels)
+                    continue
+                buf.append((inputs, labels))
+                room = (num_iters - it if num_iters is not None
+                        else None)
+                if room is not None and room < window:
+                    # budget smaller than a window: finish per-batch
+                    # (the top-of-loop check stops at num_iters
+                    # exactly); without this the loop would buffer the
+                    # whole remaining epoch
+                    for i2, l2 in buf:
+                        if self.stop_training or it >= num_iters:
+                            break
+                        plain(i2, l2)
+                    buf = []
+                    continue
+                if len(buf) == window:
+                    flush_window(buf)
+                    buf = []
+            for inputs, labels in buf:  # epoch tail / num_iters remnant
+                if self.stop_training:
+                    break  # preempted: the checkpoint position is final
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
                 plain(inputs, labels)
-                continue
-            buf.append((inputs, labels))
-            room = (num_iters - it if num_iters is not None else None)
-            if room is not None and room < window:
-                # budget smaller than a window: finish per-batch (the
-                # top-of-loop check stops at num_iters exactly); without
-                # this the loop would buffer the whole remaining epoch
-                for i2, l2 in buf:
-                    if self.stop_training or it >= num_iters:
-                        break
-                    plain(i2, l2)
-                buf = []
-                continue
-            if len(buf) == window:
-                flush_window(buf)
-                buf = []
-        for inputs, labels in buf:  # epoch tail (or num_iters remnant)
-            if self.stop_training:
-                break  # preempted: the checkpoint position is final
-            if num_iters is not None and it >= num_iters:
-                self.stop_training = True
-                break
-            plain(inputs, labels)
+        finally:
+            self._prefetch_hook = None
         if num_iters is not None and it >= num_iters:
             self.stop_training = True
         return logs, it

@@ -540,7 +540,7 @@ class StaticFunction:
     (reference ``jit/dy2static/program_translator.py:708``)."""
 
     def __init__(self, fn, build_strategy=None, backend=None,
-                 full_graph=False):
+                 full_graph=False, remat=None):
         self.fn = fn
         self._cache: dict[Any, _Executable] = {}
         self._fallback_keys: set = set()
@@ -549,6 +549,12 @@ class StaticFunction:
         self.__name__ = getattr(fn, "__name__", "static_fn")
         self._conv_fn = None
         self._conv_tried = False
+        # resolved 1-tuple (policy,) from to_static(remat=...), or None.
+        # Applied AFTER dy2static conversion (see _converted): wrapping
+        # before it would hand dy2static a wrapper whose source/closure
+        # don't match the user function.
+        self._remat = remat
+        self._remat_fn = None
 
     def _converted(self):
         """The dy2static AST-converted function (plain Python if/while/for
@@ -581,7 +587,17 @@ class StaticFunction:
                     f"failed ({type(e).__name__}: {e}); using the "
                     "original function")
                 self._conv_fn = None
-        return self._conv_fn or self.fn
+        fn = self._conv_fn or self.fn
+        if self._remat is None:
+            return fn
+        if self._remat_fn is None:
+            from ..distributed.fleet.recompute import recompute
+            pol = self._remat[0]
+
+            def _remat_fn(*args, **kw):
+                return recompute(fn, *args, policy=pol, **kw)
+            self._remat_fn = _remat_fn
+        return self._remat_fn
 
     def __get__(self, instance, owner):
         # bound-method support for @to_static on Layer methods
@@ -783,16 +799,47 @@ def aot_lower(fn, *args, donate_state=True, **kwargs):
     return _jax.jit(drive, donate_argnums=donate).lower(*specs)
 
 
+def _resolve_remat(policy):
+    """Validate ``to_static(remat=...)`` and return the
+    ``fleet.recompute`` policy object (``None`` spells 'full': save
+    nothing, recompute everything). The wrap itself happens after
+    dy2static conversion (``StaticFunction._converted``): the whole
+    call runs under ``fleet.recompute`` with this policy, so its
+    backward recomputes the non-saveable intermediates instead of
+    keeping them live — which is what moves the captured executable's
+    ``static_peak_bytes``. Gradients are bitwise-identical either way.
+    The wrapped function must be a pure forward (args -> outputs);
+    train-step closures that call ``.backward()`` inside should use
+    ``Model.prepare(remat=)`` instead, which remats the transformer
+    blocks themselves."""
+    from ..distributed.fleet.recompute import _POLICIES
+    if policy is True or policy == "full":
+        return None
+    if policy is None or policy not in _POLICIES:
+        raise ValueError(
+            f"to_static(remat={policy!r}): unknown remat policy; "
+            f"expected True, 'full', or one of "
+            f"{sorted(k for k in _POLICIES if isinstance(k, str))}")
+    return policy
+
+
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=False, **kwargs):
-    """``paddle.jit.to_static`` analog (reference ``jit/api.py:135``)."""
+              backend=None, full_graph=False, remat=None, **kwargs):
+    """``paddle.jit.to_static`` analog (reference ``jit/api.py:135``).
+
+    ``remat`` (TPU extension, ISSUE 19): ``True``/'full' or a
+    ``fleet.recompute`` policy name runs the converted function under
+    selective activation recompute at capture — see
+    :func:`_resolve_remat`."""
     def deco(fn):
         if isinstance(fn, StaticFunction):
             if input_spec is not None:
                 fn._input_spec = input_spec
             return fn
         import functools
-        sf = StaticFunction(fn, build_strategy, backend, full_graph)
+        sf = StaticFunction(fn, build_strategy, backend, full_graph,
+                            remat=(_resolve_remat(remat),)
+                            if remat else None)
         functools.update_wrapper(sf, fn, updated=[])
         sf._input_spec = input_spec
         return sf

@@ -58,6 +58,11 @@ def _init(std=0.02):
     return I.Normal(mean=0.0, std=std)
 
 
+def _glue_fusion() -> bool:
+    from ..core import state
+    return bool(state.get_flag("train_glue_fusion"))
+
+
 class BertEmbeddings(Layer):
     def __init__(self, cfg: BertConfig):
         super().__init__()
@@ -134,11 +139,26 @@ class BertLayer(Layer):
         y = self.fc2(F.gelu(self.fc1(x), approximate=True))
         return self.ln2(x + self.drop(y))
 
+    def _inner_fused(self, x):
+        """Glue-fused twin of ``_inner`` (train_glue_fusion, ISSUE 19).
+        Post-LN fuses in place — each (add, norm) pair becomes one
+        dispatch, no cross-block pending branch to thread."""
+        _, x = F.fused_residual_norm(
+            x, self.drop(self.attn(x)), self.ln1.weight, self.ln1.bias,
+            epsilon=self.ln1._epsilon)
+        y = self.fc2(F.gelu(self.fc1(x), approximate=True))
+        _, x = F.fused_residual_norm(
+            x, self.drop(y), self.ln2.weight, self.ln2.bias,
+            epsilon=self.ln2._epsilon)
+        return x
+
     def forward(self, x):
+        inner = (self._inner_fused
+                 if self.training and _glue_fusion() else self._inner)
         if self._recompute and self.training:
             from ..distributed.fleet.recompute import recompute
-            return recompute(self._inner, x, policy=self._policy)
-        return self._inner(x)
+            return recompute(inner, x, policy=self._policy)
+        return inner(x)
 
 
 class BertModel(Layer):

@@ -58,6 +58,15 @@ def _init_normal(std):
     return I.Normal(mean=0.0, std=std)
 
 
+def _glue_fusion() -> bool:
+    """train_glue_fusion flag (ISSUE 19): fused residual+norm glue
+    kernels in the TRAINING forward. Read per forward — one dict
+    lookup; eval/serving paths never consult it (callers also gate on
+    ``self.training``)."""
+    from ..core import state
+    return bool(state.get_flag("train_glue_fusion"))
+
+
 class GPTAttention(Layer):
     """Causal self-attention with a fused qkv projection (the shape the
     reference fuses in ``fused_attention``-family kernels, SURVEY C12)."""
@@ -140,6 +149,41 @@ class GPTBlock(Layer):
             return recompute(self._inner, x, policy=self._recompute_policy)
         return self._inner(x)
 
+    def _inner_fused(self, x, pending=None):
+        """Glue-fused twin of ``_inner`` (train_glue_fusion, ISSUE 19).
+        Pre-norm blocks can't fuse their OWN ln1 with a residual add —
+        the add that feeds ln1 belongs to the previous block — so the
+        model loop threads the previous block's un-added MLP branch in
+        as ``pending``: (x+pending -> ln1) and (x+attn -> ln2) each run
+        as ONE fused dispatch, and the block returns its own MLP branch
+        un-added for the next block (the final add fuses with ln_f).
+        Four glue dispatches per layer (add, ln1, add, ln2) become
+        two."""
+        if pending is None:
+            h1 = self.ln1(x)
+        else:
+            x, h1 = F.fused_residual_norm(
+                x, pending, self.ln1.weight, self.ln1.bias,
+                epsilon=self.ln1._epsilon)
+        a = self.drop(self.attn(h1))
+        x, h2 = F.fused_residual_norm(
+            x, a, self.ln2.weight, self.ln2.bias,
+            epsilon=self.ln2._epsilon)
+        return x, self.drop(self.mlp(h2))
+
+    def forward_fused(self, x, pending=None):
+        """(x, pending) -> (x, pending') for the glue-fused train loop;
+        composes with block recompute (the pending branch rides as an
+        extra checkpointed tensor arg)."""
+        if self._recompute and self.training:
+            from ..distributed.fleet.recompute import recompute
+            if pending is None:
+                return recompute(self._inner_fused, x,
+                                 policy=self._recompute_policy)
+            return recompute(self._inner_fused, x, pending,
+                             policy=self._recompute_policy)
+        return self._inner_fused(x, pending)
+
 
 class GPTModel(Layer):
     """Embeddings + transformer stack + final norm -> hidden states."""
@@ -163,6 +207,15 @@ class GPTModel(Layer):
         pos = ops.arange(0, s, dtype="int32")
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
+        if self.training and self.blocks and _glue_fusion():
+            pending = None
+            for blk in self.blocks:
+                x, pending = blk.forward_fused(x, pending)
+            # the last block's MLP branch fuses into the final norm
+            _, h = F.fused_residual_norm(
+                x, pending, self.ln_f.weight, self.ln_f.bias,
+                epsilon=self.ln_f._epsilon)
+            return h
         for blk in self.blocks:
             x = blk(x)
         return self.ln_f(x)

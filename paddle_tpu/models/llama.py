@@ -56,6 +56,11 @@ def _init(std=0.02):
     return I.Normal(mean=0.0, std=std)
 
 
+def _glue_fusion() -> bool:
+    from ..core import state
+    return bool(state.get_flag("train_glue_fusion"))
+
+
 def rope_angles(positions, d, theta):
     """Half-rotation rope tables: (cos, sin) [..., d] for ``positions``
     (numpy or traced jnp values). SINGLE home of the LLaMA rope
@@ -169,6 +174,34 @@ class LlamaDecoderLayer(Layer):
             return recompute(self._inner, x, policy=self._policy)
         return self._inner(x)
 
+    def _inner_fused(self, x, pending=None):
+        """Glue-fused twin of ``_inner`` (train_glue_fusion, ISSUE 19):
+        same pending-branch threading as GPTBlock._inner_fused — the
+        previous layer's un-added MLP branch fuses with this layer's
+        input_norm, the attention branch with post_norm; the RMS pair
+        (add, norm) runs as one fused dispatch each."""
+        if pending is None:
+            h1 = self.input_norm(x)
+        else:
+            x, h1 = F.fused_residual_norm(
+                x, pending, self.input_norm.weight, norm="rms",
+                epsilon=self.input_norm._epsilon)
+        a = self.attn(h1)
+        x, h2 = F.fused_residual_norm(
+            x, a, self.post_norm.weight, norm="rms",
+            epsilon=self.post_norm._epsilon)
+        return x, self.mlp(h2)
+
+    def forward_fused(self, x, pending=None):
+        if self._recompute and self.training:
+            from ..distributed.fleet.recompute import recompute
+            if pending is None:
+                return recompute(self._inner_fused, x,
+                                 policy=self._policy)
+            return recompute(self._inner_fused, x, pending,
+                             policy=self._policy)
+        return self._inner_fused(x, pending)
+
 
 class LlamaModel(Layer):
     def __init__(self, cfg: LlamaConfig):
@@ -183,6 +216,14 @@ class LlamaModel(Layer):
 
     def forward(self, input_ids):
         x = self.embed_tokens(input_ids)
+        if self.training and self.layers and _glue_fusion():
+            pending = None
+            for l in self.layers:
+                x, pending = l.forward_fused(x, pending)
+            _, h = F.fused_residual_norm(
+                x, pending, self.norm.weight, norm="rms",
+                epsilon=self.norm._epsilon)
+            return h
         for l in self.layers:
             x = l(x)
         return self.norm(x)

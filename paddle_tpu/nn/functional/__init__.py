@@ -18,7 +18,7 @@ from .pooling import (  # noqa: F401
 )
 from .norm import (  # noqa: F401
     layer_norm, rms_norm, batch_norm, instance_norm, group_norm,
-    local_response_norm, spectral_norm,
+    local_response_norm, spectral_norm, fused_residual_norm,
 )
 from .loss import (  # noqa: F401
     cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,

@@ -102,6 +102,37 @@ def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
     return apply("rms_norm", impl, *args)
 
 
+def fused_residual_norm(x, y, weight, bias=None, epsilon=None,
+                        norm="layer", name=None):
+    """Fused residual-add + norm glue op (ISSUE 19,
+    ``ops.pallas.fused_residual_norm``): returns ``(res, normed)`` with
+    ``res = x + y`` (the residual-stream value the next adder consumes)
+    and ``normed`` its layer/rms norm — ONE dispatch with a fused
+    custom-vjp backward, replacing the separate add and norm ops of the
+    training glue chain. ``norm`` selects "layer" (weight+bias) or
+    "rms" (weight only). Unlike ``layer_norm``/``rms_norm`` this always
+    takes the Pallas kernel path (interpret mode off-TPU); callers gate
+    on the ``train_glue_fusion`` flag — see its help for why the fused
+    path is an A/B knob rather than a default."""
+    if norm not in ("layer", "rms"):
+        raise ValueError(f"norm must be 'layer' or 'rms', got {norm!r}")
+    if norm == "layer" and bias is None:
+        raise ValueError("fused_residual_norm(norm='layer') requires "
+                         "bias (LayerNorm's affine pair)")
+    eps = epsilon if epsilon is not None else \
+        (1e-5 if norm == "layer" else 1e-6)
+
+    def impl(xv, yv, *wb):
+        from ...ops.pallas import fused_residual_norm as frn
+        if norm == "layer":
+            return frn.fused_residual_layer_norm(xv, yv, wb[0], wb[1],
+                                                 eps=eps)
+        return frn.fused_residual_rms_norm(xv, yv, wb[0], eps=eps)
+
+    args = [x, y] + [t for t in (weight, bias) if t is not None]
+    return apply("fused_residual_norm", impl, *args)
+
+
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.9, epsilon=1e-5,
                data_format="NCHW", use_global_stats=None, name=None):

@@ -26,8 +26,10 @@ from . import flash_attention  # noqa: E402
 from . import fused_decode_mlp  # noqa: E402
 from . import fused_decode_qkv  # noqa: E402
 from . import fused_optimizer  # noqa: E402
+from . import fused_residual_norm  # noqa: E402
 from . import norms  # noqa: E402
 from . import rope  # noqa: E402
 
 __all__ = ["flash_attention", "fused_decode_mlp", "fused_decode_qkv",
-           "fused_optimizer", "norms", "rope", "use_interpret"]
+           "fused_optimizer", "fused_residual_norm", "norms", "rope",
+           "use_interpret"]

@@ -25,9 +25,13 @@ tiling of the fused weight-only int8 matmul —
 quant_matmul.pick_blocks), ``fused_decode_qkv_rows`` (row block of the
 decode megakernel's norm+QKV+rope+paged-append ingress kernel —
 fused_decode_qkv.pick_qkv_rows; candidates VMEM-capped, default one
-block covering the whole decode batch) and ``fused_decode_mlp_rows``
+block covering the whole decode batch), ``fused_decode_mlp_rows``
 (row block of the megakernel's out-proj+residual+MLP egress kernel —
-fused_decode_mlp.pick_mlp_rows).
+fused_decode_mlp.pick_mlp_rows) and ``fused_residual_norm_rows`` (row
+block of the training glue kernels' fused residual-add+norm fwd/bwd
+pair — fused_residual_norm.pick_glue_rows; the sweep times a full
+grad-through-custom_vjp round trip since the bwd kernel replays the
+same tile walk).
 
 LIMITATION (measured, round 4): the sweep times candidates in an
 isolated chained program; the winner inside a REAL train step can

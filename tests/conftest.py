@@ -53,6 +53,9 @@ _TIER1_ORDER = [
     # ISSUE-11 acceptance: fused-backward bitwise parity + overlap
     # grad-sync bitwise gates — model-free/tiny-model, ~80s combined
     "test_flash_bwd.py", "test_overlap.py",
+    # ISSUE-19 acceptance: remat bitwise family, fused glue twin
+    # parity, static-peak drop, prefetch overlap — tiny models, CPU
+    "test_train_perf.py",
     "test_profiler_device.py",
     # ISSUE-16 acceptance: whole-program jaxpr analyzer (collective
     # schedule hash/verify, donation provenance, shape-fork PDT242) —
