@@ -370,6 +370,16 @@ def measure():
     rows["tp4"] = _measure_tp(cfg, model, gbps, 4)
     rows["disagg"] = _measure_disagg(cfg, model)
     rows["fleet"] = _measure_fleet(cfg, model)
+    # migration columns (ISSUE 20) ride the fleet row: drain latency
+    # both ways, warm pages shipped, and the bitwise gate
+    mig = _measure_migration(cfg, model)
+    rows["fleet"].update({
+        "drain_ms_migrate": mig["drain_ms_migrate"],
+        "drain_ms_wait": mig["drain_ms_wait"],
+        "migrated_pages": mig["migrated_pages"],
+        "prefill_tokens_saved": mig["prefill_tokens_saved"],
+        "outputs_equal_migration": mig["outputs_equal"]
+        and mig["pages_leaked"] == 0})
     # per-code finding counts from every serving program compiled above
     # (engine caches, decode windows, TP wrappers); the regression
     # sentinel judges PDT* leaves lower-is-better
@@ -1206,6 +1216,87 @@ def _measure_fleet(cfg, model, slots=4, prompt_len=64, new_tokens=24,
     return row
 
 
+def _measure_migration(cfg, model, slots=4, prompt_len=64,
+                       new_tokens=24, n_requests=6, page_size=16,
+                       decode_window=16, prefill_chunk=64,
+                       max_seq_len=256, q_block=8, drain_step=3,
+                       seed=13, warm=True):
+    """ISSUE 20 ``migration`` columns (merged onto the ``fleet`` row):
+    graceful drain measured BOTH ways on one 2-replica workload —
+    ``drain_ms_migrate`` (live migration on: residents ship warm over
+    ``KVPageTransport`` and the drained replica parks as soon as the
+    transfers land) vs ``drain_ms_wait`` (cold drain: the replica
+    waits out every resident decode before parking).
+    ``migrated_pages`` counts the KV pages that actually moved;
+    ``prefill_tokens_saved`` prices them (pages * page_size — every
+    shipped page is a page of already-computed tokens the destination
+    did NOT recompute, exactly what the PR17 cold requeue would have
+    re-prefilled); ``outputs_equal`` gates the row: both drained runs
+    must be bitwise the undrained run (greedy decode is deterministic
+    and batch-invariant, so migration is scheduling, never semantics).
+    Absolute times are TPU claims; the CPU smoke gates semantics."""
+    from paddle_tpu.inference import FleetRouter
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            prompt_len).astype(np.int32)
+               for _ in range(n_requests)]
+    kw = dict(max_slots=slots, page_size=page_size,
+              max_seq_len=max_seq_len, decode_window=decode_window,
+              prefill_chunk=prefill_chunk, q_block=q_block)
+
+    def drive(drain, migration):
+        r = FleetRouter(model, replicas=2, replica_kwargs=kw,
+                        migration=migration)
+        rids = [r.add_request(p, new_tokens) for p in prompts]
+        done, step, t_drain, t_parked = {}, 0, None, None
+        while r.has_work:
+            if drain and step == drain_step:
+                t_drain = time.perf_counter()
+                r.drain("r0")
+            for c in r.step():
+                done[c.request_id] = c
+            if (t_drain is not None and t_parked is None
+                    and r.replica_states()["r0"] == "standby"):
+                t_parked = time.perf_counter()
+            step += 1
+            assert step < 100000, "migration bench wedged"
+        if t_drain is not None and t_parked is None:
+            t_parked = time.perf_counter()
+        drain_ms = ((t_parked - t_drain) * 1e3
+                    if t_drain is not None else 0.0)
+        return r, rids, done, drain_ms
+
+    if warm:
+        drive(False, False)
+    _, rids0, base, _ = drive(False, False)       # no drain: the bar
+    rm, rids_m, dm, ms_migrate = drive(True, True)
+    rw, rids_w, dw, ms_wait = drive(True, False)
+    outputs_equal = all(
+        np.array_equal(base[a].tokens, dm[b].tokens)
+        and np.array_equal(base[a].tokens, dw[c].tokens)
+        for a, b, c in zip(rids0, rids_m, rids_w))
+    leaked = sum(rep.engine.stats["pages_in_use"]
+                 for rep in rm._replicas if rep.state != "dead")
+    row = {
+        "drain_ms_migrate": round(ms_migrate, 3),
+        "drain_ms_wait": round(ms_wait, 3),
+        "migrated_pages": int(rm.stats["migrated_pages"]),
+        "prefill_tokens_saved": int(rm.stats["migrated_pages"]
+                                    * page_size),
+        "migration_failures": int(rm.stats["migration_failures"]),
+        "outputs_equal": bool(outputs_equal),
+        "pages_leaked": int(leaked),   # must be 0
+    }
+    print(f"migration: drain {row['drain_ms_wait']} ms (cold wait) -> "
+          f"{row['drain_ms_migrate']} ms (live migrate), "
+          f"{row['migrated_pages']} pages shipped warm "
+          f"({row['prefill_tokens_saved']} prefill tokens saved), "
+          f"outputs_equal={row['outputs_equal']}",
+          file=sys.stderr, flush=True)
+    return row
+
+
 def _disagg_handoff_mean(srv) -> float:
     node = srv.metrics()
     for part in ("serving", "handoff_ms"):
@@ -1318,6 +1409,9 @@ FILES = ["benchmarks/serving_bench.py",
          # replica-kill recovery all ride it
          "paddle_tpu/inference/router.py",
          "paddle_tpu/resilience/serving.py",
+         # live migration (ISSUE 20): the fleet row's drain/migration
+         # columns ride snapshot/restore + the preempt flag
+         "paddle_tpu/resilience/preempt.py",
          "paddle_tpu/core/state.py",
          "paddle_tpu/ops/pallas/paged_attention.py",
          "paddle_tpu/ops/pallas/flash_attention.py",

@@ -1529,3 +1529,85 @@ def check_eager_input_feed(fndef, ctx):
                 "train.input_wait_ms), or stage batch N+1 between "
                 "the step's dispatch and its loss readback")
             return
+
+
+# router kwargs that prove the fleet is judged on latency: deadlines
+# tick and SLOs burn while a cold drain waits out tail decodes
+_ROUTER_SLO_KWARGS = {"fleet_slo", "default_deadline_ms",
+                      "scalein_hold_s"}
+
+
+def _migration_off_or_absent(call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "migration":
+            v = kw.value
+            if not isinstance(v, ast.Constant):
+                return False      # computed value: can't prove it's off
+            # None defers to the serving_migration flag default: off
+            return v.value in (None, False, 0)
+    return True                   # absent: serving_migration defaults off
+
+
+@register(
+    "PDT122", "cold-drain-under-load", Severity.NOTE, "ast",
+    scope="eager",
+    example="""
+import paddle_tpu as paddle
+from paddle_tpu.inference import FleetRouter
+
+def serve_fleet(model, prompts):
+    r = FleetRouter(model, replicas=4, standby=1,
+                    fleet_slo="queue_p95_ms=200,goodput=0.99",
+                    default_deadline_ms=500.0,
+                    scalein_hold_s=30.0)
+    for p in prompts:
+        r.add_request(p, 32)
+    return r.run()
+""",
+    near_miss="""
+import paddle_tpu as paddle
+from paddle_tpu.inference import FleetRouter
+
+def serve_fleet(model, prompts):
+    r = FleetRouter(model, replicas=4, standby=1,
+                    fleet_slo="queue_p95_ms=200,goodput=0.99",
+                    default_deadline_ms=500.0,
+                    scalein_hold_s=30.0,
+                    migration=True, lameduck_ms=2000.0)
+    for p in prompts:
+        r.add_request(p, 32)
+    return r.run()
+""")
+def check_cold_drain_under_load(fndef, ctx):
+    """A ``FleetRouter`` armed with latency judgment (``fleet_slo`` /
+    ``default_deadline_ms`` / ``scalein_hold_s`` — scale-in and drain
+    WILL happen, and deadlines tick while they do) but with live
+    migration absent or off-spelled.  A cold drain waits out the tail
+    decode of every resident request before the replica parks:
+    under load that is seconds of deadline burn per scale-in, and a
+    planned preemption (SIGTERM) loses every resident request's
+    prefill work to a from-scratch requeue.  ``migration=True`` (or
+    the ``serving_migration`` flag) moves residents warm instead —
+    snapshot -> KV-page transfer -> restore through the import
+    scatter; token streams are bitwise-identical
+    (tests/test_migration.py gates this), only drain latency and
+    re-prefill work move.  Note-level advice: single-replica rigs and
+    fleets that never scale in are legitimate."""
+    for node in _walk_fn(fndef):
+        if not isinstance(node, ast.Call) \
+                or (_dotted(node.func) or "").split(".")[-1] \
+                != "FleetRouter":
+            continue
+        kws = {kw.arg for kw in node.keywords if kw.arg}
+        if kws & _ROUTER_SLO_KWARGS \
+                and _migration_off_or_absent(node):
+            yield node, (
+                "fleet router is judged on latency (fleet_slo/"
+                "default_deadline_ms/scalein_hold_s) but drains cold: "
+                "scale-in and preemption wait out every resident "
+                "request's tail decode while deadlines tick, and a "
+                "SIGTERM loses resident prefill work to a cold "
+                "requeue — pass migration=True (or the "
+                "serving_migration flag) so residents move warm over "
+                "KVPageTransport; token streams are bitwise-"
+                "identical, only drain latency moves")

@@ -221,6 +221,22 @@ class ReplicaLostError(EnforceNotMet, ConnectionError):
     error_code = "PDT-E024"
 
 
+class MigrationError(EnforceNotMet):
+    """A live request migration between serving replicas failed
+    (``inference.router.FleetRouter.drain`` / lame-duck / scale-in,
+    ISSUE 20): the KV-snapshot transfer exhausted its bounded retry
+    budget (the ``router_migration_transient`` drill) or the payload
+    failed CRC validation at restore (``engine_snapshot_torn`` — a
+    torn transfer).  The fleet degrades, never loses the request: a
+    torn snapshot is REJECTED at ``restore_request`` and the source
+    replica keeps serving it; an exhausted transfer budget falls back
+    to the PR17 cold requeue (front-of-line re-prefill on a survivor,
+    bitwise by greedy determinism, demand counted once) with exactly
+    one coded flight record carrying this code."""
+
+    error_code = "PDT-E025"
+
+
 def enforce(cond: bool, msg: str, exc=InvalidArgumentError):
     """PADDLE_ENFORCE: raise ``exc`` with ``msg`` unless ``cond``."""
     if not cond:

@@ -277,6 +277,40 @@ define_flag("serving_fleet_slo", "",
             "drains it back. '' (default) arms nothing — no "
             "SLO-driven scaling; FleetRouter kwarg fleet_slo "
             "overrides.")
+define_flag("serving_migration", False,
+            "live request migration for the serving fleet (ISSUE 20): "
+            "FleetRouter drain/scale-in/lame-duck MIGRATES resident "
+            "requests warm to surviving replicas over the PR13 "
+            "KVPageTransport (engine snapshot_request/restore_request) "
+            "instead of waiting for in-flight decode or cold-requeuing "
+            "prefilled work. Bitwise: a migrated stream equals the "
+            "unmigrated stream token-for-token (greedy decode is "
+            "deterministic and KV bytes are a pure function of the "
+            "token prefix). Off (default) = PR17 behavior — drain "
+            "waits, death cold-requeues; PDT122 notes routers that "
+            "drain cold while deadlines/SLOs are configured. "
+            "FleetRouter kwarg migration overrides.")
+define_flag("serving_lameduck_ms", 0.0,
+            "degraded-heartbeat age (ms) past which a live fleet "
+            "replica enters LAME-DUCK: new placements stop and its "
+            "residents are proactively migrated to survivors BEFORE "
+            "the serving_fleet_heartbeat_ms death deadline, so a "
+            "planned preemption (maintenance event, preemptible "
+            "capacity) loses zero prefill work. Must be smaller than "
+            "the heartbeat timeout to matter; 0 disables the detector "
+            "(SIGTERM via resilience.preempt still triggers lame-duck "
+            "when serving_migration is on). FleetRouter kwarg "
+            "lameduck_ms overrides.")
+define_flag("serving_migration_retries", 3,
+            "bounded resilience.retry RE-attempts for one live-"
+            "migration snapshot transfer (KVPageTransport."
+            "ship_snapshot) after a transient ConnectionError — incl. "
+            "the injected router_migration_transient fault site. "
+            "Exhausting the budget writes one MigrationError "
+            "(PDT-E025) flight record and falls back to the PR17 cold "
+            "requeue (demand counted once). N retries = N+1 attempts; "
+            "0 disables retry. FleetRouter kwarg migration_retries "
+            "overrides.")
 define_flag("dp_overlap_grad_sync", False,
             "overlap-scheduled bucketed DP gradient sync "
             "(distributed/overlap.py): DataParallel registers per-param "

@@ -65,11 +65,12 @@ from ..observability.metrics import LATENCY_BUCKETS_MS
 from ..resilience import faults
 from ..resilience.retry import retry_call
 from ..resilience.serving import (SITE_DECODE_WORKER_LOST,
-                                  SITE_HANDOFF_TRANSIENT)
+                                  SITE_HANDOFF_TRANSIENT,
+                                  SITE_MIGRATION_TRANSIENT)
 from .engine import CompletedRequest, ContinuousBatchingEngine
 
 __all__ = ["DisaggServer", "KVPageTransport", "register_decode_worker",
-           "rpc_deliver_payload"]
+           "rpc_deliver_payload", "rpc_restore_payload"]
 
 
 # ------------------------------------------------------------------ rpc
@@ -95,6 +96,17 @@ def rpc_deliver_payload(name: str, data: bytes, max_new_tokens: int,
         raise KeyError(f"no decode worker registered as {name!r}")
     return eng.import_request(pickle.loads(data), max_new_tokens,
                               deadline_ms=deadline_ms)
+
+
+def rpc_restore_payload(name: str, data: bytes):
+    """Server-side half of an rpc live migration (ISSUE 20):
+    deserialize a ``snapshot_request`` payload and restore it into the
+    registered engine.  Returns the restored rid, or None when the
+    engine has no capacity right now (the caller retries)."""
+    eng = _DECODE_WORKERS.get(str(name))
+    if eng is None:
+        raise KeyError(f"no worker registered as {name!r}")
+    return eng.restore_request(pickle.loads(data))
 
 
 class KVPageTransport:
@@ -132,6 +144,34 @@ class KVPageTransport:
             return dst_engine.import_request(
                 pickle.loads(data), max_new_tokens,
                 deadline_ms=deadline_ms)
+
+        out = retry_call(_send, max_attempts=max(1, self.retries + 1),
+                         base_delay=0.005, max_delay=0.05,
+                         retry_on=(ConnectionError,),
+                         on_retry=on_retry)
+        return out, len(data)
+
+    def ship_snapshot(self, payload, dst_engine, on_retry=None):
+        """Live-migration half (ISSUE 20): move a full-request
+        ``snapshot_request`` payload into ``dst_engine.restore_request``
+        (or the rpc worker when ``to`` is set) under the same bounded
+        retry discipline — the ``router_migration_transient`` fault
+        site sits INSIDE the retried closure, so a ``*N`` drill is
+        absorbed by N retries exactly like a real transient.  A torn
+        payload surfaces ``MigrationError`` (PDT-E025) from the
+        restore CRC check UNRETRIED (it is not a ConnectionError): the
+        source keeps the request.  Returns ``(rid_or_None, n_bytes)``
+        — None when the destination has no capacity yet."""
+        rid = payload["rid"]
+        data = pickle.dumps(payload)
+
+        def _send():
+            faults.maybe_raise(SITE_MIGRATION_TRANSIENT, str(rid))
+            if self.to is not None:
+                from ..distributed.rpc import rpc_sync
+                return rpc_sync(self.to, rpc_restore_payload,
+                                args=(self.to, data))
+            return dst_engine.restore_request(pickle.loads(data))
 
         out = retry_call(_send, max_attempts=max(1, self.retries + 1),
                          base_delay=0.005, max_delay=0.05,

@@ -217,6 +217,23 @@ kwargs / ``serving_tp`` flag; ``inference/distserve.py``):
   prefill->handoff->decode pipeline on top, with
   ``engine_handoff_transient`` / ``engine_decode_worker_lost`` drills
   and per-handoff spans/metrics.
+* LIVE MIGRATION (ISSUE 20) — :meth:`snapshot_request` generalizes
+  export to QUEUED and MID-PREFILL requests too (full scheduler
+  state: tokens-so-far, cur_pos/prefill_off, deadline remaining,
+  preemption/demand bookkeeping, a CRC over the KV bytes);
+  :meth:`restore_request` CRC-validates the payload (a torn transfer
+  is REJECTED with ``MigrationError`` PDT-E025 — the
+  ``engine_snapshot_torn`` drill — and the source keeps the request),
+  then funnels through the same import scatter / ``_release_slot``
+  discipline; :meth:`discard_request` is the source's half of a
+  completed migration — silently relinquish, no completion (unless a
+  racing :meth:`cancel` owns the slot, in which case the source sweep
+  finalizes it as "cancelled" and the destination drops its restore).
+  A stream migrated mid-decode equals the unmigrated stream
+  token-for-token: greedy decode is deterministic and batch-invariant
+  and KV bytes are a pure function of the token prefix.
+  ``FleetRouter`` drain / scale-in / lame-duck ride this
+  (``inference/router.py``; ``serving_migration`` flag).
 
 Compile-time program audit (ISSUE 16; ``analysis/program.py``):
 
@@ -232,6 +249,7 @@ from __future__ import annotations
 
 import time
 import warnings
+import zlib
 from collections import deque
 
 import jax
@@ -239,7 +257,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.errors import (CacheIntegrityError, EngineStallError,
-                           PageBudgetError, QueueFullError)
+                           MigrationError, PageBudgetError,
+                           QueueFullError)
 from ..core.tensor import Tensor
 from ..observability import Registry as _ObsRegistry
 from ..observability import flight as _flight
@@ -250,7 +269,8 @@ from ..observability import watchdog as _watchdog
 from ..observability.serving import RegistryCounters, ServingTimelines
 from ..resilience import faults
 from ..resilience.serving import (SITE_DRAFT_MISMATCH, SITE_DRAFT_NAN,
-                                  SITE_PAGE_PRESSURE, DecodeGuard,
+                                  SITE_PAGE_PRESSURE,
+                                  SITE_SNAPSHOT_TORN, DecodeGuard,
                                   dispatch_retry)
 from . import speculative as _spec
 from .prefix_cache import PrefixCache
@@ -310,6 +330,16 @@ class CompletedRequest:
     def sequence(self):
         """prompt + generated tokens, the ``generate()``-comparable row."""
         return np.concatenate([self.prompt, self.tokens])
+
+
+def _payload_crc(pools) -> int:
+    """CRC32 over a migration payload's KV pool bytes (ISSUE 20) —
+    computed at snapshot, validated at restore, so a torn transfer is
+    rejected before any destination page is allocated."""
+    crc = 0
+    for arr in pools:
+        crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(), crc)
+    return crc & 0xFFFFFFFF
 
 
 class _Slot:
@@ -623,6 +653,10 @@ class ContinuousBatchingEngine:
         # the stats contract is keys/order-stable, new keys at the end
         self._spec_stats = RegistryCounters(self._registry, (
             "spec_proposed", "spec_accepted"))
+        # live migration (ISSUE 20) — own block, APPENDED after the
+        # spec keys by the stats property for the same reason
+        self._mig_stats = RegistryCounters(self._registry, (
+            "migrated_in", "migrated_out"))
         # per-request serving timelines (queue/TTFT/TPOT histograms +
         # structured events for the flight recorder), on the engine's
         # deadline clock so tests can drive them deterministically
@@ -694,6 +728,9 @@ class ContinuousBatchingEngine:
         d["spec_accept_rate"] = round(
             d["spec_accepted"] / d["spec_proposed"], 4) \
             if d["spec_proposed"] else 0.0
+        # live migration (ISSUE 20) — APPENDED after the spec keys
+        d["migrated_in"] = self._mig_stats["migrated_in"]
+        d["migrated_out"] = self._mig_stats["migrated_out"]
         return d
 
     def metrics(self) -> dict:
@@ -907,25 +944,74 @@ class ContinuousBatchingEngine:
             self._import_fn = cache.get(key)
         if self._import_fn is None:
             n = len(self._caches)
-
-            def imp(idx, *args):
-                pools, payload = args[:n], args[n:]
-                return tuple(p.at[:, idx].set(pl.astype(p.dtype))
-                             for p, pl in zip(pools, payload))
-
-            kw = {}
+            from ..models.generation import make_import_scatter
+            shardings = None
             if self._tpp is not None:
                 from jax.sharding import NamedSharding as _NS
 
                 from ..models.generation import tp_cache_spec
                 cspec = tp_cache_spec(self._tpp.meta, self.tp_axis)
-                kw["out_shardings"] = tuple(
-                    _NS(self._jmesh, cspec) for _ in range(n))
-            self._import_fn = jax.jit(
-                imp, donate_argnums=tuple(range(1, 1 + n)), **kw)
+                shardings = [_NS(self._jmesh, cspec)
+                             for _ in range(n)]
+            self._import_fn = make_import_scatter(n, shardings)
             self._program_cache()[("import", len(self._caches))
                                   + self._geometry()] = self._import_fn
         return self._import_fn
+
+    def _scatter_payload(self, pages, n_matched, n_imp, pools):
+        """Scatter a payload's FRESH page rows (payload slots
+        ``[n_matched, n_imp)``; prefix-cache-matched pages already hold
+        identical bytes) into the pool pages named by ``pages`` — ONE
+        compiled dispatch per geometry (the page-id vector is traced
+        data; idx/payload pad to the table width so one program serves
+        every import/restore of this geometry).  On failure every page
+        reference in ``pages`` is released before re-raising: no slot
+        owns them yet, so the ``_release_slot`` funnel could never
+        return them and each caller retry would leak ``n_alloc``
+        pages otherwise."""
+        NP = self.np_per_seq
+        idx = np.zeros(NP, np.int32)
+        sel = np.zeros(NP, np.int64)          # payload page slot -> row
+        take = np.zeros(NP, bool)
+        for j in range(n_matched, n_imp):
+            idx[j] = pages[j]
+            sel[j] = j
+            take[j] = True
+        if not take.any():   # a full prefix-cache hit scatters nothing
+            return
+        pads = []
+        for arr in pools:
+            pad = np.zeros(arr.shape[:1] + (NP,) + arr.shape[2:],
+                           arr.dtype)
+            pad[:, take] = arr[:, sel[take]]
+            pads.append(pad)
+        fn = self._get_import_fn()
+        vals = [c._read() for c in self._caches]
+        self._audit_program(
+            "import", fn,
+            (jnp.asarray(idx), *vals,
+             *[jnp.asarray(p) for p in pads]),
+            donated=tuple(range(1, 1 + len(vals))))
+
+        def _import_call():
+            if any(getattr(v, "is_deleted", lambda: False)()
+                   for v in vals):
+                raise RuntimeError(
+                    "import dispatch failed after its KV buffers "
+                    "were donated; a mid-execution transient is "
+                    "unrecoverable at this layer — re-create the "
+                    "engine and re-submit the pending requests")
+            return fn(jnp.asarray(idx), *vals,
+                      *[jnp.asarray(p) for p in pads])
+
+        try:
+            new = self._dispatch("import", _import_call)
+        except Exception:
+            self._cache.release(pages)
+            raise
+        for t, v in zip(self._caches, new):
+            t._data = v
+            t._node = None
 
     def import_request(self, payload, max_new_tokens, request_id=None,
                        deadline_ms=None):
@@ -993,56 +1079,8 @@ class ContinuousBatchingEngine:
         alloc = [self._cache.acquire(key=str(rid))
                  for _ in range(n_alloc)]
         pages = matched + alloc
-        # scatter payload bytes into the FRESH page slots only
-        # (matched pages already hold them); page-id vector and
-        # payload pad to the table width so one program serves every
-        # import of this geometry
-        NP = self.np_per_seq
-        idx = np.zeros(NP, np.int32)
-        sel = np.zeros(NP, np.int64)          # payload page slot -> row
-        take = np.zeros(NP, bool)
-        for j in range(len(matched), n_imp):
-            idx[j] = pages[j]
-            sel[j] = j
-            take[j] = True
-        pads = []
-        for arr in payload["pools"]:
-            pad = np.zeros(arr.shape[:1] + (NP,) + arr.shape[2:],
-                           arr.dtype)
-            pad[:, take] = arr[:, sel[take]]
-            pads.append(pad)
-        if take.any():       # a full prefix-cache hit scatters nothing
-            fn = self._get_import_fn()
-            vals = [c._read() for c in self._caches]
-            self._audit_program(
-                "import", fn,
-                (jnp.asarray(idx), *vals,
-                 *[jnp.asarray(p) for p in pads]),
-                donated=tuple(range(1, 1 + len(vals))))
-
-            def _import_call():
-                if any(getattr(v, "is_deleted", lambda: False)()
-                       for v in vals):
-                    raise RuntimeError(
-                        "import dispatch failed after its KV buffers "
-                        "were donated; a mid-execution transient is "
-                        "unrecoverable at this layer — re-create the "
-                        "engine and re-submit the pending requests")
-                return fn(jnp.asarray(idx), *vals,
-                          *[jnp.asarray(p) for p in pads])
-
-            try:
-                new = self._dispatch("import", _import_call)
-            except Exception:
-                # no slot owns these pages yet, so the _release_slot
-                # funnel can never return them — put every acquired
-                # AND retained reference back before propagating, or
-                # each caller retry would leak n_alloc pages
-                self._cache.release(pages)
-                raise
-            for t, v in zip(self._caches, new):
-                t._data = v
-                t._node = None
+        self._scatter_payload(pages, len(matched), n_imp,
+                              payload["pools"])
         req = _Request(rid, prompt, int(max_new_tokens),
                        int(payload["eos"]),
                        (self._clock() + float(deadline_ms) / 1e3)
@@ -1071,6 +1109,273 @@ class ContinuousBatchingEngine:
             self._tl.token(rid)
         self._note_peak()
         return rid
+
+    # ------------------------------------------- live migration -------
+    # ISSUE 20: the full-request snapshot/restore/discard triple the
+    # fleet router's drain / scale-in / lame-duck paths ride.  Snapshot
+    # generalizes export to queued and mid-prefill requests (full
+    # scheduler state + a CRC over the KV bytes); restore validates and
+    # funnels through the import scatter; discard is the source's half
+    # of a completed migration — silent, no CompletedRequest, and it
+    # DEFERS to a racing cancel() (the sweep owns cancelled slots).
+
+    def _snapshot_state(self, req, phase):
+        rem = None
+        if req.deadline is not None:
+            rem = (req.deadline - self._clock()) * 1e3
+        return {
+            "kind": "snapshot",
+            "version": 1,
+            "phase": phase,
+            "rid": req.rid,
+            "prompt": np.asarray(req.prompt, np.int32),
+            "max_new_tokens": int(req.max_new_tokens),
+            "eos": int(req.eos_token_id),
+            "deadline_ms": rem,
+            "preemptions": int(req.preemptions),
+            "requested_counted": bool(req.requested_counted),
+            "page_size": self.page_size,
+            "kv_quant": self.kv_quant,
+        }
+
+    def snapshot_request(self, rid):
+        """Serialize a QUEUED or RESIDENT request for live migration
+        (ISSUE 20).  The payload extends :meth:`export_request` with
+        the full scheduler state — phase, tokens-so-far, deadline
+        REMAINING (absolute deadlines don't survive a clock change of
+        engine), preemption/demand bookkeeping — plus a CRC over the
+        KV pool bytes so :meth:`restore_request` rejects a torn
+        transfer.  Queued requests carry no pools; mid-prefill
+        residents carry the pages written so far (``prefill_off``
+        positions), so a planned preemption loses zero prefill work.
+        The request stays here untouched — the caller pairs a
+        successful restore with :meth:`discard_request`.  Raises
+        ``KeyError`` when ``rid`` is not in flight and ``ValueError``
+        for a slot migration must skip (cancelled: the sweep owns it;
+        done: it retires on the next step)."""
+        for r in self._queue:
+            if r.rid == rid:
+                p = self._snapshot_state(r, "queued")
+                p.update(done_toks=[int(t) for t in r.done_toks],
+                         len_written=0, n_pages=0, pools=[],
+                         crc=_payload_crc([]))
+                return p
+        for s in self._slots:
+            if s.req is not None and s.req.rid == rid:
+                break
+        else:
+            raise KeyError(f"request {rid!r} is not queued or resident")
+        if s.cancelled:
+            raise ValueError(
+                f"request {rid!r} is cancelled — the sweep finalizes "
+                "it on this engine; migration must skip it")
+        if s.phase == "decode" and s.done:
+            raise ValueError(
+                f"request {rid!r} is complete — it retires on the "
+                "next step; migration must skip it")
+        n = s.len_written
+        n_pages = -(-n // self.page_size)
+        pages = np.asarray(s.pages[:n_pages], np.int64)
+        pools = [np.asarray(c._read()[:, pages]) for c in self._caches]
+        p = self._snapshot_state(s.req, s.phase)
+        p.update(done_toks=[int(t) for t in s.out_toks],
+                 cur_tok=int(s.cur_tok), cur_pos=int(s.cur_pos),
+                 len_written=int(n), n_pages=int(n_pages),
+                 pools=pools, crc=_payload_crc(pools))
+        if s.phase == "prefill":
+            p["prefill_off"] = int(s.prefill_off)
+        return p
+
+    def restore_request(self, payload, max_new_tokens=None,
+                        request_id=None, deadline_ms=None):
+        """Install a migrated :meth:`snapshot_request` payload —
+        queued payloads re-enter admission (demand already counted on
+        the source rides the ``requeue`` contract), resident payloads
+        funnel through the import scatter and land a slot in the
+        SAME phase at the same position, so the continued stream is
+        bitwise the unmigrated one.  The payload CRC is validated
+        first: a torn transfer (``engine_snapshot_torn`` drill) raises
+        ``MigrationError`` (PDT-E025) before any page is allocated and
+        the source keeps the request.  Returns the request id, or
+        ``None`` when no slot / not enough pages are free right now
+        (retry after a step); raises ``ValueError`` for a payload
+        whose source cancelled it (the destination drops the
+        restore)."""
+        phase = payload.get("phase", "decode")
+        rid = payload["rid"] if request_id is None else request_id
+        if payload.get("cancelled"):
+            raise ValueError(
+                f"request {rid!r} was cancelled on the source — "
+                "dropping the restore (the source sweep finalizes it)")
+        pools = list(payload.get("pools") or [])
+        if pools and faults.check(SITE_SNAPSHOT_TORN, key=str(rid)):
+            # drill: the transfer tore mid-flight — flip one KV byte
+            # on a local copy so CRC validation catches it below
+            torn = np.array(pools[0], copy=True)
+            if torn.nbytes:
+                torn.view(np.uint8).reshape(-1)[0] ^= 0xFF
+            pools[0] = torn
+        crc = payload.get("crc")
+        if crc is not None and _payload_crc(pools) != int(crc):
+            raise MigrationError(
+                f"restore_request: snapshot payload for request "
+                f"{rid!r} failed CRC validation (torn transfer) — "
+                f"restore rejected, the source keeps the request "
+                f"[{MigrationError.error_code}]")
+        mnt = int(payload["max_new_tokens"]
+                  if max_new_tokens is None else max_new_tokens)
+        if deadline_ms is None:
+            deadline_ms = payload.get("deadline_ms")
+        if phase == "queued":
+            eos = int(payload["eos"])
+            out = self.add_request(
+                payload["prompt"], mnt, None if eos < 0 else eos,
+                request_id=rid, deadline_ms=deadline_ms,
+                requeue=bool(payload.get("requested_counted")))
+            req = self._queue[-1]
+            req.done_toks = [int(t) for t in payload.get("done_toks",
+                                                         [])]
+            req.preemptions = int(payload.get("preemptions", 0))
+            self._mig_stats["migrated_in"] += 1
+            self._tl.migrated(out, "in", phase="queued")
+            return out
+        if phase == "decode":
+            pl = dict(payload)
+            pl["pools"] = pools
+            out = self.import_request(pl, mnt, request_id=request_id,
+                                      deadline_ms=deadline_ms)
+            if out is None:
+                return None
+            for s in self._slots:
+                if s.req is not None and s.req.rid == out:
+                    s.req.preemptions = int(
+                        payload.get("preemptions", 0))
+                    s.req.requested_counted = bool(
+                        payload.get("requested_counted", True))
+                    break
+            self._mig_stats["migrated_in"] += 1
+            self._tl.migrated(out, "in",
+                              pages=int(payload.get("n_pages", 0)),
+                              phase="decode")
+            return out
+        # phase == "prefill": land a MID-PREFILL resident — the pages
+        # written so far ship warm; the destination's chunked prefill
+        # resumes at prefill_off exactly (arbitrary offsets are normal
+        # there: budget-limited chunks split mid-page already), so no
+        # prefill work is recomputed and the stream stays bitwise
+        if payload["page_size"] != self.page_size \
+                or payload["kv_quant"] != self.kv_quant \
+                or len(pools) != len(self._caches):
+            raise ValueError(
+                "restore_request: incompatible KV layout (page_size/"
+                "kv_quant/pool count must match the source engine)")
+        prompt = np.asarray(payload["prompt"], np.int32)
+        done = [int(t) for t in payload["done_toks"]]
+        off = int(payload["prefill_off"])
+        stop = prompt.size + mnt
+        if stop > self.max_seq_len:
+            raise ValueError(
+                f"request needs {stop} tokens > engine max_seq_len "
+                f"{self.max_seq_len}")
+        if isinstance(rid, int):
+            self._next_rid = max(self._next_rid, rid + 1)
+        in_flight = {r.rid for r in self._queue} | {
+            s.req.rid for s in self._slots if s.req is not None}
+        if rid in in_flight:
+            raise ValueError(f"request_id {rid!r} already in flight")
+        need_full = -(-stop // self.page_size)
+        if need_full > self.total_pages - 1:
+            self._stats["rejected"] += 1
+            raise PageBudgetError(
+                f"request needs {need_full} pages but the pool only "
+                f"has {self.total_pages - 1} "
+                f"[{PageBudgetError.error_code}]")
+        for b, s in enumerate(self._slots):
+            if s.req is None:
+                break
+        else:
+            return None                       # no free slot: retry
+        ps = self.page_size
+        n_imp = int(payload["n_pages"])
+        ids = (np.concatenate([prompt, np.asarray(done, np.int32)])
+               if done else prompt)
+        resume = int(ids.size)
+        target = max(resume, min(resume + 1, stop))
+        n_need = max(n_imp, max(1, -(-target // ps)))
+        matched = self._cache.match(ids[:off])[:n_imp]
+        self._cache.retain(matched)
+        n_alloc = n_need - len(matched)
+        if n_alloc > self._cache.available():
+            self._cache.release(matched)
+            return None                       # pool pressure: retry
+        alloc = [self._cache.acquire(key=str(rid))
+                 for _ in range(n_alloc)]
+        pages = matched + alloc
+        self._scatter_payload(pages, len(matched), n_imp, pools)
+        req = _Request(rid, prompt, mnt, int(payload["eos"]),
+                       (self._clock() + float(deadline_ms) / 1e3)
+                       if deadline_ms else None)
+        req.done_toks = done
+        req.preemptions = int(payload.get("preemptions", 0))
+        req.requested_counted = bool(
+            payload.get("requested_counted", True))
+        s.req = req
+        s.phase = "prefill"
+        s.pages = pages
+        s.prefill_ids = ids
+        s.prefill_off = off
+        s.out_toks = list(done)
+        s.stop_len = stop
+        s.eos = int(payload["eos"])
+        s.admit_seq = self._admit_counter
+        self._admit_counter += 1
+        self._bt[b, :] = 0
+        self._bt[b, :len(pages)] = pages
+        self._stats["admitted"] += 1
+        self._stats["pages_allocated"] += len(alloc)
+        if matched:
+            self._stats["cache_hits"] += 1
+            self._stats["cache_hit_tokens"] += len(matched) * ps
+        self._mig_stats["migrated_in"] += 1
+        self._tl.enqueued(rid, prompt.size, mnt)
+        self._tl.admitted(rid, b, cached_tokens=len(matched) * ps,
+                          resume_len=off)
+        self._tl.migrated(rid, "in", pages=n_imp, phase="prefill")
+        self._note_peak()
+        return rid
+
+    def discard_request(self, rid) -> bool:
+        """Silently relinquish a queued or resident request — the
+        SOURCE half of a completed live migration.  No
+        CompletedRequest is emitted (the request lives on at the
+        destination, whose retirement owns the finish reason); a
+        resident's fully-written pages are published to the prefix
+        cache first, then the slot funnels through
+        :meth:`_release_slot` as always.  Returns ``False`` without
+        touching anything when a racing :meth:`cancel` marked the
+        slot: the sweep finalizes it as "cancelled" HERE — the caller
+        must drop the destination's restore so exactly one side
+        honors the cancel.  Raises ``KeyError`` when ``rid`` is not
+        in flight."""
+        for i, r in enumerate(self._queue):
+            if r.rid == rid:
+                del self._queue[i]
+                self._mig_stats["migrated_out"] += 1
+                self._tl.migrated(rid, "out", phase="queued")
+                return True
+        for b, s in enumerate(self._slots):
+            if s.req is not None and s.req.rid == rid:
+                if s.cancelled:
+                    return False
+                phase = s.phase
+                n_pages = len(s.pages)
+                self._publish_slot(b)
+                self._release_slot(b)
+                self._mig_stats["migrated_out"] += 1
+                self._tl.migrated(rid, "out", pages=n_pages,
+                                  phase=phase)
+                return True
+        raise KeyError(f"request {rid!r} is not queued or resident")
 
     # ------------------------------------------------- scheduling -----
     def _release_slot(self, b):

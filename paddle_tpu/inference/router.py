@@ -53,6 +53,26 @@ survivable fleet:
   ``scalein_hold_s`` drains it back to standby.  If every live
   replica dies, a standby is admitted immediately (failover needs no
   SLO verdict).
+* LIVE MIGRATION & GRACEFUL DRAIN (ISSUE 20) — with ``migration`` on
+  (``serving_migration``), :meth:`FleetRouter.drain` and SLO scale-in
+  MIGRATE a replica's resident requests to the survivors instead of
+  waiting them out: the engine's ``snapshot_request`` (tokens so far,
+  decode position, remaining deadline, warm KV pages + CRC) ships
+  over ``KVPageTransport.ship_snapshot`` (bounded ``resilience.retry``)
+  and ``restore_request`` rebuilds the slot on the destination through
+  the PR13 import scatter.  Greedy decode is deterministic and
+  batch-invariant, so the migrated stream is token-for-token identical
+  to the unmigrated one, and a mid-prefill move keeps the finished
+  chunks — planned preemption loses zero prefill work.  A transfer
+  that fails past the retry budget falls back to the PR17 cold
+  requeue (front of the tenant queue, demand counted once) with
+  exactly one coded flight record (``MigrationError`` PDT-E025); a
+  torn (CRC-invalid) snapshot is rejected at restore and the source
+  keeps serving the request.  LAME-DUCK mode answers planned
+  preemption (``resilience.preempt``'s SIGTERM flag, polled once per
+  ``step()``) and degraded heartbeats (``lameduck_ms``): the replica
+  stops taking placements, its residents migrate warm, and the
+  emptied replica parks in standby before the eviction lands.
 
 Observability: the router owns a ``serving_router`` registry —
 always-on counters (the ``stats`` contract), ``serving.queue_ms`` /
@@ -70,8 +90,9 @@ from collections import deque
 
 import numpy as np
 
-from ..core.errors import (EngineStallError, PageBudgetError,
-                           QueueFullError, ReplicaLostError)
+from ..core.errors import (EngineStallError, MigrationError,
+                           PageBudgetError, QueueFullError,
+                           ReplicaLostError)
 from ..core.tensor import Tensor
 from ..observability import Registry as _ObsRegistry
 from ..observability import events as _events
@@ -83,11 +104,13 @@ from ..observability import watchdog as _watchdog
 from ..observability.metrics import LATENCY_BUCKETS_MS
 from ..observability.serving import RegistryCounters
 from ..resilience import faults
+from ..resilience import preempt as _preempt
 from ..resilience.retry import retry_call
 from ..resilience.serving import (SITE_ROUTER_DISPATCH_TRANSIENT,
                                   SITE_ROUTER_REPLICA_LOST,
                                   SITE_ROUTER_SCALEOUT_STALL,
                                   simulated_stall)
+from .distserve import KVPageTransport
 from .engine import CompletedRequest, ContinuousBatchingEngine
 
 __all__ = ["FleetRouter", "TenantSpec", "RpcReplica",
@@ -165,6 +188,18 @@ class RpcReplica:
     def cached_prefix_tokens(self, ids) -> int:
         return int(self._call("cached_prefix_tokens",
                               np.asarray(ids, np.int32)))
+
+    def snapshot_request(self, rid):
+        return self._call("snapshot_request", rid)
+
+    def restore_request(self, payload, max_new_tokens=None,
+                        request_id=None, deadline_ms=None):
+        return self._call("restore_request", payload,
+                          max_new_tokens=max_new_tokens,
+                          request_id=request_id, deadline_ms=deadline_ms)
+
+    def discard_request(self, rid) -> bool:
+        return bool(self._call("discard_request", rid))
 
     def pending_requests(self):
         return self._call("pending_requests")
@@ -279,7 +314,8 @@ class _Replica:
         self.limits = limits
 
 
-_STATE_CODE = {"standby": 0, "live": 1, "draining": 2, "dead": 3}
+_STATE_CODE = {"standby": 0, "live": 1, "draining": 2, "dead": 3,
+               "lameduck": 4}
 
 
 class FleetRouter:
@@ -304,7 +340,8 @@ class FleetRouter:
                  dispatch_retries=None, scaleout_timeout_ms=None,
                  scalein_hold_s=None, watchdog_ms=None,
                  max_queue=None, queue_policy=None,
-                 default_deadline_ms=None, clock=None):
+                 default_deadline_ms=None, migration=None,
+                 lameduck_ms=None, migration_retries=None, clock=None):
         from ..core import state as _state
         self._clock = time.monotonic if clock is None else clock
         self.affinity = bool(_state.get_flag("serving_fleet_affinity")
@@ -330,6 +367,14 @@ class FleetRouter:
         self.default_deadline_ms = float(
             _state.get_flag("serving_deadline_ms")
             if default_deadline_ms is None else default_deadline_ms)
+        self.migration = bool(_state.get_flag("serving_migration")
+                              if migration is None else migration)
+        self.lameduck_ms = float(_state.get_flag("serving_lameduck_ms")
+                                 if lameduck_ms is None else lameduck_ms)
+        self.migration_retries = int(
+            _state.get_flag("serving_migration_retries")
+            if migration_retries is None else migration_retries)
+        self._transport = KVPageTransport(retries=self.migration_retries)
 
         # ------------------------------------------------- replicas --
         if replicas is None:
@@ -379,7 +424,9 @@ class FleetRouter:
         self._c = RegistryCounters(self._registry, (
             "admitted", "placed", "finished", "rejected", "timeouts",
             "requeues", "retries", "deaths", "scaleouts", "scaleins",
-            "scaleout_failures", "affinity_hits", "affinity_spills"),
+            "scaleout_failures", "affinity_hits", "affinity_spills",
+            "migrations", "migrated_pages", "migration_retries",
+            "migration_failures", "lameducks"),
             prefix="router")
         self._h_queue = self._registry.histogram(
             "serving.queue_ms", "router-queue wait: admission -> "
@@ -433,6 +480,7 @@ class FleetRouter:
         self._next_scaleout_t = float("-inf")
         self._scaleout_cooldown_s = 1.0
         self._tick = 0
+        self._preempt_seen = False
 
     # ------------------------------------------------------ tenants --
     def _add_tenant(self, spec):
@@ -552,12 +600,16 @@ class FleetRouter:
         the completions that surfaced this tick."""
         now = self._clock()
         self._tick += 1
+        if (self.migration and not self._preempt_seen
+                and _preempt.requested()):
+            self._preempt_seen = True
+            self._on_preempt()
         out = list(self._finalized)
         self._finalized.clear()
         self._check_replicas(now)
         out.extend(self._place(now))
         for rep in list(self._replicas):
-            if rep.state not in ("live", "draining"):
+            if rep.state not in ("live", "draining", "lameduck"):
                 continue
             token = _watchdog.arm("router.step", self.watchdog_ms,
                                   key=rep.name,
@@ -603,15 +655,16 @@ class FleetRouter:
     @property
     def has_work(self):
         return (any(self._tq.values()) or bool(self._finalized)
-                or any(rep.rids or (rep.state in ("live", "draining")
-                                    and rep.engine.has_work)
+                or any(rep.rids
+                       or (rep.state in ("live", "draining", "lameduck")
+                           and rep.engine.has_work)
                        for rep in self._replicas
                        if rep.state != "dead"))
 
     # ---------------------------------------------- failure handling --
     def _check_replicas(self, now):
         for rep in list(self._replicas):
-            if rep.state not in ("live", "draining"):
+            if rep.state not in ("live", "draining", "lameduck"):
                 continue
             if faults.check(SITE_ROUTER_REPLICA_LOST, key=rep.name):
                 self._kill(rep, "fault_drill")
@@ -619,6 +672,13 @@ class FleetRouter:
                   and (now - rep.last_beat) * 1e3
                   > self.heartbeat_timeout_ms):
                 self._kill(rep, "heartbeat_timeout")
+            elif (rep.state == "live" and self.migration
+                  and self.lameduck_ms
+                  and (now - rep.last_beat) * 1e3 > self.lameduck_ms
+                  and len(self._live()) > 1):
+                # degraded but not yet dead: stop feeding it and move
+                # its residents out warm before the heartbeat verdict
+                self._lameduck(rep, "degraded_heartbeat")
 
     def _kill(self, rep, reason, error=None, flight=True):
         """Declare ``rep`` dead: generation bump, ONE coded flight
@@ -656,6 +716,200 @@ class FleetRouter:
         _events.emit("router.replica_dead", replica=rep.name,
                      reason=reason, requeued=len(affected),
                      generation=self._gen)
+
+    # ------------------------------------------------ live migration --
+    def drain(self, name) -> bool:
+        """Gracefully drain replica ``name``: placements stop NOW and,
+        with ``migration`` on, resident requests migrate warm to the
+        surviving live replicas instead of running to completion —
+        scale-in latency becomes a transfer, not a tail decode.  With
+        migration off (or no survivor) residents finish in place.  The
+        emptied replica returns to ``standby`` (cache intact).
+        Returns False for unknown / already-draining / dead replicas."""
+        rep = self._by_name(str(name))
+        if rep is None or rep.state not in ("live", "lameduck"):
+            return False
+        rep.state = "draining"
+        self._gen += 1
+        rep.gen = self._gen
+        _events.emit("router.draining", replica=rep.name,
+                     reason="drain", generation=self._gen)
+        if self.migration:
+            self._migrate_replica(rep)
+        return True
+
+    def _lameduck(self, rep, reason):
+        """Planned-preemption / degraded-replica disposition: stop new
+        placements, migrate residents warm, keep stepping what stays
+        (a failed migration leaves the request serving on the duck)."""
+        if rep.state != "live":
+            return
+        rep.state = "lameduck"
+        self._gen += 1
+        rep.gen = self._gen
+        self._c["lameducks"] += 1
+        _events.emit("router.lameduck", replica=rep.name,
+                     reason=reason, generation=self._gen)
+        if self.migration:
+            self._migrate_replica(rep)
+
+    def _on_preempt(self):
+        """The eviction notice arrived (``resilience.preempt``): lame-
+        duck the elastically scaled-out replicas first, else the last
+        live one — never the last replica standing, which must keep
+        serving until the process actually dies."""
+        live = self._live()
+        victims = [r for r in live if r.scaled_out]
+        if not victims and len(live) > 1:
+            victims = [live[-1]]
+        for rep in victims:
+            if len(self._live()) <= 1:
+                break
+            self._lameduck(rep, "preempt")
+
+    def _migrate_replica(self, rep):
+        for rid in list(rep.rids):
+            self._migrate_one(rep, rid)
+            if rep.state == "dead":
+                break
+
+    def _pick_migration_dst(self, rep, rs):
+        cands = [r for r in self._live()
+                 if r is not rep
+                 and self._fits_limits(r.limits, rs.prompt.size,
+                                       rs.max_new_tokens)]
+        if not cands:
+            return None
+        if self.affinity:
+            hits = {}
+            for r in cands:
+                try:
+                    hits[r.name] = int(
+                        r.engine.cached_prefix_tokens(rs.prompt))
+                except (ConnectionError, AttributeError):
+                    hits[r.name] = 0
+            best = max(cands, key=lambda r: (hits[r.name],
+                                             -len(r.rids), -r.index))
+            if hits[best.name] > 0:
+                return best
+        return min(cands, key=lambda r: (len(r.rids), r.index))
+
+    def _migrate_one(self, rep, rid) -> bool:
+        """Move one resident request off ``rep``: snapshot -> ship
+        (bounded retry) -> restore on the destination -> discard at
+        the source.  Failure dispositions: a torn snapshot (CRC
+        mismatch, ``MigrationError`` unretried) leaves the request
+        serving at the source; an exhausted transfer budget falls back
+        to the PR17 cold requeue with exactly ONE coded flight record;
+        a raced ``cancel`` keeps the request at the source so its
+        sweep emits the single ``cancelled`` completion and the
+        destination restore is dropped."""
+        rs = self._reqs.get(rid)
+        if rs is None or rs.state != "placed":
+            return False
+        try:
+            payload = rep.engine.snapshot_request(rid)
+        except ConnectionError as e:
+            self._kill(rep, "snapshot", error=e)
+            return False
+        except (KeyError, ValueError, AttributeError):
+            # finished/cancelling under us, or a replica kind without
+            # the snapshot surface (DisaggServer): it finishes in place
+            return False
+        dst = self._pick_migration_dst(rep, rs)
+        if dst is None:
+            return False           # no survivor fits: retry next tick
+
+        def on_retry(_exc, _attempt):
+            self._c["migration_retries"] += 1
+
+        try:
+            with _tracing.span("router.migrate", rid=str(rid),
+                               src=rep.name, dst=dst.name):
+                got, nbytes = self._transport.ship_snapshot(
+                    payload, dst.engine, on_retry=on_retry)
+        except MigrationError as e:
+            # torn snapshot: rejected AT RESTORE — nothing landed on
+            # the destination and the source never stopped serving
+            self._c["migration_failures"] += 1
+            _flight.dump("router_migration_torn", error=e, extra={
+                "rid": str(rid), "src": rep.name, "dst": dst.name,
+                "fallback": "source_keeps"})
+            _events.emit("router.migration_torn", rid=rid,
+                         src=rep.name, dst=dst.name)
+            return False
+        except ConnectionError as e:
+            # transfer budget exhausted: cold requeue (PR17) — a
+            # from-scratch re-prefill that greedy determinism keeps
+            # bitwise; prefill work is lost, the request is not
+            self._c["migration_failures"] += 1
+            err = MigrationError(
+                f"migrating request {rid!r} from {rep.name!r} to "
+                f"{dst.name!r} failed past the retry budget "
+                f"({self.migration_retries}): {e}; falling back to "
+                f"cold requeue [{MigrationError.error_code}]")
+            _flight.dump("router_migration_failed", error=err, extra={
+                "rid": str(rid), "src": rep.name, "dst": dst.name,
+                "retries": self.migration_retries,
+                "fallback": "cold_requeue"})
+            _events.emit("router.migration_failed", rid=rid,
+                         src=rep.name, dst=dst.name)
+            self._cold_requeue(rep, rs)
+            return False
+        if got is None:
+            return False     # destination full this tick: retry later
+        rep.rids.pop(rid, None)
+        try:
+            kept = rep.engine.discard_request(rid)
+        except ConnectionError as e:
+            # source died right after the copy landed; the migrated
+            # copy is authoritative, _kill requeues only the rest
+            self._kill(rep, "migration_discard", error=e)
+            kept = True
+        except KeyError:
+            kept = True
+        if kept is False:
+            # cancel raced the migration: the SOURCE sweep owns the
+            # single "cancelled" completion; drop the restored copy
+            try:
+                dst.engine.discard_request(rid)
+            except (KeyError, ConnectionError):
+                pass
+            if rep.state != "dead":
+                rep.rids[rid] = True
+            return False
+        dst.rids[rid] = True
+        rs.replica = dst.name
+        pages = int(payload.get("n_pages", 0) or 0)
+        self._c["migrations"] += 1
+        self._c["migrated_pages"] += pages
+        _events.emit("router.migrated", rid=rid, src=rep.name,
+                     dst=dst.name, phase=str(payload.get("phase")),
+                     pages=pages, bytes=int(nbytes))
+        return True
+
+    def _cold_requeue(self, rep, rs):
+        """Migration fallback: release the source copy and put the
+        request back at the front of its tenant queue for a cold
+        re-prefill (``requeue=True`` on the next placement keeps the
+        demand counted once)."""
+        try:
+            kept = rep.engine.discard_request(rs.rid)
+        except ConnectionError as e:
+            self._kill(rep, "migration", error=e)   # requeues it too
+            return
+        except KeyError:
+            kept = True
+        if kept is False:
+            return       # cancel raced: the source sweep finalizes it
+        rep.rids.pop(rs.rid, None)
+        rs.state = "pending"
+        rs.replica = None
+        rs.requeues += 1
+        self._tq[rs.tenant].appendleft(rs)
+        self._c["requeues"] += 1
+        _events.emit("router.requeued", rid=rs.rid, replica=rep.name,
+                     reason="migration_failed")
 
     # ----------------------------------------------------- placement --
     def _remaining_ms(self, rs):
@@ -856,11 +1110,16 @@ class FleetRouter:
         elif (self._last_breach_t is not None
               and now - self._last_breach_t >= self.scalein_hold_s):
             self._scale_in(now)
-        # drain completion: a draining replica with no work returns
-        # to standby (cache intact — a re-admission is part-warm)
+        # drain completion: a draining/lame-duck replica with no work
+        # returns to standby (cache intact — a re-admission is
+        # part-warm); stragglers (a full destination, a skipped
+        # cancel) get another migration attempt each tick first
         for rep in self._replicas:
-            if (rep.state == "draining" and not rep.rids
-                    and not rep.engine.has_work):
+            if rep.state not in ("draining", "lameduck"):
+                continue
+            if rep.rids and self.migration:
+                self._migrate_replica(rep)
+            if not rep.rids and not rep.engine.has_work:
                 rep.state = "standby"
                 rep.scaled_out = False
                 self._gen += 1
@@ -918,6 +1177,10 @@ class FleetRouter:
             return
         rep.state = "draining"
         _events.emit("router.draining", replica=rep.name)
+        if self.migration:
+            # scale-in without waiting out the tail: move the
+            # residents warm and the replica parks next tick
+            self._migrate_replica(rep)
 
     # ------------------------------------------------ observability --
     def _reg_replica_gauges(self, rep):
@@ -927,7 +1190,7 @@ class FleetRouter:
         g.set_function(lambda rep=rep: len(rep.rids))
         g = self._registry.gauge(
             "router.replica_state",
-            "0=standby 1=live 2=draining 3=dead",
+            "0=standby 1=live 2=draining 3=dead 4=lameduck",
             labels={"replica": rep.name})
         g.set_function(lambda rep=rep: _STATE_CODE[rep.state])
         g = self._registry.gauge(
@@ -956,6 +1219,8 @@ class FleetRouter:
             1 for r in self._replicas if r.state == "draining")
         d["replicas_dead"] = sum(
             1 for r in self._replicas if r.state == "dead")
+        d["replicas_lameduck"] = sum(
+            1 for r in self._replicas if r.state == "lameduck")
         d["generation"] = self._gen
         d["tenants"] = {
             name: {"queued": len(self._tq[name]),

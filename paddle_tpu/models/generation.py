@@ -460,6 +460,26 @@ def _zero_pool(shape, count, dtype="float32"):
                  for _ in range(count))
 
 
+def make_import_scatter(n_pools, out_shardings=None):
+    """The KV-page import scatter program (PR13 handoff, reused by
+    ISSUE 20 live-migration restore): ONE donated jit per pool
+    geometry that writes a payload's page rows into the pool pages
+    named by ``idx``.  The page-id vector is traced DATA (padded to
+    the block-table width by the caller), so every import/restore of
+    a geometry rides the same compiled program; donation keeps the
+    update in-place in HBM.  ``out_shardings`` pins the TP kv-head
+    sharding when the pools live on a mesh."""
+    def imp(idx, *args):
+        pools, payload = args[:n_pools], args[n_pools:]
+        return tuple(p.at[:, idx].set(pl.astype(p.dtype))
+                     for p, pl in zip(pools, payload))
+
+    kw = {} if out_shardings is None else {
+        "out_shardings": tuple(out_shardings)}
+    return jax.jit(imp, donate_argnums=tuple(range(1, 1 + n_pools)),
+                   **kw)
+
+
 def _split_caches(caches, n_layers):
     """Serving cache-list layout: ``[k0, v0, ..., kL-1, vL-1]`` for fp
     pools, with the int8 path APPENDING the per-page scale side-pools

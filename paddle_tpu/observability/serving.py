@@ -208,6 +208,20 @@ class ServingTimelines:
         _events.emit("serving.preempted", rid=rid,
                      tokens_done=int(tokens_done))
 
+    def migrated(self, rid, direction, pages=0, phase=""):
+        """A live migration moved ``rid`` across engines (ISSUE 20).
+        ``direction`` is ``"out"`` — this engine silently relinquished
+        the request (no finish reason: its open timeline closes here
+        and the DESTINATION's timeline carries the request to
+        retirement) — or ``"in"`` (restored here)."""
+        if direction == "out":
+            self._open.pop(rid, None)
+        if not enabled():
+            return
+        _events.emit("serving.migrated", rid=rid,
+                     direction=str(direction), pages=int(pages),
+                     phase=str(phase))
+
     def retired(self, rid, reason, n_tokens, preemptions=0):
         if not enabled():
             self._open.pop(rid, None)

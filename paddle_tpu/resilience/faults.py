@@ -79,6 +79,17 @@ sleeps or randomness:
   it surfaces ``EngineStallError`` (PDT-E020) + a flight record and
   the fleet degrades gracefully (standby stays parked, live replicas
   keep serving). Key = the standby replica name.
+* ``router_migration_transient`` — one live-migration snapshot
+  transfer (``KVPageTransport.ship_snapshot``, ISSUE 20) raises
+  ``InjectedConnectionError``; absorbed by the bounded retry every
+  transfer runs under (``serving_migration_retries``); past the
+  budget the router writes ONE ``MigrationError`` (PDT-E025) flight
+  record and falls back to the PR17 cold requeue (bitwise, demand
+  counted once). Key = the request id.
+* ``engine_snapshot_torn`` — one migration payload arrives torn (a
+  KV byte flipped in flight): ``restore_request`` rejects it on CRC
+  validation (``MigrationError`` PDT-E025) and the SOURCE keeps the
+  request resident, decoding on bitwise. Key = the request id.
 * ``rank_dead``          — an elastic-training rank
   (``resilience/elastic_train.py`` ``FleetSupervisor``) dies at a
   step boundary: heartbeats stop, its collective contribution never

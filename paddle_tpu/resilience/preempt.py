@@ -13,13 +13,22 @@ the ordinary way.
 has a ``save_dir`` to checkpoint into; custom loops call it directly.
 The synthetic ``preempt`` fault (``resilience.faults``) goes through
 ``signal.raise_signal``, i.e. through this exact path.
+
+The SERVING consumer (ISSUE 20): ``inference.router.FleetRouter``
+polls ``requested()`` once per ``step()`` when live migration is on
+(``serving_migration``) and answers a planned preemption by putting
+its elastically scaled-out replicas (else the last live one, never
+the last replica standing) into LAME-DUCK: placements stop and
+resident requests migrate warm to the survivors — the eviction notice
+loses zero prefill work. The same handler serves both stacks: one
+flag, training checkpoints, serving drains.
 """
 from __future__ import annotations
 
 import signal
 import threading
 
-__all__ = ["install", "uninstall", "requested", "clear",
+__all__ = ["install", "uninstall", "requested", "last_signal", "clear",
            "DEFAULT_SIGNALS"]
 
 DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)

@@ -80,6 +80,22 @@ Serving fault sites (``resilience.faults`` spec grammar):
   ``EngineStallError`` (PDT-E020) with a flight record and the fleet
   DEGRADES GRACEFULLY — the standby stays parked and the live
   replicas keep serving. Key = the standby replica name.
+* ``router_migration_transient`` — one live-migration snapshot
+  transfer (``inference.distserve.KVPageTransport.ship_snapshot``,
+  ISSUE 20) raises ``InjectedConnectionError``; absorbed by the
+  bounded ``resilience.retry`` every transfer runs under
+  (``serving_migration_retries``), only ``migration_retries`` moves.
+  Exhausting the budget writes exactly one ``MigrationError``
+  (PDT-E025) flight record and falls back to the PR17 COLD requeue:
+  the source discards the resident silently, the request re-prefills
+  front-of-line on a survivor — outputs stay bitwise (greedy decode
+  is deterministic), demand is counted once. Key = the request id.
+* ``engine_snapshot_torn`` — one migration payload arrives TORN at
+  the destination (a byte of its KV pool bytes flipped in flight):
+  ``restore_request`` rejects it on CRC validation with
+  ``MigrationError`` (PDT-E025) and the SOURCE keeps the request —
+  it stays resident and keeps decoding there, bitwise; only
+  ``migration_failures`` moves. Key = the request id.
 """
 from __future__ import annotations
 
@@ -96,6 +112,7 @@ __all__ = [
     "SITE_HANDOFF_TRANSIENT", "SITE_DECODE_WORKER_LOST",
     "SITE_STALL", "SITE_ROUTER_REPLICA_LOST",
     "SITE_ROUTER_DISPATCH_TRANSIENT", "SITE_ROUTER_SCALEOUT_STALL",
+    "SITE_MIGRATION_TRANSIENT", "SITE_SNAPSHOT_TORN",
 ]
 
 #: Every value ``CompletedRequest.finish_reason`` can take.
@@ -113,6 +130,8 @@ SITE_STALL = "engine_stall"
 SITE_ROUTER_REPLICA_LOST = "router_replica_lost"
 SITE_ROUTER_DISPATCH_TRANSIENT = "router_dispatch_transient"
 SITE_ROUTER_SCALEOUT_STALL = "router_scaleout_stall"
+SITE_MIGRATION_TRANSIENT = "router_migration_transient"
+SITE_SNAPSHOT_TORN = "engine_snapshot_torn"
 
 
 def simulated_stall(key: str, max_s: float = 30.0, site: str = SITE_STALL):

@@ -98,6 +98,11 @@ _TIER1_ORDER = [
     # reuses the session serving_gpt + the same geometry, so every
     # replica engine rides the already-compiled serving programs
     "test_router.py",
+    # test_migration is the ISSUE-20 acceptance suite (live request
+    # migration & graceful drain); it reuses the session serving_gpt +
+    # the serving-suite geometry, so every engine on both sides of a
+    # move rides the already-compiled serving programs
+    "test_migration.py",
     # <- unlisted files slot in here (rank _TIER1_DEFAULT)
     # medium density; the budget cutoff lands somewhere below
     "test_fft_signal_distribution.py", "test_op_tail.py",

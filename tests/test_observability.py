@@ -225,6 +225,8 @@ _STAT_KEYS = [
     # speculative decoding (ISSUE 9) — strictly APPENDED so every
     # pre-existing key keeps its position
     "spec_proposed", "spec_accepted", "spec_accept_rate",
+    # live migration (ISSUE 20) — strictly APPENDED, same contract
+    "migrated_in", "migrated_out",
 ]
 
 
